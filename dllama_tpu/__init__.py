@@ -1,0 +1,23 @@
+"""dllama_tpu — a TPU-native distributed LLM inference framework.
+
+A brand-new implementation of the capabilities of distributed-llama
+(tensor-parallel Llama 2/3/3.x + Qwen3 inference with Q40 weights and quantized
+activation exchange, CLI + OpenAI-compatible API), designed idiomatically for
+TPU: JAX/XLA for the compute graph, `jax.sharding` meshes + XLA collectives for
+the distribution layer, and Pallas kernels for the quantized hot ops.
+
+Layer map (mirrors SURVEY.md §1 of the reference, re-architected for TPU):
+
+    serve/     CLI (inference/chat/perplexity) + OpenAI-compatible HTTP API
+    runtime/   InferenceEngine: jitted prefill/decode steps, KV cache, weights
+    models/    functional transformer graphs (Llama, Qwen3), rope caches
+    parallel/  mesh construction + shardings (TP/SP/DP) — replaces the
+               reference's TCP mesh & sync steps with XLA collectives
+    ops/       Pallas/XLA kernels: quantized matmul, attention, rmsnorm, sampling
+    formats/   on-disk formats: .m model files, .t tokenizer files, Q40/Q80 codecs
+    tokenizer/ BPE encode / streaming decode, sampler, chat templates, EOS
+    convert/   HF safetensors → .m, HF/sentencepiece tokenizer → .t
+    native/    C++ runtime components (weight repacker, tokenizer core)
+"""
+
+__version__ = "0.1.0"

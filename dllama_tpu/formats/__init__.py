@@ -1,0 +1,19 @@
+"""On-disk formats: Q40/Q80 block codecs, .m model files, .t tokenizer files."""
+
+from .quants import (  # noqa: F401
+    F32,
+    F16,
+    Q40,
+    Q80,
+    Q40_BLOCK_SIZE,
+    Q80_BLOCK_SIZE,
+    quantize_q40,
+    dequantize_q40,
+    quantize_q80,
+    dequantize_q80,
+    q40_bytes,
+    q80_bytes,
+    tensor_bytes,
+)
+from .mfile import ModelHeader, ModelFile, ArchType, RopeType, HiddenAct  # noqa: F401
+from .tfile import TokenizerData, read_tfile, write_tfile  # noqa: F401

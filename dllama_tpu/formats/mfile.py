@@ -1,0 +1,349 @@
+""".m model file format — header parse and tensor walker.
+
+Wire-compatible with the reference format (reference: src/llm.cpp:34-145 for the
+header parse, src/llm.cpp:499-539 for the tensor order, converter/writer.py:109-147
+for the writer):
+
+    int32 magic = 0xA00ABCD
+    int32 headerSize            # total header bytes INCLUDING magic + this field
+    (int32 key, int32 value) *  # (headerSize - 8) / 8 pairs
+    tensor data ...             # starts at offset headerSize
+
+Tensor order (llm.cpp:499-539): embedding (F32), then per layer
+q, k, v, wo, w1(gate), w2(down), w3(up) in the weight float type, Qwen3's
+per-head q/k norms (F32), block norms 0/1 (F32); finally final_norm (F32) and
+the logits matmul (weight float type).
+
+This module is pure numpy/host-side — device placement and the TPU repack live
+in :mod:`dllama_tpu.runtime.weights`.
+"""
+
+from __future__ import annotations
+
+import enum
+import mmap
+import struct
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import numpy as np
+
+from .quants import F32, Q40, tensor_bytes, dequantize_q40, unpack_q40
+
+MODEL_MAGIC = 0xA00ABCD
+
+
+class HeaderKey(enum.IntEnum):
+    """Header key ids (reference: src/llm.hpp:8-30)."""
+
+    VERSION = 0
+    ARCH_TYPE = 1
+    DIM = 2
+    HIDDEN_DIM = 3
+    N_LAYERS = 4
+    N_HEADS = 5
+    N_KV_HEADS = 6
+    N_EXPERTS = 7
+    N_ACTIVE_EXPERTS = 8
+    VOCAB_SIZE = 9
+    SEQ_LEN = 10
+    HIDDEN_ACT = 11
+    ROPE_THETA = 12
+    WEIGHT_FLOAT_TYPE = 13
+    ROPE_SCALING_FACTOR = 14
+    ROPE_SCALING_LOW_FREQ_FACTOR = 15
+    ROPE_SCALING_HIGH_FREQ_FACTORY = 16
+    ROPE_SCALING_ORIG_MAX_SEQ_LEN = 17
+    ROPE_TYPE = 18
+    HEAD_DIM = 19
+    NORM_EPSILON = 20
+
+
+class ArchType(enum.IntEnum):
+    """Architecture ids (reference: src/llm.hpp:37-40)."""
+
+    LLAMA = 0xABCD00
+    QWEN3 = 0xABCD01
+
+
+class RopeType(enum.IntEnum):
+    """RoPE style ids (reference: src/nn/nn-core.hpp rope types)."""
+
+    LLAMA = 0
+    FALCON = 1
+    LLAMA3_1 = 2
+
+
+class HiddenAct(enum.IntEnum):
+    GELU = 0
+    SILU = 1
+
+
+@dataclass
+class ModelHeader:
+    """Parsed .m header — the LlmHeader equivalent (reference: src/llm.hpp:42-71)."""
+
+    version: int = 0
+    arch_type: ArchType = ArchType.LLAMA
+    dim: int = 0
+    hidden_dim: int = 0
+    n_layers: int = 0
+    n_heads: int = 0
+    n_kv_heads: int = 0
+    n_experts: int = 0
+    n_active_experts: int = 0
+    vocab_size: int = 0
+    orig_seq_len: int = 0
+    seq_len: int = 0
+    hidden_act: HiddenAct = HiddenAct.SILU
+    rope_theta: float = 10000.0
+    rope_type: RopeType = RopeType.LLAMA
+    rope_scaling_factor: float = 1.0
+    rope_scaling_low_freq_factor: float = 0.0
+    rope_scaling_high_freq_factor: float = 0.0
+    rope_scaling_orig_max_seq_len: int = 0
+    norm_epsilon: float = 1e-5
+    head_dim: int = 0
+    weight_type: int = -1
+    sync_type: int = F32
+    header_size: int = 0
+    file_size: int = 0
+
+    @property
+    def q_dim(self) -> int:
+        return self.head_dim * self.n_heads
+
+    @property
+    def kv_dim(self) -> int:
+        return self.head_dim * self.n_kv_heads
+
+
+def _norm_epsilon_from_int(value: int) -> float:
+    # The header stores the epsilon exponent (reference: llm.cpp:61-65).
+    if value == 5:
+        return 1e-5
+    if value == 6:
+        return 1e-6
+    raise ValueError(f"unsupported norm epsilon code {value}")
+
+
+def norm_epsilon_to_int(eps: float) -> int:
+    if abs(eps - 1e-5) < 1e-9:
+        return 5
+    if abs(eps - 1e-6) < 1e-10:
+        return 6
+    raise ValueError(f"unsupported norm epsilon {eps}")
+
+
+def parse_header(raw: bytes, path_size: int, max_seq_len: int = 0,
+                 sync_type: int = F32) -> ModelHeader:
+    """Parse the .m header bytes (reference: llm.cpp:67-145)."""
+    magic, header_size = struct.unpack_from("<ii", raw, 0)
+    if magic in (0xABCD00, 0xABCD01):
+        raise ValueError("old model format is not supported")
+    if magic != MODEL_MAGIC:
+        raise ValueError(f"unsupported magic number {magic:#x}")
+    n_kv = (header_size - 8) // 8
+    h = ModelHeader()
+    for i in range(n_kv):
+        key, value = struct.unpack_from("<ii", raw, 8 + i * 8)
+        if key == HeaderKey.VERSION:
+            h.version = value
+        elif key == HeaderKey.ARCH_TYPE:
+            h.arch_type = ArchType(value)
+        elif key == HeaderKey.DIM:
+            h.dim = value
+        elif key == HeaderKey.HIDDEN_DIM:
+            h.hidden_dim = value
+        elif key == HeaderKey.N_LAYERS:
+            h.n_layers = value
+        elif key == HeaderKey.N_HEADS:
+            h.n_heads = value
+        elif key == HeaderKey.N_KV_HEADS:
+            h.n_kv_heads = value
+        elif key == HeaderKey.N_EXPERTS:
+            h.n_experts = value
+        elif key == HeaderKey.N_ACTIVE_EXPERTS:
+            h.n_active_experts = value
+        elif key == HeaderKey.VOCAB_SIZE:
+            h.vocab_size = value
+        elif key == HeaderKey.SEQ_LEN:
+            h.seq_len = value
+        elif key == HeaderKey.HIDDEN_ACT:
+            h.hidden_act = HiddenAct(value)
+        elif key == HeaderKey.ROPE_THETA:
+            h.rope_theta = float(value)
+        elif key == HeaderKey.WEIGHT_FLOAT_TYPE:
+            h.weight_type = value
+        elif key == HeaderKey.ROPE_SCALING_FACTOR:
+            h.rope_scaling_factor = float(value)
+        elif key == HeaderKey.ROPE_SCALING_LOW_FREQ_FACTOR:
+            h.rope_scaling_low_freq_factor = float(value)
+        elif key == HeaderKey.ROPE_SCALING_HIGH_FREQ_FACTORY:
+            h.rope_scaling_high_freq_factor = float(value)
+        elif key == HeaderKey.ROPE_SCALING_ORIG_MAX_SEQ_LEN:
+            h.rope_scaling_orig_max_seq_len = value
+        elif key == HeaderKey.ROPE_TYPE:
+            h.rope_type = RopeType(value)
+        elif key == HeaderKey.HEAD_DIM:
+            h.head_dim = value
+        elif key == HeaderKey.NORM_EPSILON:
+            h.norm_epsilon = _norm_epsilon_from_int(value)
+        else:
+            raise ValueError(f"unsupported header key {key}")
+
+    if h.weight_type == -1:
+        raise ValueError("model does not specify weight type")
+
+    h.orig_seq_len = h.seq_len
+    if max_seq_len > 0 and h.seq_len > max_seq_len:
+        h.seq_len = max_seq_len
+    if h.head_dim == 0:
+        h.head_dim = h.dim // h.n_heads
+    h.sync_type = sync_type
+    h.header_size = header_size
+    h.file_size = path_size
+    if h.arch_type == ArchType.QWEN3:
+        h.rope_type = RopeType.FALCON
+    return h
+
+
+@dataclass
+class TensorRecord:
+    """One tensor's location inside the .m file."""
+
+    name: str
+    layer: int
+    shape: tuple[int, ...]  # logical (rows, cols); rows = output dim
+    float_type: int
+    offset: int
+    n_bytes: int
+
+
+@dataclass
+class ModelFile:
+    """Memory-mapped .m file with a resolved tensor directory.
+
+    The tensor walk reproduces loadLlmNetWeight (reference: llm.cpp:499-539) but
+    produces a flat name→record directory instead of streaming slices to
+    workers: on TPU, sharding happens at `jax.device_put` time from this single
+    host-side map (SURVEY.md §7.1 "NnRootWeightLoader / splitters").
+    """
+
+    path: str
+    header: ModelHeader
+    tensors: dict[str, TensorRecord] = field(default_factory=dict)
+
+    _mm: mmap.mmap | None = None
+    _file: object | None = None
+
+    @classmethod
+    def open(cls, path: str | Path, max_seq_len: int = 0, sync_type: int = F32) -> "ModelFile":
+        path = str(path)
+        f = open(path, "rb")
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        header = parse_header(mm[:4096] if len(mm) >= 4096 else mm[:], len(mm),
+                              max_seq_len=max_seq_len, sync_type=sync_type)
+        mf = cls(path=path, header=header)
+        mf._mm = mm
+        mf._file = f
+        mf._walk()
+        return mf
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+        if self._file is not None:
+            self._file.close()  # type: ignore[attr-defined]
+            self._file = None
+
+    def __enter__(self) -> "ModelFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _add(self, name: str, layer: int, shape: tuple[int, ...], float_type: int,
+             offset: int) -> int:
+        n = int(np.prod(shape))
+        nb = tensor_bytes(float_type, n)
+        key = f"{name}.{layer}" if layer >= 0 else name
+        self.tensors[key] = TensorRecord(name=name, layer=layer, shape=shape,
+                                         float_type=float_type, offset=offset, n_bytes=nb)
+        return nb
+
+    def _walk(self) -> None:
+        h = self.header
+        wt = h.weight_type
+        off = h.header_size
+        # Tensor names mirror the reference's op names so parity is auditable
+        # (llm.cpp:503-538).
+        off += self._add("embedding", -1, (h.vocab_size, h.dim), F32, off)
+        for l in range(h.n_layers):
+            off += self._add("block_matmul_q", l, (h.q_dim, h.dim), wt, off)
+            off += self._add("block_matmul_k", l, (h.kv_dim, h.dim), wt, off)
+            off += self._add("block_matmul_v", l, (h.kv_dim, h.dim), wt, off)
+            off += self._add("block_matmul_wo", l, (h.dim, h.q_dim), wt, off)
+            off += self._add("block_matmul_w1", l, (h.hidden_dim, h.dim), wt, off)
+            off += self._add("block_matmul_w2", l, (h.dim, h.hidden_dim), wt, off)
+            off += self._add("block_matmul_w3", l, (h.hidden_dim, h.dim), wt, off)
+            if h.arch_type == ArchType.QWEN3:
+                off += self._add("block_norm_q", l, (h.head_dim,), F32, off)
+                off += self._add("block_norm_k", l, (h.head_dim,), F32, off)
+            off += self._add("block_norm_0", l, (h.dim,), F32, off)
+            off += self._add("block_norm_1", l, (h.dim,), F32, off)
+        off += self._add("final_norm", -1, (h.dim,), F32, off)
+        off += self._add("final_matmul_logits", -1, (h.vocab_size, h.dim), wt, off)
+        if off != h.file_size:
+            raise ValueError(
+                f"weight file size mismatch: file has {h.file_size} bytes, "
+                f"tensor walk needs {off}")
+
+    # -- tensor access ------------------------------------------------------
+
+    def raw(self, key: str) -> memoryview:
+        rec = self.tensors[key]
+        assert self._mm is not None, "file closed"
+        return memoryview(self._mm)[rec.offset:rec.offset + rec.n_bytes]
+
+    def tensor_f32(self, key: str) -> np.ndarray:
+        """Read a tensor fully dequantized to float32 with its logical shape."""
+        rec = self.tensors[key]
+        buf = self.raw(key)
+        n = int(np.prod(rec.shape))
+        if rec.float_type == F32:
+            arr = np.frombuffer(buf, dtype=np.float32, count=n)
+        elif rec.float_type == Q40:
+            arr = dequantize_q40(buf, n)
+        else:
+            raise ValueError(f"unsupported tensor float type {rec.float_type}")
+        return arr.reshape(rec.shape)
+
+    def tensor_q40_planes(self, key: str) -> tuple[np.ndarray, np.ndarray]:
+        """Read a Q40 matmul weight as separated (scales, int4-codes) planes.
+
+        Returns ``scales: float16 [rows, cols/32]`` and ``codes: int8 [rows, cols]``
+        — the TPU-friendly repack of the reference's 18-byte interleaved blocks
+        (SURVEY.md §7.4).
+        """
+        rec = self.tensors[key]
+        assert rec.float_type == Q40, rec
+        rows, cols = rec.shape
+        scales, codes = unpack_q40(self.raw(key), rows * cols)
+        return (scales.reshape(rows, cols // 32), codes.reshape(rows, cols))
+
+
+# ---------------------------------------------------------------------------
+# Writer (converter backend + test fixture generator)
+# ---------------------------------------------------------------------------
+
+
+def write_header(f, params: dict) -> None:
+    """Write the .m header (reference: converter/writer.py:109-147)."""
+    data = b""
+    for key, value in params.items():
+        data += struct.pack("<ii", int(HeaderKey[key.upper()]), int(value))
+    f.write(struct.pack("<i", MODEL_MAGIC))
+    f.write(struct.pack("<i", 8 + len(data)))
+    f.write(data)

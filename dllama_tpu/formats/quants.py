@@ -1,0 +1,160 @@
+"""Q40 / Q80 block quantization codecs.
+
+Wire-compatible with the reference formats (reference: src/nn/nn-quants.hpp:56-72,
+nn-quants.cpp:67-240, converter/writer.py:29-74):
+
+* **Q40** — blocks of 32 weights stored as 18 bytes: one float16 scale ``d``
+  followed by 16 bytes of 4-bit codes. Byte ``j`` holds element ``j`` in its low
+  nibble and element ``j+16`` in its high nibble; the dequantized value is
+  ``(nibble - 8) * d``. The scale is ``signed_absmax / -8`` (the sign trick lets
+  -8 hit the extreme value exactly).
+* **Q80** — blocks of 32 values stored as 34 bytes: one float16 scale
+  ``d = absmax/127`` followed by 32 int8 codes; value is ``code * d``.
+
+These numpy codecs are the portable reference implementation, used for the
+offline converter, for host-side weight loading (before repacking into the
+TPU-friendly layout in :mod:`dllama_tpu.runtime.weights`), and as the golden
+model for kernel tests. A faster C++ implementation lives in
+``dllama_tpu/native`` and is used automatically when built.
+
+All functions operate on flat 1-D arrays whose length is a multiple of the
+block size, mirroring the reference's row-major tensor walk.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+Q40_BLOCK_SIZE = 32
+Q80_BLOCK_SIZE = 32
+Q40_BLOCK_BYTES = 2 + Q40_BLOCK_SIZE // 2  # f16 scale + 16 nibble bytes = 18
+Q80_BLOCK_BYTES = 2 + Q80_BLOCK_SIZE  # f16 scale + 32 int8 = 34
+
+# NnFloatType values (reference: src/nn/nn-quants.hpp:55-61)
+F32 = 0
+F16 = 1
+Q40 = 2
+Q80 = 3
+
+FLOAT_TYPE_NAMES = {F32: "f32", F16: "f16", Q40: "q40", Q80: "q80"}
+
+
+def q40_bytes(n: int) -> int:
+    """Size in bytes of ``n`` Q40-quantized elements."""
+    assert n % Q40_BLOCK_SIZE == 0, n
+    return (n // Q40_BLOCK_SIZE) * Q40_BLOCK_BYTES
+
+
+def q80_bytes(n: int) -> int:
+    """Size in bytes of ``n`` Q80-quantized elements."""
+    assert n % Q80_BLOCK_SIZE == 0, n
+    return (n // Q80_BLOCK_SIZE) * Q80_BLOCK_BYTES
+
+
+def tensor_bytes(float_type: int, n: int) -> int:
+    """On-disk byte size of an ``n``-element tensor of the given float type."""
+    if float_type == F32:
+        return 4 * n
+    if float_type == F16:
+        return 2 * n
+    if float_type == Q40:
+        return q40_bytes(n)
+    if float_type == Q80:
+        return q80_bytes(n)
+    raise ValueError(f"unsupported float type {float_type}")
+
+
+# ---------------------------------------------------------------------------
+# Q40
+# ---------------------------------------------------------------------------
+
+
+def quantize_q40(x: np.ndarray) -> bytes:
+    """Quantize flat float32 ``x`` to Q40 wire bytes.
+
+    Matches converter/writer.py:29-53 (and nn-quants.cpp:193-227): scale is the
+    signed max-magnitude value divided by -8; codes are ``floor(x/d + 8.5)``
+    clipped to [0, 15].
+    """
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    assert x.ndim == 1 and x.size % Q40_BLOCK_SIZE == 0, x.shape
+    g = x.reshape(-1, Q40_BLOCK_SIZE)
+    gmax = g.max(axis=1)
+    gmin = g.min(axis=1)
+    d = np.where(-gmin > gmax, gmin, gmax) / -8.0
+    d16 = d.astype(np.float16)
+    inv = np.where(d != 0, np.divide(1.0, d, where=d != 0), 0.0).astype(np.float32)
+    q = np.clip(np.floor(g * inv[:, None] + 8.5), 0, 15).astype(np.uint8)
+    half = Q40_BLOCK_SIZE // 2
+    packed = (q[:, :half] & 0xF) | ((q[:, half:] & 0xF) << 4)
+
+    out = np.zeros((g.shape[0], Q40_BLOCK_BYTES), dtype=np.uint8)
+    out[:, 0:2] = d16.view(np.uint8).reshape(-1, 2)
+    out[:, 2:] = packed
+    return out.tobytes()
+
+
+def dequantize_q40(buf: bytes | np.ndarray, n: int) -> np.ndarray:
+    """Dequantize ``n`` elements of Q40 wire bytes to float32."""
+    scales, q = unpack_q40(buf, n)
+    return (q.astype(np.float32) * scales[:, None].astype(np.float32)).reshape(-1)
+
+
+def unpack_q40(buf: bytes | np.ndarray, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Split Q40 wire bytes into ``(scales_f16[nblocks], codes_i8[nblocks, 32])``.
+
+    Codes are already centered (int8 in [-8, 7]). This is the host half of the
+    TPU repack: device layout keeps scales and codes in separate planes so the
+    MXU path can tile them (SURVEY.md §7.4 "Q40 layout in Pallas").
+    """
+    assert n % Q40_BLOCK_SIZE == 0, n
+    nblocks = n // Q40_BLOCK_SIZE
+    raw = np.frombuffer(buf, dtype=np.uint8, count=nblocks * Q40_BLOCK_BYTES).reshape(
+        nblocks, Q40_BLOCK_BYTES
+    )
+    scales = raw[:, 0:2].copy().view(np.float16).reshape(-1)
+    packed = raw[:, 2:]
+    lo = (packed & 0x0F).astype(np.int8) - 8  # elements 0..15
+    hi = (packed >> 4).astype(np.int8) - 8  # elements 16..31
+    return scales, np.concatenate([lo, hi], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Q80
+# ---------------------------------------------------------------------------
+
+
+def quantize_q80(x: np.ndarray) -> bytes:
+    """Quantize flat float32 ``x`` to Q80 wire bytes.
+
+    Matches nn-quants.cpp:67-173 scalar path: ``d = absmax/127``, codes are
+    round-half-away-from-zero of ``x/d`` (the NEON/AVX2 paths round to nearest;
+    we follow the scalar ``roundf`` semantics, which the reference's own test
+    tolerance also absorbs).
+    """
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    assert x.ndim == 1 and x.size % Q80_BLOCK_SIZE == 0, x.shape
+    g = x.reshape(-1, Q80_BLOCK_SIZE)
+    amax = np.abs(g).max(axis=1)
+    d = (amax / 127.0).astype(np.float32)
+    d16 = d.astype(np.float16)
+    inv = np.where(d != 0, np.divide(1.0, d, where=d != 0), 0.0).astype(np.float32)
+    scaled = g * inv[:, None]
+    q = (np.sign(scaled) * np.floor(np.abs(scaled) + 0.5)).astype(np.int8)
+
+    out = np.zeros((g.shape[0], Q80_BLOCK_BYTES), dtype=np.uint8)
+    out[:, 0:2] = d16.view(np.uint8).reshape(-1, 2)
+    out[:, 2:] = q.view(np.uint8)
+    return out.tobytes()
+
+
+def dequantize_q80(buf: bytes | np.ndarray, n: int) -> np.ndarray:
+    """Dequantize ``n`` elements of Q80 wire bytes to float32."""
+    assert n % Q80_BLOCK_SIZE == 0, n
+    nblocks = n // Q80_BLOCK_SIZE
+    raw = np.frombuffer(buf, dtype=np.uint8, count=nblocks * Q80_BLOCK_BYTES).reshape(
+        nblocks, Q80_BLOCK_BYTES
+    )
+    scales = raw[:, 0:2].copy().view(np.float16).reshape(-1).astype(np.float32)
+    q = raw[:, 2:].view(np.int8)
+    return (q.astype(np.float32) * scales[:, None]).reshape(-1)

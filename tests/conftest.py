@@ -1,0 +1,17 @@
+"""Test config: force an 8-device virtual CPU platform before JAX initializes.
+
+This is the TPU build's equivalent of the reference's NnFakeNodeSynchronizer +
+localhost-TCP-worker strategy (reference: src/nn/nn-executor.hpp:29-33,
+examples/n-workers.sh): multi-chip behavior is tested on a single host by
+letting XLA present 8 virtual CPU devices, so every sharding/collective path
+runs for real — just not over ICI.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

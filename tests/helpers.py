@@ -1,0 +1,109 @@
+"""Shared test fixtures: synthetic tiny .m/.t files built with the format writers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dllama_tpu.formats import mfile, quants, tfile
+
+
+def tiny_header_params(arch=mfile.ArchType.LLAMA, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=96, vocab_size=128, seq_len=64,
+                       head_dim=0, weight_type=quants.Q40, rope_type=mfile.RopeType.LLAMA):
+    return {
+        "version": 1,
+        "arch_type": int(arch),
+        "dim": dim,
+        "hidden_dim": hidden_dim,
+        "n_layers": n_layers,
+        "n_heads": n_heads,
+        "n_kv_heads": n_kv_heads,
+        "vocab_size": vocab_size,
+        "seq_len": seq_len,
+        "hidden_act": int(mfile.HiddenAct.SILU),
+        "rope_theta": 10000,
+        "weight_float_type": weight_type,
+        "rope_type": int(rope_type),
+        "head_dim": head_dim,
+        "norm_epsilon": 5,
+    }
+
+
+def write_tensor(f, x: np.ndarray, float_type: int) -> None:
+    flat = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    if float_type == quants.F32:
+        f.write(flat.tobytes())
+    elif float_type == quants.Q40:
+        f.write(quants.quantize_q40(flat))
+    elif float_type == quants.Q80:
+        f.write(quants.quantize_q80(flat))
+    else:
+        raise ValueError(float_type)
+
+
+def write_tiny_model(path, params: dict, rng: np.random.Generator, scale=0.05):
+    """Write a synthetic .m file with random weights; returns the dense weights."""
+    dim = params["dim"]
+    n_layers = params["n_layers"]
+    n_heads = params["n_heads"]
+    n_kv_heads = params["n_kv_heads"]
+    hidden_dim = params["hidden_dim"]
+    vocab = params["vocab_size"]
+    head_dim = params.get("head_dim") or dim // n_heads
+    q_dim = head_dim * n_heads
+    kv_dim = head_dim * n_kv_heads
+    wt = params["weight_float_type"]
+    qwen3 = params["arch_type"] == int(mfile.ArchType.QWEN3)
+
+    def rand(*shape):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    dense = {}
+    with open(path, "wb") as f:
+        mfile.write_header(f, params)
+
+        def put(name, layer, x, ft):
+            key = f"{name}.{layer}" if layer >= 0 else name
+            dense[key] = x
+            write_tensor(f, x, ft)
+
+        put("embedding", -1, rand(vocab, dim), quants.F32)
+        for l in range(n_layers):
+            put("block_matmul_q", l, rand(q_dim, dim), wt)
+            put("block_matmul_k", l, rand(kv_dim, dim), wt)
+            put("block_matmul_v", l, rand(kv_dim, dim), wt)
+            put("block_matmul_wo", l, rand(dim, q_dim), wt)
+            put("block_matmul_w1", l, rand(hidden_dim, dim), wt)
+            put("block_matmul_w2", l, rand(dim, hidden_dim), wt)
+            put("block_matmul_w3", l, rand(hidden_dim, dim), wt)
+            if qwen3:
+                put("block_norm_q", l, 1.0 + rand(head_dim), quants.F32)
+                put("block_norm_k", l, 1.0 + rand(head_dim), quants.F32)
+            put("block_norm_0", l, 1.0 + rand(dim), quants.F32)
+            put("block_norm_1", l, 1.0 + rand(dim), quants.F32)
+        put("final_norm", -1, 1.0 + rand(dim), quants.F32)
+        put("final_matmul_logits", -1, rand(vocab, dim), wt)
+    return dense
+
+
+def byte_vocab_tokenizer() -> tfile.TokenizerData:
+    """A tokenizer whose regular vocab is all 256 bytes plus a few merges.
+
+    Vocab layout mirrors the reference assumption: regular tokens first,
+    bos at index `regular_vocab_size`, special tokens after.
+    """
+    vocab = [bytes([b]) if b > 0 else b"\x00" for b in range(256)]
+    scores = [0.0] * 256
+    merges = [b"he", b"ll", b"llo", b"hello", b" wor", b" world", b"<|x|>"]
+    for i, m in enumerate(merges[:-1]):
+        vocab.append(m)
+        scores.append(float(i + 1))
+    bos_id = len(vocab)
+    vocab += [b"<s>", b"</s>", merges[-1]]
+    scores += [0.0, 0.0, 0.0]
+    return tfile.TokenizerData(
+        vocab=vocab, scores=scores, bos_id=bos_id, add_bos=True,
+        eos_token_ids=[bos_id + 1],
+        chat_template=None,
+        max_token_length=max(len(t) for t in vocab),
+    )

@@ -1,0 +1,89 @@
+"""Round-trip tests for the .m and .t file formats using the format writers
+(the same writers back the offline converter, mirroring the reference's
+converter/writer.py + tokenizer-writer.py)."""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import mfile, quants, tfile
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+def test_mfile_header_roundtrip(tmp_path):
+    path = tmp_path / "tiny.m"
+    params = tiny_header_params()
+    rng = np.random.default_rng(0)
+    write_tiny_model(path, params, rng)
+    mf = mfile.ModelFile.open(path)
+    h = mf.header
+    assert h.arch_type == mfile.ArchType.LLAMA
+    assert h.dim == 64 and h.n_layers == 2 and h.n_heads == 4 and h.n_kv_heads == 2
+    assert h.head_dim == 16 and h.q_dim == 64 and h.kv_dim == 32
+    assert h.vocab_size == 128 and h.seq_len == 64
+    assert h.weight_type == quants.Q40
+    assert h.rope_theta == 10000.0
+    assert h.norm_epsilon == pytest.approx(1e-5)
+    mf.close()
+
+
+def test_mfile_tensor_walk_and_dequant(tmp_path):
+    path = tmp_path / "tiny.m"
+    params = tiny_header_params()
+    rng = np.random.default_rng(1)
+    dense = write_tiny_model(path, params, rng)
+    with mfile.ModelFile.open(path) as mf:
+        assert set(mf.tensors) == set(dense)
+        # F32 tensors byte-exact; Q40 within block tolerance.
+        np.testing.assert_array_equal(mf.tensor_f32("embedding"), dense["embedding"])
+        w = mf.tensor_f32("block_matmul_q.0")
+        ref = dense["block_matmul_q.0"]
+        assert w.shape == ref.shape == (64, 64)
+        scale = np.abs(ref).max()
+        assert np.abs(w - ref).max() <= scale / 8 + 1e-6
+
+
+def test_mfile_qwen3_walk(tmp_path):
+    path = tmp_path / "tiny-qwen.m"
+    params = tiny_header_params(arch=mfile.ArchType.QWEN3, head_dim=24)
+    rng = np.random.default_rng(2)
+    write_tiny_model(path, params, rng)
+    with mfile.ModelFile.open(path) as mf:
+        assert mf.header.rope_type == mfile.RopeType.FALCON  # forced (llm.cpp:109-110)
+        assert mf.header.head_dim == 24
+        assert "block_norm_q.0" in mf.tensors
+        assert mf.tensors["block_norm_q.1"].shape == (24,)
+
+
+def test_mfile_max_seq_len_truncation(tmp_path):
+    path = tmp_path / "tiny.m"
+    rng = np.random.default_rng(3)
+    write_tiny_model(path, tiny_header_params(seq_len=64), rng)
+    with mfile.ModelFile.open(path, max_seq_len=16) as mf:
+        assert mf.header.seq_len == 16 and mf.header.orig_seq_len == 64
+
+
+def test_mfile_q40_planes(tmp_path):
+    path = tmp_path / "tiny.m"
+    rng = np.random.default_rng(4)
+    dense = write_tiny_model(path, tiny_header_params(), rng)
+    with mfile.ModelFile.open(path) as mf:
+        scales, codes = mf.tensor_q40_planes("block_matmul_w1.0")
+        assert scales.shape == (96, 2) and codes.shape == (96, 64)
+        recon = codes.astype(np.float32).reshape(96, 2, 32) * scales.astype(np.float32)[:, :, None]
+        np.testing.assert_allclose(recon.reshape(96, 64), mf.tensor_f32("block_matmul_w1.0"))
+
+
+def test_tfile_roundtrip(tmp_path):
+    data = byte_vocab_tokenizer()
+    data.chat_template = "{% for m in messages %}...{% endfor %}"
+    path = tmp_path / "tok.t"
+    tfile.write_tfile(path, data)
+    rd = read = tfile.read_tfile(path)
+    assert rd.vocab == data.vocab
+    assert rd.scores == pytest.approx(data.scores)
+    assert rd.bos_id == data.bos_id
+    assert rd.add_bos == data.add_bos
+    assert rd.eos_token_ids == data.eos_token_ids
+    assert rd.chat_template == data.chat_template
+    assert rd.regular_vocab_size == data.bos_id

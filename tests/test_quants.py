@@ -1,0 +1,78 @@
+"""Q40/Q80 codec tests — mirrors the reference's quantize→dequantize tolerance
+tests (reference: src/nn/nn-cpu-ops-test.cpp:83-100) plus byte-golden checks
+against hand-computed block layouts (reference: converter/writer-test.py)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import quants
+
+
+def test_q40_roundtrip_tolerance():
+    rng = np.random.default_rng(12345)
+    x = (rng.standard_normal(4096) * 2.0).astype(np.float32)
+    buf = quants.quantize_q40(x)
+    assert len(buf) == quants.q40_bytes(4096)
+    y = quants.dequantize_q40(buf, 4096)
+    # Max error per element is ~ absmax/8 within each block; use the same
+    # spirit as nn-cpu-ops-test.cpp's epsilon checks.
+    err = np.abs(x - y).reshape(-1, 32)
+    scale = np.abs(x.reshape(-1, 32)).max(axis=1, keepdims=True)
+    # bound: clip asymmetry can cost up to absmax/8, plus half a step of rounding
+    assert (err <= scale / 8.0 + scale / 16.0 + 1e-6).all()
+
+
+def test_q80_roundtrip_tolerance():
+    rng = np.random.default_rng(7)
+    x = (rng.standard_normal(2048) * 3.0).astype(np.float32)
+    buf = quants.quantize_q80(x)
+    assert len(buf) == quants.q80_bytes(2048)
+    y = quants.dequantize_q80(buf, 2048)
+    scale = np.abs(x.reshape(-1, 32)).max(axis=1, keepdims=True)
+    assert np.abs(x - y).max() <= (scale / 127.0).max() * 0.51 + 1e-6
+
+
+def test_q40_block_layout_golden():
+    # One block: element k = k - 8 (so absmax value is -8 at k=0 → d = -8/-8 = 1...
+    # construct explicitly: x[k] = (k % 16) - 8 gives signed max -8).
+    x = np.array([(k % 16) - 8 for k in range(32)], dtype=np.float32)
+    buf = quants.quantize_q40(x)
+    assert len(buf) == 18
+    d = np.frombuffer(buf[:2], dtype=np.float16)[0]
+    assert d == np.float16(1.0)  # signed absmax is -8 → d = -8/-8 = 1
+    packed = np.frombuffer(buf[2:], dtype=np.uint8)
+    lo = (packed & 0xF).astype(np.int8) - 8
+    hi = (packed >> 4).astype(np.int8) - 8
+    np.testing.assert_array_equal(lo, x[:16].astype(np.int8))
+    np.testing.assert_array_equal(hi, x[16:].astype(np.int8))
+
+
+def test_q80_block_layout_golden():
+    x = np.arange(-127, 127 * 31 + 1, 127, dtype=np.float32) / 127.0 * 127.0
+    x = np.linspace(-127, 127, 32).astype(np.float32)
+    buf = quants.quantize_q80(x)
+    d, = struct.unpack_from("<e", buf, 0)
+    assert d == pytest.approx(1.0, rel=1e-3)
+    q = np.frombuffer(buf, dtype=np.int8, count=32, offset=2)
+    assert q[0] == -127 and q[-1] == 127
+
+
+def test_q40_unpack_planes_shapes():
+    rng = np.random.default_rng(3)
+    rows, cols = 8, 64
+    x = rng.standard_normal(rows * cols).astype(np.float32)
+    buf = quants.quantize_q40(x)
+    scales, codes = quants.unpack_q40(buf, rows * cols)
+    assert scales.shape == (rows * cols // 32,)
+    assert codes.shape == (rows * cols // 32, 32)
+    assert codes.min() >= -8 and codes.max() <= 7
+    recon = (codes.astype(np.float32) * scales[:, None].astype(np.float32)).reshape(-1)
+    np.testing.assert_allclose(recon, quants.dequantize_q40(buf, rows * cols))
+
+
+def test_zero_block():
+    x = np.zeros(32, dtype=np.float32)
+    np.testing.assert_array_equal(quants.dequantize_q40(quants.quantize_q40(x), 32), x)
+    np.testing.assert_array_equal(quants.dequantize_q80(quants.quantize_q80(x), 32), x)
