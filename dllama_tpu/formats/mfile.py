@@ -241,13 +241,22 @@ class ModelFile:
     def open(cls, path: str | Path, max_seq_len: int = 0, sync_type: int = F32) -> "ModelFile":
         path = str(path)
         f = open(path, "rb")
-        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-        header = parse_header(mm[:4096] if len(mm) >= 4096 else mm[:], len(mm),
-                              max_seq_len=max_seq_len, sync_type=sync_type)
-        mf = cls(path=path, header=header)
-        mf._mm = mm
-        mf._file = f
-        mf._walk()
+        try:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except Exception:
+            f.close()
+            raise
+        try:
+            header = parse_header(mm[:4096] if len(mm) >= 4096 else mm[:], len(mm),
+                                  max_seq_len=max_seq_len, sync_type=sync_type)
+            mf = cls(path=path, header=header)
+            mf._mm = mm
+            mf._file = f
+            mf._walk()
+        except Exception:
+            mm.close()
+            f.close()
+            raise
         return mf
 
     def close(self) -> None:
@@ -308,12 +317,17 @@ class ModelFile:
         return memoryview(self._mm)[rec.offset:rec.offset + rec.n_bytes]
 
     def tensor_f32(self, key: str) -> np.ndarray:
-        """Read a tensor fully dequantized to float32 with its logical shape."""
+        """Read a tensor fully dequantized to float32 with its logical shape.
+
+        Always returns an owning copy so the array stays valid after
+        :meth:`close` (a zero-copy view would make ``mmap.close`` raise
+        ``BufferError``); bulk load paths that want zero-copy use :meth:`raw`.
+        """
         rec = self.tensors[key]
         buf = self.raw(key)
         n = int(np.prod(rec.shape))
         if rec.float_type == F32:
-            arr = np.frombuffer(buf, dtype=np.float32, count=n)
+            arr = np.frombuffer(buf, dtype=np.float32, count=n).copy()
         elif rec.float_type == Q40:
             arr = dequantize_q40(buf, n)
         else:

@@ -127,10 +127,12 @@ def unpack_q40(buf: bytes | np.ndarray, n: int) -> tuple[np.ndarray, np.ndarray]
 def quantize_q80(x: np.ndarray) -> bytes:
     """Quantize flat float32 ``x`` to Q80 wire bytes.
 
-    Matches nn-quants.cpp:67-173 scalar path: ``d = absmax/127``, codes are
-    round-half-away-from-zero of ``x/d`` (the NEON/AVX2 paths round to nearest;
-    we follow the scalar ``roundf`` semantics, which the reference's own test
-    tolerance also absorbs).
+    Byte-golden with the reference converter (converter/writer.py:55-74):
+    ``d = absmax/127``, codes are ``np.round`` (half-to-even) of ``x/d``. Note
+    the reference's *runtime* scalar path (nn-quants.cpp:168-170 ``roundf``)
+    rounds half away from zero instead — ties differ in the last bit of a
+    half-step value; file parity follows the converter, which is what this
+    codec writes and reads.
     """
     x = np.ascontiguousarray(x, dtype=np.float32)
     assert x.ndim == 1 and x.size % Q80_BLOCK_SIZE == 0, x.shape
@@ -139,8 +141,7 @@ def quantize_q80(x: np.ndarray) -> bytes:
     d = (amax / 127.0).astype(np.float32)
     d16 = d.astype(np.float16)
     inv = np.where(d != 0, np.divide(1.0, d, where=d != 0), 0.0).astype(np.float32)
-    scaled = g * inv[:, None]
-    q = (np.sign(scaled) * np.floor(np.abs(scaled) + 0.5)).astype(np.int8)
+    q = np.round(g * inv[:, None]).astype(np.int8)
 
     out = np.zeros((g.shape[0], Q80_BLOCK_BYTES), dtype=np.uint8)
     out[:, 0:2] = d16.view(np.uint8).reshape(-1, 2)

@@ -121,9 +121,11 @@ def read_tfile(path: str | Path) -> TokenizerData:
 
     vocab: list[bytes] = []
     scores: list[float] = []
-    for _ in range(vocab_size):
+    for i in range(vocab_size):
         score, length = struct.unpack_from("<fi", raw, off)
         off += 8
+        if off + length > len(raw):
+            raise ValueError(f"cannot read token {i} from tokenizer file (truncated)")
         vocab.append(raw[off:off + length])
         off += length
         scores.append(score)

@@ -1,0 +1,17 @@
+"""Tokenizer layer: BPE encode, streaming decode, sampling, chat templates, EOS.
+
+Behavior-compatible with the reference tokenizer stack
+(reference: src/tokenizer.{hpp,cpp}); the on-disk .t format lives in
+:mod:`dllama_tpu.formats.tfile`.
+"""
+
+from .bpe import Tokenizer  # noqa: F401
+from .sampler import Sampler, xorshift_random_f32  # noqa: F401
+from .chat import (  # noqa: F401
+    ChatItem,
+    ChatTemplateGenerator,
+    ChatTemplateType,
+    EosDetector,
+    EosResult,
+    GeneratedChat,
+)

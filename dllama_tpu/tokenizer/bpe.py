@@ -1,0 +1,214 @@
+"""Greedy-merge BPE encoder and streaming UTF-8-safe decoder.
+
+Behavior-compatible with the reference implementation
+(reference: src/tokenizer.cpp:309-388 encode, 222-307 decode/detokUtf8,
+194-208 token lookup). The vocab is raw bytes (byte-level BPE or
+sentencepiece pieces produced by the converter); encoding works on bytes, so
+multi-byte UTF-8 input accumulates until a vocab entry matches.
+
+Differences from the reference, by design:
+
+* lookup uses hash maps instead of ``bsearch`` over a sorted array;
+* the merge loop keeps the reference's "highest score wins, leftmost on tie"
+  policy but scans pairs with dict lookups;
+* unresolvable bytes raise ``ValueError`` instead of ``assert`` (the
+  reference aborts — llm vocabularies always cover all bytes in practice).
+"""
+
+from __future__ import annotations
+
+from ..formats.tfile import TokenizerData, read_tfile
+
+_REPLACEMENT = "�".encode("utf-8")  # 0xEF 0xBF 0xBD
+
+
+def _utf8_expected_continuation(byte: int) -> int | None:
+    """How many continuation bytes a UTF-8 lead byte announces; None if invalid."""
+    if byte <= 0x7F:
+        return 0
+    if 0xC0 <= byte <= 0xDF:
+        return 1
+    if 0xE0 <= byte <= 0xEF:
+        return 2
+    if 0xF0 <= byte <= 0xF7:
+        return 3
+    return None
+
+
+class Tokenizer:
+    """Vocab + encode/decode over a parsed .t file."""
+
+    def __init__(self, data: TokenizerData):
+        self.data = data
+        self.vocab = data.vocab
+        self.scores = data.scores
+        self.bos_id = data.bos_id
+        self.add_bos = data.add_bos
+        self.eos_token_ids = list(data.eos_token_ids)
+        self.chat_template = data.chat_template
+        self.vocab_size = data.vocab_size
+        self.regular_vocab_size = data.regular_vocab_size
+
+        # Regular vocab: bytes -> id. On duplicates keep the FIRST id, matching
+        # the reference's bsearch over a stably-ordered array of unique keys.
+        self._regular: dict[bytes, int] = {}
+        for i in range(self.regular_vocab_size):
+            self._regular.setdefault(self.vocab[i], i)
+        # Special vocab keeps file order: the reference's prefix scan takes the
+        # first match in vocab order (tokenizer.cpp:194-202).
+        self._special: list[tuple[int, bytes]] = [
+            (i, self.vocab[i])
+            for i in range(self.regular_vocab_size, self.vocab_size)
+        ]
+        self._pending = bytearray()  # streaming decoder carry-over
+
+    @classmethod
+    def load(cls, path) -> "Tokenizer":
+        return cls(read_tfile(path))
+
+    def is_eos(self, token: int) -> bool:
+        return token in self.eos_token_ids
+
+    # -- encode -------------------------------------------------------------
+
+    def encode(self, text: str | bytes, is_start: bool = True,
+               add_special_tokens: bool = True) -> list[int]:
+        """Tokenize: byte accumulation pass, then greedy best-score pair merging."""
+        if isinstance(text, str):
+            text = text.encode("utf-8")
+        tokens: list[int] = []
+        if is_start and self.add_bos and self.bos_id >= 0:
+            tokens.append(self.bos_id)
+
+        buf = bytearray()
+        i = 0
+        n = len(text)
+        while i < n:
+            if add_special_tokens and not buf:
+                matched = None
+                for tid, piece in self._special:
+                    if text.startswith(piece, i):
+                        matched = (tid, len(piece))
+                        break
+                if matched is not None:
+                    tokens.append(matched[0])
+                    i += matched[1]
+                    continue
+            elif add_special_tokens:
+                # The reference checks special tokens at every byte position even
+                # mid-accumulation (tokenizer.cpp:323-330); replicate that.
+                matched = None
+                for tid, piece in self._special:
+                    if text.startswith(piece, i):
+                        matched = (tid, len(piece))
+                        break
+                if matched is not None:
+                    if buf:
+                        raise ValueError(
+                            f"unresolvable bytes before special token: {bytes(buf)!r}")
+                    tokens.append(matched[0])
+                    i += matched[1]
+                    continue
+            buf.append(text[i])
+            i += 1
+            tid = self._regular.get(bytes(buf))
+            if tid is not None:
+                tokens.append(tid)
+                buf.clear()
+        if buf:
+            raise ValueError(f"unresolvable bytes in input: {bytes(buf)!r}")
+
+        # Greedy merge: each round merge the single best-scoring adjacent pair
+        # (leftmost on ties), exactly like tokenizer.cpp:349-377.
+        while True:
+            best_score = -1e10
+            best_idx = -1
+            best_id = -1
+            for j in range(len(tokens) - 1):
+                merged = self.vocab[tokens[j]] + self.vocab[tokens[j + 1]]
+                mid = self._regular.get(merged)
+                if mid is not None and self.scores[mid] > best_score:
+                    best_score = self.scores[mid]
+                    best_idx = j
+                    best_id = mid
+            if best_idx == -1:
+                break
+            tokens[best_idx:best_idx + 2] = [best_id]
+        return tokens
+
+    # -- streaming decode ---------------------------------------------------
+
+    def reset_decoder(self) -> None:
+        self._pending.clear()
+
+    def decode(self, token: int) -> str | None:
+        """Decode one token for streaming output.
+
+        Returns the printable delta, or None when nothing is emittable yet
+        (bos, incomplete UTF-8 sequence). Incomplete trailing sequences stay
+        buffered for the next call; invalid bytes become U+FFFD with stream
+        recovery (tokenizer.cpp:224-285).
+        """
+        if token == self.bos_id:
+            return None
+        if self.is_eos(token):
+            if self._pending:
+                out = bytes(self._pending).decode("utf-8", errors="replace")
+                self._pending.clear()
+                return out
+            return None
+        self._pending.extend(self.vocab[token])
+        return self._drain_utf8()
+
+    def decode_all(self, tokens: list[int]) -> str:
+        """Non-streaming convenience: decode a whole sequence."""
+        self.reset_decoder()
+        parts = [p for p in (self.decode(t) for t in tokens) if p]
+        if self._pending:
+            parts.append(bytes(self._pending).decode("utf-8", errors="replace"))
+            self._pending.clear()
+        return "".join(parts)
+
+    def _drain_utf8(self) -> str | None:
+        """Emit the longest valid-or-recovered UTF-8 prefix, keep the rest."""
+        src = bytes(self._pending)
+        out = bytearray()
+        checkpoint = 0  # bytes of `out` that end on a sequence boundary
+        checkpoint_src = 0
+        i = 0
+        expect = 0
+        while i < len(src):
+            c = src[i]
+            recovery = False
+            if expect:
+                if (c & 0xC0) == 0x80:
+                    out.append(c)
+                    i += 1
+                    expect -= 1
+                else:
+                    recovery = True
+            else:
+                exp = _utf8_expected_continuation(c)
+                if exp is None:
+                    recovery = True
+                else:
+                    out.append(c)
+                    i += 1
+                    expect = exp
+            if not recovery:
+                if not expect:
+                    checkpoint = len(out)
+                    checkpoint_src = i
+            else:
+                if expect:
+                    expect = 0
+                else:
+                    i += 1
+                del out[checkpoint:]
+                out.extend(_REPLACEMENT)
+                checkpoint = len(out)
+                checkpoint_src = i
+        self._pending = bytearray(src[checkpoint_src:])
+        if checkpoint > 0:
+            return out[:checkpoint].decode("utf-8")
+        return None

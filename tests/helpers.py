@@ -92,9 +92,10 @@ def byte_vocab_tokenizer() -> tfile.TokenizerData:
     Vocab layout mirrors the reference assumption: regular tokens first,
     bos at index `regular_vocab_size`, special tokens after.
     """
-    vocab = [bytes([b]) if b > 0 else b"\x00" for b in range(256)]
+    vocab = [bytes([b]) for b in range(256)]
     scores = [0.0] * 256
-    merges = [b"he", b"ll", b"llo", b"hello", b" wor", b" world", b"<|x|>"]
+    merges = [b"he", b"ll", b"llo", b"hello", b" w", b" wo", b" wor", b" worl",
+              b" world", b"<|x|>"]
     for i, m in enumerate(merges[:-1]):
         vocab.append(m)
         scores.append(float(i + 1))

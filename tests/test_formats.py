@@ -79,7 +79,7 @@ def test_tfile_roundtrip(tmp_path):
     data.chat_template = "{% for m in messages %}...{% endfor %}"
     path = tmp_path / "tok.t"
     tfile.write_tfile(path, data)
-    rd = read = tfile.read_tfile(path)
+    rd = tfile.read_tfile(path)
     assert rd.vocab == data.vocab
     assert rd.scores == pytest.approx(data.scores)
     assert rd.bos_id == data.bos_id
