@@ -122,10 +122,13 @@ def read_tfile(path: str | Path) -> TokenizerData:
     vocab: list[bytes] = []
     scores: list[float] = []
     for i in range(vocab_size):
+        if off + 8 > len(raw):
+            raise ValueError(f"cannot read token {i} header from tokenizer file (truncated)")
         score, length = struct.unpack_from("<fi", raw, off)
         off += 8
-        if off + length > len(raw):
-            raise ValueError(f"cannot read token {i} from tokenizer file (truncated)")
+        if length < 1 or off + length > len(raw):
+            raise ValueError(f"cannot read token {i} from tokenizer file "
+                             f"(length {length}, truncated or corrupt)")
         vocab.append(raw[off:off + length])
         off += length
         scores.append(score)

@@ -1,0 +1,4 @@
+"""Model layer: functional transformer graphs for Llama 2/3/3.x and Qwen3."""
+
+from .config import ModelConfig  # noqa: F401
+from .llama import forward, init_random_params, load_params_from_mfile  # noqa: F401

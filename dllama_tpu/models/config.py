@@ -1,0 +1,84 @@
+"""Model configuration — the runtime view of a .m header.
+
+Carries everything the graph builder needs (reference: LlmHeader,
+src/llm.hpp:42-71) plus TPU-side execution choices (compute dtype, weight
+layout) that have no reference equivalent.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from ..formats.mfile import ArchType, HiddenAct, ModelHeader, RopeType
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    arch: ArchType
+    dim: int
+    hidden_dim: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    vocab_size: int
+    seq_len: int
+    norm_epsilon: float
+    rope_theta: float
+    rope_type: RopeType
+    rope_scaling_factor: float = 1.0
+    rope_scaling_low_freq_factor: float = 0.0
+    rope_scaling_high_freq_factor: float = 0.0
+    rope_scaling_orig_max_seq_len: int = 0
+    hidden_act: HiddenAct = HiddenAct.SILU
+    n_experts: int = 0
+    n_active_experts: int = 0
+
+    # TPU execution choices (no reference equivalent):
+    compute_dtype: str = "float32"  # "float32" for parity, "bfloat16" for speed
+
+    @property
+    def q_dim(self) -> int:
+        return self.head_dim * self.n_heads
+
+    @property
+    def kv_dim(self) -> int:
+        return self.head_dim * self.n_kv_heads
+
+    @property
+    def kv_mul(self) -> int:
+        """GQA group size (reference: multiheadAtt_F32 kvMul, nn-cpu-ops.cpp:756)."""
+        return self.n_heads // self.n_kv_heads
+
+    @property
+    def uses_qk_norm(self) -> bool:
+        """Qwen3 applies per-head RMS norm to q/k before rope (llm.cpp:285-309)."""
+        return self.arch == ArchType.QWEN3
+
+    @classmethod
+    def from_header(cls, h: ModelHeader, compute_dtype: str = "float32") -> "ModelConfig":
+        return cls(
+            arch=h.arch_type,
+            dim=h.dim,
+            hidden_dim=h.hidden_dim,
+            n_layers=h.n_layers,
+            n_heads=h.n_heads,
+            n_kv_heads=h.n_kv_heads,
+            head_dim=h.head_dim,
+            vocab_size=h.vocab_size,
+            seq_len=h.seq_len,
+            norm_epsilon=h.norm_epsilon,
+            rope_theta=h.rope_theta,
+            rope_type=h.rope_type,
+            rope_scaling_factor=h.rope_scaling_factor,
+            rope_scaling_low_freq_factor=h.rope_scaling_low_freq_factor,
+            rope_scaling_high_freq_factor=h.rope_scaling_high_freq_factor,
+            rope_scaling_orig_max_seq_len=h.rope_scaling_orig_max_seq_len,
+            hidden_act=h.hidden_act,
+            n_experts=h.n_experts,
+            n_active_experts=h.n_active_experts,
+            compute_dtype=compute_dtype,
+        )
+
+    def with_seq_len(self, seq_len: int) -> "ModelConfig":
+        return replace(self, seq_len=seq_len)

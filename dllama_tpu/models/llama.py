@@ -1,0 +1,239 @@
+"""Functional transformer forward for Llama 2/3/3.x and Qwen3.
+
+This replaces the reference's per-node op-graph builder (reference:
+buildLlmNet, src/llm.cpp:142-490) with a single SPMD program: the graph that
+the reference assembles as [merge_add, inv_rms, rms_norm, cast, matmul_q/k/v,
+(qwen3 q/k norms), rope, shift, multihead_att, cast, matmul_wo, cast, SYNC] +
+[merge_add, inv_rms, rms_norm, cast, w1/w3, silu, mul, cast, w2, cast, SYNC]
+per layer (llm.cpp:226-443) is expressed directly in jnp; tensor-parallel
+synchronization (the two all-reduces per layer) is carried by sharding
+annotations + XLA collectives instead of explicit SYNC steps.
+
+Design choices (TPU-first, not a translation):
+
+* **Stacked layer parameters + ``lax.scan``** — one compiled layer body
+  regardless of depth; keeps compile time O(1) in ``n_layers`` and lets XLA
+  pipeline HBM prefetch of the next layer's weights.
+* Batch dimension is ``[B, T]`` *sequences × positions* — the reference's
+  positions-as-batch prefill (nBatches, SURVEY.md §2.2) is the ``B=1`` case.
+* Activations carry logical axis names via
+  :func:`dllama_tpu.parallel.constrain` so the same code runs single-chip or
+  sharded over any mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..formats.mfile import ArchType, HiddenAct, ModelFile, RopeType
+from ..formats.quants import Q40
+from ..ops.attention import attention
+from ..ops.linear import QuantizedWeight, Weight, linear, quantize_weight_q40
+from ..ops.norms import rms_norm, rms_norm_per_head
+from ..parallel.api import constrain
+from ..runtime.kvcache import KVCache, update_layer
+from .config import ModelConfig
+from .rope import apply_rope, build_rope_cache
+
+
+class LayerParams(NamedTuple):
+    """Per-layer weights; every leaf carries a leading ``[n_layers]`` axis."""
+
+    wq: Weight  # [L, q_dim, dim]
+    wk: Weight  # [L, kv_dim, dim]
+    wv: Weight  # [L, kv_dim, dim]
+    wo: Weight  # [L, dim, q_dim]
+    w1: Weight  # [L, hidden_dim, dim]   (gate)
+    w2: Weight  # [L, dim, hidden_dim]   (down)
+    w3: Weight  # [L, hidden_dim, dim]   (up)
+    norm_att: jax.Array  # [L, dim]
+    norm_ffn: jax.Array  # [L, dim]
+    norm_q: jax.Array | None  # [L, head_dim] (qwen3) or None
+    norm_k: jax.Array | None
+
+
+class Params(NamedTuple):
+    embedding: jax.Array  # [vocab, dim]
+    layers: LayerParams
+    final_norm: jax.Array  # [dim]
+    logits: Weight  # [vocab, dim]
+
+
+def _hidden_act(cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    if cfg.hidden_act == HiddenAct.SILU:
+        return jax.nn.silu(x)
+    # tanh-approx gelu (reference: gelu_F32, nn-cpu-ops.cpp:1133-1142)
+    return jax.nn.gelu(x, approximate=True)
+
+
+def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
+                k_cache: jax.Array, v_cache: jax.Array,
+                cos: jax.Array, sin: jax.Array, start_pos: jax.Array,
+                positions: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One transformer block. ``x: [B, T, dim]``, caches ``[B, S, n_kv, hd]``."""
+    B, T, _ = x.shape
+
+    # -- attention half (reference att segment, llm.cpp:226-366) -----------
+    h = rms_norm(x, lp.norm_att, cfg.norm_epsilon)
+    q = linear(h, lp.wq).reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = linear(h, lp.wk).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = linear(h, lp.wv).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q = constrain(q, "batch", None, "heads", None)
+    k = constrain(k, "batch", None, "kv_heads", None)
+    v = constrain(v, "batch", None, "kv_heads", None)
+
+    if cfg.uses_qk_norm:
+        q = rms_norm_per_head(q, lp.norm_q, cfg.norm_epsilon)
+        k = rms_norm_per_head(k, lp.norm_k, cfg.norm_epsilon)
+
+    q = apply_rope(q, cos, sin, positions, cfg.rope_type)
+    k = apply_rope(k, cos, sin, positions, cfg.rope_type)
+
+    k_cache, v_cache = update_layer(k_cache, v_cache, k, v, start_pos)
+    att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
+    att = constrain(att, "batch", None, "heads", None)
+    x = x + linear(att.reshape(B, T, cfg.q_dim), lp.wo)
+    x = constrain(x, "batch", None, None)
+
+    # -- ffn half (reference ff segment, llm.cpp:369-439) ------------------
+    h = rms_norm(x, lp.norm_ffn, cfg.norm_epsilon)
+    gate = _hidden_act(cfg, linear(h, lp.w1))
+    up = linear(h, lp.w3)
+    hidden = constrain(gate * up, "batch", None, "hidden")
+    x = x + linear(hidden, lp.w2)
+    x = constrain(x, "batch", None, None)
+    return x, k_cache, v_cache
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+            start_pos: jax.Array, kv: KVCache) -> tuple[jax.Array, KVCache]:
+    """Full forward: ``tokens [B, T]`` at absolute ``start_pos`` → logits.
+
+    Returns float32 logits ``[B, T, vocab]`` and the updated cache. Jittable;
+    ``start_pos`` is a traced scalar so prefill chunks and decode steps reuse
+    one compilation per ``T``.
+    """
+    B, T = tokens.shape
+    x = params.embedding[tokens].astype(cfg.compute_dtype)
+    x = constrain(x, "batch", None, None)
+
+    cos, sin = build_rope_cache(cfg)
+    positions = start_pos + jnp.arange(T, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (B, T))
+
+    def body(carry, xs):
+        x = carry
+        lp, k_l, v_l = xs
+        x, k_l, v_l = _layer_step(cfg, x, lp, k_l, v_l, cos, sin,
+                                  start_pos, positions)
+        return x, (k_l, v_l)
+
+    # scan over the stacked layer axis; caches ride along as per-layer xs/ys
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params.layers, kv.k, kv.v))
+
+    x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
+    logits = linear(x, params.logits).astype(jnp.float32)
+    logits = constrain(logits, "batch", None, "vocab")
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+# ---------------------------------------------------------------------------
+# Parameter construction
+# ---------------------------------------------------------------------------
+
+
+def _stack_weights(ws: list[Any]) -> Any:
+    if isinstance(ws[0], QuantizedWeight):
+        return QuantizedWeight(
+            scales=jnp.stack([w.scales for w in ws]),
+            codes=jnp.stack([w.codes for w in ws]),
+        )
+    return jnp.stack(ws)
+
+
+def load_params_from_mfile(mf: ModelFile, cfg: ModelConfig,
+                           weight_mode: str = "auto") -> Params:
+    """Build device params from a .m file.
+
+    ``weight_mode``: ``"auto"`` keeps Q40 files quantized on device (planes),
+    ``"f32"``/``"bf16"`` dequantize to dense. This replaces the reference's
+    root-to-worker weight streaming (NnRootWeightLoader, SURVEY.md §2 #12):
+    under SPMD the per-device shard transfer happens in ``jax.device_put``
+    against the params' NamedShardings.
+    """
+    h = mf.header
+    quantized = h.weight_type == Q40 and weight_mode == "auto"
+    dense_dtype = jnp.bfloat16 if weight_mode == "bf16" else jnp.float32
+
+    def matmul_weight(key: str) -> Weight:
+        if quantized:
+            scales, codes = mf.tensor_q40_planes(key)
+            return QuantizedWeight(scales=jnp.asarray(scales), codes=jnp.asarray(codes))
+        return jnp.asarray(mf.tensor_f32(key), dtype=dense_dtype)
+
+    def f32(key: str) -> jax.Array:
+        return jnp.asarray(mf.tensor_f32(key))
+
+    layers = LayerParams(
+        wq=_stack_weights([matmul_weight(f"block_matmul_q.{l}") for l in range(h.n_layers)]),
+        wk=_stack_weights([matmul_weight(f"block_matmul_k.{l}") for l in range(h.n_layers)]),
+        wv=_stack_weights([matmul_weight(f"block_matmul_v.{l}") for l in range(h.n_layers)]),
+        wo=_stack_weights([matmul_weight(f"block_matmul_wo.{l}") for l in range(h.n_layers)]),
+        w1=_stack_weights([matmul_weight(f"block_matmul_w1.{l}") for l in range(h.n_layers)]),
+        w2=_stack_weights([matmul_weight(f"block_matmul_w2.{l}") for l in range(h.n_layers)]),
+        w3=_stack_weights([matmul_weight(f"block_matmul_w3.{l}") for l in range(h.n_layers)]),
+        norm_att=jnp.stack([f32(f"block_norm_0.{l}") for l in range(h.n_layers)]),
+        norm_ffn=jnp.stack([f32(f"block_norm_1.{l}") for l in range(h.n_layers)]),
+        norm_q=(jnp.stack([f32(f"block_norm_q.{l}") for l in range(h.n_layers)])
+                if h.arch_type == ArchType.QWEN3 else None),
+        norm_k=(jnp.stack([f32(f"block_norm_k.{l}") for l in range(h.n_layers)])
+                if h.arch_type == ArchType.QWEN3 else None),
+    )
+    return Params(
+        embedding=f32("embedding"),
+        layers=layers,
+        final_norm=f32("final_norm"),
+        logits=matmul_weight("final_matmul_logits"),
+    )
+
+
+def init_random_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02,
+                       quantized: bool = False, dtype=jnp.float32) -> Params:
+    """Random params for tests/benchmarks (shape-identical to a loaded model)."""
+    rng = np.random.default_rng(seed)
+
+    def rand(*shape):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    def mk(out, in_) -> Weight:
+        w = rand(cfg.n_layers, out, in_)
+        if quantized:
+            return _stack_weights([quantize_weight_q40(w[l]) for l in range(cfg.n_layers)])
+        return jnp.asarray(w, dtype=dtype)
+
+    qwen3 = cfg.arch == ArchType.QWEN3
+    layers = LayerParams(
+        wq=mk(cfg.q_dim, cfg.dim),
+        wk=mk(cfg.kv_dim, cfg.dim),
+        wv=mk(cfg.kv_dim, cfg.dim),
+        wo=mk(cfg.dim, cfg.q_dim),
+        w1=mk(cfg.hidden_dim, cfg.dim),
+        w2=mk(cfg.dim, cfg.hidden_dim),
+        w3=mk(cfg.hidden_dim, cfg.dim),
+        norm_att=jnp.asarray(1.0 + rand(cfg.n_layers, cfg.dim)),
+        norm_ffn=jnp.asarray(1.0 + rand(cfg.n_layers, cfg.dim)),
+        norm_q=jnp.asarray(1.0 + rand(cfg.n_layers, cfg.head_dim)) if qwen3 else None,
+        norm_k=jnp.asarray(1.0 + rand(cfg.n_layers, cfg.head_dim)) if qwen3 else None,
+    )
+    logits = rand(cfg.vocab_size, cfg.dim)
+    return Params(
+        embedding=jnp.asarray(rand(cfg.vocab_size, cfg.dim)),
+        layers=layers,
+        final_norm=jnp.asarray(1.0 + rand(cfg.dim)),
+        logits=(quantize_weight_q40(logits) if quantized
+                else jnp.asarray(logits, dtype=dtype)),
+    )

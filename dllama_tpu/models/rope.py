@@ -1,0 +1,89 @@
+"""RoPE frequency caches and application.
+
+Numerically matches the reference's precomputed-cache approach (reference:
+fullfillRopeLlamaCache / fullfillRopeFalconCache, src/nn/nn-core.cpp:329-370;
+apply kernels ropeLlama_F32 / ropeFalcon_F32, src/nn/nn-cpu-ops.cpp:836-878):
+
+* **llama style** — adjacent interleaved pairs ``(x[2j], x[2j+1])`` within each
+  head, frequency ``theta^(-2j/head_dim)``. Used by Llama 2/3 together with the
+  converter's Q/K head permutation (convert-hf.py:12-15).
+* **llama3.1** — llama pairing with Meta's wavelength-banded frequency scaling
+  (scaleFrequencyLlama3, nn-core.cpp:313-327).
+* **falcon (neox) style** — half-split pairs ``(x[j], x[j + head_dim/2])``,
+  same frequencies. Used by Qwen3.
+
+Unlike the reference, the cache here is global per model (``[seq_len,
+head_dim/2]``), not per-TP-shard: the TP shard always holds whole heads, and
+every head uses identical frequencies, so slicing the cache per node
+(sliceRope, nn-core.cpp:232-263) is unnecessary under SPMD sharding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..formats.mfile import RopeType
+from .config import ModelConfig
+
+
+def _scale_frequency_llama3(freq: np.ndarray, cfg: ModelConfig) -> np.ndarray:
+    """Meta's llama3.1 rope scaling (reference: nn-core.cpp:313-327)."""
+    wave_len = 2.0 * np.pi / freq
+    high_freq_wavelen = cfg.rope_scaling_orig_max_seq_len / cfg.rope_scaling_high_freq_factor
+    low_freq_wavelen = cfg.rope_scaling_orig_max_seq_len / cfg.rope_scaling_low_freq_factor
+    smooth = (cfg.rope_scaling_orig_max_seq_len / wave_len - cfg.rope_scaling_low_freq_factor) / (
+        cfg.rope_scaling_high_freq_factor - cfg.rope_scaling_low_freq_factor)
+    smoothed = (1.0 - smooth) * freq / cfg.rope_scaling_factor + smooth * freq
+    out = np.where(wave_len < high_freq_wavelen, freq,
+                   np.where(wave_len > low_freq_wavelen,
+                            freq / cfg.rope_scaling_factor, smoothed))
+    return out
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=16)
+def build_rope_cache(cfg: ModelConfig) -> tuple[np.ndarray, np.ndarray]:
+    """cos/sin caches of shape ``[seq_len, head_dim // 2]`` in float32.
+
+    Memoized per config (frozen dataclass): the host-side trig tables are
+    computed once per model, not per trace. Returns plain numpy arrays —
+    callers may be inside a jit trace, where caching a ``jnp`` constant would
+    leak a tracer; numpy constants embed safely."""
+    half = cfg.head_dim // 2
+    j = np.arange(half, dtype=np.float32)
+    # llama: pair index j covers dims (2j, 2j+1), h = 2j in the reference loop.
+    # falcon: freq exponent is 2j/head_dim as well (nn-core.cpp:354) — the two
+    # styles share frequencies and differ only in pairing layout.
+    freqs = 1.0 / np.power(cfg.rope_theta, 2.0 * j / cfg.head_dim, dtype=np.float32)
+    if cfg.rope_type == RopeType.LLAMA3_1 and cfg.rope_scaling_factor != 1.0:
+        freqs = _scale_frequency_llama3(freqs.astype(np.float64), cfg).astype(np.float32)
+    pos = np.arange(cfg.seq_len, dtype=np.float32)[:, None]
+    angles = pos * freqs[None, :]
+    return np.cos(angles), np.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               positions: jnp.ndarray, rope_type: RopeType) -> jnp.ndarray:
+    """Rotate ``x: [B, T, n_heads, head_dim]`` at ``positions: [B, T]``."""
+    c = jnp.asarray(cos)[positions]  # [B, T, half]
+    s = jnp.asarray(sin)[positions]
+    c = c[:, :, None, :]  # broadcast over heads
+    s = s[:, :, None, :]
+    if rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1):
+        x0 = x[..., 0::2]
+        x1 = x[..., 1::2]
+        r0 = x0 * c - x1 * s
+        r1 = x0 * s + x1 * c
+        # re-interleave: stack on a new trailing axis then flatten
+        return jnp.stack([r0, r1], axis=-1).reshape(x.shape)
+    elif rope_type == RopeType.FALCON:
+        half = x.shape[-1] // 2
+        x0 = x[..., :half]
+        x1 = x[..., half:]
+        r0 = x0 * c - x1 * s
+        r1 = x0 * s + x1 * c
+        return jnp.concatenate([r0, r1], axis=-1)
+    raise ValueError(f"unsupported rope type {rope_type}")

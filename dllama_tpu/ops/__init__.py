@@ -1,0 +1,12 @@
+"""Compute ops: quantized linear, norms, attention, sampling.
+
+This package is the TPU replacement for the reference's op-kernel surface
+(reference: src/nn/nn-cpu-ops.cpp dispatch table, SURVEY.md §2.3): instead of
+12 op codes × quant-variant function pointers, the ops are composable JAX
+functions that XLA fuses, with Pallas kernels for the quantized matmul and
+attention hot paths.
+"""
+
+from .linear import QuantizedWeight, linear, quantize_weight_q40, fake_quant_q80  # noqa: F401
+from .norms import rms_norm, rms_norm_per_head  # noqa: F401
+from .attention import attention  # noqa: F401

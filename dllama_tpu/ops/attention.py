@@ -1,0 +1,44 @@
+"""Causal GQA attention over a preallocated KV cache.
+
+Semantics match the reference's OP_MULTIHEAD_ATT (reference: multiheadAtt_F32,
+src/nn/nn-cpu-ops.cpp:751-786): per head, scores ``q·k / sqrt(head_dim)`` over
+cache positions ``0..pos``, float32 softmax, weighted V sum; GQA via the
+``kv_mul`` head-group factor. The serial per-position loop becomes one batched
+einsum pair so XLA maps it onto the MXU; masking replaces the loop bound.
+
+This XLA implementation is the semantics oracle; the Pallas flash-attention
+kernel in :mod:`dllama_tpu.ops.flash_attention` must match it bit-for-bit in
+f32 (tested the way nn-vulkan-test.cpp checks GPU ops against expectations).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+              positions: jax.Array, head_dim: int) -> jax.Array:
+    """Attend ``q: [B, T, n_heads, head_dim]`` over cached
+    ``k/v: [B, S, n_kv_heads, head_dim]``.
+
+    ``positions: [B, T]`` is the absolute position of each query row; cache
+    entries at ``s <= position`` are visible (the reference's ``t <= pos`` loop
+    bound), which assumes the cache holds keys for positions ``0..pos``.
+    """
+    B, T, n_heads, _ = q.shape
+    S = k_cache.shape[1]
+    n_kv = k_cache.shape[2]
+    kv_mul = n_heads // n_kv
+
+    qg = q.reshape(B, T, n_kv, kv_mul, head_dim)
+    scores = jnp.einsum("btkmh,bskh->btkms", qg.astype(jnp.float32),
+                        k_cache.astype(jnp.float32))
+    scores = scores / jnp.sqrt(jnp.float32(head_dim))
+
+    mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]  # [B, T, S]
+    scores = jnp.where(mask[:, :, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+
+    out = jnp.einsum("btkms,bskh->btkmh", probs, v_cache.astype(jnp.float32))
+    return out.reshape(B, T, n_heads, head_dim).astype(q.dtype)

@@ -1,0 +1,113 @@
+"""Linear/matmul ops over dense or Q40-quantized weights.
+
+The quantized path replaces the reference's Q80×Q40 integer-dot kernels
+(reference: matmul_Q80_Q40_F32, src/nn/nn-cpu-ops.cpp:229-447 and the
+llamafile sgemm prefill path): weights stay in the Q40 block domain (separated
+scale/code planes from :func:`dllama_tpu.formats.quants.unpack_q40`), and the
+matmul dequantizes on the fly. On TPU the XLA path below lets the compiler
+fuse dequantization into the MXU matmul; a hand-tiled Pallas kernel lives in
+:mod:`dllama_tpu.ops.quant_matmul` for the cases XLA schedules poorly.
+
+``fake_quant_q80`` mirrors the reference's activation-quantization ("sync
+type" Q80 casts, llm.cpp:258-265): quantize-dequantize in-graph so the
+numerical effect of the wire quantization is reproduced even though TPU
+collectives move bf16/f32.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..formats.quants import Q40_BLOCK_SIZE, Q80_BLOCK_SIZE
+
+
+class QuantizedWeight(NamedTuple):
+    """Q40 weight as TPU-friendly planes.
+
+    ``scales``: float16 ``[out, in // 32]`` block scales.
+    ``codes``: int8 ``[out, in]`` centered 4-bit codes in [-8, 7].
+
+    Logical value: ``w[o, i] = codes[o, i] * scales[o, i // 32]``
+    (reference block layout: NnBlockQ40, src/nn/nn-quants.hpp:64-67).
+    """
+
+    scales: jax.Array
+    codes: jax.Array
+
+    @property
+    def out_features(self) -> int:
+        return self.codes.shape[-2]
+
+    @property
+    def in_features(self) -> int:
+        return self.codes.shape[-1]
+
+
+Weight = Union[jax.Array, QuantizedWeight]
+
+
+def quantize_weight_q40(w: np.ndarray) -> QuantizedWeight:
+    """Quantize a dense ``[out, in]`` float32 weight to Q40 planes (host-side)."""
+    from ..formats.quants import quantize_q40, unpack_q40
+
+    out, in_ = w.shape
+    buf = quantize_q40(np.ascontiguousarray(w, dtype=np.float32).reshape(-1))
+    scales, codes = unpack_q40(buf, out * in_)
+    return QuantizedWeight(
+        scales=jnp.asarray(scales.reshape(out, in_ // Q40_BLOCK_SIZE)),
+        codes=jnp.asarray(codes.reshape(out, in_)),
+    )
+
+
+def dequantize_weight(w: QuantizedWeight, dtype=jnp.float32) -> jax.Array:
+    """Expand Q40 planes to a dense ``[..., out, in]`` array."""
+    *lead, out, in_ = w.codes.shape
+    scales = jnp.repeat(w.scales.astype(dtype), Q40_BLOCK_SIZE, axis=-1)
+    return w.codes.astype(dtype) * scales
+
+
+def linear(x: jax.Array, w: Weight) -> jax.Array:
+    """``y[..., out] = x[..., in] @ w.T`` with dense or Q40 weight.
+
+    Weights use the reference's on-disk ``[out, in]`` orientation (row-major,
+    llm.cpp matmul weights), so TP row/col split semantics stay auditable:
+    row-split = shard ``out``, col-split = shard ``in``.
+    """
+    if isinstance(w, QuantizedWeight):
+        wd = dequantize_weight(w, dtype=x.dtype)
+    else:
+        wd = w.astype(x.dtype)
+    return jax.lax.dot_general(
+        x, wd,
+        dimension_numbers=(((x.ndim - 1,), (wd.ndim - 1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+
+def fake_quant_q80(x: jax.Array) -> jax.Array:
+    """In-graph Q80 quantize→dequantize of the trailing axis.
+
+    Numerically mirrors the reference *runtime* path quantizeF32toQ80 +
+    dequantizeQ80toF32 (src/nn/nn-quants.cpp:158-192 scalar): the int8 code is
+    ``roundf(x / d)`` with the UNROUNDED f32 scale ``d = absmax/127`` (half
+    away from zero), while the dequant multiply uses the f16-rounded stored
+    scale. Used when the engine runs in "sync q80" parity mode so activations
+    passing a sync point carry the same quantization the reference's wire
+    format applies.
+    """
+    orig_shape = x.shape
+    orig_dtype = x.dtype
+    n = orig_shape[-1]
+    assert n % Q80_BLOCK_SIZE == 0, n
+    g = x.astype(jnp.float32).reshape(*orig_shape[:-1], n // Q80_BLOCK_SIZE, Q80_BLOCK_SIZE)
+    amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    d = amax / 127.0
+    inv = jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 0.0)
+    scaled = g * inv
+    q = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)  # roundf semantics
+    d16 = d.astype(jnp.float16).astype(jnp.float32)
+    return (q * d16).reshape(orig_shape).astype(orig_dtype)

@@ -1,0 +1,27 @@
+"""RMS normalization ops.
+
+Numerically matches the reference's two-step INV_RMS + RMS_NORM pipeline
+(reference: invRms_F32, src/nn/nn-cpu-ops.cpp:112-142; rmsNormForward,
+:1000-1049): ``inv = 1/sqrt(mean(x^2) + eps)``, ``y = x * inv * w``. On TPU
+the two steps fuse into one; reductions run in float32 regardless of the
+compute dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """Normalize over the trailing axis."""
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * inv * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm_per_head(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """Qwen3's per-head q/k norm: ``x: [..., n_heads, head_dim]``, shared
+    ``weight: [head_dim]`` (reference: nColumns-style multi-column rms_norm,
+    llm.cpp:285-309 + nn-cpu-ops.cpp:1000-1027)."""
+    return rms_norm(x, weight, eps)

@@ -1,0 +1,12 @@
+"""Parallelism layer: device mesh, sharding rules, TP/SP/DP plans.
+
+This package is the TPU-native replacement for the reference's entire
+distribution stack — the TCP mesh, sync steps, slicers and weight splitters
+(reference: src/nn/nn-network.cpp, nn-core.cpp slicers; SURVEY.md §2 #10-12):
+a `jax.sharding.Mesh` plus NamedShardings express the same tensor-parallel
+plan, and XLA emits the collectives (psum where the reference all-gathers
+partial sums + OP_MERGE_ADDs them, all-gather for the logits).
+"""
+
+from .api import MeshPlan, constrain, current_plan, use_plan  # noqa: F401
+from .sharding import param_shardings, shard_params  # noqa: F401

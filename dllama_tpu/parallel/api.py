@@ -1,0 +1,146 @@
+"""Mesh context and logical-axis activation constraints.
+
+Model code names activation axes logically ("batch", "heads", "hidden",
+"vocab"); a :class:`MeshPlan` maps those names onto mesh axes. With no active
+plan every constraint is a no-op, so the same model code runs single-chip,
+under the 8-device CPU test mesh, or on a real TPU slice — the SPMD analogue
+of the reference running 1-node without sync steps (nn-executor.cpp:56,79).
+
+Axis conventions:
+
+* ``tp`` — tensor parallelism: attention heads / ffn hidden / vocab, the same
+  three shard groups as the reference's row/col matmul split (SURVEY.md §2.2).
+* ``dp`` — data parallelism over independent sequences (new capability; the
+  reference is single-sequence).
+* ``sp`` — sequence parallelism for long context (new capability; see
+  :mod:`dllama_tpu.parallel.ring`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+DEFAULT_RULES: dict[str, str | tuple[str, ...] | None] = {
+    "batch": "dp",
+    "seq": "sp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "hidden": "tp",
+    "vocab": "tp",
+    "q_dim": "tp",
+}
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A mesh plus logical-axis→mesh-axis rules."""
+
+    mesh: Mesh
+    rules: dict[str, str | tuple[str, ...] | None] = field(
+        default_factory=lambda: dict(DEFAULT_RULES))
+
+    def resolve(self, logical: str | None):
+        if logical is None:
+            return None
+        mesh_axis = self.rules.get(logical)
+        if mesh_axis is None:
+            return None
+        # a rule may name a mesh axis that this mesh doesn't have (e.g. "sp"
+        # on a pure-TP mesh) — treat as replicated
+        axes = mesh_axis if isinstance(mesh_axis, tuple) else (mesh_axis,)
+        axes = tuple(a for a in axes if a in self.mesh.axis_names)
+        if not axes:
+            return None
+        return axes if len(axes) > 1 else axes[0]
+
+    def spec(self, *logical_axes: str | None) -> PartitionSpec:
+        return PartitionSpec(*[self.resolve(a) for a in logical_axes])
+
+    def sharding(self, *logical_axes: str | None) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec(*logical_axes))
+
+    def _axis_size(self, mesh_axis) -> int:
+        axes = mesh_axis if isinstance(mesh_axis, tuple) else (mesh_axis,)
+        n = 1
+        for a in axes:
+            n *= self.mesh.shape[a]
+        return n
+
+    def sharding_for(self, shape: tuple[int, ...], *logical_axes: str | None) -> NamedSharding:
+        """Shape-aware sharding: a logical axis whose dimension is not
+        divisible by its mesh-axis size falls back to replicated.
+
+        This is how KV-head replication groups work when tp > n_kv_heads (a
+        capability the reference lacks — it caps nodes at nKvHeads,
+        app.cpp:232-234): the cache's kv-head dim stays replicated while q
+        heads remain fully sharded.
+        """
+        assert len(shape) == len(logical_axes), (shape, logical_axes)
+        resolved = []
+        for dim, logical in zip(shape, logical_axes):
+            m = self.resolve(logical)
+            if m is not None and dim % self._axis_size(m) != 0:
+                m = None
+            resolved.append(m)
+        return NamedSharding(self.mesh, PartitionSpec(*resolved))
+
+
+_state = threading.local()
+
+
+def current_plan() -> MeshPlan | None:
+    return getattr(_state, "plan", None)
+
+
+@contextlib.contextmanager
+def use_plan(plan: MeshPlan | None):
+    """Activate a mesh plan for model/engine code in this thread."""
+    prev = current_plan()
+    _state.plan = plan
+    try:
+        yield plan
+    finally:
+        _state.plan = prev
+
+
+def constrain(x: jax.Array, *logical_axes: str | None) -> jax.Array:
+    """Apply a sharding constraint by logical axis names; no-op without a plan.
+
+    Non-divisible axes degrade to replicated (see MeshPlan.sharding_for)."""
+    plan = current_plan()
+    if plan is None:
+        return x
+    assert len(logical_axes) == x.ndim, (logical_axes, x.shape)
+    return jax.lax.with_sharding_constraint(
+        x, plan.sharding_for(tuple(x.shape), *logical_axes))
+
+
+def make_tp_mesh(n_devices: int | None = None, devices=None) -> MeshPlan:
+    """A 1-D tensor-parallel mesh over the first ``n_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    import numpy as np
+
+    mesh = Mesh(np.asarray(devices), ("tp",))
+    return MeshPlan(mesh=mesh)
+
+
+def make_mesh(axis_sizes: dict[str, int], devices=None) -> MeshPlan:
+    """General mesh, e.g. ``{"dp": 2, "tp": 4}``; axis order follows dict order."""
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    n = 1
+    for s in axis_sizes.values():
+        n *= s
+    arr = np.asarray(devices[:n]).reshape(tuple(axis_sizes.values()))
+    return MeshPlan(mesh=Mesh(arr, tuple(axis_sizes.keys())))

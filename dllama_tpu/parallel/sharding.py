@@ -1,0 +1,106 @@
+"""Parameter sharding plans — the SPMD analogue of the reference's slicers.
+
+Maps every model parameter to a NamedSharding under a :class:`MeshPlan`:
+
+* row-split matmuls (wq/wk/wv/w1/w3/logits — reference sliceRowMatmul,
+  nn-core.cpp:207-217): shard the OUTPUT dim over ``tp``;
+* col-split matmuls (wo/w2 — reference sliceColMatmul, nn-core.cpp:219-230):
+  shard the INPUT dim over ``tp``; their partial-sum outputs are what XLA
+  all-reduces (the reference's SYNC_NODE_SLICES + OP_MERGE_ADD pair);
+* norms and the embedding stay replicated (the embedding broadcast is the
+  reference's SYNC_WITH_ROOT, free under replication);
+* KV cache shards over kv-heads like sliceKvCache (nn-core.cpp:198-205).
+
+The reference's divisibility constraints (asserts in the slicers; README's
+2^n nodes ≤ nKvHeads rule) become :func:`validate_tp` here — with the
+extension that ``n_heads % tp == 0`` may hold while ``n_kv_heads < tp``
+requires KV replication, a capability the reference lacks (SURVEY.md §7.4).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import jax
+
+from ..ops.linear import QuantizedWeight
+from .api import MeshPlan
+
+if TYPE_CHECKING:  # imported lazily at runtime (models imports parallel.api)
+    from ..models.config import ModelConfig
+    from ..models.llama import Params
+    from ..runtime.kvcache import KVCache
+
+
+def _weight_sharding(plan: MeshPlan, w, out_axis: str | None, in_axis: str | None,
+                     stacked: bool):
+    """Sharding for one matmul weight ([L?, out, in] dense or Q40 planes)."""
+    lead = (None,) if stacked else ()
+    if isinstance(w, QuantizedWeight):
+        return QuantizedWeight(
+            scales=plan.sharding_for(tuple(w.scales.shape), *lead, out_axis, in_axis),
+            codes=plan.sharding_for(tuple(w.codes.shape), *lead, out_axis, in_axis),
+        )
+    return plan.sharding_for(tuple(w.shape), *lead, out_axis, in_axis)
+
+
+def param_shardings(plan: MeshPlan, params: "Params") -> "Params":
+    """A Params-shaped tree of NamedShardings."""
+    from ..models.llama import LayerParams, Params
+
+    lp = params.layers
+    layers = LayerParams(
+        wq=_weight_sharding(plan, lp.wq, "heads", None, True),
+        wk=_weight_sharding(plan, lp.wk, "kv_heads", None, True),
+        wv=_weight_sharding(plan, lp.wv, "kv_heads", None, True),
+        wo=_weight_sharding(plan, lp.wo, None, "heads", True),
+        w1=_weight_sharding(plan, lp.w1, "hidden", None, True),
+        w2=_weight_sharding(plan, lp.w2, None, "hidden", True),
+        w3=_weight_sharding(plan, lp.w3, "hidden", None, True),
+        norm_att=plan.sharding(None, None),
+        norm_ffn=plan.sharding(None, None),
+        norm_q=None if lp.norm_q is None else plan.sharding(None, None),
+        norm_k=None if lp.norm_k is None else plan.sharding(None, None),
+    )
+    return Params(
+        embedding=plan.sharding(None, None),
+        layers=layers,
+        final_norm=plan.sharding(None),
+        logits=_weight_sharding(plan, params.logits, "vocab", None, False),
+    )
+
+
+def kv_cache_sharding(plan: MeshPlan, kv: "KVCache") -> "KVCache":
+    """[L, B, S, n_kv, hd] — kv-heads over tp, batch over dp, seq over sp.
+
+    When tp > n_kv_heads the kv-head dim is replicated (KV replication
+    groups; the reference instead caps nodes at nKvHeads)."""
+    from ..runtime.kvcache import KVCache
+
+    s = plan.sharding_for(tuple(kv.k.shape), None, "batch", None, "kv_heads", None)
+    return KVCache(k=s, v=s)
+
+
+def shard_params(plan: MeshPlan, params: "Params") -> "Params":
+    """Place params on the mesh with the TP shardings."""
+    shardings = param_shardings(plan, params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s) if s is not None else x,
+        params, shardings,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def validate_tp(cfg: "ModelConfig", tp: int) -> None:
+    """TP divisibility rules (reference: asserts nn-core.cpp:200-221 and the
+    n_nodes ≤ n_kv_heads cap, app.cpp:232-234)."""
+    if cfg.n_heads % tp != 0:
+        raise ValueError(f"n_heads {cfg.n_heads} not divisible by tp={tp}")
+    if cfg.hidden_dim % tp != 0:
+        raise ValueError(f"hidden_dim {cfg.hidden_dim} not divisible by tp={tp}")
+    if cfg.vocab_size % tp != 0:
+        raise ValueError(f"vocab_size {cfg.vocab_size} not divisible by tp={tp}")
+    if cfg.n_kv_heads % tp != 0 and tp % cfg.n_kv_heads != 0:
+        raise ValueError(
+            f"tp={tp} incompatible with n_kv_heads={cfg.n_kv_heads}: needs "
+            f"either n_kv_heads % tp == 0 or tp % n_kv_heads == 0 (replication)")

@@ -1,0 +1,3 @@
+"""Runtime: KV cache, weight loading, and the inference engine."""
+
+from .kvcache import KVCache  # noqa: F401

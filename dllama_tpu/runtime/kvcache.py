@@ -1,0 +1,49 @@
+"""Preallocated per-layer KV cache.
+
+The reference keeps a dense ``seq_len × kv_dim0`` key/value buffer per node
+per layer, appended by OP_SHIFT at the current position (reference:
+shiftForward_F32_F32, src/nn/nn-cpu-ops.cpp:1304-1326; cache slicing
+sliceKvCache, nn-core.cpp:198-205). Here the cache is one stacked array pair
+``[n_layers, batch, seq_len, n_kv_heads, head_dim]`` updated functionally with
+``lax.dynamic_update_slice`` — donated into the jitted decode step so XLA
+updates it in place, and sharded over the kv-head axis under TP exactly like
+the reference's per-node head shards.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, S, n_kv_heads, head_dim]
+    v: jax.Array
+
+    @classmethod
+    def create(cls, cfg: ModelConfig, batch_size: int = 1,
+               dtype=jnp.float32) -> "KVCache":
+        shape = (cfg.n_layers, batch_size, cfg.seq_len, cfg.n_kv_heads, cfg.head_dim)
+        return cls(k=jnp.zeros(shape, dtype=dtype), v=jnp.zeros(shape, dtype=dtype))
+
+    @property
+    def seq_len(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def batch_size(self) -> int:
+        return self.k.shape[1]
+
+
+def update_layer(k_layer: jax.Array, v_layer: jax.Array, new_k: jax.Array,
+                 new_v: jax.Array, start_pos: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Write ``new_k/new_v: [B, T, n_kv, hd]`` at ``start_pos`` (OP_SHIFT)."""
+    zero = jnp.zeros((), dtype=jnp.int32)
+    idx = (zero, start_pos.astype(jnp.int32), zero, zero)
+    k_layer = jax.lax.dynamic_update_slice(k_layer, new_k.astype(k_layer.dtype), idx)
+    v_layer = jax.lax.dynamic_update_slice(v_layer, new_v.astype(v_layer.dtype), idx)
+    return k_layer, v_layer

@@ -84,24 +84,11 @@ class Tokenizer:
         i = 0
         n = len(text)
         while i < n:
-            if add_special_tokens and not buf:
-                matched = None
-                for tid, piece in self._special:
-                    if text.startswith(piece, i):
-                        matched = (tid, len(piece))
-                        break
-                if matched is not None:
-                    tokens.append(matched[0])
-                    i += matched[1]
-                    continue
-            elif add_special_tokens:
-                # The reference checks special tokens at every byte position even
-                # mid-accumulation (tokenizer.cpp:323-330); replicate that.
-                matched = None
-                for tid, piece in self._special:
-                    if text.startswith(piece, i):
-                        matched = (tid, len(piece))
-                        break
+            if add_special_tokens:
+                # The reference checks special tokens at every byte position,
+                # even mid-accumulation (tokenizer.cpp:323-330).
+                matched = next(((tid, len(piece)) for tid, piece in self._special
+                                if text.startswith(piece, i)), None)
                 if matched is not None:
                     if buf:
                         raise ValueError(
