@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Benchmark: single-chip decode throughput on a Llama-3.2-1B-shaped Q40 model.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` is the fraction of the north-star target rate (BASELINE.json:
+>=1000 tok/s/chip for Llama-3.1-8B Q40 on v5e-8; the reference's own published
+numbers are Raspberry-Pi-class and not comparable, BASELINE.md). The benched
+model here is 1B-shaped on ONE chip, so this is a provisional proxy until the
+8B multi-chip bench lands; value > 1.0 does not yet mean the north star is met.
+
+The decode loop is the TPU-idiomatic fused step: forward + on-device greedy
+sampling, token fed back without host round-trips, KV cache donated.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dllama_tpu.formats.mfile import ArchType, RopeType
+from dllama_tpu.models import ModelConfig, forward
+from dllama_tpu.runtime import KVCache
+
+# Llama 3.2 1B shapes (HF config), seq capped for bench
+CFG = ModelConfig(
+    arch=ArchType.LLAMA, dim=2048, hidden_dim=8192, n_layers=16,
+    n_heads=32, n_kv_heads=8, head_dim=64, vocab_size=128256, seq_len=1024,
+    norm_epsilon=1e-5, rope_theta=500000.0, rope_type=RopeType.LLAMA3_1,
+    rope_scaling_factor=32.0, rope_scaling_low_freq_factor=1.0,
+    rope_scaling_high_freq_factor=4.0, rope_scaling_orig_max_seq_len=8192,
+    compute_dtype="bfloat16",
+)
+
+PREFILL_LEN = 128
+DECODE_STEPS = 64
+NORTH_STAR_TOK_S = 1000.0
+
+
+def _fast_random_params(cfg: ModelConfig):
+    """Random Q40-plane params generated directly (no float quantization pass)
+    — keeps bench startup fast on a single host core."""
+    import numpy as np
+
+    from dllama_tpu.models.llama import LayerParams, Params
+    from dllama_tpu.ops.linear import QuantizedWeight
+
+    rng = np.random.default_rng(0)
+
+    def qw(out, in_):
+        return QuantizedWeight(
+            scales=jnp.asarray(
+                (rng.random((cfg.n_layers, out, in_ // 32), dtype=np.float32)
+                 * 0.01 + 0.001).astype(np.float16)),
+            codes=jnp.asarray(
+                rng.integers(-8, 8, (cfg.n_layers, out, in_), dtype=np.int8)),
+        )
+
+    ones = lambda *s: jnp.asarray(np.ones(s, dtype=np.float32))
+    layers = LayerParams(
+        wq=qw(cfg.q_dim, cfg.dim), wk=qw(cfg.kv_dim, cfg.dim),
+        wv=qw(cfg.kv_dim, cfg.dim), wo=qw(cfg.dim, cfg.q_dim),
+        w1=qw(cfg.hidden_dim, cfg.dim), w2=qw(cfg.dim, cfg.hidden_dim),
+        w3=qw(cfg.hidden_dim, cfg.dim),
+        norm_att=ones(cfg.n_layers, cfg.dim), norm_ffn=ones(cfg.n_layers, cfg.dim),
+        norm_q=None, norm_k=None,
+    )
+    lw = QuantizedWeight(
+        scales=jnp.asarray((rng.random((cfg.vocab_size, cfg.dim // 32),
+                                       dtype=np.float32) * 0.01).astype(np.float16)),
+        codes=jnp.asarray(rng.integers(-8, 8, (cfg.vocab_size, cfg.dim),
+                                       dtype=np.int8)))
+    emb = rng.random((cfg.vocab_size, cfg.dim), dtype=np.float32) * 0.02
+    return Params(embedding=jnp.asarray(emb), layers=layers,
+                  final_norm=ones(cfg.dim), logits=lw)
+
+
+def main() -> None:
+    params = jax.device_put(_fast_random_params(CFG))
+    kv = KVCache.create(CFG, dtype=jnp.bfloat16)
+
+    step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
+
+    @jax.jit
+    def argmax_token(logits):
+        return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+    # prefill
+    prompt = jnp.ones((1, PREFILL_LEN), dtype=jnp.int32)
+    t0 = time.perf_counter()
+    logits, kv = step(params, CFG, prompt, jnp.int32(0), kv)
+    token = argmax_token(logits)
+    token.block_until_ready()
+    prefill_compile_s = time.perf_counter() - t0
+
+    # decode warmup (compile T=1 path)
+    tok2d = token[:, None]
+    logits, kv = step(params, CFG, tok2d, jnp.int32(PREFILL_LEN), kv)
+    token = argmax_token(logits)
+    token.block_until_ready()
+
+    t0 = time.perf_counter()
+    pos = PREFILL_LEN + 1
+    for i in range(DECODE_STEPS):
+        logits, kv = step(params, CFG, token[:, None], jnp.int32(pos + i), kv)
+        token = argmax_token(logits)
+    token.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tok_s = DECODE_STEPS / dt
+    print(json.dumps({
+        "metric": "decode_tok_per_s_llama1b_q40_1chip",
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / NORTH_STAR_TOK_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

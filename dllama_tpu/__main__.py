@@ -1,0 +1,8 @@
+"""``python -m dllama_tpu`` — the dllama-equivalent CLI entry point."""
+
+import sys
+
+from .serve.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

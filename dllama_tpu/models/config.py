@@ -36,6 +36,10 @@ class ModelConfig:
 
     # TPU execution choices (no reference equivalent):
     compute_dtype: str = "float32"  # "float32" for parity, "bfloat16" for speed
+    # Q80 activation-sync parity: reproduce the reference's Q80 cast points
+    # in-graph (llm.cpp:258-265 casts; wire pipes SURVEY.md §2 #10) via
+    # fake-quantization. Costs throughput; off for pure-TPU serving.
+    sync_q80: bool = False
 
     @property
     def q_dim(self) -> int:
@@ -57,7 +61,10 @@ class ModelConfig:
 
     @classmethod
     def from_header(cls, h: ModelHeader, compute_dtype: str = "float32") -> "ModelConfig":
+        from ..formats.quants import Q80
+
         return cls(
+            sync_q80=h.sync_type == Q80,
             arch=h.arch_type,
             dim=h.dim,
             hidden_dim=h.hidden_dim,

@@ -32,7 +32,13 @@ import numpy as np
 from ..formats.mfile import ArchType, HiddenAct, ModelFile, RopeType
 from ..formats.quants import Q40
 from ..ops.attention import attention
-from ..ops.linear import QuantizedWeight, Weight, linear, quantize_weight_q40
+from ..ops.linear import (
+    QuantizedWeight,
+    Weight,
+    fake_quant_q80,
+    linear,
+    quantize_weight_q40,
+)
 from ..ops.norms import rms_norm, rms_norm_per_head
 from ..parallel.api import constrain
 from ..runtime.kvcache import KVCache, update_layer
@@ -77,8 +83,13 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     """One transformer block. ``x: [B, T, dim]``, caches ``[B, S, n_kv, hd]``."""
     B, T, _ = x.shape
 
+    # Q80 sync-parity: fake-quantize at the reference's cast points — matmul
+    # inputs (X→Q80 casts) and the partial-sum outputs that cross the wire
+    # (ZQ pipe casts, llm.cpp:258-265, 360-365, 433-438).
+    fq = fake_quant_q80 if cfg.sync_q80 else (lambda a: a)
+
     # -- attention half (reference att segment, llm.cpp:226-366) -----------
-    h = rms_norm(x, lp.norm_att, cfg.norm_epsilon)
+    h = fq(rms_norm(x, lp.norm_att, cfg.norm_epsilon))
     q = linear(h, lp.wq).reshape(B, T, cfg.n_heads, cfg.head_dim)
     k = linear(h, lp.wk).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     v = linear(h, lp.wv).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
@@ -96,15 +107,15 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     k_cache, v_cache = update_layer(k_cache, v_cache, k, v, start_pos)
     att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
     att = constrain(att, "batch", None, "heads", None)
-    x = x + linear(att.reshape(B, T, cfg.q_dim), lp.wo)
+    x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo))
     x = constrain(x, "batch", None, None)
 
     # -- ffn half (reference ff segment, llm.cpp:369-439) ------------------
-    h = rms_norm(x, lp.norm_ffn, cfg.norm_epsilon)
+    h = fq(rms_norm(x, lp.norm_ffn, cfg.norm_epsilon))
     gate = _hidden_act(cfg, linear(h, lp.w1))
     up = linear(h, lp.w3)
-    hidden = constrain(gate * up, "batch", None, "hidden")
-    x = x + linear(hidden, lp.w2)
+    hidden = constrain(fq(gate * up), "batch", None, "hidden")
+    x = x + fq(linear(hidden, lp.w2))
     x = constrain(x, "batch", None, None)
     return x, k_cache, v_cache
 
@@ -136,6 +147,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     x, (new_k, new_v) = jax.lax.scan(body, x, (params.layers, kv.k, kv.v))
 
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
+    if cfg.sync_q80:  # final cast before the logits matmul (llm.cpp:445-486)
+        x = fake_quant_q80(x)
     logits = linear(x, params.logits).astype(jnp.float32)
     logits = constrain(logits, "batch", None, "vocab")
     return logits, KVCache(k=new_k, v=new_v)

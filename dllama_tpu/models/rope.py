@@ -68,22 +68,24 @@ def build_rope_cache(cfg: ModelConfig) -> tuple[np.ndarray, np.ndarray]:
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
                positions: jnp.ndarray, rope_type: RopeType) -> jnp.ndarray:
     """Rotate ``x: [B, T, n_heads, head_dim]`` at ``positions: [B, T]``."""
-    c = jnp.asarray(cos)[positions]  # [B, T, half]
+    dtype = x.dtype
+    c = jnp.asarray(cos)[positions]  # [B, T, half] float32
     s = jnp.asarray(sin)[positions]
     c = c[:, :, None, :]  # broadcast over heads
     s = s[:, :, None, :]
+    xf = x.astype(jnp.float32)  # rotate in f32, cast back (parity + no promotion)
     if rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1):
-        x0 = x[..., 0::2]
-        x1 = x[..., 1::2]
+        x0 = xf[..., 0::2]
+        x1 = xf[..., 1::2]
         r0 = x0 * c - x1 * s
         r1 = x0 * s + x1 * c
         # re-interleave: stack on a new trailing axis then flatten
-        return jnp.stack([r0, r1], axis=-1).reshape(x.shape)
+        return jnp.stack([r0, r1], axis=-1).reshape(x.shape).astype(dtype)
     elif rope_type == RopeType.FALCON:
         half = x.shape[-1] // 2
-        x0 = x[..., :half]
-        x1 = x[..., half:]
+        x0 = xf[..., :half]
+        x1 = xf[..., half:]
         r0 = x0 * c - x1 * s
         r1 = x0 * s + x1 * c
-        return jnp.concatenate([r0, r1], axis=-1)
+        return jnp.concatenate([r0, r1], axis=-1).astype(dtype)
     raise ValueError(f"unsupported rope type {rope_type}")

@@ -65,7 +65,6 @@ def quantize_weight_q40(w: np.ndarray) -> QuantizedWeight:
 
 def dequantize_weight(w: QuantizedWeight, dtype=jnp.float32) -> jax.Array:
     """Expand Q40 planes to a dense ``[..., out, in]`` array."""
-    *lead, out, in_ = w.codes.shape
     scales = jnp.repeat(w.scales.astype(dtype), Q40_BLOCK_SIZE, axis=-1)
     return w.codes.astype(dtype) * scales
 

@@ -71,7 +71,9 @@ def param_shardings(plan: MeshPlan, params: "Params") -> "Params":
 
 
 def kv_cache_sharding(plan: MeshPlan, kv: "KVCache") -> "KVCache":
-    """[L, B, S, n_kv, hd] — kv-heads over tp, batch over dp, seq over sp.
+    """[L, B, S, n_kv, hd] — kv-heads over tp, batch over dp; the seq dim
+    stays replicated here (plain attention reads the whole cache — the ring
+    attention path in parallel/ring.py manages its own seq-sharded layout).
 
     When tp > n_kv_heads the kv-head dim is replicated (KV replication
     groups; the reference instead caps nodes at nKvHeads)."""

@@ -1,0 +1,255 @@
+"""InferenceEngine — the root driver, TPU-style.
+
+Replaces the reference's RootLlmInference + NnExecutor + worker control flow
+(reference: src/app.cpp:164-226, nn-executor.cpp:134-187): instead of
+broadcasting a control packet and spin-barrier-stepping an op list on every
+node, the engine holds sharded params + KV cache and dispatches two jitted
+SPMD programs — a chunked prefill (the reference's nBatches positions-as-batch
+micro-batching, app.cpp:28) and a single-token decode step with donated KV
+buffers. Sampling runs on host for reference parity (Sampler semantics,
+tokenizer.cpp:480-510).
+
+Padded prefill tails are safe without masking: pad-position garbage lands in
+KV slots strictly beyond the current position, is invisible to the causal
+mask (``s <= pos``), and every slot is rewritten by its real token's
+``update_layer`` before it ever becomes visible.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..formats.mfile import ModelFile
+from ..formats.quants import F32, Q80
+from ..models.config import ModelConfig
+from ..models.llama import Params, forward, load_params_from_mfile
+from ..parallel.api import MeshPlan, make_tp_mesh, use_plan
+from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
+from ..tokenizer.bpe import Tokenizer
+from ..tokenizer.sampler import Sampler
+from .kvcache import KVCache
+
+DEFAULT_N_BATCHES = 32  # reference default nBatches (app.cpp:28)
+
+
+@dataclass
+class StepMetrics:
+    """Per-token timing, mirroring the reference's console metrics
+    (dllama.cpp:59-67, 88-97). On TPU the eval/sync split lives inside XLA, so
+    the engine reports whole-step wall time; collective time needs the profiler."""
+
+    kind: str  # "eval" (prefill chunk) or "pred" (decode)
+    ms: float
+    n_tokens: int
+
+
+@dataclass
+class GenerationResult:
+    tokens: list[int]
+    text: str
+    prompt_tokens: int
+    steps: list[StepMetrics] = field(default_factory=list)
+
+    @property
+    def eval_ms(self) -> float:
+        return sum(s.ms for s in self.steps if s.kind == "eval")
+
+    @property
+    def pred_ms(self) -> float:
+        return sum(s.ms for s in self.steps if s.kind == "pred")
+
+    @property
+    def pred_tok_per_s(self) -> float:
+        n = sum(s.n_tokens for s in self.steps if s.kind == "pred")
+        return n / (self.pred_ms / 1000.0) if self.pred_ms > 0 else 0.0
+
+    @property
+    def eval_tok_per_s(self) -> float:
+        n = sum(s.n_tokens for s in self.steps if s.kind == "eval")
+        return n / (self.eval_ms / 1000.0) if self.eval_ms > 0 else 0.0
+
+
+class InferenceEngine:
+    """Owns config, params, KV cache, and the jitted step functions."""
+
+    def __init__(self, model_path: str, tokenizer_path: str | None = None, *,
+                 tp: int | None = None, max_seq_len: int = 0,
+                 weight_mode: str = "auto", sync_type: int = F32,
+                 n_batches: int = DEFAULT_N_BATCHES,
+                 temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5):
+        self.model_file = ModelFile.open(model_path, max_seq_len=max_seq_len,
+                                         sync_type=sync_type)
+        self.cfg = ModelConfig.from_header(self.model_file.header)
+        self.n_batches = min(n_batches, self.cfg.seq_len)
+        self.tokenizer = Tokenizer.load(tokenizer_path) if tokenizer_path else None
+        self.sampler = Sampler(self.cfg.vocab_size, temperature, topp, seed)
+
+        n_dev = len(jax.devices())
+        if tp is None:
+            # largest power-of-2 device count the model's shapes accept
+            tp = 1
+            while (tp * 2 <= n_dev and _tp_ok(self.cfg, tp * 2)):
+                tp *= 2
+        self.tp = tp
+        self.plan: MeshPlan | None = make_tp_mesh(tp) if tp > 1 else None
+        if self.plan is not None:
+            validate_tp(self.cfg, tp)
+
+        params = load_params_from_mfile(self.model_file, self.cfg, weight_mode)
+        self.params: Params = (shard_params(self.plan, params)
+                               if self.plan is not None else
+                               jax.device_put(params))
+        self.kv: KVCache = self._fresh_kv()
+        self.pos = 0
+        # donate the KV cache (arg 4) so decode updates it in place
+        self._step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
+
+    def _fresh_kv(self) -> KVCache:
+        kv = KVCache.create(self.cfg)
+        if self.plan is not None:
+            kv = jax.device_put(kv, kv_cache_sharding(self.plan, kv))
+        return kv
+
+    def reset(self) -> None:
+        self.kv = self._fresh_kv()
+        self.pos = 0
+        if self.tokenizer is not None:
+            self.tokenizer.reset_decoder()
+
+    def close(self) -> None:
+        self.model_file.close()
+
+    # -- low-level steps ----------------------------------------------------
+
+    def _forward(self, tokens_2d: np.ndarray, start_pos: int) -> jax.Array:
+        """Run one jitted step; returns logits [1, T, vocab] (device)."""
+        from contextlib import nullcontext
+
+        with (use_plan(self.plan) if self.plan is not None else nullcontext()):
+            logits, self.kv = self._step(
+                self.params, self.cfg, jnp.asarray(tokens_2d, dtype=jnp.int32),
+                jnp.int32(start_pos), self.kv)
+        return logits
+
+    def prefill(self, token_ids: list[int]) -> tuple[np.ndarray, list[StepMetrics]]:
+        """Evaluate the prompt in n_batches-sized chunks; returns logits of the
+        final prompt token and per-chunk metrics. Advances ``self.pos``."""
+        if self.pos + len(token_ids) > self.cfg.seq_len:
+            raise ValueError(
+                f"prompt of {len(token_ids)} tokens at position {self.pos} exceeds "
+                f"seq_len {self.cfg.seq_len}")
+        metrics: list[StepMetrics] = []
+        last_logits = None
+        i = 0
+        n = len(token_ids)
+        while i < n:
+            chunk = token_ids[i:i + self.n_batches]
+            valid = len(chunk)
+            # Never let padding spill past seq_len: dynamic_update_slice would
+            # clamp start_pos and overwrite genuine history. At the context
+            # tail, pad only up to the remaining room (one extra compile max).
+            pad_to = min(self.n_batches, self.cfg.seq_len - self.pos)
+            padded = chunk + [0] * (pad_to - valid)
+            t0 = time.perf_counter()
+            logits = self._forward(np.asarray([padded]), self.pos)
+            logits_np = np.asarray(logits[0, valid - 1])
+            ms = (time.perf_counter() - t0) * 1000.0
+            metrics.append(StepMetrics("eval", ms, valid))
+            last_logits = logits_np
+            self.pos += valid
+            i += valid
+        return last_logits, metrics
+
+    def decode_step(self, token: int) -> np.ndarray:
+        """One-token decode at the current position; returns logits [vocab]."""
+        if self.pos >= self.cfg.seq_len:
+            raise ValueError(f"position {self.pos} reached seq_len {self.cfg.seq_len}")
+        logits = self._forward(np.asarray([[token]]), self.pos)
+        self.pos += 1
+        return np.asarray(logits[0, 0])
+
+    # -- generation ---------------------------------------------------------
+
+    def generate(self, prompt: str | list[int], max_tokens: int,
+                 on_token=None, stop_on_eos: bool = True) -> GenerationResult:
+        """Prefill + sample-decode loop (reference flow: dllama.cpp:13-116).
+
+        ``on_token(token_id, piece)`` streams decoded text; ``max_tokens``
+        caps generated tokens (the cache cap also applies).
+        """
+        if isinstance(prompt, str):
+            assert self.tokenizer is not None, "tokenizer required for str prompts"
+            ids = self.tokenizer.encode(prompt, is_start=self.pos == 0)
+        else:
+            ids = list(prompt)
+        if not ids:
+            raise ValueError("empty prompt")
+
+        steps: list[StepMetrics] = []
+        # evaluate all but the last prompt token; the last one seeds decode
+        if len(ids) > 1:
+            _, m = self.prefill(ids[:-1])
+            steps.extend(m)
+
+        out_tokens: list[int] = []
+        pieces: list[str] = []
+        token = ids[-1]
+        limit = min(self.cfg.seq_len - self.pos, max_tokens)
+        for _ in range(limit):
+            t0 = time.perf_counter()
+            logits = self.decode_step(token)
+            token = self.sampler.sample(logits)
+            steps.append(StepMetrics("pred", (time.perf_counter() - t0) * 1000.0, 1))
+            out_tokens.append(token)
+            piece = self.tokenizer.decode(token) if self.tokenizer else None
+            if piece is not None:
+                pieces.append(piece)
+            if on_token is not None:
+                on_token(token, piece)
+            if stop_on_eos and self.tokenizer is not None and self.tokenizer.is_eos(token):
+                break
+        return GenerationResult(tokens=out_tokens, text="".join(pieces),
+                                prompt_tokens=len(ids), steps=steps)
+
+    def perplexity(self, token_ids: list[int]) -> float:
+        """Perplexity of a token sequence (reference mode: dllama.cpp:132-172):
+        mean negative log-likelihood of each next token given its prefix."""
+        if len(token_ids) < 2:
+            raise ValueError("perplexity needs at least 2 tokens")
+        if len(token_ids) > self.cfg.seq_len:
+            raise ValueError("sequence longer than seq_len")
+        self.reset()
+        nll = 0.0
+        count = 0
+        i = 0
+        while i < len(token_ids) - 1:
+            chunk = token_ids[i:i + self.n_batches]
+            pad_to = min(self.n_batches, self.cfg.seq_len - self.pos)
+            pad = [0] * (pad_to - len(chunk))
+            logits = self._forward(np.asarray([chunk + pad]), self.pos)
+            logits_np = np.asarray(logits[0, :len(chunk)], dtype=np.float64)
+            for j in range(len(chunk)):
+                nxt = i + j + 1
+                if nxt >= len(token_ids):
+                    break
+                row = logits_np[j]
+                row = row - row.max()
+                logp = row[token_ids[nxt]] - np.log(np.exp(row).sum())
+                nll -= logp
+                count += 1
+            self.pos += len(chunk)
+            i += len(chunk)
+        return float(np.exp(nll / count))
+
+
+def _tp_ok(cfg: ModelConfig, tp: int) -> bool:
+    try:
+        validate_tp(cfg, tp)
+        return True
+    except ValueError:
+        return False

@@ -12,12 +12,13 @@ the reference's per-node head shards.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import TYPE_CHECKING, NamedTuple
 
 import jax
 import jax.numpy as jnp
 
-from ..models.config import ModelConfig
+if TYPE_CHECKING:  # avoid a runtime cycle: models.llama imports this module
+    from ..models.config import ModelConfig
 
 
 class KVCache(NamedTuple):
@@ -25,7 +26,7 @@ class KVCache(NamedTuple):
     v: jax.Array
 
     @classmethod
-    def create(cls, cfg: ModelConfig, batch_size: int = 1,
+    def create(cls, cfg: "ModelConfig", batch_size: int = 1,
                dtype=jnp.float32) -> "KVCache":
         shape = (cfg.n_layers, batch_size, cfg.seq_len, cfg.n_kv_heads, cfg.head_dim)
         return cls(k=jnp.zeros(shape, dtype=dtype), v=jnp.zeros(shape, dtype=dtype))

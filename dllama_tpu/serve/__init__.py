@@ -1,0 +1,1 @@
+"""Serving surface: CLI (inference/chat/perplexity) and the HTTP API server."""

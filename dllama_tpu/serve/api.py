@@ -1,0 +1,267 @@
+"""OpenAI-compatible HTTP API server.
+
+Endpoint-compatible with the reference server (reference: src/dllama-api.cpp):
+
+* ``POST /v1/chat/completions`` — messages → completion, optional SSE
+  streaming (``"stream": true``), ``temperature``/``top_p``/``seed``/
+  ``max_tokens`` per request (dllama-api.cpp:341-361);
+* ``GET /v1/models`` — single-model listing (dllama-api.cpp:523-532);
+* the **NaiveCache**: KV reuse keyed on message-history prefix — a repeated
+  conversation continues from its cached position instead of re-prefilling
+  (dllama-api.cpp:294-339).
+
+Built on http.server (stdlib) rather than hand-parsed sockets; single-threaded
+by design — the engine serializes on one accelerator anyway, matching the
+reference's accept loop.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from ..runtime.engine import InferenceEngine
+from ..tokenizer.chat import ChatItem, ChatTemplateGenerator, EosDetector, EosResult
+
+
+@dataclass
+class CachedMessage:
+    role: str
+    content: str
+    end_pos: int
+
+
+@dataclass
+class NaiveCache:
+    """Message-prefix KV cache (reference: NaiveCache, dllama-api.cpp:294-339)."""
+
+    items: list[CachedMessage] = field(default_factory=list)
+
+    def resolve_delta(self, messages: list[dict]) -> tuple[list[dict], int]:
+        """If ``messages`` strictly extends the cached history, return the new
+        suffix plus the cached end position; else clear and return all."""
+        n = len(self.items)
+        if n and len(messages) > n:
+            for i, item in enumerate(self.items):
+                m = messages[i]
+                if item.role != m.get("role") or item.content != m.get("content"):
+                    break
+            else:
+                return messages[n:], self.items[n - 1].end_pos
+        self.items.clear()
+        return messages, 0
+
+    def push(self, messages: list[dict], end_pos: int) -> None:
+        for m in messages:
+            self.items.append(CachedMessage(m.get("role", ""), m.get("content", ""),
+                                            end_pos))
+
+
+class ApiState:
+    """Engine + chat plumbing shared across requests."""
+
+    def __init__(self, engine: InferenceEngine, model_name: str = "dllama-tpu"):
+        self.engine = engine
+        self.model_name = model_name
+        tok = engine.tokenizer
+        eos_piece = (tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
+                     if tok.eos_token_ids else "")
+        self.template = ChatTemplateGenerator(tok.chat_template, eos=eos_piece)
+        self.stop_pieces = [tok.vocab[t].decode("utf-8", "replace")
+                            for t in tok.eos_token_ids]
+        self.cache = NaiveCache()
+
+    def complete(self, body: dict, emit=None) -> dict:
+        """Run one chat completion; ``emit(text)`` streams deltas when set.
+
+        Flow mirrors ApiServer::complete (dllama-api.cpp:363-484): resolve the
+        delta prompt against the cache, template + encode, chunked prefill,
+        then sample/decode with the EosDetector gating emitted text.
+        """
+        engine = self.engine
+        tok = engine.tokenizer
+        messages = body.get("messages", [])
+        if not messages:
+            raise ValueError("messages required")
+        if "temperature" in body:
+            engine.sampler.set_temp(float(body["temperature"]))
+        if "seed" in body:
+            engine.sampler.set_seed(int(body["seed"]))
+        if "top_p" in body:
+            engine.sampler.topp = float(body["top_p"])
+        max_tokens = int(body.get("max_tokens") or 0)
+
+        delta, start_pos = self.cache.resolve_delta(messages)
+        if start_pos == 0:
+            engine.reset()
+        else:
+            engine.pos = start_pos
+
+        items = [ChatItem(m.get("role", "user"), m.get("content", "")) for m in delta]
+        prompt = self.template.generate(items, append_generation_prompt=True)
+        ids = tok.encode(prompt.content, is_start=start_pos == 0,
+                         add_special_tokens=True)
+
+        prompt_end = min(start_pos + len(ids) - 1, engine.cfg.seq_len)
+        max_pred = min(engine.cfg.seq_len,
+                       prompt_end + max_tokens if max_tokens > 0 else engine.cfg.seq_len)
+        self.cache.push(delta, prompt_end)
+
+        text_parts: list[str] = []
+        if prompt.public_prompt:
+            text_parts.append(prompt.public_prompt)
+            if emit:
+                emit(prompt.public_prompt)
+
+        if len(ids) > 1:
+            engine.prefill(ids[: prompt_end - start_pos])
+        token = ids[prompt_end - start_pos] if prompt_end - start_pos < len(ids) else ids[-1]
+        tok.reset_decoder()
+        detector = EosDetector(tok.eos_token_ids, self.stop_pieces,
+                               max((len(s) for s in self.stop_pieces), default=0),
+                               max((len(s) for s in self.stop_pieces), default=0))
+
+        n_completion = 0
+        finish_reason = "length"
+        while engine.pos < max_pred:
+            logits = engine.decode_step(token)
+            token = engine.sampler.sample(logits)
+            n_completion += 1
+            piece = tok.decode(token)
+            res = detector.append(token, piece)
+            if res in (EosResult.NOT_EOS, EosResult.EOS):
+                d = detector.get_delta()
+                if d:
+                    text_parts.append(d)
+                    if emit:
+                        emit(d)
+                detector.reset()
+            if res == EosResult.EOS:
+                finish_reason = "stop"
+                break
+
+        self.cache.push([{"role": "assistant", "content": "".join(text_parts)}],
+                        engine.pos)
+        return {
+            "text": "".join(text_parts),
+            "finish_reason": finish_reason,
+            "prompt_tokens": len(ids),
+            "completion_tokens": n_completion,
+        }
+
+
+def _completion_json(state: ApiState, out: dict) -> dict:
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": state.model_name,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": out["text"]},
+            "finish_reason": out["finish_reason"],
+        }],
+        "usage": {
+            "prompt_tokens": out["prompt_tokens"],
+            "completion_tokens": out["completion_tokens"],
+            "total_tokens": out["prompt_tokens"] + out["completion_tokens"],
+        },
+    }
+
+
+def _chunk_json(state: ApiState, delta: dict, finish_reason=None) -> dict:
+    return {
+        "id": "chatcmpl-stream",
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": state.model_name,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+    }
+
+
+def make_handler(state: ApiState):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quieter default logging
+            print(f"🕸️ {self.address_string()} {fmt % args}")
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/v1/models":
+                self._json(200, {"object": "list", "data": [{
+                    "id": state.model_name, "object": "model",
+                    "created": int(time.time()), "owned_by": "dllama_tpu",
+                }]})
+            elif self.path in ("/health", "/healthz"):
+                self._json(200, {"status": "ok"})
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path not in ("/v1/chat/completions",):
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                self._json(400, {"error": "invalid JSON body"})
+                return
+            stream = bool(body.get("stream", False))
+            try:
+                if stream:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+
+                    def emit(text: str) -> None:
+                        chunk = _chunk_json(state, {"content": text})
+                        self.wfile.write(
+                            b"data: " + json.dumps(chunk).encode("utf-8") + b"\n\n")
+                        self.wfile.flush()
+
+                    out = state.complete(body, emit=emit)
+                    final = _chunk_json(state, {}, out["finish_reason"])
+                    self.wfile.write(
+                        b"data: " + json.dumps(final).encode("utf-8") + b"\n\n")
+                    self.wfile.write(b"data: [DONE]\n\n")
+                else:
+                    out = state.complete(body)
+                    self._json(200, _completion_json(state, out))
+            except ValueError as e:
+                if not stream:
+                    self._json(400, {"error": str(e)})
+                else:
+                    raise
+
+    return Handler
+
+
+def run_api_server(args) -> int:
+    from .cli import make_engine
+
+    engine = make_engine(args)
+    state = ApiState(engine)
+    server = HTTPServer((args.host, args.port), make_handler(state))
+    print(f"🕸️ listening on http://{args.host}:{args.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        engine.close()
+    return 0

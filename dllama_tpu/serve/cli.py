@@ -1,0 +1,227 @@
+"""CLI — the dllama equivalent.
+
+Modes and flags mirror the reference CLI (reference: src/dllama.cpp:260-285,
+arg parsing src/app.cpp:24-131) where they are meaningful on TPU:
+
+    python -m dllama_tpu inference  --model m.m --tokenizer t.t --prompt "..." --steps 64
+    python -m dllama_tpu chat       --model m.m --tokenizer t.t
+    python -m dllama_tpu perplexity --model m.m --tokenizer t.t --file text.txt
+    python -m dllama_tpu api        --model m.m --tokenizer t.t --port 9990
+
+Reference flags that are executor/network specifics (--nthreads, --workers,
+--net-turbo, --gpu-index, --gpu-segments) are accepted-and-ignored or replaced
+by ``--tp`` (device count; the reference's nNodes) — the TPU runtime has no
+worker processes to address. ``worker`` mode exists for multi-host launches
+via ``jax.distributed`` (one process per host, same program — replaces
+runWorkerApp, app.cpp:299-358).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..formats.quants import F32, Q80
+from ..runtime.engine import DEFAULT_N_BATCHES, InferenceEngine
+from ..tokenizer.chat import ChatItem, ChatTemplateGenerator, EosDetector, EosResult
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dllama_tpu",
+                                description="TPU-native distributed-llama")
+    p.add_argument("mode", choices=["inference", "chat", "perplexity", "api", "worker"])
+    p.add_argument("--model", required=False, help=".m model file")
+    p.add_argument("--tokenizer", required=False, help=".t tokenizer file")
+    p.add_argument("--prompt", default=None)
+    p.add_argument("--file", default=None, help="text file (perplexity mode)")
+    p.add_argument("--steps", type=int, default=0, help="max total positions")
+    p.add_argument("--max-seq-len", type=int, default=0)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--buffer-float-type", choices=["f32", "q80"], default="q80",
+                   help="activation sync quantization parity mode")
+    p.add_argument("--weight-mode", choices=["auto", "f32", "bf16"], default="auto")
+    p.add_argument("--nbatches", type=int, default=DEFAULT_N_BATCHES)
+    p.add_argument("--tp", type=int, default=None,
+                   help="tensor-parallel device count (reference: number of nodes)")
+    p.add_argument("--port", type=int, default=9990, help="api mode port")
+    p.add_argument("--host", default="127.0.0.1", help="api mode bind host")
+    # accepted for reference-flag compatibility; no-ops on TPU:
+    p.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
+    p.add_argument("--workers", nargs="*", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--net-turbo", type=int, default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def make_engine(args) -> InferenceEngine:
+    if not args.model or not args.tokenizer:
+        raise SystemExit("--model and --tokenizer are required")
+    seed = args.seed if args.seed is not None else int(time.time())
+    engine = InferenceEngine(
+        args.model, args.tokenizer,
+        tp=args.tp, max_seq_len=args.max_seq_len, weight_mode=args.weight_mode,
+        sync_type=Q80 if args.buffer_float_type == "q80" else F32,
+        n_batches=args.nbatches,
+        temperature=args.temperature, topp=args.topp, seed=seed,
+    )
+    h = engine.model_file.header
+    print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "
+          f"Heads: {h.n_heads}/{h.n_kv_heads}  SeqLen: {h.seq_len}")
+    print(f"🕸️ TP devices: {engine.tp}")
+    return engine
+
+
+def run_inference(args) -> int:
+    if args.prompt is None:
+        raise SystemExit("Prompt is required")
+    if args.steps == 0:
+        raise SystemExit("Number of steps is required")
+    engine = make_engine(args)
+    print(args.prompt)
+    ids = engine.tokenizer.encode(args.prompt)
+    max_new = max(0, min(args.steps, engine.cfg.seq_len) - len(ids))
+
+    def on_token(tid, piece):
+        sys.stdout.write(piece if piece is not None else "")
+        sys.stdout.flush()
+
+    result = engine.generate(ids, max_new, on_token=on_token, stop_on_eos=False)
+    print()
+    n_eval = sum(s.n_tokens for s in result.steps if s.kind == "eval")
+    n_pred = sum(s.n_tokens for s in result.steps if s.kind == "pred")
+    print("\nEvaluation")
+    print(f"   nBatches: {args.nbatches}")
+    print(f"    nTokens: {n_eval}")
+    print(f"   tokens/s: {result.eval_tok_per_s:.2f} "
+          f"({result.eval_ms / max(1, n_eval):.2f} ms/tok)")
+    print("Prediction")
+    print(f"    nTokens: {n_pred}")
+    print(f"   tokens/s: {result.pred_tok_per_s:.2f} "
+          f"({result.pred_ms / max(1, n_pred):.2f} ms/tok)")
+    engine.close()
+    return 0
+
+
+def run_chat(args) -> int:
+    """Interactive chat REPL (reference: dllama.cpp:174-258)."""
+    engine = make_engine(args)
+    tok = engine.tokenizer
+    eos_piece = (tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
+                 if tok.eos_token_ids else "")
+    template = ChatTemplateGenerator(tok.chat_template, eos=eos_piece)
+    stop_pieces = [tok.vocab[t].decode("utf-8", "replace") for t in tok.eos_token_ids]
+    max_stop = max((len(s) for s in stop_pieces), default=0)
+    detector = EosDetector(tok.eos_token_ids, stop_pieces, max_stop, max_stop)
+
+    first = True
+    while True:
+        try:
+            user = input("\n💻 > " if first else "\n💻 > ")
+        except EOFError:
+            break
+        if not user.strip():
+            continue
+        items = [ChatItem("user", user)]
+        chat = template.generate(items, append_generation_prompt=True)
+        ids = tok.encode(chat.content, is_start=first, add_special_tokens=True)
+        first = False
+        if engine.pos + len(ids) >= engine.cfg.seq_len:
+            print("🚧 context is full (seq_len reached), stopping")
+            break
+        if chat.public_prompt:
+            sys.stdout.write(chat.public_prompt)
+        sys.stdout.write("\n🤖 ")
+        sys.stdout.flush()
+
+        _, _ = engine.prefill(ids[:-1]) if len(ids) > 1 else (None, [])
+        token = ids[-1]
+        detector.reset()
+        tok.reset_decoder()
+        while engine.pos < engine.cfg.seq_len:
+            logits = engine.decode_step(token)
+            token = engine.sampler.sample(logits)
+            piece = tok.decode(token)
+            res = detector.append(token, piece)
+            if res == EosResult.NOT_EOS:
+                delta = detector.get_delta()
+                if delta:
+                    sys.stdout.write(delta)
+                    sys.stdout.flush()
+                detector.reset()
+            elif res == EosResult.EOS:
+                delta = detector.get_delta()
+                if delta:
+                    sys.stdout.write(delta)
+                    sys.stdout.flush()
+                break
+        # flush anything still buffered as MAYBE_EOS when the loop exits on
+        # the seq_len bound rather than a stop match
+        tail = detector.get_delta()
+        if tail and engine.pos >= engine.cfg.seq_len:
+            sys.stdout.write(tail)
+            sys.stdout.flush()
+        print()
+    engine.close()
+    return 0
+
+
+def run_perplexity(args) -> int:
+    engine = make_engine(args)
+    if args.file:
+        text = open(args.file, encoding="utf-8").read()
+    elif args.prompt is not None:
+        text = args.prompt
+    else:
+        raise SystemExit("--file or --prompt required for perplexity")
+    ids = engine.tokenizer.encode(text)
+    if args.max_seq_len:
+        ids = ids[: args.max_seq_len]
+    ids = ids[: engine.cfg.seq_len]
+    t0 = time.perf_counter()
+    ppl = engine.perplexity(ids)
+    dt = time.perf_counter() - t0
+    print(f"📊 nTokens: {len(ids)}")
+    print(f"📊 Perplexity: {ppl:.4f}")
+    print(f"📊 Time: {dt:.2f}s ({len(ids) / dt:.1f} tok/s)")
+    engine.close()
+    return 0
+
+
+def run_worker(args) -> int:
+    """Multi-host worker: join the jax.distributed cluster and idle.
+
+    On TPU pods every host runs the SAME program (SPMD); there is no separate
+    worker graph to receive over a wire (the reference's config/weight wire
+    protocol, nn-network.cpp:621-901, is replaced by each host loading its own
+    shard). This entry point exists so launch tooling has a uniform command.
+    """
+    import jax
+
+    jax.distributed.initialize()
+    print(f"⭕ worker: process {jax.process_index()} of {jax.process_count()}, "
+          f"{jax.local_device_count()} local devices")
+    print("⭕ worker idle — run the root program on process 0")
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.mode == "inference":
+        return run_inference(args)
+    if args.mode == "chat":
+        return run_chat(args)
+    if args.mode == "perplexity":
+        return run_perplexity(args)
+    if args.mode == "api":
+        from .api import run_api_server
+
+        return run_api_server(args)
+    if args.mode == "worker":
+        return run_worker(args)
+    raise SystemExit(f"unknown mode {args.mode}")

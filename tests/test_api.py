@@ -1,0 +1,115 @@
+"""HTTP API server tests: /v1/models, /v1/chat/completions (plain + SSE),
+NaiveCache prefix reuse, error paths (reference: dllama-api.cpp)."""
+
+import json
+import threading
+import urllib.request
+from http.server import HTTPServer
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.serve.api import ApiState, make_handler
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    d = tmp_path_factory.mktemp("api")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(9)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    td = byte_vocab_tokenizer()
+    td.chat_template = "<|start_header_id|>"  # detected as llama3
+    tfile.write_tfile(tpath, td)
+    engine = InferenceEngine(str(mpath), str(tpath), temperature=0.0, seed=3)
+    state = ApiState(engine)
+    httpd = HTTPServer(("127.0.0.1", 0), make_handler(state))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}", state
+    httpd.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url + "/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def test_models_endpoint(server):
+    url, _ = server
+    with urllib.request.urlopen(url + "/v1/models", timeout=30) as r:
+        data = json.loads(r.read())
+    assert data["object"] == "list"
+    assert data["data"][0]["id"] == "dllama-tpu"
+
+
+def test_chat_completion(server):
+    url, _ = server
+    with _post(url, {"messages": [{"role": "user", "content": "hello"}],
+                     "max_tokens": 6, "temperature": 0}) as r:
+        data = json.loads(r.read())
+    assert data["object"] == "chat.completion"
+    choice = data["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert data["usage"]["completion_tokens"] >= 1
+    assert data["usage"]["total_tokens"] == (
+        data["usage"]["prompt_tokens"] + data["usage"]["completion_tokens"])
+
+
+def test_chat_completion_sse_stream(server):
+    url, _ = server
+    with _post(url, {"messages": [{"role": "user", "content": "hello"}],
+                     "max_tokens": 5, "temperature": 0, "stream": True}) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = r.read().decode()
+    events = [line[6:] for line in raw.split("\n") if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    parsed = [json.loads(e) for e in events[:-1]]
+    assert all(p["object"] == "chat.completion.chunk" for p in parsed)
+    assert parsed[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_naive_cache_prefix_reuse(server):
+    url, state = server
+    convo = [{"role": "user", "content": "hi"}]
+    with _post(url, {"messages": convo, "max_tokens": 4, "temperature": 0}) as r:
+        first = json.loads(r.read())
+    cached_items = len(state.cache.items)
+    assert cached_items >= 1
+    convo2 = convo + [{"role": "assistant",
+                       "content": first["choices"][0]["message"]["content"]},
+                      {"role": "user", "content": "again"}]
+    delta, start = state.cache.resolve_delta(convo2)
+    assert start > 0
+    assert len(delta) < len(convo2)
+
+
+def test_bad_json_body(server):
+    url, _ = server
+    req = urllib.request.Request(url + "/v1/chat/completions", data=b"{not json",
+                                 headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400
+
+
+def test_missing_messages(server):
+    url, _ = server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, {"max_tokens": 3})
+    assert e.value.code == 400
+
+
+def test_unknown_route(server):
+    url, _ = server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(url + "/nope", timeout=30)
+    assert e.value.code == 404
