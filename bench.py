@@ -51,12 +51,13 @@ def _fast_random_params(cfg: ModelConfig):
     rng = np.random.default_rng(0)
 
     def qw(out, in_):
+        # K-major planes (see ops.linear.QuantizedWeight)
         return QuantizedWeight(
             scales=jnp.asarray(
-                (rng.random((cfg.n_layers, out, in_ // 32), dtype=np.float32)
-                 * 0.01 + 0.001).astype(np.float16)),
+                rng.random((cfg.n_layers, in_ // 32, out), dtype=np.float32)
+                * 0.01 + 0.001),
             codes=jnp.asarray(
-                rng.integers(-8, 8, (cfg.n_layers, out, in_), dtype=np.int8)),
+                rng.integers(-8, 8, (cfg.n_layers, in_, out), dtype=np.int8)),
         )
 
     ones = lambda *s: jnp.asarray(np.ones(s, dtype=np.float32))
@@ -69,9 +70,9 @@ def _fast_random_params(cfg: ModelConfig):
         norm_q=None, norm_k=None,
     )
     lw = QuantizedWeight(
-        scales=jnp.asarray((rng.random((cfg.vocab_size, cfg.dim // 32),
-                                       dtype=np.float32) * 0.01).astype(np.float16)),
-        codes=jnp.asarray(rng.integers(-8, 8, (cfg.vocab_size, cfg.dim),
+        scales=jnp.asarray(rng.random((cfg.dim // 32, cfg.vocab_size),
+                                      dtype=np.float32) * 0.01),
+        codes=jnp.asarray(rng.integers(-8, 8, (cfg.dim, cfg.vocab_size),
                                        dtype=np.int8)))
     emb = rng.random((cfg.vocab_size, cfg.dim), dtype=np.float32) * 0.02
     return Params(embedding=jnp.asarray(emb), layers=layers,

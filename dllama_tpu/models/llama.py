@@ -185,7 +185,10 @@ def load_params_from_mfile(mf: ModelFile, cfg: ModelConfig,
     def matmul_weight(key: str) -> Weight:
         if quantized:
             scales, codes = mf.tensor_q40_planes(key)
-            return QuantizedWeight(scales=jnp.asarray(scales), codes=jnp.asarray(codes))
+            # disk layout is out-major; device layout is K-major (QuantizedWeight)
+            return QuantizedWeight(
+                scales=jnp.asarray(scales.T.astype(np.float32)),
+                codes=jnp.asarray(np.ascontiguousarray(codes.T)))
         return jnp.asarray(mf.tensor_f32(key), dtype=dense_dtype)
 
     def f32(key: str) -> jax.Array:

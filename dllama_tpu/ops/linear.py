@@ -16,6 +16,7 @@ collectives move bf16/f32.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Union
 
 import jax
@@ -26,13 +27,18 @@ from ..formats.quants import Q40_BLOCK_SIZE, Q80_BLOCK_SIZE
 
 
 class QuantizedWeight(NamedTuple):
-    """Q40 weight as TPU-friendly planes.
+    """Q40 weight as TPU-friendly planes, K-major.
 
-    ``scales``: float16 ``[out, in // 32]`` block scales.
-    ``codes``: int8 ``[out, in]`` centered 4-bit codes in [-8, 7].
+    ``scales``: float32 ``[in // 32, out]`` block scales (f16 on disk; stored
+    f32 on device because narrow f16 blocks don't lower on the TPU Mosaic
+    toolchain — costs 0.125 B/weight next to the 1 B/weight codes).
+    ``codes``: int8 ``[in, out]`` centered 4-bit codes in [-8, 7].
 
-    Logical value: ``w[o, i] = codes[o, i] * scales[o, i // 32]``
-    (reference block layout: NnBlockQ40, src/nn/nn-quants.hpp:64-67).
+    Logical value: ``w[o, i] = codes[i, o] * scales[i // 32, o]``
+    (reference block layout: NnBlockQ40, src/nn/nn-quants.hpp:64-67; the
+    on-disk layout is out-major and gets transposed once at load). K-major
+    means ``y = x @ codes``-style dots feed the MXU with no transpose, and
+    every Pallas block spec indexes both planes natively.
     """
 
     scales: jax.Array
@@ -40,11 +46,11 @@ class QuantizedWeight(NamedTuple):
 
     @property
     def out_features(self) -> int:
-        return self.codes.shape[-2]
+        return self.codes.shape[-1]
 
     @property
     def in_features(self) -> int:
-        return self.codes.shape[-1]
+        return self.codes.shape[-2]
 
 
 Weight = Union[jax.Array, QuantizedWeight]
@@ -58,31 +64,68 @@ def quantize_weight_q40(w: np.ndarray) -> QuantizedWeight:
     buf = quantize_q40(np.ascontiguousarray(w, dtype=np.float32).reshape(-1))
     scales, codes = unpack_q40(buf, out * in_)
     return QuantizedWeight(
-        scales=jnp.asarray(scales.reshape(out, in_ // Q40_BLOCK_SIZE)),
-        codes=jnp.asarray(codes.reshape(out, in_)),
+        scales=jnp.asarray(
+            scales.reshape(out, in_ // Q40_BLOCK_SIZE).T.astype(np.float32)),
+        codes=jnp.asarray(np.ascontiguousarray(codes.reshape(out, in_).T)),
     )
 
 
 def dequantize_weight(w: QuantizedWeight, dtype=jnp.float32) -> jax.Array:
-    """Expand Q40 planes to a dense ``[..., out, in]`` array."""
-    scales = jnp.repeat(w.scales.astype(dtype), Q40_BLOCK_SIZE, axis=-1)
+    """Expand Q40 planes to a dense K-major ``[..., in, out]`` array."""
+    scales = jnp.repeat(w.scales.astype(dtype), Q40_BLOCK_SIZE, axis=-2)
     return w.codes.astype(dtype) * scales
+
+
+def _on_tpu() -> bool:
+    """True when the default backend drives TPU hardware (the platform may be
+    named "tpu" or a plugin name like "axon"; device_kind says what it is)."""
+    devices = jax.devices()
+    return bool(devices) and "tpu" in devices[0].device_kind.lower()
+
+
+def _pallas_wanted(x: jax.Array, w: QuantizedWeight) -> bool:
+    # read per call so tests/debug sessions can flip it after import
+    mode = os.environ.get("DLLAMA_TPU_QUANT_KERNEL", "auto")  # auto|pallas|xla
+    if mode == "xla":
+        return False
+    from .quant_matmul import supports
+
+    ok = supports(tuple(x.shape), w)
+    if mode == "pallas":
+        return ok
+    # auto: TPU only (the kernel uses pltpu memory spaces; CPU interpret is
+    # slow and GPU can't lower it), and only single-device for now — a
+    # pallas_call inside a GSPMD-partitioned graph needs a shard_map wrapper
+    # (planned; until then TP runs use the XLA dequant+dot path).
+    from ..parallel.api import current_plan
+
+    return ok and _on_tpu() and current_plan() is None
 
 
 def linear(x: jax.Array, w: Weight) -> jax.Array:
     """``y[..., out] = x[..., in] @ w.T`` with dense or Q40 weight.
 
-    Weights use the reference's on-disk ``[out, in]`` orientation (row-major,
-    llm.cpp matmul weights), so TP row/col split semantics stay auditable:
-    row-split = shard ``out``, col-split = shard ``in``.
+    Dense weights use the reference's on-disk ``[out, in]`` orientation
+    (row-major, llm.cpp matmul weights); Q40 planes are K-major ``[in, out]``
+    (see QuantizedWeight). TP row/col split semantics stay auditable either
+    way: row-split = shard ``out``, col-split = shard ``in``. Q40 weights
+    dispatch to the Pallas kernel on TPU (override with
+    DLLAMA_TPU_QUANT_KERNEL=auto|pallas|xla); sharded cases and odd shapes
+    fall back to XLA dequant+dot with identical f32 dequant values.
     """
     if isinstance(w, QuantizedWeight):
+        if _pallas_wanted(x, w):
+            from .quant_matmul import quant_matmul
+
+            return quant_matmul(x, w)
         wd = dequantize_weight(w, dtype=x.dtype)
+        contract = wd.ndim - 2  # K-major: contract the `in` axis
     else:
         wd = w.astype(x.dtype)
+        contract = wd.ndim - 1
     return jax.lax.dot_general(
         x, wd,
-        dimension_numbers=(((x.ndim - 1,), (wd.ndim - 1,)), ((), ())),
+        dimension_numbers=(((x.ndim - 1,), (contract,)), ((), ())),
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)
 

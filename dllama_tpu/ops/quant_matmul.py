@@ -1,0 +1,150 @@
+"""Pallas TPU kernel: Q40 weight-dequantizing matmul.
+
+The TPU replacement for the reference's Q80×Q40 integer-dot kernels
+(reference: matmul_Q80_Q40_F32, src/nn/nn-cpu-ops.cpp:229-447, and the
+llamafile sgemm prefill path, SURVEY.md §2 #7): weights stream from HBM in
+their K-major plane layout (int8 codes ``[K, N]`` + f32 scales ``[K/32, N]``)
+and are dequantized in VMEM right before hitting the MXU — the dense weight
+never exists in HBM, so the matmul moves ~3.5× fewer bytes than a dense-f32
+weight would.
+
+Kernel shape: ``y[M, N] = x[M, K] @ dequant(codes, scales)``
+
+Grid ``(N // BN, K // BK)``; each step:
+
+1. expands the step's scale block to ``[BK, BN]`` via a tiny MXU matmul with a
+   constant 0/1 sublane-expansion matrix ``E[BK, BK/32]`` (this Mosaic
+   toolchain rejects reshape-broadcast and ``jnp.repeat`` lowerings, and
+   ``pltpu.repeat`` has tile-repeat — not element-repeat — semantics);
+2. dequantizes codes on the VPU (``codes * sexp``);
+3. accumulates ``x_blk @ wd`` into the revisited f32 output tile.
+
+Both dots run at ``Precision.HIGHEST`` — measured ~2e-5 absolute error vs the
+exact host oracle on real hardware (default MXU precision loses ~3e-3).
+K-major layout is what makes every operand block-indexable: the out-major
+layout needed narrow f16/f32 scale blocks or in-kernel dynamic slices, both
+of which this Mosaic build refuses to lower.
+
+Falls back to the XLA dequant+dot path (ops.linear) when shapes don't fit the
+tile grid; parity is tested in tests/test_quant_matmul.py the way
+nn-vulkan-test.cpp checks GPU ops against the CPU reference.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..formats.quants import Q40_BLOCK_SIZE
+from .linear import QuantizedWeight
+
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+
+def _kernel(x_ref, codes_ref, scales_ref, expand_ref, out_ref):
+    """One (n, k) grid step: out[M, BN] += x[M, BK] @ dequant(W[BK, BN])."""
+    k = pl.program_id(1)
+
+    # element-repeat each scale 32× along K (sublanes) as a 0/1 matmul
+    sexp = jax.lax.dot_general(
+        expand_ref[:], scales_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=_HIGHEST)
+    wd = codes_ref[:].astype(jnp.float32) * sexp
+
+    partial = jax.lax.dot_general(
+        x_ref[:], wd,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=_HIGHEST)
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[:] = partial
+
+    @pl.when(k != 0)
+    def _():
+        out_ref[:] += partial
+
+
+def _pick_block(dim: int, candidates: tuple[int, ...], min_align: int) -> int | None:
+    """A 128-aligned block dividing ``dim``, or the whole dim (Mosaic allows a
+    block equal to the array extent) when it at least meets ``min_align``."""
+    for c in candidates:
+        if dim % c == 0:
+            return c
+    if dim % min_align == 0:
+        return dim
+    return None
+
+
+@functools.lru_cache(maxsize=8)
+def _expansion_matrix(bk: int) -> np.ndarray:
+    """0/1 matrix ``E[bk, bk/32]`` with ``E[32i:32(i+1), i] = 1``.
+
+    Returns numpy (not jnp): this is called during traces, where caching a
+    jnp constant would leak a tracer."""
+    return np.kron(np.eye(bk // Q40_BLOCK_SIZE, dtype=np.float32),
+                   np.ones((Q40_BLOCK_SIZE, 1), np.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quant_matmul(x: jax.Array, w: QuantizedWeight, *, interpret: bool = False) -> jax.Array:
+    """``y[..., N] = x[..., K] @ dequant(w)`` via the Pallas kernel.
+
+    ``x`` is cast to f32 for the dequantized dot (parity with the XLA path);
+    leading dims flatten into M.
+    """
+    *lead, K = x.shape
+    N = w.out_features
+    M = 1
+    for d in lead:
+        M *= d
+
+    bn = _pick_block(N, (512, 256, 128), min_align=8)
+    bk = _pick_block(K, (512, 256, 128), min_align=Q40_BLOCK_SIZE)
+    if bn is None or bk is None:
+        raise ValueError(f"shapes N={N}, K={K} do not fit the tile grid")
+
+    xf = x.reshape(M, K).astype(jnp.float32)
+    grid = (N // bn, K // bk)
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((M, bk), lambda n, k: (0, k), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, bn), lambda n, k: (k, n), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk // Q40_BLOCK_SIZE, bn), lambda n, k: (k, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, bk // Q40_BLOCK_SIZE), lambda n, k: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((M, bn), lambda n, k: (0, n), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
+        interpret=interpret,
+    )(xf, w.codes, w.scales.astype(jnp.float32), _expansion_matrix(bk))
+
+    return out.reshape(*lead, N).astype(x.dtype)
+
+
+# Largest M the un-tiled batch axis may take: x block + out block + dequant
+# scratch must fit VMEM (~16MB) alongside double-buffered weight tiles.
+MAX_M = 512
+
+
+def supports(x_shape: tuple[int, ...], w: QuantizedWeight) -> bool:
+    """Whether the kernel's tile grid covers these shapes."""
+    K = x_shape[-1]
+    M = 1
+    for d in x_shape[:-1]:
+        M *= d
+    return (w.codes.ndim == 2
+            and w.in_features == K
+            and M <= MAX_M
+            and _pick_block(w.out_features, (512, 256, 128), min_align=8) is not None
+            and _pick_block(K, (512, 256, 128), min_align=Q40_BLOCK_SIZE) is not None)

@@ -34,12 +34,13 @@ if TYPE_CHECKING:  # imported lazily at runtime (models imports parallel.api)
 
 def _weight_sharding(plan: MeshPlan, w, out_axis: str | None, in_axis: str | None,
                      stacked: bool):
-    """Sharding for one matmul weight ([L?, out, in] dense or Q40 planes)."""
+    """Sharding for one matmul weight: dense ``[L?, out, in]`` or K-major Q40
+    planes ``[L?, in, out]`` / ``[L?, in/32, out]``."""
     lead = (None,) if stacked else ()
     if isinstance(w, QuantizedWeight):
         return QuantizedWeight(
-            scales=plan.sharding_for(tuple(w.scales.shape), *lead, out_axis, in_axis),
-            codes=plan.sharding_for(tuple(w.codes.shape), *lead, out_axis, in_axis),
+            scales=plan.sharding_for(tuple(w.scales.shape), *lead, in_axis, out_axis),
+            codes=plan.sharding_for(tuple(w.codes.shape), *lead, in_axis, out_axis),
         )
     return plan.sharding_for(tuple(w.shape), *lead, out_axis, in_axis)
 

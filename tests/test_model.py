@@ -87,7 +87,7 @@ def _dense_from_params(params, cfg):
         if isinstance(w, QuantizedWeight):
             w = dequantize_weight(QuantizedWeight(w.scales[l], w.codes[l])) if l is not None \
                 else dequantize_weight(w)
-            return np.asarray(w, np.float32)
+            return np.asarray(w, np.float32).T  # K-major → golden's [out, in]
         return np.asarray(w if l is None else w[l], np.float32)
 
     lp = params.layers

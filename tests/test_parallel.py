@@ -69,8 +69,8 @@ def test_tp_quantized_weights_shard():
 
     plan = make_tp_mesh(4)
     sharded = shard_params(plan, params)
-    # Q40 planes must shard on the out axis: scales [L, out, in/32]
-    assert sharded.layers.wq.scales.sharding.spec[1] == "tp"
+    # Q40 planes must shard on the out axis: K-major scales [L, in/32, out]
+    assert sharded.layers.wq.scales.sharding.spec[2] == "tp"
     with use_plan(plan):
         tp_logits, _ = jax.jit(forward, static_argnums=1)(
             sharded, cfg, tokens, jnp.int32(0),

@@ -1,0 +1,55 @@
+"""Pallas Q40 matmul kernel vs the XLA dequant+dot oracle (the parity
+methodology of nn-vulkan-test.cpp: accelerated op vs reference semantics)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dllama_tpu.ops.linear import linear, quantize_weight_q40
+from dllama_tpu.ops.quant_matmul import quant_matmul, supports
+
+
+def _mk(out, in_, seed=0):
+    rng = np.random.default_rng(seed)
+    w = (rng.standard_normal((out, in_)) * 0.1).astype(np.float32)
+    return quantize_weight_q40(w)
+
+
+@pytest.mark.parametrize("m,n,k", [
+    (1, 256, 512),     # decode step
+    (8, 512, 1024),    # small prefill
+    (32, 128, 256),    # reference nBatches
+    (16, 64, 128),     # kv-proj-like narrow output
+])
+def test_kernel_matches_xla_oracle(m, n, k):
+    w = _mk(n, k, seed=n + k)
+    x = jnp.asarray(np.random.default_rng(m).standard_normal((m, k)), jnp.float32)
+    want = linear(x, w)
+    got = quant_matmul(x, w, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_kernel_3d_batch():
+    w = _mk(256, 512, seed=1)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((2, 3, 512)), jnp.float32)
+    want = linear(x, w)
+    got = quant_matmul(x, w, interpret=True)
+    assert got.shape == (2, 3, 256)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_supports_predicate():
+    assert supports((1, 512), _mk(256, 512))
+    assert supports((1, 96), _mk(256, 96))  # K=96: whole-K block (÷32)
+    assert supports((1, 512), _mk(96, 512))  # N=96: whole-N block
+    # K mismatch between x and w is never dispatched to the kernel
+    assert not supports((1, 256), _mk(96, 512))
+    # oversized batch falls back to XLA (VMEM bound on the un-tiled M axis)
+    assert not supports((2048, 512), _mk(96, 512))
+    # stacked (3D) weights fall back to XLA
+    from dllama_tpu.ops.linear import QuantizedWeight
+
+    w = _mk(96, 512)
+    stacked = QuantizedWeight(scales=w.scales[None], codes=w.codes[None])
+    assert not supports((1, 512), stacked)
