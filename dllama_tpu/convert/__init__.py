@@ -1,0 +1,30 @@
+"""Offline converter tooling — HF/Meta checkpoints → .m, tokenizers → .t.
+
+The TPU-side equivalent of the reference's converter/ directory
+(reference: converter/convert-hf.py, convert-llama.py, convert-tokenizer-*.py,
+writer.py, tokenizer-writer.py). Output files are wire-compatible with the
+reference formats so models prepared for either runtime are interchangeable.
+
+Usage (CLI):
+
+    python -m dllama_tpu.convert hf <hf_model_dir> q40 <name>
+    python -m dllama_tpu.convert llama <meta_model_dir> q40
+    python -m dllama_tpu.convert tokenizer-hf <hf_model_dir> <name>
+    python -m dllama_tpu.convert tokenizer-llama2 <dir_with_tokenizer.model>
+    python -m dllama_tpu.convert tokenizer-llama3 <tokenizer.model>
+"""
+
+from .hf import convert_hf, load_hf_config
+from .tokenizers import (
+    convert_tokenizer_hf,
+    convert_tokenizer_llama2,
+    convert_tokenizer_llama3,
+)
+
+__all__ = [
+    "convert_hf",
+    "load_hf_config",
+    "convert_tokenizer_hf",
+    "convert_tokenizer_llama2",
+    "convert_tokenizer_llama3",
+]

@@ -1,0 +1,85 @@
+"""Converter CLI — ``python -m dllama_tpu.convert <subcommand>``.
+
+Subcommands mirror the reference converter scripts
+(reference: converter/convert-hf.py, convert-llama.py,
+convert-tokenizer-{hf,llama2,llama3}.py):
+
+    python -m dllama_tpu.convert hf <hf_dir> <f32|q40|q80> <name>
+    python -m dllama_tpu.convert llama <meta_dir> <f32|q40|q80>
+    python -m dllama_tpu.convert tokenizer-hf <hf_dir> <name>
+    python -m dllama_tpu.convert tokenizer-llama2 <dir>
+    python -m dllama_tpu.convert tokenizer-llama3 <tokenizer.model>
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .hf import (
+    convert_hf,
+    convert_meta_llama,
+    default_output_name,
+    parse_float_type,
+)
+from .tokenizers import (
+    convert_tokenizer_hf,
+    convert_tokenizer_llama2,
+    convert_tokenizer_llama3,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="dllama_tpu.convert")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    hf = sub.add_parser("hf", help="HF safetensors dir -> .m")
+    hf.add_argument("source")
+    hf.add_argument("float_type", choices=["f32", "q40", "q80"])
+    hf.add_argument("name")
+    hf.add_argument("--output", default=None)
+
+    meta = sub.add_parser("llama", help="Meta consolidated.*.pth dir -> .m")
+    meta.add_argument("source")
+    meta.add_argument("float_type", choices=["f32", "q40", "q80"])
+    meta.add_argument("--output", default=None)
+
+    th = sub.add_parser("tokenizer-hf", help="HF tokenizer dir -> .t")
+    th.add_argument("source")
+    th.add_argument("name")
+    th.add_argument("--output", default=None)
+
+    t2 = sub.add_parser("tokenizer-llama2", help="sentencepiece dir -> .t")
+    t2.add_argument("source")
+    t2.add_argument("--output", default="dllama_tokenizer_llama2.t")
+
+    t3 = sub.add_parser("tokenizer-llama3", help="tiktoken tokenizer.model -> .t")
+    t3.add_argument("source")
+    t3.add_argument("--output", default="dllama_tokenizer_llama3.t")
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "hf":
+        ft = parse_float_type(args.float_type)
+        out = args.output or default_output_name(args.name, ft)
+        convert_hf(args.source, ft, out)
+        print(f"✅ {out} created successfully")
+    elif args.cmd == "llama":
+        ft = parse_float_type(args.float_type)
+        name = os.path.basename(os.path.normpath(args.source)).lower()
+        out = args.output or default_output_name(name, ft)
+        convert_meta_llama(args.source, ft, out)
+        print(f"✅ {out} created successfully")
+    elif args.cmd == "tokenizer-hf":
+        out = args.output or f"dllama_tokenizer_{args.name}.t"
+        convert_tokenizer_hf(args.source, out)
+    elif args.cmd == "tokenizer-llama2":
+        convert_tokenizer_llama2(args.source, args.output)
+    elif args.cmd == "tokenizer-llama3":
+        convert_tokenizer_llama3(args.source, args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,369 @@
+"""HF safetensors / Meta .pth checkpoint → .m converter.
+
+Behavior parity with the reference converter (reference: converter/convert-hf.py
+for the plan + config mapping, converter/convert-llama.py for Meta checkpoints,
+converter/writer.py for tensor encoding), re-done as a declarative tensor plan
+over vectorized numpy codecs (:mod:`dllama_tpu.formats.quants`). No torch
+needed for the safetensors path; the Meta path uses torch only to unpickle.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Iterable
+
+import numpy as np
+
+from ..formats.mfile import ArchType, HiddenAct, RopeType, write_header
+from ..formats.quants import F32, Q40, Q80, quantize_q40, quantize_q80
+
+FLOAT_TYPE_BY_NAME = {"f32": F32, "q40": Q40, "q80": Q80}
+FLOAT_NAME_BY_TYPE = {v: k for k, v in FLOAT_TYPE_BY_NAME.items()}
+
+ARCH_BY_MODEL_TYPE = {
+    # reference: convert-hf.py:144-152
+    "llama": ArchType.LLAMA,
+    "mistral": ArchType.LLAMA,
+    "qwen3": ArchType.QWEN3,
+}
+
+HIDDEN_ACT_BY_NAME = {"gelu": HiddenAct.GELU, "silu": HiddenAct.SILU}
+
+
+def parse_float_type(name: str) -> int:
+    try:
+        return FLOAT_TYPE_BY_NAME[name]
+    except KeyError:
+        raise ValueError(f"unsupported float type {name!r}; "
+                         f"expected one of {sorted(FLOAT_TYPE_BY_NAME)}") from None
+
+
+def permute_rope_rows(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """Reorder Q/K projection rows from HF's half-split rotary layout to the
+    interleaved layout the llama rope kernel expects (reference:
+    convert-hf.py:12-15). Operates on ``[out, in]`` weight matrices where
+    ``out = n_heads * head_dim``."""
+    out_dim = w.shape[0]
+    head_dim = out_dim // n_heads
+    return (w.reshape(n_heads, 2, head_dim // 2, *w.shape[1:])
+            .swapaxes(1, 2)
+            .reshape(w.shape))
+
+
+def encode_tensor(x: np.ndarray, float_type: int) -> bytes:
+    """Encode a tensor body the way the reference writer does
+    (reference: converter/writer.py:29-107)."""
+    flat = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    if float_type == F32:
+        return flat.tobytes()
+    if float_type == Q40:
+        return quantize_q40(flat)
+    if float_type == Q80:
+        return quantize_q80(flat)
+    raise ValueError(f"unsupported target float type {float_type}")
+
+
+# ---------------------------------------------------------------------------
+# config.json → header params
+# ---------------------------------------------------------------------------
+
+
+def load_hf_config(folder: str | Path, weight_float_type: int) -> dict:
+    """Map an HF ``config.json`` to .m header params keyed by
+    :class:`~dllama_tpu.formats.mfile.HeaderKey` names
+    (reference: convert-hf.py:178-229)."""
+    folder = Path(folder)
+    with open(folder / "config.json", encoding="utf-8") as f:
+        cfg = json.load(f)
+
+    model_type = cfg["model_type"]
+    if model_type not in ARCH_BY_MODEL_TYPE:
+        raise ValueError(f"unsupported arch type: {model_type}")
+
+    params: dict = {
+        "version": 0,
+        "arch_type": int(ARCH_BY_MODEL_TYPE[model_type]),
+        "hidden_act": int(HIDDEN_ACT_BY_NAME[cfg["hidden_act"]]),
+        "dim": cfg["hidden_size"],
+        "hidden_dim": cfg["intermediate_size"],
+        "n_layers": cfg["num_hidden_layers"],
+        "n_heads": cfg["num_attention_heads"],
+        "n_kv_heads": cfg["num_key_value_heads"],
+        "weight_float_type": weight_float_type,
+        "seq_len": cfg["max_position_embeddings"],
+        "vocab_size": cfg["vocab_size"],
+    }
+
+    n_experts = cfg.get("num_local_experts")
+    n_active = cfg.get("num_active_local_experts") or cfg.get("num_experts_per_tok")
+    params["n_experts"] = int(n_experts) if n_experts else 0
+    params["n_active_experts"] = int(n_active) if n_active else 0
+
+    if cfg.get("rope_theta") is not None:
+        params["rope_theta"] = int(cfg["rope_theta"])
+
+    rs = cfg.get("rope_scaling")
+    if rs is not None:
+        if rs.get("rope_type") != "llama3":
+            raise ValueError(f"unsupported rope scaling type {rs.get('rope_type')!r}")
+        params["rope_scaling_factor"] = int(rs["factor"])
+        params["rope_scaling_low_freq_factor"] = int(rs["low_freq_factor"])
+        params["rope_scaling_high_freq_factory"] = int(rs["high_freq_factor"])
+        params["rope_scaling_orig_max_seq_len"] = int(
+            rs["original_max_position_embeddings"])
+        params["rope_type"] = int(RopeType.LLAMA3_1)
+
+    if cfg.get("head_dim") is not None:
+        params["head_dim"] = cfg["head_dim"]
+
+    eps = cfg.get("rms_norm_eps")
+    if eps is not None:
+        if eps == 1e-5:
+            params["norm_epsilon"] = 5
+        elif eps == 1e-6:
+            params["norm_epsilon"] = 6
+        else:
+            raise ValueError(f"unsupported rms_norm_eps {eps}")
+    return params
+
+
+# ---------------------------------------------------------------------------
+# tensor plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PlanItem:
+    """One tensor to emit: candidate source keys (first found wins — the
+    second entry expresses lm_head→embedding weight tying,
+    reference: convert-hf.py:101-102), target encoding, optional transform."""
+
+    keys: tuple[str, ...]
+    float_type: int
+    transform: Callable[[np.ndarray], np.ndarray] | None = None
+
+
+def hf_tensor_plan(params: dict) -> list[PlanItem]:
+    """The .m tensor emission order for an HF checkpoint
+    (reference: convert-hf.py:58-102; consumed by llm.cpp:499-539 and our
+    :meth:`dllama_tpu.formats.mfile.ModelFile._walk`)."""
+    wt = params["weight_float_type"]
+    arch = ArchType(params["arch_type"])
+    n_heads = params["n_heads"]
+    n_kv_heads = params["n_kv_heads"]
+
+    def permute_q(w: np.ndarray) -> np.ndarray:
+        return permute_rope_rows(w, n_heads)
+
+    def permute_k(w: np.ndarray) -> np.ndarray:
+        return permute_rope_rows(w, n_kv_heads)
+
+    # Qwen3 ships rotary halves directly (neox rope) — no permutation there.
+    q_tr = permute_q if arch == ArchType.LLAMA else None
+    k_tr = permute_k if arch == ArchType.LLAMA else None
+
+    plan = [PlanItem(("model.embed_tokens.weight",), F32)]
+    for l in range(params["n_layers"]):
+        pre = f"model.layers.{l}"
+        plan.append(PlanItem((f"{pre}.self_attn.q_proj.weight",), wt, q_tr))
+        plan.append(PlanItem((f"{pre}.self_attn.k_proj.weight",), wt, k_tr))
+        plan.append(PlanItem((f"{pre}.self_attn.v_proj.weight",), wt))
+        plan.append(PlanItem((f"{pre}.self_attn.o_proj.weight",), wt))
+        if params["n_experts"] > 0:
+            # Expert emission order mirrors the reference converter even though
+            # neither runtime consumes MoE weights yet (reference:
+            # convert-hf.py:73-80; SURVEY.md §2.2 "EP: NO at runtime").
+            for e in range(params["n_experts"]):
+                eb = f"{pre}.block_sparse_moe.experts.{e}"
+                plan.append(PlanItem((f"{eb}.w3.weight",), wt))
+                plan.append(PlanItem((f"{eb}.w1.weight",), wt))
+                plan.append(PlanItem((f"{eb}.w2.weight",), wt))
+        else:
+            plan.append(PlanItem((f"{pre}.mlp.gate_proj.weight",), wt))  # w1
+            plan.append(PlanItem((f"{pre}.mlp.down_proj.weight",), wt))  # w2
+            plan.append(PlanItem((f"{pre}.mlp.up_proj.weight",), wt))    # w3
+        if arch == ArchType.QWEN3:
+            plan.append(PlanItem((f"{pre}.self_attn.q_norm.weight",), F32))
+            plan.append(PlanItem((f"{pre}.self_attn.k_norm.weight",), F32))
+        plan.append(PlanItem((f"{pre}.input_layernorm.weight",), F32))
+        plan.append(PlanItem((f"{pre}.post_attention_layernorm.weight",), F32))
+    plan.append(PlanItem(("model.norm.weight",), F32))
+    plan.append(PlanItem(("lm_head.weight", "model.embed_tokens.weight"), wt))
+    return plan
+
+
+class SafetensorsDirectory:
+    """Lazy multi-file safetensors reader: keeps at most one shard open,
+    resolves key→file via the (tiny) headers up front — unlike the reference's
+    sequential guessing walk (convert-hf.py:104-136), the index is exact."""
+
+    def __init__(self, files: Iterable[str | Path]):
+        from safetensors import safe_open
+        self._safe_open = safe_open
+        self.files = [str(f) for f in files]
+        if not self.files:
+            raise ValueError("no safetensors files given")
+        self.key_to_file: dict[str, str] = {}
+        for path in self.files:
+            with safe_open(path, framework="numpy", device="cpu") as f:
+                for key in f.keys():
+                    self.key_to_file[key] = path
+        self._open_path: str | None = None
+        self._open_file = None
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.key_to_file
+
+    def get(self, key: str) -> np.ndarray:
+        path = self.key_to_file[key]
+        if path != self._open_path:
+            if self._open_file is not None:
+                self._open_file.__exit__(None, None, None)
+            self._open_file = self._safe_open(path, framework="numpy", device="cpu")
+            self._open_file.__enter__()
+            self._open_path = path
+        t = self._open_file.get_tensor(key)
+        # bf16 arrives as an ml_dtypes.bfloat16 ndarray; astype handles it
+        return np.asarray(t).astype(np.float32)
+
+    def close(self) -> None:
+        if self._open_file is not None:
+            self._open_file.__exit__(None, None, None)
+            self._open_file = None
+            self._open_path = None
+
+
+def convert_hf(source_dir: str | Path, weight_float_type: int | str,
+               output_path: str | Path, *, progress: bool = True) -> str:
+    """Convert an HF safetensors model directory to a .m file
+    (reference: convert-hf.py main flow)."""
+    if isinstance(weight_float_type, str):
+        weight_float_type = parse_float_type(weight_float_type)
+    source_dir = Path(source_dir)
+    params = load_hf_config(source_dir, weight_float_type)
+
+    files = sorted(p for p in source_dir.iterdir()
+                   if p.name.endswith(".safetensors") and not p.name.startswith("."))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {source_dir}")
+    src = SafetensorsDirectory(files)
+
+    plan = hf_tensor_plan(params)
+    try:
+        with open(output_path, "wb") as out:
+            write_header(out, params)
+            for item in plan:
+                key = next((k for k in item.keys if k in src), None)
+                if key is None:
+                    raise KeyError(f"tensor {item.keys[0]} not found in checkpoint")
+                tensor = src.get(key)
+                if item.transform is not None:
+                    tensor = item.transform(tensor)
+                if progress:
+                    print(f"🔶 Writing {key} {tensor.shape} as "
+                          f"{FLOAT_NAME_BY_TYPE[item.float_type]}")
+                out.write(encode_tensor(tensor, item.float_type))
+    finally:
+        src.close()
+    return str(output_path)
+
+
+# ---------------------------------------------------------------------------
+# Meta (consolidated.*.pth) checkpoints
+# ---------------------------------------------------------------------------
+
+
+def convert_meta_llama(source_dir: str | Path, weight_float_type: int | str,
+                       output_path: str | Path, *, progress: bool = True) -> str:
+    """Convert a Meta-format Llama checkpoint (params.json +
+    consolidated.NN.pth shards) to .m (reference: convert-llama.py:11-121).
+
+    Shards are column-chunks for row-parallel tensors (embedding, wo, w2 —
+    concat on axis 1) and row-chunks for the rest (concat on axis 0); 1-D
+    tensors are replicated. Shards are opened with ``mmap=True`` so tensor
+    storages stay lazy; peak memory is one tensor × n_shards, not the model.
+    """
+    import torch  # CPU-only unpickle of the .pth shards
+
+    if isinstance(weight_float_type, str):
+        weight_float_type = parse_float_type(weight_float_type)
+    source_dir = Path(source_dir)
+    with open(source_dir / "params.json", encoding="utf-8") as f:
+        meta = json.load(f)
+    if meta.get("vocab_size", -1) < 1:
+        raise ValueError("vocab_size missing/invalid in params.json")
+    if meta.get("max_seq_len") is None:
+        raise ValueError("max_seq_len is required in params.json")
+
+    shard_paths = sorted(source_dir.glob("consolidated.*.pth"))
+    if not shard_paths:
+        raise FileNotFoundError(f"no consolidated.*.pth in {source_dir}")
+    shards = [torch.load(p, map_location="cpu", weights_only=True, mmap=True)
+              for p in shard_paths]
+
+    n_layers = meta["n_layers"]
+    params: dict = {
+        "version": 0,
+        "arch_type": int(ArchType.LLAMA),
+        "hidden_act": int(HiddenAct.SILU),
+        "dim": meta["dim"],
+        "hidden_dim": shards[0]["layers.0.feed_forward.w1.weight"].shape[0]
+                      * len(shards),
+        "n_layers": n_layers,
+        "n_heads": meta["n_heads"],
+        "n_kv_heads": meta.get("n_kv_heads") or meta["n_heads"],
+        "n_experts": 0,
+        "n_active_experts": 0,
+        "weight_float_type": weight_float_type,
+        "seq_len": meta["max_seq_len"],
+        "vocab_size": meta["vocab_size"],
+    }
+    if "rope_theta" in meta:
+        params["rope_theta"] = int(meta["rope_theta"])
+    if "norm_eps" in meta:
+        if meta["norm_eps"] == 1e-5:
+            params["norm_epsilon"] = 5
+        elif meta["norm_eps"] == 1e-6:
+            params["norm_epsilon"] = 6
+
+    names: list[str] = ["tok_embeddings.weight"]
+    for l in range(n_layers):
+        names += [f"layers.{l}.attention.wq.weight",
+                  f"layers.{l}.attention.wk.weight",
+                  f"layers.{l}.attention.wv.weight",
+                  f"layers.{l}.attention.wo.weight",
+                  f"layers.{l}.feed_forward.w1.weight",
+                  f"layers.{l}.feed_forward.w2.weight",
+                  f"layers.{l}.feed_forward.w3.weight",
+                  f"layers.{l}.attention_norm.weight",
+                  f"layers.{l}.ffn_norm.weight"]
+    names += ["norm.weight", "output.weight"]
+
+    col_chunked = {"tok_embeddings.weight"}
+    f32_always = {"tok_embeddings.weight", "norm.weight"}
+
+    def merged(name: str) -> np.ndarray:
+        parts = [np.asarray(s[name].to(torch.float32).numpy()) for s in shards]
+        if len(parts) == 1 or parts[0].ndim == 1:
+            return parts[0]
+        axis = 1 if (name in col_chunked or name.endswith(".attention.wo.weight")
+                     or name.endswith(".feed_forward.w2.weight")) else 0
+        return np.concatenate(parts, axis=axis)
+
+    with open(output_path, "wb") as out:
+        write_header(out, params)
+        for name in names:
+            is_f32 = (name in f32_always or name.endswith(".attention_norm.weight")
+                      or name.endswith(".ffn_norm.weight"))
+            ft = F32 if is_f32 else weight_float_type
+            tensor = merged(name)
+            if progress:
+                print(f"🔶 Writing {name} {tensor.shape} as {FLOAT_NAME_BY_TYPE[ft]}")
+            out.write(encode_tensor(tensor, ft))
+    return str(output_path)
+
+
+def default_output_name(name: str, weight_float_type: int) -> str:
+    return f"dllama_model_{name}_{FLOAT_NAME_BY_TYPE[weight_float_type]}.m"

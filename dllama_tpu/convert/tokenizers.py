@@ -1,0 +1,251 @@
+"""Tokenizer converters — HF fast / sentencepiece / tiktoken-file → .t.
+
+Behavior parity with the reference converters (reference:
+converter/convert-tokenizer-hf.py, convert-tokenizer-llama2.py,
+convert-tokenizer-llama3.py), writing through
+:func:`dllama_tpu.formats.tfile.write_tfile`.
+
+The HF path resolves every vocab entry to raw bytes via the GPT-2 byte-level
+unicode↔byte table; the llama3 path parses the tiktoken ``.model`` file format
+(base64 token + rank per line) directly, so no tiktoken dependency is needed.
+sentencepiece paths are gated on the library being installed.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from pathlib import Path
+
+from ..formats.tfile import TokenizerData, write_tfile
+
+# ---------------------------------------------------------------------------
+# GPT-2 byte-level BPE unicode↔byte table
+# ---------------------------------------------------------------------------
+
+
+def unicode_to_bytes() -> dict[str, int]:
+    """The GPT-2 printable-unicode → raw-byte mapping used by byte-level BPE
+    vocabs (reference: convert-tokenizer-hf.py:12-23; the table is the inverse
+    of GPT-2's bytes_to_unicode)."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for c, b in zip(cs, bs)}
+
+
+def token_str_to_bytes(token: str, table: dict[str, int]) -> bytes:
+    """Decode one byte-level-BPE vocab string to raw bytes; characters outside
+    the table (special tokens like ``<|eot_id|>``) pass through as UTF-8
+    (reference: convert-tokenizer-hf.py:38-46)."""
+    out = bytearray()
+    for ch in token:
+        if ch in table:
+            out.append(table[ch])
+        else:
+            out.extend(ch.encode("utf-8"))
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# HF tokenizer directory → .t
+# ---------------------------------------------------------------------------
+
+
+def _open_json(path: Path) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def resolve_hf_vocab(token_strings: list[str]) -> tuple[list[bytes], list[float]]:
+    """Byte-level vocab strings → (bytes, scores). Scores are ``-id`` so that
+    greedy BPE prefers lower-id (earlier-learned) merges, matching the
+    reference (convert-tokenizer-hf.py:46-47)."""
+    table = unicode_to_bytes()
+    vocab = [token_str_to_bytes(t, table) for t in token_strings]
+    scores = [-float(i) for i in range(len(vocab))]
+    return vocab, scores
+
+
+def resolve_sentencepiece_vocab(model_path: str | Path
+                                ) -> tuple[list[bytes], list[float], int, int]:
+    """sentencepiece model → (bytes, scores, bos_id, eos_id)
+    (reference: convert-tokenizer-hf.py:63-82). Requires sentencepiece."""
+    try:
+        from sentencepiece import SentencePieceProcessor
+    except ImportError as e:
+        raise RuntimeError(
+            "sentencepiece is not installed in this environment; convert this "
+            "tokenizer on a machine that has it, or use the HF fast-tokenizer "
+            "path (tokenizer.json)") from e
+    sp = SentencePieceProcessor(model_file=str(model_path))
+    vocab: list[bytes] = []
+    scores: list[float] = []
+    for i in range(sp.vocab_size()):
+        piece = sp.id_to_piece(i).replace("\u2581", " ")
+        if len(piece) == 6 and piece.startswith("<0x") and piece.endswith(">"):
+            b = bytes.fromhex(piece[3:-1])
+        else:
+            b = piece.encode("utf-8")
+        vocab.append(b)
+        scores.append(sp.get_score(i))
+    return vocab, scores, sp.bos_id(), sp.eos_id()
+
+
+def convert_tokenizer_hf(source_dir: str | Path, output_path: str | Path,
+                         *, progress: bool = True) -> str:
+    """HF tokenizer directory (tokenizer_config.json + tokenizer.json or
+    tokenizer.model) → .t (reference: convert-tokenizer-hf.py)."""
+    source_dir = Path(source_dir)
+    tok_config = _open_json(source_dir / "tokenizer_config.json")
+    cls = tok_config.get("tokenizer_class", "PreTrainedTokenizerFast")
+
+    bos_id: int | None = None
+    eos_ids: list[int] | None = None
+
+    if cls in ("PreTrainedTokenizerFast", "LlamaTokenizerFast", "Qwen2Tokenizer"):
+        from transformers import PreTrainedTokenizerFast
+        tok = PreTrainedTokenizerFast(
+            tokenizer_file=str(source_dir / "tokenizer.json"))
+        n = len(tok.get_vocab())
+        strings = tok.convert_ids_to_tokens(list(range(n)))
+        vocab, scores = resolve_hf_vocab(strings)
+        bos_id = tok.bos_token_id
+        if tok.eos_token_id is not None:
+            eos_ids = [tok.eos_token_id]
+    elif cls == "LlamaTokenizer":
+        vocab, scores, bos_id, eos_id = resolve_sentencepiece_vocab(
+            source_dir / "tokenizer.model")
+        eos_ids = [eos_id]
+    else:
+        raise ValueError(f"tokenizer class {cls} is not supported")
+
+    if bos_id is None or eos_ids is None:
+        config = _open_json(source_dir / "config.json")
+        if bos_id is None:
+            bos_id = config["bos_token_id"]
+        if eos_ids is None:
+            eos = config["eos_token_id"]
+            eos_ids = list(eos) if isinstance(eos, list) else [eos]
+
+    chat_template = tok_config.get("chat_template")
+    add_bos = bool(tok_config.get("add_bos_token", True))
+
+    data = TokenizerData(vocab=vocab, scores=scores, bos_id=int(bos_id),
+                         add_bos=add_bos, eos_token_ids=[int(e) for e in eos_ids],
+                         chat_template=chat_template,
+                         max_token_length=max(len(t) for t in vocab))
+    write_tfile(output_path, data)
+    if progress:
+        print(f"✅ wrote {output_path}: vocab={len(vocab)} bos={bos_id} "
+              f"eos={eos_ids} add_bos={add_bos}")
+    return str(output_path)
+
+
+# ---------------------------------------------------------------------------
+# Llama 2 sentencepiece → .t
+# ---------------------------------------------------------------------------
+
+# reference: convert-tokenizer-llama2.py:6
+LLAMA2_CHAT_TEMPLATE = (
+    "{% if messages[0]['role'] == 'system' %}{% set loop_messages = messages[1:] %}"
+    "{% set system_message = messages[0]['content'] %}{% else %}"
+    "{% set loop_messages = messages %}{% set system_message = false %}{% endif %}"
+    "{% for message in loop_messages %}"
+    "{% if (message['role'] == 'user') != (loop.index0 % 2 == 0) %}"
+    "{{ raise_exception('Conversation roles must alternate user/assistant/user/assistant/...') }}"
+    "{% endif %}"
+    "{% if loop.index0 == 0 and system_message != false %}"
+    "{% set content = '<<SYS>>\\n' + system_message + '\\n<</SYS>>\\n\\n' + message['content'] %}"
+    "{% else %}{% set content = message['content'] %}{% endif %}"
+    "{% if message['role'] == 'user' %}"
+    "{{ bos_token + '[INST] ' + content.strip() + ' [/INST]' }}"
+    "{% elif message['role'] == 'assistant' %}"
+    "{{ ' '  + content.strip() + ' ' + eos_token }}{% endif %}{% endfor %}")
+
+
+def convert_tokenizer_llama2(source_dir: str | Path, output_path: str | Path,
+                             *, progress: bool = True) -> str:
+    """Llama 2 sentencepiece tokenizer.model → .t
+    (reference: convert-tokenizer-llama2.py)."""
+    vocab, scores, bos_id, eos_id = resolve_sentencepiece_vocab(
+        Path(source_dir) / "tokenizer.model")
+    data = TokenizerData(vocab=vocab, scores=scores, bos_id=bos_id,
+                         add_bos=True, eos_token_ids=[eos_id],
+                         chat_template=LLAMA2_CHAT_TEMPLATE,
+                         max_token_length=max(len(t) for t in vocab))
+    write_tfile(output_path, data)
+    if progress:
+        print(f"✅ wrote {output_path}: vocab={len(vocab)}")
+    return str(output_path)
+
+
+# ---------------------------------------------------------------------------
+# Llama 3 tiktoken model file → .t
+# ---------------------------------------------------------------------------
+
+LLAMA3_N_SPECIAL_TOKENS = 256
+LLAMA3_BOS_ID = 128000
+LLAMA3_EOS_ID = 128001
+LLAMA3_CHAT_EOS_ID = 128009
+
+# reference: convert-tokenizer-llama3.py:32
+LLAMA3_CHAT_TEMPLATE = (
+    "{% set loop_messages = messages %}{% for message in loop_messages %}"
+    "{% set content = '<|start_header_id|>' + message['role'] + '<|end_header_id|>\n\n'"
+    "+ message['content'] | trim + '<|eot_id|>' %}"
+    "{% if loop.index0 == 0 %}{% set content = bos_token + content %}{% endif %}"
+    "{{ content }}{% endfor %}{% if add_generation_prompt %}"
+    "{{ '<|start_header_id|>assistant<|end_header_id|>\n\n' }}{% endif %}")
+
+
+def llama3_special_tokens() -> list[str]:
+    """The Llama 3 reserved special-token id block
+    (reference: convert-tokenizer-llama3.py:13-28)."""
+    named = ["<|begin_of_text|>", "<|end_of_text|>",
+             "<|reserved_special_token_0|>", "<|reserved_special_token_1|>",
+             "<|reserved_special_token_2|>", "<|reserved_special_token_3|>",
+             "<|start_header_id|>", "<|end_header_id|>",
+             "<|reserved_special_token_4|>", "<|eot_id|>"]
+    reserved = [f"<|reserved_special_token_{i}|>"
+                for i in range(5, LLAMA3_N_SPECIAL_TOKENS - 5)]
+    return named + reserved
+
+
+def convert_tokenizer_llama3(model_path: str | Path, output_path: str | Path,
+                             *, progress: bool = True) -> str:
+    """Llama 3 tiktoken ``tokenizer.model`` (``<base64> <rank>`` lines) → .t
+    (reference: convert-tokenizer-llama3.py). Parses the file directly —
+    tiktoken itself is not required."""
+    vocab: list[bytes] = []
+    scores: list[float] = []
+    with open(model_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            b64, rank = line.split(" ")
+            vocab.append(base64.b64decode(b64))
+            scores.append(-float(rank))
+
+    next_id = len(vocab)
+    for i, token in enumerate(llama3_special_tokens()):
+        vocab.append(token.encode("utf-8"))
+        scores.append(-float(next_id + i))
+
+    data = TokenizerData(vocab=vocab, scores=scores, bos_id=LLAMA3_BOS_ID,
+                         add_bos=True,
+                         eos_token_ids=[LLAMA3_EOS_ID, LLAMA3_CHAT_EOS_ID],
+                         chat_template=LLAMA3_CHAT_TEMPLATE,
+                         max_token_length=max(len(t) for t in vocab))
+    write_tfile(output_path, data)
+    if progress:
+        print(f"✅ wrote {output_path}: vocab={len(vocab)}")
+    return str(output_path)
