@@ -36,6 +36,10 @@ class ModelConfig:
 
     # TPU execution choices (no reference equivalent):
     compute_dtype: str = "float32"  # "float32" for parity, "bfloat16" for speed
+    # attention implementation: "auto" = Pallas flash kernel on TPU when the
+    # shapes fit (single-device graph), XLA oracle otherwise; "xla"/"flash"
+    # force one. The TP/SP paths pick their own kernels inside shard_map.
+    attn_impl: str = "auto"
     # Q80 activation-sync parity: reproduce the reference's Q80 cast points
     # in-graph (llm.cpp:258-265 casts; wire pipes SURVEY.md §2 #10) via
     # fake-quantization. Costs throughput; off for pure-TPU serving.

@@ -31,7 +31,9 @@ import numpy as np
 
 from ..formats.mfile import ArchType, HiddenAct, ModelFile, RopeType
 from ..formats.quants import Q40
+from ..ops import flash_attention as _fa
 from ..ops.attention import attention
+from ..ops.flash_attention import flash_attention
 from ..ops.linear import (
     QuantizedWeight,
     Weight,
@@ -69,6 +71,30 @@ class Params(NamedTuple):
     logits: Weight  # [vocab, dim]
 
 
+def _use_flash(cfg: ModelConfig, q_shape, kv_shape) -> bool:
+    """Trace-time choice of attention kernel. The Pallas kernel only runs in
+    single-device graphs for now: under a live mesh plan the auto-sharded
+    graph cannot partition a pallas_call (the TP/SP paths wrap their own
+    kernels in shard_map instead)."""
+    from ..parallel.api import current_plan
+
+    if cfg.attn_impl not in ("auto", "xla", "flash"):
+        raise ValueError(f"attn_impl must be auto|xla|flash, got {cfg.attn_impl!r}")
+    if cfg.attn_impl == "xla":
+        return False
+    n_kv, s = kv_shape[1], kv_shape[2]
+    ok = _fa.supports(q_shape, n_kv, s)
+    if cfg.attn_impl == "flash":
+        if not ok:
+            raise ValueError(f"flash attention unsupported for q={q_shape}, S={s}")
+        if current_plan() is not None:
+            raise ValueError(
+                "attn_impl='flash' cannot run under a mesh plan: a pallas_call "
+                "is not partitionable by the auto-sharder (use 'auto')")
+        return True
+    return ok and _fa.default_enabled() and current_plan() is None
+
+
 def _hidden_act(cfg: ModelConfig, x: jax.Array) -> jax.Array:
     if cfg.hidden_act == HiddenAct.SILU:
         return jax.nn.silu(x)
@@ -80,7 +106,8 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
                 k_cache: jax.Array, v_cache: jax.Array,
                 cos: jax.Array, sin: jax.Array, start_pos: jax.Array,
                 positions: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One transformer block. ``x: [B, T, dim]``, caches ``[B, S, n_kv, hd]``."""
+    """One transformer block. ``x: [B, T, dim]``; caches are head-major
+    ``[B, n_kv, S, hd]`` (see runtime.kvcache)."""
     B, T, _ = x.shape
 
     # Q80 sync-parity: fake-quantize at the reference's cast points — matmul
@@ -105,7 +132,10 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     k = apply_rope(k, cos, sin, positions, cfg.rope_type)
 
     k_cache, v_cache = update_layer(k_cache, v_cache, k, v, start_pos)
-    att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
+    if _use_flash(cfg, q.shape, k_cache.shape):
+        att = flash_attention(q, k_cache, v_cache, start_pos, cfg.head_dim)
+    else:
+        att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
     att = constrain(att, "batch", None, "heads", None)
     x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo))
     x = constrain(x, "batch", None, None)

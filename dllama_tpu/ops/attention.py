@@ -20,19 +20,19 @@ import jax.numpy as jnp
 def attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
               positions: jax.Array, head_dim: int) -> jax.Array:
     """Attend ``q: [B, T, n_heads, head_dim]`` over cached
-    ``k/v: [B, S, n_kv_heads, head_dim]``.
+    ``k/v: [B, n_kv_heads, S, head_dim]`` (head-major, see runtime.kvcache).
 
     ``positions: [B, T]`` is the absolute position of each query row; cache
     entries at ``s <= position`` are visible (the reference's ``t <= pos`` loop
     bound), which assumes the cache holds keys for positions ``0..pos``.
     """
     B, T, n_heads, _ = q.shape
-    S = k_cache.shape[1]
-    n_kv = k_cache.shape[2]
+    n_kv = k_cache.shape[1]
+    S = k_cache.shape[2]
     kv_mul = n_heads // n_kv
 
     qg = q.reshape(B, T, n_kv, kv_mul, head_dim)
-    scores = jnp.einsum("btkmh,bskh->btkms", qg.astype(jnp.float32),
+    scores = jnp.einsum("btkmh,bksh->btkms", qg.astype(jnp.float32),
                         k_cache.astype(jnp.float32))
     scores = scores / jnp.sqrt(jnp.float32(head_dim))
 
@@ -40,5 +40,5 @@ def attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     scores = jnp.where(mask[:, :, None, None, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
 
-    out = jnp.einsum("btkms,bskh->btkmh", probs, v_cache.astype(jnp.float32))
+    out = jnp.einsum("btkms,bksh->btkmh", probs, v_cache.astype(jnp.float32))
     return out.reshape(B, T, n_heads, head_dim).astype(q.dtype)

@@ -1,0 +1,172 @@
+"""Pallas TPU kernel: blockwise online-softmax attention over the KV cache.
+
+The TPU replacement for the reference's serial per-head attention loop
+(reference: multiheadAtt_F32, src/nn/nn-cpu-ops.cpp:751-786): instead of
+walking positions ``0..pos`` one dot product at a time, KV blocks stream from
+HBM through VMEM and the softmax is computed online (running max / running
+sum), so the full ``[T, S]`` score matrix never materializes and both dots
+land on the MXU.
+
+Layouts (chosen together with :mod:`dllama_tpu.runtime.kvcache`):
+
+* cache is head-major ``[B, n_kv_heads, S, head_dim]`` — KV blocks are
+  directly tileable ``(S, head_dim)`` slabs, no transpose on the hot path;
+* queries fold the GQA group into rows: ``[B, n_kv_heads, T*kv_mul, D]`` —
+  one kernel instance per (batch, kv-head) attends the whole query group, so
+  GQA widens the MXU tile instead of shrinking it.
+
+Causality follows the reference's affine position rule: query row ``r``
+(source position ``start_pos + r // kv_mul``) sees cache slots
+``s <= start_pos + r // kv_mul``; positions are derived in-kernel from the
+``start_pos`` scalar, so no mask tensor is built.
+
+The XLA oracle in :mod:`dllama_tpu.ops.attention` is the semantics reference;
+parity is tested in tests/test_flash_attention.py (the way
+nn-vulkan-test.cpp checks GPU ops against CPU expectations).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128  # VPU lane width; scratch vectors are stored lane-broadcast
+
+
+def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
+            *, bs: int, kv_mul: int, t: int, scale: float):
+    s_idx = pl.program_id(2)
+    ns = pl.num_programs(2)
+    start_pos = pos_ref[0, 0]
+
+    @pl.when(s_idx == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Blocks past the newest position are entirely masked: skip their DMA'd
+    # compute (their loads still stream, matching the oracle's byte traffic).
+    @pl.when(s_idx * bs <= start_pos + (t - 1))
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)  # (TQ, D)
+        k = k_ref[0, 0].astype(jnp.float32)  # (BS, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+
+        scores = jax.lax.dot_general(  # (TQ, BS) = q @ k.T
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        tq = scores.shape[0]
+        row_t = jax.lax.broadcasted_iota(jnp.int32, (tq, bs), 0) // kv_mul
+        col = s_idx * bs + jax.lax.broadcasted_iota(jnp.int32, (tq, bs), 1)
+        scores = jnp.where(col <= start_pos + row_t, scores, -jnp.inf)
+
+        # online softmax update; m/l live lane-broadcast in (TQ, 128) scratch
+        m_prev = jnp.max(m_ref[:], axis=-1, keepdims=True)  # (TQ, 1)
+        l_prev = jnp.max(l_ref[:], axis=-1, keepdims=True)
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)  # fully-masked rows: m_new=m_prev finite after block 0
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+
+        pv = jax.lax.dot_general(  # (TQ, D)
+            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(s_idx == ns - 1)
+    def _():
+        l = jnp.max(l_ref[:], axis=-1, keepdims=True)
+        out_ref[0, 0] = acc_ref[:] / l  # block 0 guarantees l >= 1 visible col
+
+
+def _pick_bs(s: int) -> int | None:
+    for c in (512, 256, 128):
+        if s % c == 0:
+            return c
+    return None
+
+
+@functools.partial(jax.jit, static_argnames=("head_dim", "t", "interpret"))
+def _call(q_g: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+          start_pos: jax.Array, head_dim: int, t: int, interpret: bool) -> jax.Array:
+    B, n_kv, TQ, D = q_g.shape
+    S = k_cache.shape[2]
+    bs = _pick_bs(S)
+    kv_mul = TQ // t
+    pos = jnp.reshape(start_pos.astype(jnp.int32), (1, 1))
+
+    kernel = functools.partial(_kernel, bs=bs, kv_mul=kv_mul, t=t,
+                               scale=1.0 / (head_dim ** 0.5))
+    return pl.pallas_call(
+        kernel,
+        grid=(B, n_kv, S // bs),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, h, s: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, TQ, D), lambda b, h, s: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bs, D), lambda b, h, s: (b, h, s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bs, D), lambda b, h, s: (b, h, s, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, TQ, D), lambda b, h, s: (b, h, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, n_kv, TQ, D), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((TQ, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((TQ, _LANES), jnp.float32),  # running sum
+            pltpu.VMEM((TQ, D), jnp.float32),       # output accumulator
+        ],
+        interpret=interpret,
+    )(pos, q_g, k_cache, v_cache)
+
+
+def flash_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                    start_pos: jax.Array, head_dim: int, *,
+                    interpret: bool = False) -> jax.Array:
+    """Causal GQA attention: ``q [B, T, n_heads, D]`` over head-major caches
+    ``k/v [B, n_kv, S, D]``; query row positions are ``start_pos + t``.
+
+    Drop-in for :func:`dllama_tpu.ops.attention.attention` whenever positions
+    are the affine ``start_pos + arange(T)`` the model always uses.
+    """
+    B, T, n_heads, D = q.shape
+    n_kv = k_cache.shape[1]
+    kv_mul = n_heads // n_kv
+
+    # fold GQA groups into query rows: [B, n_kv, T*kv_mul, D], row r=(t, m)
+    q_g = (q.reshape(B, T, n_kv, kv_mul, D)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(B, n_kv, T * kv_mul, D)
+            .astype(jnp.float32))
+    out = _call(q_g, k_cache, v_cache, start_pos, head_dim, T, interpret)
+    return (out.reshape(B, n_kv, T, kv_mul, D)
+               .transpose(0, 2, 1, 3, 4)
+               .reshape(B, T, n_heads, D)
+               .astype(q.dtype))
+
+
+MAX_TQ = 2048  # scores tile (TQ, bs) + acc must fit VMEM comfortably
+
+
+def supports(q_shape: tuple[int, ...], n_kv: int, s: int) -> bool:
+    """Whether the kernel's tile grid covers these shapes."""
+    B, T, n_heads, D = q_shape
+    kv_mul = n_heads // n_kv
+    return (_pick_bs(s) is not None
+            and D % 8 == 0
+            and T * kv_mul <= MAX_TQ)
+
+
+def default_enabled() -> bool:
+    """Flash is the default on TPU backends; the XLA oracle elsewhere."""
+    return jax.default_backend() == "tpu"

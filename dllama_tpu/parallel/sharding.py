@@ -72,7 +72,7 @@ def param_shardings(plan: MeshPlan, params: "Params") -> "Params":
 
 
 def kv_cache_sharding(plan: MeshPlan, kv: "KVCache") -> "KVCache":
-    """[L, B, S, n_kv, hd] — kv-heads over tp, batch over dp; the seq dim
+    """[L, B, n_kv, S, hd] — kv-heads over tp, batch over dp; the seq dim
     stays replicated here (plain attention reads the whole cache — the ring
     attention path in parallel/ring.py manages its own seq-sharded layout).
 
@@ -80,7 +80,7 @@ def kv_cache_sharding(plan: MeshPlan, kv: "KVCache") -> "KVCache":
     groups; the reference instead caps nodes at nKvHeads)."""
     from ..runtime.kvcache import KVCache
 
-    s = plan.sharding_for(tuple(kv.k.shape), None, "batch", None, "kv_heads", None)
+    s = plan.sharding_for(tuple(kv.k.shape), None, "batch", "kv_heads", None, None)
     return KVCache(k=s, v=s)
 
 

@@ -4,10 +4,15 @@ The reference keeps a dense ``seq_len × kv_dim0`` key/value buffer per node
 per layer, appended by OP_SHIFT at the current position (reference:
 shiftForward_F32_F32, src/nn/nn-cpu-ops.cpp:1304-1326; cache slicing
 sliceKvCache, nn-core.cpp:198-205). Here the cache is one stacked array pair
-``[n_layers, batch, seq_len, n_kv_heads, head_dim]`` updated functionally with
-``lax.dynamic_update_slice`` — donated into the jitted decode step so XLA
-updates it in place, and sharded over the kv-head axis under TP exactly like
-the reference's per-node head shards.
+``[n_layers, batch, n_kv_heads, seq_len, head_dim]`` updated functionally
+with ``lax.dynamic_update_slice`` — donated into the jitted decode step so
+XLA updates it in place, and sharded over the kv-head axis under TP exactly
+like the reference's per-node head shards.
+
+The head-major layout (heads before sequence) is deliberate TPU design: the
+trailing ``(seq_len, head_dim)`` dims are what attention kernels tile over,
+so both the XLA oracle and the Pallas flash kernel read cache blocks without
+any transpose, and the ring-attention path shards the seq dim directly.
 """
 
 from __future__ import annotations
@@ -22,18 +27,18 @@ if TYPE_CHECKING:  # avoid a runtime cycle: models.llama imports this module
 
 
 class KVCache(NamedTuple):
-    k: jax.Array  # [L, B, S, n_kv_heads, head_dim]
+    k: jax.Array  # [L, B, n_kv_heads, S, head_dim]
     v: jax.Array
 
     @classmethod
     def create(cls, cfg: "ModelConfig", batch_size: int = 1,
                dtype=jnp.float32) -> "KVCache":
-        shape = (cfg.n_layers, batch_size, cfg.seq_len, cfg.n_kv_heads, cfg.head_dim)
+        shape = (cfg.n_layers, batch_size, cfg.n_kv_heads, cfg.seq_len, cfg.head_dim)
         return cls(k=jnp.zeros(shape, dtype=dtype), v=jnp.zeros(shape, dtype=dtype))
 
     @property
     def seq_len(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
     @property
     def batch_size(self) -> int:
@@ -42,9 +47,15 @@ class KVCache(NamedTuple):
 
 def update_layer(k_layer: jax.Array, v_layer: jax.Array, new_k: jax.Array,
                  new_v: jax.Array, start_pos: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Write ``new_k/new_v: [B, T, n_kv, hd]`` at ``start_pos`` (OP_SHIFT)."""
+    """Write ``new_k/new_v: [B, T, n_kv, hd]`` at ``start_pos`` (OP_SHIFT).
+
+    The new rows arrive time-major from the QKV matmuls and are laid down
+    head-major into the cache.
+    """
     zero = jnp.zeros((), dtype=jnp.int32)
-    idx = (zero, start_pos.astype(jnp.int32), zero, zero)
+    idx = (zero, zero, start_pos.astype(jnp.int32), zero)
+    new_k = jnp.swapaxes(new_k, 1, 2)  # [B, n_kv, T, hd]
+    new_v = jnp.swapaxes(new_v, 1, 2)
     k_layer = jax.lax.dynamic_update_slice(k_layer, new_k.astype(k_layer.dtype), idx)
     v_layer = jax.lax.dynamic_update_slice(v_layer, new_v.astype(v_layer.dtype), idx)
     return k_layer, v_layer

@@ -1,0 +1,60 @@
+"""Flash-attention kernel parity vs the XLA oracle (interpret mode on CPU).
+
+Mirrors how the reference validates its GPU attention against CPU
+expectations (reference: nn-vulkan-test.cpp multihead-att cases)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.ops.attention import attention
+from dllama_tpu.ops.flash_attention import flash_attention, supports
+
+
+def _mk(B, T, H, n_kv, D, S, start_pos, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((B, T, H, D)).astype(np.float32)
+    k = np.zeros((B, n_kv, S, D), np.float32)
+    v = np.zeros((B, n_kv, S, D), np.float32)
+    # fill cache up to and including the current rows' positions
+    filled = start_pos + T
+    k[:, :, :filled] = rng.standard_normal((B, n_kv, filled, D))
+    v[:, :, :filled] = rng.standard_normal((B, n_kv, filled, D))
+    return (jnp.asarray(q, dtype), jnp.asarray(k, dtype), jnp.asarray(v, dtype))
+
+
+@pytest.mark.parametrize("B,T,H,n_kv,D,S,start_pos", [
+    (1, 1, 8, 4, 64, 256, 0),       # decode at pos 0
+    (1, 1, 8, 2, 64, 256, 200),     # decode deep into the cache
+    (1, 16, 8, 4, 64, 256, 37),     # prefill chunk mid-sequence
+    (2, 4, 4, 4, 128, 512, 5),      # MHA (kv_mul=1), batch>1, D=128
+    (1, 8, 16, 2, 64, 128, 0),      # wide GQA group, single S block
+])
+def test_matches_oracle(B, T, H, n_kv, D, S, start_pos):
+    q, k, v = _mk(B, T, H, n_kv, D, S, start_pos)
+    assert supports(q.shape, n_kv, S)
+    positions = start_pos + jnp.arange(T, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (B, T))
+
+    want = attention(q, k, v, positions, D)
+    got = flash_attention(q, k, v, jnp.int32(start_pos), D, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_bf16_cache_matches_oracle():
+    B, T, H, n_kv, D, S, start_pos = 1, 1, 8, 4, 64, 256, 100
+    q, k, v = _mk(B, T, H, n_kv, D, S, start_pos, dtype=jnp.bfloat16)
+    positions = jnp.full((B, T), start_pos, dtype=jnp.int32)
+    want = attention(q, k, v, positions, D)
+    got = flash_attention(q, k, v, jnp.int32(start_pos), D, interpret=True)
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(want, dtype=np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_supports_gate():
+    assert not supports((1, 1, 8, 64), 4, 100)      # S not tileable
+    assert supports((1, 1, 8, 64), 4, 256)
+    assert not supports((1, 2048, 8, 64), 1, 256)   # TQ too large

@@ -55,19 +55,19 @@ def golden_forward(dense, cfg: ModelConfig, tokens: np.ndarray, start_pos: int,
             q = rms(q, dense[f"block_norm_q.{l}"])
             k = rms(k, dense[f"block_norm_k.{l}"])
         q, k = rope(q, positions), rope(k, positions)
-        k_cache[l, :, start_pos:start_pos + T] = k
-        v_cache[l, :, start_pos:start_pos + T] = v
-        S = k_cache.shape[2]
+        # cache layout is head-major [L, B, H_kv, S, hd]
+        k_cache[l, :, :, start_pos:start_pos + T] = k.transpose(0, 2, 1, 3)
+        v_cache[l, :, :, start_pos:start_pos + T] = v.transpose(0, 2, 1, 3)
         att_out = np.zeros((B, T, cfg.n_heads, hd), np.float32)
         for hh in range(cfg.n_heads):
             kv_h = hh // (cfg.n_heads // cfg.n_kv_heads)
             for b in range(B):
                 for t in range(T):
                     pos = positions[b, t]
-                    scores = (k_cache[l, b, :pos + 1, kv_h] @ q[b, t, hh]) / np.sqrt(hd)
+                    scores = (k_cache[l, b, kv_h, :pos + 1] @ q[b, t, hh]) / np.sqrt(hd)
                     e = np.exp(scores - scores.max())
                     p = e / e.sum()
-                    att_out[b, t, hh] = p @ v_cache[l, b, :pos + 1, kv_h]
+                    att_out[b, t, hh] = p @ v_cache[l, b, kv_h, :pos + 1]
         x = x + att_out.reshape(B, T, -1) @ dense[f"block_matmul_wo.{l}"].T
         h = rms(x, dense[f"block_norm_1.{l}"])
         g = h @ dense[f"block_matmul_w1.{l}"].T

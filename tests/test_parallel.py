@@ -112,4 +112,4 @@ def test_kv_cache_shards_over_heads():
     cfg = _cfg()
     plan = make_tp_mesh(4)
     kv = jax.device_put(KVCache.create(cfg), kv_cache_sharding(plan, KVCache.create(cfg)))
-    assert kv.k.sharding.spec[3] == "tp"
+    assert kv.k.sharding.spec[2] == "tp"
