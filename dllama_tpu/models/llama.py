@@ -43,6 +43,7 @@ from ..ops.linear import (
 )
 from ..ops.norms import rms_norm, rms_norm_per_head
 from ..parallel.api import constrain
+from ..parallel.api import current_plan as _current_plan
 from ..runtime.kvcache import KVCache, update_layer
 from .config import ModelConfig
 from .rope import apply_rope, build_rope_cache
@@ -131,11 +132,21 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     q = apply_rope(q, cos, sin, positions, cfg.rope_type)
     k = apply_rope(k, cos, sin, positions, cfg.rope_type)
 
-    k_cache, v_cache = update_layer(k_cache, v_cache, k, v, start_pos)
-    if _use_flash(cfg, q.shape, k_cache.shape):
-        att = flash_attention(q, k_cache, v_cache, start_pos, cfg.head_dim)
+    sp_res = None
+    plan = _current_plan()
+    if plan is not None and plan.axis_size("sp") > 1:
+        from ..parallel.ring import sp_attention
+
+        sp_res = sp_attention(plan, q, k_cache, v_cache, k, v, positions,
+                              start_pos, cfg.head_dim)
+    if sp_res is not None:
+        att, k_cache, v_cache = sp_res
     else:
-        att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
+        k_cache, v_cache = update_layer(k_cache, v_cache, k, v, start_pos)
+        if _use_flash(cfg, q.shape, k_cache.shape):
+            att = flash_attention(q, k_cache, v_cache, start_pos, cfg.head_dim)
+        else:
+            att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
     att = constrain(att, "batch", None, "heads", None)
     x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo))
     x = constrain(x, "batch", None, None)

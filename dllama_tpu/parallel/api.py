@@ -72,6 +72,10 @@ class MeshPlan:
             n *= self.mesh.shape[a]
         return n
 
+    def axis_size(self, name: str) -> int:
+        """Size of a mesh axis, 1 if the mesh doesn't have it."""
+        return self.mesh.shape.get(name, 1)
+
     def sharding_for(self, shape: tuple[int, ...], *logical_axes: str | None) -> NamedSharding:
         """Shape-aware sharding: a logical axis whose dimension is not
         divisible by its mesh-axis size falls back to replicated.

@@ -1,0 +1,265 @@
+"""Ring attention / sequence-parallel attention over an ``sp`` mesh axis.
+
+Long-context capability the reference lacks entirely (SURVEY.md §5
+"Long-context / sequence parallelism: Absent" — its KV cache is a dense
+``seq_len × kv_dim0`` buffer per node and attention is a serial loop,
+src/nn/nn-cpu-ops.cpp:751-786). Here the KV cache's *sequence* dim is sharded
+across the ``sp`` mesh axis so context length scales with the number of
+chips, and attention runs as manual-SPMD (``shard_map``) with XLA collectives
+riding ICI:
+
+* **Prefill (queries seq-sharded):** classic ring attention — each device
+  computes block attention against its local KV shard while rotating the
+  K/V blocks around the ring with ``lax.ppermute``, folding each block into
+  an online-softmax accumulator ``(m, l, acc)``. ``n_sp`` steps; compute and
+  the permute of the next block overlap inside XLA's async collectives.
+* **Decode (queries replicated, T not divisible by sp):** flash-decoding
+  style — one block pass over the local KV shard, then a log-sum-exp merge
+  across the ring (``pmax`` of maxima, ``psum`` of rescaled ``l``/``acc``).
+
+Both paths share the same block/combine math, are causal via *global*
+position ids (each shard knows which absolute positions it holds), support
+GQA, and compose with ``tp`` (kv-heads sharded) and ``dp`` (batch sharded)
+inside the same shard_map.
+
+The KV-cache append (reference OP_SHIFT) happens inside the same shard_map:
+new K/V rows are all-gathered over ``sp`` (tiny: T rows vs S cache) and each
+device scatters the rows whose absolute position falls inside its shard.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+if TYPE_CHECKING:
+    from .api import MeshPlan
+
+AXIS = "sp"
+NEG_INF = float("-inf")
+
+
+# ---------------------------------------------------------------------------
+# Block math (shared by ring and merge paths). All in float32.
+# ---------------------------------------------------------------------------
+
+
+def _block_attn(qg: jax.Array, k: jax.Array, v: jax.Array,
+                mask: jax.Array, head_dim: int):
+    """Unnormalized block attention.
+
+    ``qg: [B, T, n_kv, kv_mul, hd]`` grouped queries, ``k/v: [B, n_kv, S, hd]``
+    (head-major cache block), ``mask: [B, T, S]`` True where visible.
+    Returns ``(acc [B,T,n_kv,kv_mul,hd], m [B,T,n_kv,kv_mul], l [same])`` such
+    that the true softmax-attention over this block is ``acc * exp(m') / l'``
+    terms under the usual online-softmax algebra.
+    """
+    scores = jnp.einsum("btkmh,bksh->btkms", qg, k.astype(jnp.float32))
+    scores = scores / jnp.sqrt(jnp.float32(head_dim))
+    mask_b = mask[:, :, None, None, :]
+    scores = jnp.where(mask_b, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)                      # [B,T,k,mul]; may be -inf
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.where(mask_b, jnp.exp(scores - m_safe[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("btkms,bksh->btkmh", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def _combine(m, l, acc, bm, bl, bacc):
+    """Fold block stats ``(bm, bl, bacc)`` into the running ``(m, l, acc)``.
+
+    Safe for fully-masked blocks (all stats stay 0 / -inf, no NaNs)."""
+    m_new = jnp.maximum(m, bm)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.exp(m - m_safe)       # -inf - 0 → 0, never NaN
+    beta = jnp.exp(bm - m_safe)
+    l_new = l * alpha + bl * beta
+    acc_new = acc * alpha[..., None] + bacc * beta[..., None]
+    return m_new, l_new, acc_new
+
+
+def _finish(acc, l, dtype):
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe[..., None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# In-shard KV cache append (reference OP_SHIFT, sequence-sharded)
+# ---------------------------------------------------------------------------
+
+
+def _scatter_rows(cache: jax.Array, rows: jax.Array, local_idx: jax.Array) -> jax.Array:
+    """Write ``rows: [B, n_kv, T, hd]`` into ``cache: [B, n_kv, Sl, hd]`` at
+    per-row indices ``local_idx: [T]``; out-of-range rows are dropped (they
+    belong to another shard)."""
+    s_local = cache.shape[2]
+    in_range = (local_idx >= 0) & (local_idx < s_local)
+    # map out-of-range to an OOB index so mode="drop" discards them
+    safe_idx = jnp.where(in_range, local_idx, s_local)
+    return cache.at[:, :, safe_idx, :].set(rows.astype(cache.dtype), mode="drop")
+
+
+def _append_kv(k_shard, v_shard, new_k, new_v, start_pos, t_global,
+               q_sharded: bool, n_sp: int):
+    """Inside shard_map: append the step's K/V rows into the seq-sharded cache.
+
+    ``new_k/new_v: [B, T_local, n_kv_local, hd]`` time-major (T_local =
+    T_global/n_sp when queries are sharded, else T_global replicated)."""
+    idx = lax.axis_index(AXIS)
+    s_local = k_shard.shape[2]
+    if q_sharded and n_sp > 1:
+        new_k = lax.all_gather(new_k, AXIS, axis=1, tiled=True)
+        new_v = lax.all_gather(new_v, AXIS, axis=1, tiled=True)
+    row_pos = start_pos + jnp.arange(t_global, dtype=jnp.int32)   # [T_global]
+    local_idx = row_pos - idx * s_local
+    k_rows = jnp.swapaxes(new_k, 1, 2)   # [B, n_kv, T, hd]
+    v_rows = jnp.swapaxes(new_v, 1, 2)
+    return (_scatter_rows(k_shard, k_rows, local_idx),
+            _scatter_rows(v_shard, v_rows, local_idx))
+
+
+# ---------------------------------------------------------------------------
+# The two attention paths (run inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _ring_attention_local(qg, k_shard, v_shard, q_positions, head_dim: int,
+                          n_sp: int):
+    """Ring pass: rotate KV blocks, accumulate online softmax.
+
+    ``qg: [B, Tl, n_kv, kv_mul, hd]`` local queries, ``q_positions: [B, Tl]``
+    absolute positions, ``k/v_shard: [B, n_kv, Sl, hd]`` local cache block.
+    """
+    B, Tl, n_kv, kv_mul, hd = qg.shape
+    s_local = k_shard.shape[2]
+    idx = lax.axis_index(AXIS)
+    perm = [(j, (j + 1) % n_sp) for j in range(n_sp)]
+
+    m0 = jnp.full((B, Tl, n_kv, kv_mul), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((B, Tl, n_kv, kv_mul), dtype=jnp.float32)
+    acc0 = jnp.zeros((B, Tl, n_kv, kv_mul, hd), dtype=jnp.float32)
+
+    def fold_block(r, m, l, acc, k, v):
+        # after r forward rotations this block originated on rank (idx - r)
+        src = jnp.mod(idx - r, n_sp)
+        kv_pos = src * s_local + jnp.arange(s_local, dtype=jnp.int32)
+        mask = kv_pos[None, None, :] <= q_positions[:, :, None]
+        bacc, bm, bl = _block_attn(qg, k, v, mask, head_dim)
+        return _combine(m, l, acc, bm, bl, bacc)
+
+    def step(r, carry):
+        m, l, acc, k, v = carry
+        m, l, acc = fold_block(r, m, l, acc, k, v)
+        k = lax.ppermute(k, AXIS, perm)
+        v = lax.ppermute(v, AXIS, perm)
+        return m, l, acc, k, v
+
+    # n_sp - 1 rotations; the last block is folded without the (wasted) final
+    # permute — n_sp-1 ICI rotations total per layer
+    m, l, acc, k, v = lax.fori_loop(
+        0, n_sp - 1, step, (m0, l0, acc0, k_shard, v_shard))
+    m, l, acc = fold_block(n_sp - 1, m, l, acc, k, v)
+    return acc, l
+
+
+def _merge_attention_local(qg, k_shard, v_shard, q_positions, head_dim: int):
+    """Flash-decoding pass: one local block + LSE merge over the ring.
+
+    Queries (and their positions) are replicated across ``sp``."""
+    s_local = k_shard.shape[2]
+    idx = lax.axis_index(AXIS)
+    kv_pos = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
+    mask = kv_pos[None, None, :] <= q_positions[:, :, None]
+    acc, m, l = _block_attn(qg, k_shard, v_shard, mask, head_dim)
+
+    gm = lax.pmax(m, AXIS)
+    gm_safe = jnp.where(jnp.isfinite(gm), gm, 0.0)
+    scale = jnp.exp(m - gm_safe)            # 0 for -inf locals, no NaN
+    l = lax.psum(l * scale, AXIS)
+    acc = lax.psum(acc * scale[..., None], AXIS)
+    return acc, l
+
+
+# ---------------------------------------------------------------------------
+# Public wrapper
+# ---------------------------------------------------------------------------
+
+
+def sp_supported(plan: "MeshPlan", q_shape, kv_shape) -> bool:
+    """Whether the fused sequence-parallel attention path applies."""
+    sp = plan.axis_size("sp")
+    if sp <= 1:
+        return False
+    B, T, H, hd = q_shape
+    n_kv, S = kv_shape[1], kv_shape[2]
+    if S % sp != 0:
+        return False
+    tp = plan.axis_size("tp")
+    if tp > 1 and (H % tp != 0 or n_kv % tp != 0):
+        return False  # kv replication groups don't compose with manual sp yet
+    dp = plan.axis_size("dp")
+    if B % dp != 0:
+        return False
+    return True
+
+
+def sp_attention(plan: "MeshPlan", q: jax.Array, k_cache: jax.Array,
+                 v_cache: jax.Array, new_k: jax.Array, new_v: jax.Array,
+                 positions: jax.Array, start_pos: jax.Array, head_dim: int):
+    """Fused sequence-parallel KV append + causal GQA attention.
+
+    Args (global, auto-sharded views):
+      q:        [B, T, n_heads, hd]   (post-rope)
+      k_cache:  [B, n_kv, S, hd]      sequence-sharded over ``sp``
+      new_k/v:  [B, T, n_kv, hd]      this step's rows (post-rope, time-major)
+      positions:[B, T]                absolute position of each query row
+      start_pos: scalar               absolute position of row 0
+
+    Returns ``(att [B, T, n_heads, hd], k_cache, v_cache)`` or ``None`` when
+    the path doesn't apply (caller falls back to the dense path).
+    """
+    if not sp_supported(plan, q.shape, k_cache.shape):
+        return None
+
+    mesh = plan.mesh
+    n_sp = plan.axis_size("sp")
+    B, T, H, hd = q.shape
+    n_kv = k_cache.shape[1]
+    q_sharded = T % n_sp == 0 and T > 1
+
+    dp_ax = plan.resolve("batch") if B % plan.axis_size("dp") == 0 else None
+    tp_ax = plan.resolve("heads") if H % plan.axis_size("tp") == 0 else None
+    seq_ax = AXIS if q_sharded else None
+
+    q_spec = P(dp_ax, seq_ax, tp_ax, None)
+    new_spec = P(dp_ax, seq_ax, tp_ax, None)
+    cache_spec = P(dp_ax, tp_ax, AXIS, None)
+    pos_spec = P(dp_ax, seq_ax)
+
+    def local_fn(q_l, k_l, v_l, nk_l, nv_l, pos_l, sp0):
+        k_l, v_l = _append_kv(k_l, v_l, nk_l, nv_l, sp0, T, q_sharded, n_sp)
+        Bl, Tl, Hl, _ = q_l.shape
+        n_kv_l = k_l.shape[1]
+        kv_mul = Hl // n_kv_l
+        qg = q_l.reshape(Bl, Tl, n_kv_l, kv_mul, hd).astype(jnp.float32)
+        if q_sharded:
+            acc, l = _ring_attention_local(qg, k_l, v_l, pos_l, head_dim, n_sp)
+        else:
+            acc, l = _merge_attention_local(qg, k_l, v_l, pos_l, head_dim)
+        out = _finish(acc, l, q_l.dtype).reshape(Bl, Tl, Hl, hd)
+        return out, k_l, v_l
+
+    fn = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(q_spec, cache_spec, cache_spec, new_spec, new_spec,
+                  pos_spec, P()),
+        out_specs=(q_spec, cache_spec, cache_spec),
+        check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, new_k, new_v, positions,
+              start_pos.astype(jnp.int32))

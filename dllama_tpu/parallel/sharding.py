@@ -72,15 +72,16 @@ def param_shardings(plan: MeshPlan, params: "Params") -> "Params":
 
 
 def kv_cache_sharding(plan: MeshPlan, kv: "KVCache") -> "KVCache":
-    """[L, B, n_kv, S, hd] — kv-heads over tp, batch over dp; the seq dim
-    stays replicated here (plain attention reads the whole cache — the ring
-    attention path in parallel/ring.py manages its own seq-sharded layout).
+    """[L, B, n_kv, S, hd] — kv-heads over tp, batch over dp, and the seq dim
+    over sp when the mesh has one (the ring-attention path in parallel/ring.py
+    consumes the seq-sharded layout; on tp/dp-only meshes "seq" resolves to
+    nothing and stays replicated).
 
     When tp > n_kv_heads the kv-head dim is replicated (KV replication
     groups; the reference instead caps nodes at nKvHeads)."""
     from ..runtime.kvcache import KVCache
 
-    s = plan.sharding_for(tuple(kv.k.shape), None, "batch", "kv_heads", None, None)
+    s = plan.sharding_for(tuple(kv.k.shape), None, "batch", "kv_heads", "seq", None)
     return KVCache(k=s, v=s)
 
 

@@ -1,0 +1,150 @@
+"""Ring attention / sequence parallelism vs the dense oracle.
+
+The correctness property mirrors the reference's node-count invariance
+(SURVEY.md §4) extended to the seq axis: attention over a seq-sharded KV
+cache must produce the same output as the dense single-device path, for both
+the ring (seq-sharded queries, prefill) and LSE-merge (replicated queries,
+decode) paths, alone and composed with tp/dp.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dllama_tpu.formats import mfile
+from dllama_tpu.models import ModelConfig, forward, init_random_params
+from dllama_tpu.ops.attention import attention
+from dllama_tpu.parallel import use_plan
+from dllama_tpu.parallel.api import make_mesh
+from dllama_tpu.parallel.ring import sp_attention, sp_supported
+from dllama_tpu.parallel.sharding import kv_cache_sharding, shard_params
+from dllama_tpu.runtime import KVCache
+from dllama_tpu.runtime.kvcache import update_layer
+
+
+def _rand_case(rng, B, T, H, n_kv, S, hd, start_pos):
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)), dtype=jnp.float32)
+    new_k = jnp.asarray(rng.standard_normal((B, T, n_kv, hd)), dtype=jnp.float32)
+    new_v = jnp.asarray(rng.standard_normal((B, T, n_kv, hd)), dtype=jnp.float32)
+    # cache prefilled with history rows 0..start_pos
+    k_cache = jnp.asarray(rng.standard_normal((B, n_kv, S, hd)), dtype=jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((B, n_kv, S, hd)), dtype=jnp.float32)
+    positions = start_pos + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    return q, new_k, new_v, k_cache, v_cache, positions
+
+
+def _oracle(q, new_k, new_v, k_cache, v_cache, positions, start_pos, hd):
+    k_cache, v_cache = update_layer(k_cache, v_cache, new_k, new_v,
+                                    jnp.int32(start_pos))
+    out = attention(q, k_cache, v_cache, positions, hd)
+    return out, k_cache, v_cache
+
+
+@pytest.mark.parametrize("mesh_axes,T,start_pos", [
+    ({"sp": 8}, 16, 0),        # prefill, ring path, pure sp
+    ({"sp": 4}, 1, 9),         # decode, merge path
+    ({"sp": 2, "tp": 4}, 8, 4),   # sp × tp, ring
+    ({"dp": 2, "sp": 2, "tp": 2}, 1, 13),  # 3-axis decode
+    ({"dp": 2, "sp": 2, "tp": 2}, 6, 2),   # 3-axis prefill, ring (6 % sp2 == 0)
+    ({"sp": 4}, 6, 3),   # T=6 not divisible by sp=4 → replicated-q merge, T>1
+    ({"sp": 8}, 3, 0),   # prefill chunk smaller than ring → merge, T>1
+])
+def test_sp_attention_matches_oracle(mesh_axes, T, start_pos):
+    B = 2 if "dp" in mesh_axes else 1
+    H, n_kv, S, hd = 8, 4, 32, 16
+    rng = np.random.default_rng(42 + T + start_pos)
+    q, new_k, new_v, k_cache, v_cache, positions = _rand_case(
+        rng, B, T, H, n_kv, S, hd, start_pos)
+
+    ref_out, ref_k, ref_v = _oracle(q, new_k, new_v, k_cache, v_cache,
+                                    positions, start_pos, hd)
+
+    plan = make_mesh(mesh_axes)
+    assert sp_supported(plan, q.shape, k_cache.shape)
+    got = jax.jit(lambda *a: sp_attention(plan, *a, head_dim=hd))(
+        q, k_cache, v_cache, new_k, new_v, positions, jnp.int32(start_pos))
+    out, got_k, got_v = got
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v), atol=1e-6)
+
+
+def test_sp_attention_sequential_decode():
+    """Prefill via ring then several decode steps via merge, one shared cache."""
+    B, H, n_kv, S, hd = 1, 4, 2, 16, 8
+    plan = make_mesh({"sp": 4})
+    rng = np.random.default_rng(7)
+
+    k_cache = jnp.zeros((B, n_kv, S, hd))
+    v_cache = jnp.zeros((B, n_kv, S, hd))
+    ref_k, ref_v = k_cache, v_cache
+
+    pos = 0
+    for T in (8, 1, 1, 1):
+        q, new_k, new_v, _, _, positions = _rand_case(
+            rng, B, T, H, n_kv, S, hd, pos)
+        ref_out, ref_k, ref_v = _oracle(q, new_k, new_v, ref_k, ref_v,
+                                        positions, pos, hd)
+        out, k_cache, v_cache = sp_attention(
+            plan, q, k_cache, v_cache, new_k, new_v, positions,
+            jnp.int32(pos), head_dim=hd)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                                   rtol=1e-5, atol=1e-5)
+        pos += T
+    np.testing.assert_allclose(np.asarray(k_cache), np.asarray(ref_k), atol=1e-6)
+
+
+def _cfg(**kw):
+    base = dict(
+        arch=mfile.ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2,
+        n_heads=8, n_kv_heads=4, head_dim=8, vocab_size=128, seq_len=32,
+        norm_epsilon=1e-5, rope_theta=10000.0, rope_type=mfile.RopeType.LLAMA,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.mark.parametrize("mesh_axes", [
+    {"sp": 8},
+    {"sp": 2, "tp": 4},
+    {"dp": 2, "sp": 2, "tp": 2},
+])
+def test_forward_with_sp_matches_unsharded(mesh_axes):
+    """Full model forward on an sp mesh — prefill chunk + decode step — must
+    match the single-device run (the seq-parallel analogue of
+    test_tp_logits_match_unsharded)."""
+    cfg = _cfg()
+    B = 2 if "dp" in mesh_axes else 1
+    params = init_random_params(cfg, seed=23)
+    rng = np.random.default_rng(5)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, 8)), dtype=jnp.int32)
+
+    ref_logits, ref_kv = jax.jit(forward, static_argnums=1)(
+        params, cfg, prompt, jnp.int32(0), KVCache.create(cfg, batch_size=B))
+    nxt = jnp.argmax(ref_logits[:, -1:], axis=-1).astype(jnp.int32)
+    ref_logits2, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg, nxt, jnp.int32(8), ref_kv)
+
+    plan = make_mesh(mesh_axes)
+    sharded = shard_params(plan, params)
+    kv0 = KVCache.create(cfg, batch_size=B)
+    kv = jax.device_put(kv0, kv_cache_sharding(plan, kv0))
+    with use_plan(plan):
+        logits, kv = jax.jit(forward, static_argnums=1)(
+            sharded, cfg, prompt, jnp.int32(0), kv)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                                   rtol=2e-5, atol=2e-6)
+        nxt2 = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+        logits2, _ = jax.jit(forward, static_argnums=1)(
+            sharded, cfg, nxt2, jnp.int32(8), kv)
+    np.testing.assert_allclose(np.asarray(logits2), np.asarray(ref_logits2),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_sp_unsupported_falls_back():
+    plan = make_mesh({"sp": 8})
+    # cache seq 20 not divisible by 8 → path must decline
+    assert not sp_supported(plan, (1, 4, 8, 16), (1, 4, 20, 16))
