@@ -347,6 +347,28 @@ class ModelFile:
         scales, codes = unpack_q40(self.raw(key), rows * cols)
         return (scales.reshape(rows, cols // 32), codes.reshape(rows, cols))
 
+    def tensor_q40_kmajor(self, key: str) -> tuple[np.ndarray, np.ndarray]:
+        """Read a Q40 matmul weight as K-major device planes:
+        ``scales: float32 [cols/32, rows]``, ``codes: int8 [cols, rows]``.
+
+        The single-pass native repack (dllama_tpu/native) when built — the
+        data-loader hot loop, replacing the reference's per-shard weight
+        splitter+streamer (NnRootWeightLoader, nn-network.cpp:809-854) — with
+        a numpy transpose fallback.
+        """
+        rec = self.tensors[key]
+        assert rec.float_type == Q40, rec
+        rows, cols = rec.shape
+        from .. import native
+
+        if native.available():
+            out = native.q40_repack_kmajor(self.raw(key), rows, cols)
+            if out is not None:
+                return out
+        scales, codes = self.tensor_q40_planes(key)
+        return (np.ascontiguousarray(scales.T.astype(np.float32)),
+                np.ascontiguousarray(codes.T))
+
 
 # ---------------------------------------------------------------------------
 # Writer (converter backend + test fixture generator)

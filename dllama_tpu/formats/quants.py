@@ -74,10 +74,21 @@ def quantize_q40(x: np.ndarray) -> bytes:
 
     Matches converter/writer.py:29-53 (and nn-quants.cpp:193-227): scale is the
     signed max-magnitude value divided by -8; codes are ``floor(x/d + 8.5)``
-    clipped to [0, 15].
+    clipped to [0, 15]. Dispatches to the native codec when built
+    (byte-identical; tests/test_native.py asserts it).
     """
     x = np.ascontiguousarray(x, dtype=np.float32)
     assert x.ndim == 1 and x.size % Q40_BLOCK_SIZE == 0, x.shape
+    from .. import native
+
+    nat = native.q40_quantize(x) if native.available() else None
+    if nat is not None:
+        return nat
+    return quantize_q40_np(x)
+
+
+def quantize_q40_np(x: np.ndarray) -> bytes:
+    """Portable numpy Q40 quantizer (golden model for the native codec)."""
     g = x.reshape(-1, Q40_BLOCK_SIZE)
     gmax = g.max(axis=1)
     gmin = g.min(axis=1)
@@ -96,6 +107,17 @@ def quantize_q40(x: np.ndarray) -> bytes:
 
 def dequantize_q40(buf: bytes | np.ndarray, n: int) -> np.ndarray:
     """Dequantize ``n`` elements of Q40 wire bytes to float32."""
+    from .. import native
+
+    if native.available():
+        out = native.q40_dequantize(buf, n)
+        if out is not None:
+            return out
+    return dequantize_q40_np(buf, n)
+
+
+def dequantize_q40_np(buf: bytes | np.ndarray, n: int) -> np.ndarray:
+    """Portable numpy Q40 dequantizer (golden model for the native codec)."""
     scales, q = unpack_q40(buf, n)
     return (q.astype(np.float32) * scales[:, None].astype(np.float32)).reshape(-1)
 
@@ -136,6 +158,16 @@ def quantize_q80(x: np.ndarray) -> bytes:
     """
     x = np.ascontiguousarray(x, dtype=np.float32)
     assert x.ndim == 1 and x.size % Q80_BLOCK_SIZE == 0, x.shape
+    from .. import native
+
+    nat = native.q80_quantize(x) if native.available() else None
+    if nat is not None:
+        return nat
+    return quantize_q80_np(x)
+
+
+def quantize_q80_np(x: np.ndarray) -> bytes:
+    """Portable numpy Q80 quantizer (golden model for the native codec)."""
     g = x.reshape(-1, Q80_BLOCK_SIZE)
     amax = np.abs(g).max(axis=1)
     d = (amax / 127.0).astype(np.float32)
@@ -152,6 +184,17 @@ def quantize_q80(x: np.ndarray) -> bytes:
 def dequantize_q80(buf: bytes | np.ndarray, n: int) -> np.ndarray:
     """Dequantize ``n`` elements of Q80 wire bytes to float32."""
     assert n % Q80_BLOCK_SIZE == 0, n
+    from .. import native
+
+    if native.available():
+        out = native.q80_dequantize(buf, n)
+        if out is not None:
+            return out
+    return dequantize_q80_np(buf, n)
+
+
+def dequantize_q80_np(buf: bytes | np.ndarray, n: int) -> np.ndarray:
+    """Portable numpy Q80 dequantizer (golden model for the native codec)."""
     nblocks = n // Q80_BLOCK_SIZE
     raw = np.frombuffer(buf, dtype=np.uint8, count=nblocks * Q80_BLOCK_BYTES).reshape(
         nblocks, Q80_BLOCK_BYTES

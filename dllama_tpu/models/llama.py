@@ -225,11 +225,11 @@ def load_params_from_mfile(mf: ModelFile, cfg: ModelConfig,
 
     def matmul_weight(key: str) -> Weight:
         if quantized:
-            scales, codes = mf.tensor_q40_planes(key)
-            # disk layout is out-major; device layout is K-major (QuantizedWeight)
-            return QuantizedWeight(
-                scales=jnp.asarray(scales.T.astype(np.float32)),
-                codes=jnp.asarray(np.ascontiguousarray(codes.T)))
+            # disk layout is out-major; device layout is K-major (QuantizedWeight);
+            # the repack runs in native code when built (dllama_tpu/native)
+            scales, codes = mf.tensor_q40_kmajor(key)
+            return QuantizedWeight(scales=jnp.asarray(scales),
+                                   codes=jnp.asarray(codes))
         return jnp.asarray(mf.tensor_f32(key), dtype=dense_dtype)
 
     def f32(key: str) -> jax.Array:

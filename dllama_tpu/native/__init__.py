@@ -1,0 +1,175 @@
+"""Native (C++) host-runtime components, loaded via ctypes.
+
+The TPU compute path is JAX/XLA/Pallas; the *host* runtime around it — the
+quant codecs and the mmap→device weight repack (the data-loader hot loop) —
+is native C++, like the reference's (src/nn/nn-quants.cpp, and the weight
+slicing half of src/nn/nn-network.cpp:809-854). The library is built on first
+use with ``make`` and falls back to the numpy implementations in
+:mod:`dllama_tpu.formats.quants` when a toolchain isn't available, so the
+package stays importable everywhere.
+
+All entry points are ``extern "C"`` over raw buffers; this module wraps them
+with numpy ctypes bindings. Use :func:`get_lib` (returns ``None`` when
+unavailable) or the typed wrappers below.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).resolve().parent
+_SO = _DIR / "libdllama_native.so"
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_c_f32p = ctypes.POINTER(ctypes.c_float)
+_c_u8p = ctypes.POINTER(ctypes.c_uint8)
+_c_i8p = ctypes.POINTER(ctypes.c_int8)
+
+
+def default_threads() -> int:
+    env = os.environ.get("DLLAMA_NATIVE_THREADS")
+    if env:
+        return max(1, int(env))
+    return max(1, os.cpu_count() or 1)
+
+
+def _stale() -> bool:
+    if not _SO.exists():
+        return True
+    try:
+        return (_DIR / "quants.cpp").stat().st_mtime > _SO.stat().st_mtime
+    except OSError:
+        return True
+
+
+def _build() -> bool:
+    """Build to a per-process temp name and rename into place: concurrent
+    first-use builds (pytest workers, multi-process launches) each produce a
+    valid .so and the atomic replace keeps the last one."""
+    tmp = f"libdllama_native.so.tmp.{os.getpid()}"
+    try:
+        proc = subprocess.run(
+            ["make", "-C", str(_DIR), "-s", f"SO={tmp}"],
+            capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0 or not (_DIR / tmp).exists():
+            return False
+        os.replace(_DIR / tmp, _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        (_DIR / tmp).unlink(missing_ok=True)
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, (re)building it on first call when missing
+    or older than its source; None if that fails."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DLLAMA_NO_NATIVE"):
+        return None
+    if _stale() and not _build() and not _SO.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_SO))
+    except OSError:
+        return None
+    for name, argtypes in {
+        "q40_quantize": (_c_f32p, ctypes.c_int64, _c_u8p, ctypes.c_int),
+        "q40_dequantize": (_c_u8p, ctypes.c_int64, _c_f32p, ctypes.c_int),
+        "q80_quantize": (_c_f32p, ctypes.c_int64, _c_u8p, ctypes.c_int),
+        "q80_dequantize": (_c_u8p, ctypes.c_int64, _c_f32p, ctypes.c_int),
+        "q40_repack_kmajor": (_c_u8p, ctypes.c_int64, ctypes.c_int64,
+                              _c_f32p, _c_i8p, ctypes.c_int),
+    }.items():
+        fn = getattr(lib, name)
+        fn.argtypes = list(argtypes)
+        fn.restype = None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _u8(buf) -> np.ndarray:
+    a = np.frombuffer(buf, dtype=np.uint8) if not isinstance(buf, np.ndarray) else buf
+    return np.ascontiguousarray(a.reshape(-1).view(np.uint8))
+
+
+def q40_quantize(x: np.ndarray, nthreads: int | None = None) -> bytes | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    out = np.empty((x.size // 32) * 18, dtype=np.uint8)
+    lib.q40_quantize(x.ctypes.data_as(_c_f32p), x.size,
+                     out.ctypes.data_as(_c_u8p), nthreads or default_threads())
+    return out.tobytes()
+
+
+def q40_dequantize(buf, n: int, nthreads: int | None = None) -> np.ndarray | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    raw = _u8(buf)
+    need = (n // 32) * 18
+    if raw.size < need:
+        raise ValueError(f"q40 buffer too small: {raw.size} < {need} bytes for n={n}")
+    out = np.empty(n, dtype=np.float32)
+    lib.q40_dequantize(raw.ctypes.data_as(_c_u8p), n,
+                       out.ctypes.data_as(_c_f32p), nthreads or default_threads())
+    return out
+
+
+def q80_quantize(x: np.ndarray, nthreads: int | None = None) -> bytes | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    out = np.empty((x.size // 32) * 34, dtype=np.uint8)
+    lib.q80_quantize(x.ctypes.data_as(_c_f32p), x.size,
+                     out.ctypes.data_as(_c_u8p), nthreads or default_threads())
+    return out.tobytes()
+
+
+def q80_dequantize(buf, n: int, nthreads: int | None = None) -> np.ndarray | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    raw = _u8(buf)
+    need = (n // 32) * 34
+    if raw.size < need:
+        raise ValueError(f"q80 buffer too small: {raw.size} < {need} bytes for n={n}")
+    out = np.empty(n, dtype=np.float32)
+    lib.q80_dequantize(raw.ctypes.data_as(_c_u8p), n,
+                       out.ctypes.data_as(_c_f32p), nthreads or default_threads())
+    return out
+
+
+def q40_repack_kmajor(buf, rows: int, cols: int, nthreads: int | None = None
+                      ) -> tuple[np.ndarray, np.ndarray] | None:
+    """Disk row-major Q40 [rows, cols] → K-major device planes
+    (scales_f32 [cols/32, rows], codes_i8 [cols, rows])."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    raw = _u8(buf)
+    assert raw.size == rows * (cols // 32) * 18, (raw.size, rows, cols)
+    scales = np.empty((cols // 32, rows), dtype=np.float32)
+    codes = np.empty((cols, rows), dtype=np.int8)
+    lib.q40_repack_kmajor(raw.ctypes.data_as(_c_u8p), rows, cols,
+                          scales.ctypes.data_as(_c_f32p),
+                          codes.ctypes.data_as(_c_i8p),
+                          nthreads or default_threads())
+    return scales, codes

@@ -1,0 +1,190 @@
+// Native Q40/Q80 block codecs + TPU-layout repack — the host-side runtime's
+// hot data path (the TPU-native equivalent of the reference's C++ quant layer,
+// reference: src/nn/nn-quants.cpp:67-240, and of its weight-shard loader,
+// src/nn/nn-network.cpp:809-854: here the "loader" is mmap → repack to K-major
+// planes → jax.device_put, and this file is the repack).
+//
+// Semantics are byte-identical to the numpy codecs in
+// dllama_tpu/formats/quants.py (which follow the reference converter,
+// converter/writer.py:29-74):
+//   Q40: 32-elem block = f16 scale d (signed absmax / -8) + 16 nibble bytes,
+//        code = clip(floor(x/d + 8.5), 0, 15), value = (code - 8) * d.
+//   Q80: 32-elem block = f16 scale d (absmax / 127) + 32 int8 codes,
+//        code = rint(x/d) (round-half-even, matching np.round).
+//
+// f16 conversion uses _Float16 (IEEE binary16, round-to-nearest-even —
+// matching numpy's astype(float16)). Threaded by block ranges, mirroring the
+// reference's SPLIT_THREADS (src/nn/nn-quants.hpp:82-86).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kBlock = 32;
+constexpr int64_t kQ40Bytes = 18;  // f16 + 16 nibble bytes
+constexpr int64_t kQ80Bytes = 34;  // f16 + 32 int8
+
+inline float f16_to_f32(const uint8_t* p) {
+    _Float16 h;
+    std::memcpy(&h, p, sizeof(h));
+    return (float)h;
+}
+
+inline void f32_to_f16(float x, uint8_t* p) {
+    _Float16 h = (_Float16)x;
+    std::memcpy(p, &h, sizeof(h));
+}
+
+// run fn(first_block, last_block) over nthreads ranges
+template <typename F>
+void split_blocks(int64_t n_blocks, int nthreads, F fn) {
+    if (nthreads <= 1 || n_blocks < 2 * nthreads) {
+        fn(0, n_blocks);
+        return;
+    }
+    std::vector<std::thread> ts;
+    int64_t per = (n_blocks + nthreads - 1) / nthreads;
+    for (int t = 0; t < nthreads; t++) {
+        int64_t a = t * per;
+        int64_t b = a + per < n_blocks ? a + per : n_blocks;
+        if (a >= b) break;
+        ts.emplace_back([=] { fn(a, b); });
+    }
+    for (auto& t : ts) t.join();
+}
+
+void q40_quantize_range(const float* x, uint8_t* out, int64_t b0, int64_t b1) {
+    for (int64_t b = b0; b < b1; b++) {
+        const float* g = x + b * kBlock;
+        uint8_t* o = out + b * kQ40Bytes;
+        float gmax = g[0], gmin = g[0];
+        for (int i = 1; i < kBlock; i++) {
+            if (g[i] > gmax) gmax = g[i];
+            if (g[i] < gmin) gmin = g[i];
+        }
+        float d = ((-gmin > gmax) ? gmin : gmax) / -8.0f;
+        f32_to_f16(d, o);
+        float inv = d != 0.0f ? 1.0f / d : 0.0f;
+        for (int j = 0; j < kBlock / 2; j++) {
+            float q0 = std::floor(g[j] * inv + 8.5f);
+            float q1 = std::floor(g[j + kBlock / 2] * inv + 8.5f);
+            uint8_t c0 = (uint8_t)(q0 < 0 ? 0 : (q0 > 15 ? 15 : q0));
+            uint8_t c1 = (uint8_t)(q1 < 0 ? 0 : (q1 > 15 ? 15 : q1));
+            o[2 + j] = (uint8_t)((c0 & 0xF) | ((c1 & 0xF) << 4));
+        }
+    }
+}
+
+void q40_dequantize_range(const uint8_t* in, float* out, int64_t b0, int64_t b1) {
+    for (int64_t b = b0; b < b1; b++) {
+        const uint8_t* p = in + b * kQ40Bytes;
+        float* o = out + b * kBlock;
+        float d = f16_to_f32(p);
+        for (int j = 0; j < kBlock / 2; j++) {
+            uint8_t byte = p[2 + j];
+            o[j] = (float)((int)(byte & 0x0F) - 8) * d;
+            o[j + kBlock / 2] = (float)((int)(byte >> 4) - 8) * d;
+        }
+    }
+}
+
+void q80_quantize_range(const float* x, uint8_t* out, int64_t b0, int64_t b1) {
+    for (int64_t b = b0; b < b1; b++) {
+        const float* g = x + b * kBlock;
+        uint8_t* o = out + b * kQ80Bytes;
+        float amax = 0.0f;
+        for (int i = 0; i < kBlock; i++) {
+            float a = std::fabs(g[i]);
+            if (a > amax) amax = a;
+        }
+        float d = amax / 127.0f;
+        f32_to_f16(d, o);
+        float inv = d != 0.0f ? 1.0f / d : 0.0f;
+        int8_t* q = (int8_t*)(o + 2);
+        for (int i = 0; i < kBlock; i++) {
+            // rintf under the default FE_TONEAREST = round-half-even (np.round)
+            q[i] = (int8_t)std::rint(g[i] * inv);
+        }
+    }
+}
+
+void q80_dequantize_range(const uint8_t* in, float* out, int64_t b0, int64_t b1) {
+    for (int64_t b = b0; b < b1; b++) {
+        const uint8_t* p = in + b * kQ80Bytes;
+        float* o = out + b * kBlock;
+        float d = f16_to_f32(p);
+        const int8_t* q = (const int8_t*)(p + 2);
+        for (int i = 0; i < kBlock; i++) o[i] = (float)q[i] * d;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// n = element count (multiple of 32); layouts are the wire formats above.
+void q40_quantize(const float* x, int64_t n, uint8_t* out, int nthreads) {
+    split_blocks(n / kBlock, nthreads, [&](int64_t a, int64_t b) {
+        q40_quantize_range(x, out, a, b);
+    });
+}
+
+void q40_dequantize(const uint8_t* in, int64_t n, float* out, int nthreads) {
+    split_blocks(n / kBlock, nthreads, [&](int64_t a, int64_t b) {
+        q40_dequantize_range(in, out, a, b);
+    });
+}
+
+void q80_quantize(const float* x, int64_t n, uint8_t* out, int nthreads) {
+    split_blocks(n / kBlock, nthreads, [&](int64_t a, int64_t b) {
+        q80_quantize_range(x, out, a, b);
+    });
+}
+
+void q80_dequantize(const uint8_t* in, int64_t n, float* out, int nthreads) {
+    split_blocks(n / kBlock, nthreads, [&](int64_t a, int64_t b) {
+        q80_dequantize_range(in, out, a, b);
+    });
+}
+
+// Fused unpack + transpose + f16→f32 of a Q40 matmul weight, disk row-major
+// [rows, cols] → device K-major planes: scales_f32 [cols/32, rows],
+// codes_i8 [cols, rows] (centered, in [-8, 7]). One pass over the mmap'd
+// bytes; this is the per-shard weight-load hot loop.
+void q40_repack_kmajor(const uint8_t* in, int64_t rows, int64_t cols,
+                       float* scales, int8_t* codes, int nthreads) {
+    const int64_t blocks_per_row = cols / kBlock;
+    // row-tiled transpose: within a tile the inner loop runs over rows so the
+    // K-major stores are contiguous runs (the naive row-major walk scatters
+    // every byte ~rows apart and is cache-bound)
+    constexpr int64_t kTile = 128;
+    const int64_t n_tiles = (rows + kTile - 1) / kTile;
+    split_blocks(n_tiles, nthreads, [&](int64_t t0, int64_t t1) {
+        for (int64_t t = t0; t < t1; t++) {
+            const int64_t r0 = t * kTile;
+            const int64_t r1 = (r0 + kTile < rows) ? r0 + kTile : rows;
+            for (int64_t bc = 0; bc < blocks_per_row; bc++) {
+                const int64_t c0 = bc * kBlock;
+                float* srow = scales + bc * rows;
+                for (int64_t r = r0; r < r1; r++)
+                    srow[r] = f16_to_f32(in + (r * blocks_per_row + bc) * kQ40Bytes);
+                for (int j = 0; j < kBlock / 2; j++) {
+                    int8_t* lo = codes + (c0 + j) * rows;
+                    int8_t* hi = codes + (c0 + j + kBlock / 2) * rows;
+                    for (int64_t r = r0; r < r1; r++) {
+                        uint8_t byte =
+                            in[(r * blocks_per_row + bc) * kQ40Bytes + 2 + j];
+                        lo[r] = (int8_t)((int)(byte & 0x0F) - 8);
+                        hi[r] = (int8_t)((int)(byte >> 4) - 8);
+                    }
+                }
+            }
+        }
+    });
+}
+
+}  // extern "C"

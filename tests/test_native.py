@@ -1,0 +1,88 @@
+"""Native C++ codec vs numpy golden model — byte-exact parity.
+
+The native library is the host-runtime hot path (weight load repack + codecs);
+it must be bit-identical to the portable numpy implementations, which are
+themselves byte-golden with the reference converter (test_formats.py /
+test_convert.py). Mirrors the reference's converter/writer-test.py golden-hex
+approach plus nn-cpu-ops-test.cpp's quantize→dequantize round-trips.
+"""
+
+import numpy as np
+import pytest
+
+from dllama_tpu import native
+from dllama_tpu.formats import quants
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no toolchain)")
+
+
+def _cases():
+    rng = np.random.default_rng(99)
+    yield rng.standard_normal(32 * 17).astype(np.float32) * 3.0
+    yield np.zeros(64, dtype=np.float32)                 # d == 0 path
+    yield -np.abs(rng.standard_normal(96)).astype(np.float32)  # negative absmax
+    big = rng.standard_normal(32 * 64).astype(np.float32)
+    big[::7] *= 1e4                                      # wide dynamic range
+    yield big
+    # exact rounding ties for q80: x/d lands on k+0.5 → half-to-even
+    t = np.full(32, 63.5 / 127.0, dtype=np.float32)
+    t[0] = 1.0
+    yield t
+
+
+@pytest.mark.parametrize("i,x", list(enumerate(_cases())))
+def test_q40_quantize_byte_identical(i, x):
+    assert native.q40_quantize(x) == quants.quantize_q40_np(x)
+
+
+@pytest.mark.parametrize("i,x", list(enumerate(_cases())))
+def test_q80_quantize_byte_identical(i, x):
+    assert native.q80_quantize(x) == quants.quantize_q80_np(x)
+
+
+@pytest.mark.parametrize("i,x", list(enumerate(_cases())))
+def test_dequantize_bit_identical(i, x):
+    q40 = quants.quantize_q40_np(x)
+    got = native.q40_dequantize(q40, x.size)
+    np.testing.assert_array_equal(got, quants.dequantize_q40_np(q40, x.size))
+    q80 = quants.quantize_q80_np(x)
+    got = native.q80_dequantize(q80, x.size)
+    np.testing.assert_array_equal(got, quants.dequantize_q80_np(q80, x.size))
+
+
+def test_repack_kmajor_matches_numpy_transpose():
+    rng = np.random.default_rng(5)
+    rows, cols = 24, 96
+    w = rng.standard_normal((rows, cols)).astype(np.float32)
+    buf = quants.quantize_q40_np(w.reshape(-1))
+
+    got_scales, got_codes = native.q40_repack_kmajor(buf, rows, cols)
+
+    scales, codes = quants.unpack_q40(buf, rows * cols)
+    want_scales = scales.reshape(rows, cols // 32).T.astype(np.float32)
+    want_codes = codes.reshape(rows, cols).T
+    np.testing.assert_array_equal(got_scales, want_scales)
+    np.testing.assert_array_equal(got_codes, want_codes)
+    assert got_scales.dtype == np.float32 and got_codes.dtype == np.int8
+
+
+def test_threaded_matches_single_thread():
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal(32 * 1024).astype(np.float32)
+    assert native.q40_quantize(x, nthreads=4) == native.q40_quantize(x, nthreads=1)
+    assert native.q80_quantize(x, nthreads=4) == native.q80_quantize(x, nthreads=1)
+    buf = native.q40_quantize(x)
+    rows, cols = 32, 1024
+    s1, c1 = native.q40_repack_kmajor(buf, rows, cols, nthreads=1)
+    s4, c4 = native.q40_repack_kmajor(buf, rows, cols, nthreads=4)
+    np.testing.assert_array_equal(s1, s4)
+    np.testing.assert_array_equal(c1, c4)
+
+
+def test_dispatch_uses_native():
+    """Public codecs and the native path agree end to end (mfile load path)."""
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal(32 * 8).astype(np.float32)
+    assert quants.quantize_q40(x) == quants.quantize_q40_np(x)
+    assert quants.quantize_q80(x) == quants.quantize_q80_np(x)
