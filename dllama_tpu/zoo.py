@@ -1,0 +1,289 @@
+"""Model zoo launcher — download prequantized models and generate run scripts.
+
+The TPU build's equivalent of the reference's launcher (reference:
+launch.py:17-68 model table, 77-112 download loop): same 10-model registry of
+prequantized ``.m``/``.t`` artifacts on Hugging Face, but the download is
+per-part with true byte-range resume (a killed download continues from the
+exact byte via a ``Range`` header and ``.partNN`` files; the reference
+restarts the failed part from its start), and the generated command runs the
+TPU CLI (``python -m dllama_tpu``) instead of the C++ binary.
+
+Usage::
+
+    python -m dllama_tpu.zoo llama3_2_1b_instruct_q40 [-y] [--skip-run] [--skip-script]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import shlex
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+CHUNK = 1 << 16
+ATTEMPTS = 8
+
+
+def part_suffixes(n: int) -> list[str]:
+    """aa, ab, ... az, ba, ... — the split(1) suffixes the zoo files use."""
+    return [chr(97 + i // 26) + chr(97 + i % 26) for i in range(n)]
+
+
+@dataclass(frozen=True)
+class ZooModel:
+    name: str
+    model_urls: tuple[str, ...]
+    tokenizer_url: str
+    buffer_type: str = "q80"  # activation-sync float type (all zoo models: q80)
+    mode: str = "chat"
+    extra_args: tuple[str, ...] = ("--max-seq-len", "4096")
+
+
+def _hf(repo: str, file: str) -> str:
+    return f"https://huggingface.co/{repo}/resolve/main/{file}?download=true"
+
+
+def _multipart(repo: str, base: str, n: int, sep: str = "_") -> tuple[str, ...]:
+    return tuple(_hf(repo, f"{base}{sep}{s}") for s in part_suffixes(n))
+
+
+def _entry(repo: str, model_file, tokenizer_file: str, **kw) -> ZooModel:
+    urls = (model_file if isinstance(model_file, tuple)
+            else (_hf(repo, model_file),))
+    return ZooModel(name="", model_urls=urls,
+                    tokenizer_url=_hf(repo, tokenizer_file), **kw)
+
+
+_RAW: dict[str, ZooModel] = {
+    "llama3_1_8b_instruct_q40": _entry(
+        "b4rtaz/Llama-3_1-8B-Q40-Instruct-Distributed-Llama",
+        "dllama_model_llama3.1_instruct_q40.m", "dllama_tokenizer_llama_3_1.t"),
+    "llama3_1_405b_instruct_q40": _entry(
+        "b4rtaz/Llama-3_1-405B-Q40-Instruct-Distributed-Llama",
+        _multipart("b4rtaz/Llama-3_1-405B-Q40-Instruct-Distributed-Llama",
+                   "dllama_model_llama31_405b_q40", 56),
+        "dllama_tokenizer_llama_3_1.t"),
+    "llama3_2_1b_instruct_q40": _entry(
+        "b4rtaz/Llama-3_2-1B-Q40-Instruct-Distributed-Llama",
+        "dllama_model_llama3.2-1b-instruct_q40.m", "dllama_tokenizer_llama3_2.t"),
+    "llama3_2_3b_instruct_q40": _entry(
+        "b4rtaz/Llama-3_2-3B-Q40-Instruct-Distributed-Llama",
+        "dllama_model_llama3.2-3b-instruct_q40.m", "dllama_tokenizer_llama3_2.t"),
+    "llama3_3_70b_instruct_q40": _entry(
+        "b4rtaz/Llama-3_3-70B-Q40-Instruct-Distributed-Llama",
+        _multipart("b4rtaz/Llama-3_3-70B-Q40-Instruct-Distributed-Llama",
+                   "dllama_model_llama-3.3-70b_q40", 11, sep=""),
+        "dllama_tokenizer_llama-3.3-70b.t"),
+    "deepseek_r1_distill_llama_8b_q40": _entry(
+        "b4rtaz/DeepSeek-R1-Distill-Llama-8B-Distributed-Llama",
+        "dllama_model_deepseek-r1-distill-llama-8b_q40.m",
+        "dllama_tokenizer_deepseek-r1-distill-llama-8b.t"),
+    "qwen3_0.6b_q40": _entry(
+        "b4rtaz/Qwen3-0.6B-Q40-Distributed-Llama",
+        "dllama_model_qwen3_0.6b_q40.m", "dllama_tokenizer_qwen3_0.6b.t"),
+    "qwen3_1.7b_q40": _entry(
+        "b4rtaz/Qwen3-1.7B-Q40-Distributed-Llama",
+        "dllama_model_qwen3_1.7b_q40.m", "dllama_tokenizer_qwen3_1.7b.t"),
+    "qwen3_8b_q40": _entry(
+        "b4rtaz/Qwen3-8B-Q40-Distributed-Llama",
+        "dllama_model_qwen3_8b_q40.m", "dllama_tokenizer_qwen3_8b.t"),
+    "qwen3_14b_q40": _entry(
+        "b4rtaz/Qwen3-14B-Q40-Distributed-Llama",
+        _multipart("b4rtaz/Qwen3-14B-Q40-Distributed-Llama",
+                   "dllama_model_qwen3_14b_q40", 2),
+        "dllama_tokenizer_qwen3_14b.t"),
+}
+
+MODELS: dict[str, ZooModel] = {
+    name: dataclasses.replace(m, name=name) for name, m in _RAW.items()
+}
+
+
+# ---------------------------------------------------------------------------
+# Download with byte-range resume
+# ---------------------------------------------------------------------------
+
+# fetch(url, start_byte) -> iterator of byte chunks from that offset
+Fetch = Callable[[str, int], Iterator[bytes]]
+
+_sleep = time.sleep  # monkeypatched in tests
+
+
+class RangeNotSatisfiable(Exception):
+    """The server says the requested start offset is at/past end-of-file —
+    the part on disk is already complete."""
+
+
+def _urllib_fetch(url: str, start: int) -> Iterator[bytes]:
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+
+    req = Request(url)
+    if start > 0:
+        req.add_header("Range", f"bytes={start}-")
+    try:
+        resp = urlopen(req, timeout=30)
+    except HTTPError as e:
+        if e.code == 416:  # Range Not Satisfiable: the part is fully on disk
+            raise RangeNotSatisfiable(url) from e
+        raise
+    with resp:
+        if start > 0 and resp.status != 206:
+            raise OSError(f"server ignored Range request (status {resp.status})")
+        while True:
+            chunk = resp.read(CHUNK)
+            if not chunk:
+                return
+            yield chunk
+
+
+def _download_part(url: str, part_path: Path, fetch: Fetch,
+                   log: Callable[[str], None]) -> None:
+    """Download one URL to ``part_path``, resuming from its current size."""
+    for attempt in range(ATTEMPTS):
+        start = part_path.stat().st_size if part_path.exists() else 0
+        try:
+            with open(part_path, "ab") as f:
+                for chunk in fetch(url, start):
+                    f.write(chunk)
+            return
+        except RangeNotSatisfiable:
+            # resuming past EOF: this part finished in an earlier run
+            return
+        except Exception as e:  # noqa: BLE001 - any transport error retries
+            log(f"retry {attempt + 1}/{ATTEMPTS} after error at "
+                f"byte {start}: {e}")
+            _sleep(min(attempt, 5))
+    raise OSError(f"failed to download {url} after {ATTEMPTS} attempts")
+
+
+def download_file(urls: Iterable[str], path: str | Path, fetch: Fetch | None = None,
+                  log: Callable[[str], None] = print, force: bool = False) -> Path:
+    """Download ``urls`` (multi-part pieces) into one file at ``path``.
+
+    Each part goes to ``<path>.partNN`` with byte-range resume, then the
+    parts are concatenated and removed. An existing final file is kept
+    unless ``force``.
+    """
+    path = Path(path)
+    if path.exists() and not force:
+        log(f"{path.name} already present, skipping (use --force to re-download)")
+        return path
+    fetch = fetch or _urllib_fetch
+    urls = list(urls)
+    part_paths = [path.with_name(f"{path.name}.part{i:02d}")
+                  for i in range(len(urls))]
+    for url, pp in zip(urls, part_paths):
+        log(f"downloading {url}" + (f" -> {pp.name}" if len(urls) > 1 else ""))
+        _download_part(url, pp, fetch, log)
+    tmp = path.with_name(path.name + ".assemble")
+    with open(tmp, "wb") as out:
+        for pp in part_paths:
+            with open(pp, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    out.write(chunk)
+    os.replace(tmp, path)
+    for pp in part_paths:
+        pp.unlink(missing_ok=True)
+    return path
+
+
+def download_model(name: str, models_dir: str | Path = "models",
+                   fetch: Fetch | None = None, log: Callable[[str], None] = print,
+                   force: bool = False) -> tuple[Path, Path]:
+    model = MODELS[name]
+    d = Path(models_dir) / name
+    d.mkdir(parents=True, exist_ok=True)
+    m = download_file(model.model_urls, d / f"dllama_model_{name}.m",
+                      fetch=fetch, log=log, force=force)
+    t = download_file([model.tokenizer_url], d / f"dllama_tokenizer_{name}.t",
+                      fetch=fetch, log=log, force=force)
+    return m, t
+
+
+# ---------------------------------------------------------------------------
+# Run command / script generation
+# ---------------------------------------------------------------------------
+
+
+def run_command(name: str, model_path: str | Path, tokenizer_path: str | Path) -> str:
+    model = MODELS[name]
+    if model.mode == "chat":
+        cmd = [sys.executable or "python", "-m", "dllama_tpu", "chat"]
+    else:
+        cmd = [sys.executable or "python", "-m", "dllama_tpu", "inference",
+               "--steps", "64", "--prompt", "Hello world"]
+    cmd += ["--model", str(model_path), "--tokenizer", str(tokenizer_path),
+            "--buffer-float-type", model.buffer_type]
+    cmd += list(model.extra_args)
+    return " ".join(shlex.quote(c) for c in cmd)
+
+
+def write_run_script(name: str, command: str, directory: str | Path = ".") -> Path:
+    p = Path(directory) / f"run_{name}.sh"
+    p.write_text(f"#!/bin/sh\n\n{command}\n")
+    p.chmod(0o755)
+    return p
+
+
+def usage() -> str:
+    lines = [
+        "Usage: python -m dllama_tpu.zoo <model> [options]",
+        "",
+        "Options:",
+        "  --skip-run     do not run the model after download",
+        "  --skip-script  do not create a run_<model>.sh script",
+        "  --models-dir   download directory (default: models)",
+        "  --force        re-download existing files",
+        "  -y             skip confirmation prompts",
+        "",
+        "Available models:",
+    ]
+    lines += [f"  {n}" for n in MODELS]
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dllama_tpu.zoo", usage=usage(), add_help=True)
+    parser.add_argument("model", nargs="?", default=None)
+    parser.add_argument("--skip-run", action="store_true")
+    parser.add_argument("--skip-script", action="store_true")
+    parser.add_argument("--models-dir", default="models")
+    parser.add_argument("--force", action="store_true")
+    parser.add_argument("-y", dest="yes", action="store_true")
+    args = parser.parse_args(argv)
+    if args.model is None:
+        print(usage())
+        return 1
+    name = args.model.replace("-", "_")
+    if name not in MODELS:
+        print(f"unknown model: {name}\n\n{usage()}")
+        return 1
+
+    mp, tp = download_model(name, models_dir=args.models_dir, force=args.force)
+    cmd = run_command(name, mp, tp)
+    print("\nTo run:\n")
+    print(f"  {cmd}\n")
+    if not args.skip_script:
+        script = write_run_script(name, cmd)
+        print(f"created {script}")
+    if not args.skip_run:
+        go = args.yes or input(
+            "run now? [y/N] ").strip().lower() in ("y", "yes")
+        if go:
+            return subprocess.call(cmd, shell=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,7 @@
+#!/usr/bin/env python
+"""Model zoo launcher (reference: launch.py) — see dllama_tpu/zoo.py."""
+
+from dllama_tpu.zoo import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,152 @@
+"""Model zoo launcher: registry integrity, resumable download, run scripts.
+
+Network is mocked via the ``fetch`` injection point (the environment has no
+egress); the download machinery — per-part files, byte-range resume after
+mid-stream failures, multi-part assembly — runs for real against it.
+"""
+
+import numpy as np
+import pytest
+
+from dllama_tpu import zoo
+
+
+def test_registry_matches_reference_table():
+    """Same 10 models as the reference launcher (reference: launch.py:17-68)."""
+    assert len(zoo.MODELS) == 10
+    assert zoo.MODELS["llama3_1_405b_instruct_q40"].model_urls[0].endswith(
+        "dllama_model_llama31_405b_q40_aa?download=true")
+    assert len(zoo.MODELS["llama3_1_405b_instruct_q40"].model_urls) == 56
+    assert len(zoo.MODELS["llama3_3_70b_instruct_q40"].model_urls) == 11
+    assert len(zoo.MODELS["qwen3_14b_q40"].model_urls) == 2
+    for m in zoo.MODELS.values():
+        assert m.buffer_type == "q80"
+        assert all(u.startswith("https://huggingface.co/") for u in m.model_urls)
+        assert m.tokenizer_url.endswith(".t?download=true")
+
+
+def test_part_suffixes():
+    s = zoo.part_suffixes(56)
+    assert s[0] == "aa" and s[25] == "az" and s[26] == "ba" and s[-1] == "cd"
+
+
+class FlakyStore:
+    """Fake origin: serves ranges of per-url payloads, failing mid-stream a
+    configurable number of times per url."""
+
+    def __init__(self, payloads: dict[str, bytes], failures: int = 0):
+        self.payloads = payloads
+        self.failures = {u: failures for u in payloads}
+        self.range_starts: dict[str, list[int]] = {u: [] for u in payloads}
+
+    def fetch(self, url: str, start: int):
+        data = self.payloads[url]
+        self.range_starts[url].append(start)
+        if start > 0 and start >= len(data):
+            # a real origin answers a past-EOF Range with HTTP 416
+            raise zoo.RangeNotSatisfiable(url)
+        if self.failures[url] > 0:
+            self.failures[url] -= 1
+            # emit roughly half of the remainder, then die mid-stream
+            half = data[start:start + max(1, (len(data) - start) // 2)]
+            yield half
+            raise OSError("connection reset (simulated)")
+        yield data[start:]
+
+
+@pytest.fixture(autouse=True)
+def _no_sleep(monkeypatch):
+    monkeypatch.setattr(zoo, "_sleep", lambda s: None)
+
+
+def test_download_single_file(tmp_path):
+    rng = np.random.default_rng(0)
+    data = rng.bytes(100_000)
+    store = FlakyStore({"u0": data})
+    out = zoo.download_file(["u0"], tmp_path / "f.m", fetch=store.fetch,
+                            log=lambda s: None)
+    assert out.read_bytes() == data
+
+
+def test_download_resumes_from_exact_byte(tmp_path):
+    rng = np.random.default_rng(1)
+    data = rng.bytes(64_000)
+    store = FlakyStore({"u0": data}, failures=2)
+    out = zoo.download_file(["u0"], tmp_path / "f.m", fetch=store.fetch,
+                            log=lambda s: None)
+    assert out.read_bytes() == data
+    starts = store.range_starts["u0"]
+    assert len(starts) == 3 and starts[0] == 0
+    # each retry resumed from the bytes already on disk, not from zero
+    assert starts[1] > 0 and starts[2] > starts[1]
+
+
+def test_download_multipart_assembles_in_order(tmp_path):
+    rng = np.random.default_rng(2)
+    parts = {f"u{i}": rng.bytes(10_000 + i) for i in range(4)}
+    store = FlakyStore(parts, failures=1)
+    out = zoo.download_file(list(parts), tmp_path / "big.m", fetch=store.fetch,
+                            log=lambda s: None)
+    assert out.read_bytes() == b"".join(parts.values())
+    assert not list(tmp_path.glob("*.part*"))  # parts cleaned up
+
+
+def test_download_resumes_across_restart_with_complete_part(tmp_path):
+    """A part fully downloaded before a crash must not re-download or 416-loop
+    on restart (the origin answers its past-EOF Range with 416)."""
+    rng = np.random.default_rng(4)
+    parts = {f"u{i}": rng.bytes(8_000) for i in range(3)}
+    # simulate the pre-crash state: part00 complete, part01 half done
+    (tmp_path / "big.m.part00").write_bytes(parts["u0"])
+    (tmp_path / "big.m.part01").write_bytes(parts["u1"][:4_000])
+    store = FlakyStore(parts)
+    out = zoo.download_file(list(parts), tmp_path / "big.m", fetch=store.fetch,
+                            log=lambda s: None)
+    assert out.read_bytes() == b"".join(parts.values())
+    assert store.range_starts["u0"] == [8_000]   # 416'd, no re-download
+    assert store.range_starts["u1"] == [4_000]   # resumed from exact byte
+
+
+def test_run_command_quotes_paths_with_spaces(tmp_path):
+    cmd = zoo.run_command("qwen3_8b_q40", "/tmp/My Models/m.m", "/tmp/t.t")
+    assert "'/tmp/My Models/m.m'" in cmd
+
+
+def test_download_gives_up_after_max_attempts(tmp_path):
+    store = FlakyStore({"u0": b"x" * 1000}, failures=zoo.ATTEMPTS + 1)
+    with pytest.raises(OSError, match="failed to download"):
+        zoo.download_file(["u0"], tmp_path / "f.m", fetch=store.fetch,
+                          log=lambda s: None)
+
+
+def test_existing_file_skipped_unless_force(tmp_path):
+    p = tmp_path / "f.m"
+    p.write_bytes(b"old")
+    store = FlakyStore({"u0": b"new"})
+    zoo.download_file(["u0"], p, fetch=store.fetch, log=lambda s: None)
+    assert p.read_bytes() == b"old" and store.range_starts["u0"] == []
+    zoo.download_file(["u0"], p, fetch=store.fetch, log=lambda s: None, force=True)
+    assert p.read_bytes() == b"new"
+
+
+def test_download_model_layout_and_run_script(tmp_path):
+    name = "qwen3_14b_q40"
+    urls = list(zoo.MODELS[name].model_urls) + [zoo.MODELS[name].tokenizer_url]
+    store = FlakyStore({u: f"data-{i}".encode() for i, u in enumerate(urls)})
+    mp, tp = zoo.download_model(name, models_dir=tmp_path, fetch=store.fetch,
+                                log=lambda s: None)
+    assert mp == tmp_path / name / f"dllama_model_{name}.m"
+    assert mp.read_bytes() == b"data-0data-1"
+    assert tp.read_bytes() == b"data-2"
+
+    cmd = zoo.run_command(name, mp, tp)
+    assert "-m dllama_tpu chat" in cmd
+    assert f"--model {mp}" in cmd and "--buffer-float-type q80" in cmd
+    assert "--max-seq-len 4096" in cmd
+    script = zoo.write_run_script(name, cmd, tmp_path)
+    assert script.read_text().startswith("#!/bin/sh\n") and cmd in script.read_text()
+
+
+def test_cli_unknown_model(capsys):
+    assert zoo.main(["nope"]) == 1
+    assert "Available models" in capsys.readouterr().out
