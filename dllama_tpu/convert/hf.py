@@ -23,10 +23,13 @@ FLOAT_TYPE_BY_NAME = {"f32": F32, "q40": Q40, "q80": Q80}
 FLOAT_NAME_BY_TYPE = {v: k for k, v in FLOAT_TYPE_BY_NAME.items()}
 
 ARCH_BY_MODEL_TYPE = {
-    # reference: convert-hf.py:144-152
+    # reference: convert-hf.py:144-152; the MoE entries are ours (the
+    # reference can convert Mixtral experts but not run them)
     "llama": ArchType.LLAMA,
     "mistral": ArchType.LLAMA,
+    "mixtral": ArchType.LLAMA,
     "qwen3": ArchType.QWEN3,
+    "qwen3_moe": ArchType.QWEN3,
 }
 
 HIDDEN_ACT_BY_NAME = {"gelu": HiddenAct.GELU, "silu": HiddenAct.SILU}
@@ -96,10 +99,22 @@ def load_hf_config(folder: str | Path, weight_float_type: int) -> dict:
         "vocab_size": cfg["vocab_size"],
     }
 
-    n_experts = cfg.get("num_local_experts")
+    # Mixtral: num_local_experts; Qwen3-MoE: num_experts (+ the experts' own
+    # hidden size in moe_intermediate_size, which becomes the header's
+    # hidden_dim since MoE layers have no dense FFN)
+    n_experts = cfg.get("num_local_experts") or cfg.get("num_experts")
     n_active = cfg.get("num_active_local_experts") or cfg.get("num_experts_per_tok")
     params["n_experts"] = int(n_experts) if n_experts else 0
     params["n_active_experts"] = int(n_active) if n_active else 0
+    if params["n_experts"] > 0:
+        if cfg.get("moe_intermediate_size"):
+            params["hidden_dim"] = int(cfg["moe_intermediate_size"])
+        # Mixtral always renormalizes the selected router weights; Qwen3-MoE
+        # follows norm_topk_prob (HF Qwen3MoeConfig default: False)
+        if model_type == "qwen3_moe":
+            params["moe_norm_topk"] = int(bool(cfg.get("norm_topk_prob", False)))
+        else:
+            params["moe_norm_topk"] = 1
 
     if cfg.get("rope_theta") is not None:
         params["rope_theta"] = int(cfg["rope_theta"])
@@ -172,14 +187,22 @@ def hf_tensor_plan(params: dict) -> list[PlanItem]:
         plan.append(PlanItem((f"{pre}.self_attn.v_proj.weight",), wt))
         plan.append(PlanItem((f"{pre}.self_attn.o_proj.weight",), wt))
         if params["n_experts"] > 0:
-            # Expert emission order mirrors the reference converter even though
-            # neither runtime consumes MoE weights yet (reference:
-            # convert-hf.py:73-80; SURVEY.md §2.2 "EP: NO at runtime").
+            # Router first — OUR extension (block_moe_gate; the reference
+            # converter omits it, making its MoE files unrunnable) — then the
+            # experts in the reference's w3/w1/w2 order (convert-hf.py:73-80).
+            # Key pairs cover Mixtral (block_sparse_moe.*) and Qwen3-MoE
+            # (mlp.gate / mlp.experts.*.{gate,down,up}_proj) checkpoints.
+            plan.append(PlanItem((f"{pre}.block_sparse_moe.gate.weight",
+                                  f"{pre}.mlp.gate.weight"), F32))
             for e in range(params["n_experts"]):
-                eb = f"{pre}.block_sparse_moe.experts.{e}"
-                plan.append(PlanItem((f"{eb}.w3.weight",), wt))
-                plan.append(PlanItem((f"{eb}.w1.weight",), wt))
-                plan.append(PlanItem((f"{eb}.w2.weight",), wt))
+                mx = f"{pre}.block_sparse_moe.experts.{e}"
+                qw = f"{pre}.mlp.experts.{e}"
+                plan.append(PlanItem((f"{mx}.w3.weight",
+                                      f"{qw}.up_proj.weight"), wt))
+                plan.append(PlanItem((f"{mx}.w1.weight",
+                                      f"{qw}.gate_proj.weight"), wt))
+                plan.append(PlanItem((f"{mx}.w2.weight",
+                                      f"{qw}.down_proj.weight"), wt))
         else:
             plan.append(PlanItem((f"{pre}.mlp.gate_proj.weight",), wt))  # w1
             plan.append(PlanItem((f"{pre}.mlp.down_proj.weight",), wt))  # w2

@@ -57,6 +57,10 @@ class HeaderKey(enum.IntEnum):
     ROPE_TYPE = 18
     HEAD_DIM = 19
     NORM_EPSILON = 20
+    # OUR format extension (reference keys stop at 20): whether MoE router
+    # weights are renormalized over the selected top-k (HF norm_topk_prob;
+    # Mixtral always normalizes, Qwen3-MoE defaults to raw softmax probs).
+    MOE_NORM_TOPK = 21
 
 
 class ArchType(enum.IntEnum):
@@ -92,6 +96,7 @@ class ModelHeader:
     n_kv_heads: int = 0
     n_experts: int = 0
     n_active_experts: int = 0
+    moe_norm_topk: int = 1  # renormalize selected router weights (sum to 1)
     vocab_size: int = 0
     orig_seq_len: int = 0
     seq_len: int = 0
@@ -165,6 +170,8 @@ def parse_header(raw: bytes, path_size: int, max_seq_len: int = 0,
             h.n_experts = value
         elif key == HeaderKey.N_ACTIVE_EXPERTS:
             h.n_active_experts = value
+        elif key == HeaderKey.MOE_NORM_TOPK:
+            h.moe_norm_topk = value
         elif key == HeaderKey.VOCAB_SIZE:
             h.vocab_size = value
         elif key == HeaderKey.SEQ_LEN:
@@ -233,6 +240,9 @@ class ModelFile:
     path: str
     header: ModelHeader
     tensors: dict[str, TensorRecord] = field(default_factory=dict)
+    # False when an MoE file was written without our block_moe_gate extension
+    # (i.e. by the reference converter) — parseable but not runnable.
+    has_moe_router: bool = True
 
     _mm: mmap.mmap | None = None
     _file: object | None = None
@@ -252,7 +262,19 @@ class ModelFile:
             mf = cls(path=path, header=header)
             mf._mm = mm
             mf._file = f
-            mf._walk()
+            try:
+                mf._walk()
+            except ValueError as with_router_err:
+                if header.n_experts <= 0:
+                    raise
+                try:
+                    # reference-converter MoE layout: no router tensors
+                    mf._walk(moe_router=False)
+                except ValueError:
+                    # neither layout fits — corrupt/truncated file; surface
+                    # the router-ful expectation, not the fallback's
+                    raise with_router_err from None
+                mf.has_moe_router = False
         except Exception:
             mm.close()
             f.close()
@@ -274,18 +296,21 @@ class ModelFile:
         self.close()
 
     def _add(self, name: str, layer: int, shape: tuple[int, ...], float_type: int,
-             offset: int) -> int:
+             offset: int, expert: int | None = None) -> int:
         n = int(np.prod(shape))
         nb = tensor_bytes(float_type, n)
         key = f"{name}.{layer}" if layer >= 0 else name
+        if expert is not None:
+            key = f"{key}.{expert}"
         self.tensors[key] = TensorRecord(name=name, layer=layer, shape=shape,
                                          float_type=float_type, offset=offset, n_bytes=nb)
         return nb
 
-    def _walk(self) -> None:
+    def _walk(self, moe_router: bool = True) -> None:
         h = self.header
         wt = h.weight_type
         off = h.header_size
+        self.tensors.clear()
         # Tensor names mirror the reference's op names so parity is auditable
         # (llm.cpp:503-538).
         off += self._add("embedding", -1, (h.vocab_size, h.dim), F32, off)
@@ -294,9 +319,27 @@ class ModelFile:
             off += self._add("block_matmul_k", l, (h.kv_dim, h.dim), wt, off)
             off += self._add("block_matmul_v", l, (h.kv_dim, h.dim), wt, off)
             off += self._add("block_matmul_wo", l, (h.dim, h.q_dim), wt, off)
-            off += self._add("block_matmul_w1", l, (h.hidden_dim, h.dim), wt, off)
-            off += self._add("block_matmul_w2", l, (h.dim, h.hidden_dim), wt, off)
-            off += self._add("block_matmul_w3", l, (h.hidden_dim, h.dim), wt, off)
+            if h.n_experts > 0:
+                # Expert disk order (w3, w1, w2 per expert) matches the
+                # reference converter (convert-hf.py:73-80). The router
+                # (block_moe_gate) is OUR format extension: the reference
+                # converter never emits it and its runtime can't run MoE at
+                # all (SURVEY.md §2.2); files without it still parse
+                # (has_moe_router=False) but can't be run.
+                if moe_router:
+                    off += self._add("block_moe_gate", l, (h.n_experts, h.dim),
+                                     F32, off)
+                for e in range(h.n_experts):
+                    off += self._add("block_expert_w3", l, (h.hidden_dim, h.dim),
+                                     wt, off, expert=e)
+                    off += self._add("block_expert_w1", l, (h.hidden_dim, h.dim),
+                                     wt, off, expert=e)
+                    off += self._add("block_expert_w2", l, (h.dim, h.hidden_dim),
+                                     wt, off, expert=e)
+            else:
+                off += self._add("block_matmul_w1", l, (h.hidden_dim, h.dim), wt, off)
+                off += self._add("block_matmul_w2", l, (h.dim, h.hidden_dim), wt, off)
+                off += self._add("block_matmul_w3", l, (h.hidden_dim, h.dim), wt, off)
             if h.arch_type == ArchType.QWEN3:
                 off += self._add("block_norm_q", l, (h.head_dim,), F32, off)
                 off += self._add("block_norm_k", l, (h.head_dim,), F32, off)

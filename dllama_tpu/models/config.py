@@ -33,6 +33,12 @@ class ModelConfig:
     hidden_act: HiddenAct = HiddenAct.SILU
     n_experts: int = 0
     n_active_experts: int = 0
+    # Renormalize the selected top-k router weights to sum to 1 (HF
+    # norm_topk_prob; Mixtral semantics and Qwen3-MoE with norm_topk_prob
+    # true). False keeps the raw softmax probabilities (sum < 1). Note:
+    # softmax-then-topk-renorm and topk-then-softmax are the same function —
+    # only the renorm-vs-raw choice changes behavior.
+    moe_norm_topk: bool = True
 
     # TPU execution choices (no reference equivalent):
     compute_dtype: str = "float32"  # "float32" for parity, "bfloat16" for speed
@@ -63,6 +69,10 @@ class ModelConfig:
         """Qwen3 applies per-head RMS norm to q/k before rope (llm.cpp:285-309)."""
         return self.arch == ArchType.QWEN3
 
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
     @classmethod
     def from_header(cls, h: ModelHeader, compute_dtype: str = "float32") -> "ModelConfig":
         from ..formats.quants import Q80
@@ -88,6 +98,7 @@ class ModelConfig:
             hidden_act=h.hidden_act,
             n_experts=h.n_experts,
             n_active_experts=h.n_active_experts,
+            moe_norm_topk=bool(h.moe_norm_topk),
             compute_dtype=compute_dtype,
         )
 

@@ -56,13 +56,20 @@ class LayerParams(NamedTuple):
     wk: Weight  # [L, kv_dim, dim]
     wv: Weight  # [L, kv_dim, dim]
     wo: Weight  # [L, dim, q_dim]
-    w1: Weight  # [L, hidden_dim, dim]   (gate)
-    w2: Weight  # [L, dim, hidden_dim]   (down)
-    w3: Weight  # [L, hidden_dim, dim]   (up)
+    w1: Weight | None  # [L, hidden_dim, dim]   (gate; None for MoE layers)
+    w2: Weight | None  # [L, dim, hidden_dim]   (down)
+    w3: Weight | None  # [L, hidden_dim, dim]   (up)
     norm_att: jax.Array  # [L, dim]
     norm_ffn: jax.Array  # [L, dim]
     norm_q: jax.Array | None  # [L, head_dim] (qwen3) or None
     norm_k: jax.Array | None
+    # MoE (None for dense models). Expert weights are kept dense (compute
+    # dtype): the quantized Pallas matmul path doesn't cover the stacked
+    # expert axis yet.
+    moe_gate: jax.Array | None = None  # [L, E, dim] router
+    we1: jax.Array | None = None       # [L, E, hidden_dim, dim] (gate)
+    we2: jax.Array | None = None       # [L, E, dim, hidden_dim] (down)
+    we3: jax.Array | None = None       # [L, E, hidden_dim, dim] (up)
 
 
 class Params(NamedTuple):
@@ -101,6 +108,43 @@ def _hidden_act(cfg: ModelConfig, x: jax.Array) -> jax.Array:
         return jax.nn.silu(x)
     # tanh-approx gelu (reference: gelu_F32, nn-cpu-ops.cpp:1133-1142)
     return jax.nn.gelu(x, approximate=True)
+
+
+def _moe_ffn(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Array:
+    """Mixture-of-experts SwiGLU FFN — new capability (the reference parses
+    N_EXPERTS but its graph builder never emits expert ops, SURVEY.md §2.2).
+
+    Router: softmax over all expert logits, top-k, then either renormalize
+    the selected weights to sum to 1 (cfg.moe_norm_topk — Mixtral semantics,
+    and note renormalizing is identical to softmaxing the selected logits)
+    or keep the raw probabilities (Qwen3-MoE with HF norm_topk_prob false).
+    Compute is dense over the expert axis — every expert runs on every token,
+    weighted by the (sparse) gate — which is exact and shards cleanly: with
+    "experts" mapped to the ``ep`` mesh axis each device computes only its
+    local experts and XLA psums the combine. A grouped/megablocks-style
+    sparse matmul is a planned optimization.
+    """
+    E, k = cfg.n_experts, cfg.n_active_experts
+    logits = jnp.einsum("btd,ed->bte", h.astype(jnp.float32),
+                        lp.moe_gate.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    top, idx = jax.lax.top_k(probs, k)
+    if cfg.moe_norm_topk:
+        weights = top / jnp.sum(top, axis=-1, keepdims=True)
+    else:
+        weights = top
+    one_hot = jax.nn.one_hot(idx, E, dtype=jnp.float32)      # [B,T,k,E]
+    gates = jnp.einsum("btke,btk->bte", one_hot, weights)    # sparse rows
+    gates = constrain(gates, "batch", None, "experts")
+
+    ht = h.astype(cfg.compute_dtype)
+    h1 = jnp.einsum("btd,ehd->bteh", ht, lp.we1)
+    h3 = jnp.einsum("btd,ehd->bteh", ht, lp.we3)
+    a = _hidden_act(cfg, h1) * h3
+    a = constrain(a, "batch", None, "experts", "hidden")
+    y = jnp.einsum("bteh,edh,bte->btd", a, lp.we2,
+                   gates.astype(cfg.compute_dtype))
+    return y.astype(h.dtype)
 
 
 def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
@@ -151,12 +195,15 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo))
     x = constrain(x, "batch", None, None)
 
-    # -- ffn half (reference ff segment, llm.cpp:369-439) ------------------
+    # -- ffn half (reference ff segment, llm.cpp:369-439; MoE is new) ------
     h = fq(rms_norm(x, lp.norm_ffn, cfg.norm_epsilon))
-    gate = _hidden_act(cfg, linear(h, lp.w1))
-    up = linear(h, lp.w3)
-    hidden = constrain(fq(gate * up), "batch", None, "hidden")
-    x = x + fq(linear(hidden, lp.w2))
+    if cfg.is_moe:
+        x = x + fq(_moe_ffn(cfg, h, lp))
+    else:
+        gate = _hidden_act(cfg, linear(h, lp.w1))
+        up = linear(h, lp.w3)
+        hidden = constrain(fq(gate * up), "batch", None, "hidden")
+        x = x + fq(linear(hidden, lp.w2))
     x = constrain(x, "batch", None, None)
     return x, k_cache, v_cache
 
@@ -235,20 +282,47 @@ def load_params_from_mfile(mf: ModelFile, cfg: ModelConfig,
     def f32(key: str) -> jax.Array:
         return jnp.asarray(mf.tensor_f32(key))
 
+    moe = h.n_experts > 0
+    if moe and not mf.has_moe_router:
+        raise ValueError(
+            "MoE model file has no router tensors (written by the reference "
+            "converter, which never emits block_moe_gate) — reconvert with "
+            "python -m dllama_tpu.convert")
+
+    def expert_stack(name: str) -> jax.Array:
+        """[L, E, out, in] dense expert weights in compute dtype (cast
+        per-tensor before stacking to keep host peak memory at the target
+        dtype, not f32)."""
+        target = jnp.dtype(cfg.compute_dtype)  # ml_dtypes-backed, numpy-compatible
+        first = mf.tensor_f32(f"{name}.0.0")
+        out = np.empty((h.n_layers, h.n_experts) + first.shape, dtype=target)
+        for l in range(h.n_layers):
+            for e in range(h.n_experts):
+                out[l, e] = mf.tensor_f32(f"{name}.{l}.{e}")
+        return jnp.asarray(out)
+
     layers = LayerParams(
         wq=_stack_weights([matmul_weight(f"block_matmul_q.{l}") for l in range(h.n_layers)]),
         wk=_stack_weights([matmul_weight(f"block_matmul_k.{l}") for l in range(h.n_layers)]),
         wv=_stack_weights([matmul_weight(f"block_matmul_v.{l}") for l in range(h.n_layers)]),
         wo=_stack_weights([matmul_weight(f"block_matmul_wo.{l}") for l in range(h.n_layers)]),
-        w1=_stack_weights([matmul_weight(f"block_matmul_w1.{l}") for l in range(h.n_layers)]),
-        w2=_stack_weights([matmul_weight(f"block_matmul_w2.{l}") for l in range(h.n_layers)]),
-        w3=_stack_weights([matmul_weight(f"block_matmul_w3.{l}") for l in range(h.n_layers)]),
+        w1=None if moe else _stack_weights(
+            [matmul_weight(f"block_matmul_w1.{l}") for l in range(h.n_layers)]),
+        w2=None if moe else _stack_weights(
+            [matmul_weight(f"block_matmul_w2.{l}") for l in range(h.n_layers)]),
+        w3=None if moe else _stack_weights(
+            [matmul_weight(f"block_matmul_w3.{l}") for l in range(h.n_layers)]),
         norm_att=jnp.stack([f32(f"block_norm_0.{l}") for l in range(h.n_layers)]),
         norm_ffn=jnp.stack([f32(f"block_norm_1.{l}") for l in range(h.n_layers)]),
         norm_q=(jnp.stack([f32(f"block_norm_q.{l}") for l in range(h.n_layers)])
                 if h.arch_type == ArchType.QWEN3 else None),
         norm_k=(jnp.stack([f32(f"block_norm_k.{l}") for l in range(h.n_layers)])
                 if h.arch_type == ArchType.QWEN3 else None),
+        moe_gate=(jnp.stack([f32(f"block_moe_gate.{l}") for l in range(h.n_layers)])
+                  if moe else None),
+        we1=expert_stack("block_expert_w1") if moe else None,
+        we2=expert_stack("block_expert_w2") if moe else None,
+        we3=expert_stack("block_expert_w3") if moe else None,
     )
     return Params(
         embedding=f32("embedding"),
@@ -273,18 +347,27 @@ def init_random_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02,
         return jnp.asarray(w, dtype=dtype)
 
     qwen3 = cfg.arch == ArchType.QWEN3
+    moe = cfg.is_moe
     layers = LayerParams(
         wq=mk(cfg.q_dim, cfg.dim),
         wk=mk(cfg.kv_dim, cfg.dim),
         wv=mk(cfg.kv_dim, cfg.dim),
         wo=mk(cfg.dim, cfg.q_dim),
-        w1=mk(cfg.hidden_dim, cfg.dim),
-        w2=mk(cfg.dim, cfg.hidden_dim),
-        w3=mk(cfg.hidden_dim, cfg.dim),
+        w1=None if moe else mk(cfg.hidden_dim, cfg.dim),
+        w2=None if moe else mk(cfg.dim, cfg.hidden_dim),
+        w3=None if moe else mk(cfg.hidden_dim, cfg.dim),
         norm_att=jnp.asarray(1.0 + rand(cfg.n_layers, cfg.dim)),
         norm_ffn=jnp.asarray(1.0 + rand(cfg.n_layers, cfg.dim)),
         norm_q=jnp.asarray(1.0 + rand(cfg.n_layers, cfg.head_dim)) if qwen3 else None,
         norm_k=jnp.asarray(1.0 + rand(cfg.n_layers, cfg.head_dim)) if qwen3 else None,
+        moe_gate=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.dim))
+                  if moe else None),
+        we1=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.hidden_dim, cfg.dim),
+                         dtype=cfg.compute_dtype) if moe else None),
+        we2=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.dim, cfg.hidden_dim),
+                         dtype=cfg.compute_dtype) if moe else None),
+        we3=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.hidden_dim, cfg.dim),
+                         dtype=cfg.compute_dtype) if moe else None),
     )
     logits = rand(cfg.vocab_size, cfg.dim)
     return Params(

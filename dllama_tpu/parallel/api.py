@@ -34,6 +34,7 @@ DEFAULT_RULES: dict[str, str | tuple[str, ...] | None] = {
     "hidden": "tp",
     "vocab": "tp",
     "q_dim": "tp",
+    "experts": "ep",
 }
 
 

@@ -55,13 +55,23 @@ def param_shardings(plan: MeshPlan, params: "Params") -> "Params":
         wk=_weight_sharding(plan, lp.wk, "kv_heads", None, True),
         wv=_weight_sharding(plan, lp.wv, "kv_heads", None, True),
         wo=_weight_sharding(plan, lp.wo, None, "heads", True),
-        w1=_weight_sharding(plan, lp.w1, "hidden", None, True),
-        w2=_weight_sharding(plan, lp.w2, None, "hidden", True),
-        w3=_weight_sharding(plan, lp.w3, "hidden", None, True),
+        w1=None if lp.w1 is None else _weight_sharding(plan, lp.w1, "hidden", None, True),
+        w2=None if lp.w2 is None else _weight_sharding(plan, lp.w2, None, "hidden", True),
+        w3=None if lp.w3 is None else _weight_sharding(plan, lp.w3, "hidden", None, True),
         norm_att=plan.sharding(None, None),
         norm_ffn=plan.sharding(None, None),
         norm_q=None if lp.norm_q is None else plan.sharding(None, None),
         norm_k=None if lp.norm_k is None else plan.sharding(None, None),
+        # MoE: experts over ep, expert-hidden over tp (new capability; the
+        # reference has no runtime MoE, SURVEY.md §2.2)
+        moe_gate=None if lp.moe_gate is None else plan.sharding_for(
+            tuple(lp.moe_gate.shape), None, "experts", None),
+        we1=None if lp.we1 is None else plan.sharding_for(
+            tuple(lp.we1.shape), None, "experts", "hidden", None),
+        we2=None if lp.we2 is None else plan.sharding_for(
+            tuple(lp.we2.shape), None, "experts", None, "hidden"),
+        we3=None if lp.we3 is None else plan.sharding_for(
+            tuple(lp.we3.shape), None, "experts", "hidden", None),
     )
     return Params(
         embedding=plan.sharding(None, None),
@@ -108,3 +118,11 @@ def validate_tp(cfg: "ModelConfig", tp: int) -> None:
         raise ValueError(
             f"tp={tp} incompatible with n_kv_heads={cfg.n_kv_heads}: needs "
             f"either n_kv_heads % tp == 0 or tp % n_kv_heads == 0 (replication)")
+
+
+def validate_ep(cfg: "ModelConfig", ep: int) -> None:
+    """Expert-parallel divisibility (new capability; no reference analogue)."""
+    if not cfg.is_moe:
+        raise ValueError("ep axis requires an MoE model (n_experts > 0)")
+    if cfg.n_experts % ep != 0:
+        raise ValueError(f"n_experts {cfg.n_experts} not divisible by ep={ep}")

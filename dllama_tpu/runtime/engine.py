@@ -28,7 +28,7 @@ from ..formats.mfile import ModelFile
 from ..formats.quants import F32, Q80
 from ..models.config import ModelConfig
 from ..models.llama import Params, forward, load_params_from_mfile
-from ..parallel.api import MeshPlan, make_tp_mesh, use_plan
+from ..parallel.api import MeshPlan, make_mesh, use_plan
 from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.sampler import Sampler
@@ -78,7 +78,7 @@ class InferenceEngine:
     """Owns config, params, KV cache, and the jitted step functions."""
 
     def __init__(self, model_path: str, tokenizer_path: str | None = None, *,
-                 tp: int | None = None, max_seq_len: int = 0,
+                 tp: int | None = None, sp: int = 1, max_seq_len: int = 0,
                  weight_mode: str = "auto", sync_type: int = F32,
                  n_batches: int = DEFAULT_N_BATCHES,
                  temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5):
@@ -92,12 +92,21 @@ class InferenceEngine:
         n_dev = len(jax.devices())
         if tp is None:
             # largest power-of-2 device count the model's shapes accept
+            # (after reserving the sp axis)
             tp = 1
-            while (tp * 2 <= n_dev and _tp_ok(self.cfg, tp * 2)):
+            while (sp * tp * 2 <= n_dev and _tp_ok(self.cfg, tp * 2)):
                 tp *= 2
-        self.tp = tp
-        self.plan: MeshPlan | None = make_tp_mesh(tp) if tp > 1 else None
-        if self.plan is not None:
+        self.tp, self.sp = tp, sp
+        if sp > 1 and self.cfg.seq_len % sp != 0:
+            # sp = sequence parallelism: KV cache seq-sharded, ring attention
+            # (parallel/ring.py) — long-context capability with no reference
+            # analogue (SURVEY.md §5)
+            raise ValueError(
+                f"seq_len {self.cfg.seq_len} not divisible by sp={sp} "
+                f"(adjust --max-seq-len)")
+        axes = {name: n for name, n in (("sp", sp), ("tp", tp)) if n > 1}
+        self.plan: MeshPlan | None = make_mesh(axes) if axes else None
+        if tp > 1:
             validate_tp(self.cfg, tp)
 
         params = load_params_from_mfile(self.model_file, self.cfg, weight_mode)

@@ -46,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--nbatches", type=int, default=DEFAULT_N_BATCHES)
     p.add_argument("--tp", type=int, default=None,
                    help="tensor-parallel device count (reference: number of nodes)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel device count (ring attention; "
+                        "long-context — no reference equivalent)")
     p.add_argument("--port", type=int, default=9990, help="api mode port")
     p.add_argument("--host", default="127.0.0.1", help="api mode bind host")
     # accepted for reference-flag compatibility; no-ops on TPU:
@@ -61,7 +64,8 @@ def make_engine(args) -> InferenceEngine:
     seed = args.seed if args.seed is not None else int(time.time())
     engine = InferenceEngine(
         args.model, args.tokenizer,
-        tp=args.tp, max_seq_len=args.max_seq_len, weight_mode=args.weight_mode,
+        tp=args.tp, sp=args.sp, max_seq_len=args.max_seq_len,
+        weight_mode=args.weight_mode,
         sync_type=Q80 if args.buffer_float_type == "q80" else F32,
         n_batches=args.nbatches,
         temperature=args.temperature, topp=args.topp, seed=seed,
@@ -69,7 +73,7 @@ def make_engine(args) -> InferenceEngine:
     h = engine.model_file.header
     print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "
           f"Heads: {h.n_heads}/{h.n_kv_heads}  SeqLen: {h.seq_len}")
-    print(f"🕸️ TP devices: {engine.tp}")
+    print(f"🕸️ TP devices: {engine.tp}  SP devices: {engine.sp}")
     return engine
 
 
@@ -211,7 +215,28 @@ def run_worker(args) -> int:
 
 
 def main(argv=None) -> int:
+    import os
+
     args = build_parser().parse_args(argv)
+    if args.mode != "worker":
+        # Honor an explicit JAX_PLATFORMS (e.g. the virtual CPU mesh:
+        # JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8)
+        # in case a site hook re-pinned the platform at interpreter start; only
+        # possible before the backend initializes. Worker mode must not touch
+        # jax at all here: jax.distributed.initialize() requires a fresh
+        # backend.
+        import jax
+
+        envp = os.environ.get("JAX_PLATFORMS")
+        if envp:
+            jax.config.update("jax_platforms", envp)
+        need = max(1, (args.tp or 1)) * max(1, args.sp)
+        if need > len(jax.devices()):
+            raise SystemExit(
+                f"requested tp×sp = {need} devices but only "
+                f"{len(jax.devices())} visible (for a virtual mesh: "
+                f"JAX_PLATFORMS=cpu "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={need})")
     if args.mode == "inference":
         return run_inference(args)
     if args.mode == "chat":

@@ -9,7 +9,8 @@ from dllama_tpu.formats import mfile, quants, tfile
 
 def tiny_header_params(arch=mfile.ArchType.LLAMA, dim=64, n_layers=2, n_heads=4,
                        n_kv_heads=2, hidden_dim=96, vocab_size=128, seq_len=64,
-                       head_dim=0, weight_type=quants.Q40, rope_type=mfile.RopeType.LLAMA):
+                       head_dim=0, weight_type=quants.Q40, rope_type=mfile.RopeType.LLAMA,
+                       n_experts=0, n_active_experts=0):
     return {
         "version": 1,
         "arch_type": int(arch),
@@ -26,6 +27,8 @@ def tiny_header_params(arch=mfile.ArchType.LLAMA, dim=64, n_layers=2, n_heads=4,
         "rope_type": int(rope_type),
         "head_dim": head_dim,
         "norm_epsilon": 5,
+        "n_experts": n_experts,
+        "n_active_experts": n_active_experts,
     }
 
 
@@ -68,14 +71,22 @@ def write_tiny_model(path, params: dict, rng: np.random.Generator, scale=0.05):
             write_tensor(f, x, ft)
 
         put("embedding", -1, rand(vocab, dim), quants.F32)
+        n_experts = params.get("n_experts", 0)
         for l in range(n_layers):
             put("block_matmul_q", l, rand(q_dim, dim), wt)
             put("block_matmul_k", l, rand(kv_dim, dim), wt)
             put("block_matmul_v", l, rand(kv_dim, dim), wt)
             put("block_matmul_wo", l, rand(dim, q_dim), wt)
-            put("block_matmul_w1", l, rand(hidden_dim, dim), wt)
-            put("block_matmul_w2", l, rand(dim, hidden_dim), wt)
-            put("block_matmul_w3", l, rand(hidden_dim, dim), wt)
+            if n_experts > 0:
+                put("block_moe_gate", l, rand(n_experts, dim), quants.F32)
+                for e in range(n_experts):
+                    put(f"block_expert_w3.{l}", e, rand(hidden_dim, dim), wt)
+                    put(f"block_expert_w1.{l}", e, rand(hidden_dim, dim), wt)
+                    put(f"block_expert_w2.{l}", e, rand(dim, hidden_dim), wt)
+            else:
+                put("block_matmul_w1", l, rand(hidden_dim, dim), wt)
+                put("block_matmul_w2", l, rand(dim, hidden_dim), wt)
+                put("block_matmul_w3", l, rand(hidden_dim, dim), wt)
             if qwen3:
                 put("block_norm_q", l, 1.0 + rand(head_dim), quants.F32)
                 put("block_norm_k", l, 1.0 + rand(head_dim), quants.F32)

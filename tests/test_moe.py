@@ -1,0 +1,275 @@
+"""Mixture-of-experts: format walk, router math, forward vs numpy golden,
+expert parallelism, converter plan.
+
+All of this is NEW capability: the reference parses N_EXPERTS and its
+converter can emit expert weights, but its graph builder never reads
+nExperts — an MoE model cannot run there at all (SURVEY.md §2.2). The .m MoE
+layout here matches the reference converter's expert order (w3/w1/w2 per
+expert) and adds the missing router tensor (block_moe_gate).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dllama_tpu.formats import mfile, quants
+from dllama_tpu.models import ModelConfig, forward, init_random_params, load_params_from_mfile
+from dllama_tpu.parallel import use_plan
+from dllama_tpu.parallel.api import make_mesh
+from dllama_tpu.parallel.sharding import kv_cache_sharding, shard_params, validate_ep
+from dllama_tpu.runtime import KVCache
+
+from helpers import tiny_header_params, write_tiny_model
+
+E, K = 4, 2  # experts / active experts for the tiny configs
+
+
+def _moe_params(arch=mfile.ArchType.LLAMA, **kw):
+    return tiny_header_params(arch=arch, n_experts=E, n_active_experts=K,
+                              weight_type=quants.F32, **kw)
+
+
+def _golden_moe_ffn(cfg: ModelConfig, h: np.ndarray, gate_w: np.ndarray,
+                    we1, we2, we3) -> np.ndarray:
+    """Per-token loop reimplementation of the MoE FFN (no shared code)."""
+    B, T, _ = h.shape
+    y = np.zeros_like(h)
+    logits = h @ gate_w.T  # [B,T,E]
+    for b in range(B):
+        for t in range(T):
+            lg = logits[b, t]
+            p = np.exp(lg - lg.max())
+            p /= p.sum()
+            idx = np.argsort(-p)[: cfg.n_active_experts]
+            w = p[idx] / p[idx].sum() if cfg.moe_norm_topk else p[idx]
+            acc = np.zeros(cfg.dim, np.float32)
+            for wi, ei in zip(w, idx):
+                g = h[b, t] @ we1[ei].T
+                g = g / (1.0 + np.exp(-g))  # silu
+                u = h[b, t] @ we3[ei].T
+                acc += wi * ((g * u) @ we2[ei].T)
+            y[b, t] = acc
+    return y
+
+
+def _golden_moe_forward(dense, cfg: ModelConfig, tokens: np.ndarray):
+    """Full-model golden with the MoE FFN; attention mirrors
+    test_model.golden_forward's math."""
+    from test_model import golden_forward
+
+    # run the dense golden with zeroed FFN contribution by giving it zero
+    # w1/w3 (silu(0)*u = 0), then add MoE contributions layer by layer — not
+    # possible layerwise from outside, so instead: reimplement inline.
+    B, T = tokens.shape
+    hd = cfg.head_dim
+    x = dense["embedding"][tokens].astype(np.float32)
+
+    def rms(v, w):
+        inv = 1.0 / np.sqrt(np.mean(v * v, axis=-1, keepdims=True) + cfg.norm_epsilon)
+        return v * inv * w
+
+    def rope(v, positions):
+        half = hd // 2
+        freqs = 1.0 / cfg.rope_theta ** (2.0 * np.arange(half, dtype=np.float32) / hd)
+        ang = positions[..., None] * freqs
+        c, s = np.cos(ang)[:, :, None, :], np.sin(ang)[:, :, None, :]
+        out = v.copy()
+        a, b = v[..., 0::2], v[..., 1::2]
+        out[..., 0::2] = a * c - b * s
+        out[..., 1::2] = a * s + b * c
+        return out
+
+    positions = np.arange(T)[None, :] + np.zeros((B, 1), np.int32)
+    for l in range(cfg.n_layers):
+        h = rms(x, dense[f"block_norm_0.{l}"])
+        q = (h @ dense[f"block_matmul_q.{l}"].T).reshape(B, T, cfg.n_heads, hd)
+        k = (h @ dense[f"block_matmul_k.{l}"].T).reshape(B, T, cfg.n_kv_heads, hd)
+        v = (h @ dense[f"block_matmul_v.{l}"].T).reshape(B, T, cfg.n_kv_heads, hd)
+        q, k = rope(q, positions), rope(k, positions)
+        att = np.zeros((B, T, cfg.n_heads, hd), np.float32)
+        for hh in range(cfg.n_heads):
+            kv_h = hh // (cfg.n_heads // cfg.n_kv_heads)
+            for b in range(B):
+                for t in range(T):
+                    scores = np.einsum("sh,h->s", k[b, : t + 1, kv_h], q[b, t, hh]) / np.sqrt(hd)
+                    e = np.exp(scores - scores.max())
+                    p = e / e.sum()
+                    att[b, t, hh] = p @ v[b, : t + 1, kv_h]
+        x = x + att.reshape(B, T, -1) @ dense[f"block_matmul_wo.{l}"].T
+        h = rms(x, dense[f"block_norm_1.{l}"])
+        we1 = np.stack([dense[f"block_expert_w1.{l}.{e}"] for e in range(E)])
+        we2 = np.stack([dense[f"block_expert_w2.{l}.{e}"] for e in range(E)])
+        we3 = np.stack([dense[f"block_expert_w3.{l}.{e}"] for e in range(E)])
+        x = x + _golden_moe_ffn(cfg, h, dense[f"block_moe_gate.{l}"], we1, we2, we3)
+    x = rms(x, dense["final_norm"])
+    return x @ dense["final_matmul_logits"].T
+
+
+def test_mfile_walk_moe(tmp_path):
+    p = _moe_params()
+    write_tiny_model(tmp_path / "moe.m", p, np.random.default_rng(0))
+    with mfile.ModelFile.open(tmp_path / "moe.m") as mf:
+        assert mf.header.n_experts == E and mf.has_moe_router
+        assert "block_moe_gate.0" in mf.tensors
+        assert f"block_expert_w2.1.{E-1}" in mf.tensors
+        assert "block_matmul_w1.0" not in mf.tensors
+        # disk order within a layer: gate then w3/w1/w2 per expert
+        o = mf.tensors
+        assert (o["block_moe_gate.0"].offset < o["block_expert_w3.0.0"].offset
+                < o["block_expert_w1.0.0"].offset < o["block_expert_w2.0.0"].offset
+                < o["block_expert_w3.0.1"].offset)
+
+
+def test_mfile_routerless_moe_file_detected(tmp_path):
+    """A reference-converter-style MoE file (no router) parses with
+    has_moe_router=False and refuses to load params."""
+    p = _moe_params()
+    # write with router, then excise the router bytes to fake the reference layout
+    write_tiny_model(tmp_path / "a.m", p, np.random.default_rng(0))
+    with mfile.ModelFile.open(tmp_path / "a.m") as mf:
+        spans = sorted(
+            (r.offset, r.n_bytes) for k, r in mf.tensors.items()
+            if r.name == "block_moe_gate")
+        raw = open(tmp_path / "a.m", "rb").read()
+    out = bytearray()
+    prev = 0
+    for off, nb in spans:
+        out += raw[prev:off]
+        prev = off + nb
+    out += raw[prev:]
+    (tmp_path / "b.m").write_bytes(out)
+
+    with mfile.ModelFile.open(tmp_path / "b.m") as mf:
+        assert not mf.has_moe_router
+        cfg = ModelConfig.from_header(mf.header)
+        with pytest.raises(ValueError, match="router"):
+            load_params_from_mfile(mf, cfg)
+
+
+@pytest.mark.parametrize("norm_topk", [True, False])
+def test_moe_forward_matches_golden(tmp_path, norm_topk):
+    p = _moe_params()
+    dense = write_tiny_model(tmp_path / "moe.m", p, np.random.default_rng(7))
+    tokens = np.asarray([[5, 9, 2, 11, 3]], dtype=np.int32)
+
+    from dataclasses import replace
+
+    with mfile.ModelFile.open(tmp_path / "moe.m") as mf:
+        cfg = replace(ModelConfig.from_header(mf.header), moe_norm_topk=norm_topk)
+        assert cfg.is_moe
+        params = load_params_from_mfile(mf, cfg)
+
+    want = _golden_moe_forward(dense, cfg, tokens)
+    logits, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg, jnp.asarray(tokens), jnp.int32(0), KVCache.create(cfg))
+    np.testing.assert_allclose(np.asarray(logits)[0], want[0], rtol=2e-4, atol=2e-4)
+
+
+def test_norm_topk_changes_outputs(tmp_path):
+    """Renormalized vs raw top-k router weights genuinely differ (the only
+    behavioral router knob: softmax-then-topk-renorm equals topk-then-softmax,
+    so an arch-based 'flavor' would be a no-op)."""
+    from dataclasses import replace
+
+    write_tiny_model(tmp_path / "m.m", _moe_params(), np.random.default_rng(3))
+    tokens = jnp.asarray([[1, 2, 3]], dtype=jnp.int32)
+    with mfile.ModelFile.open(tmp_path / "m.m") as mf:
+        cfg_norm = ModelConfig.from_header(mf.header)
+        assert cfg_norm.moe_norm_topk  # header default
+        params = load_params_from_mfile(mf, cfg_norm)
+    cfg_raw = replace(cfg_norm, moe_norm_topk=False)
+    a, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg_norm, tokens, jnp.int32(0), KVCache.create(cfg_norm))
+    b, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg_raw, tokens, jnp.int32(0), KVCache.create(cfg_raw))
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_moe_norm_topk_header_round_trip(tmp_path):
+    p = _moe_params()
+    p["moe_norm_topk"] = 0
+    write_tiny_model(tmp_path / "m.m", p, np.random.default_rng(1))
+    with mfile.ModelFile.open(tmp_path / "m.m") as mf:
+        assert mf.header.moe_norm_topk == 0
+        assert not ModelConfig.from_header(mf.header).moe_norm_topk
+
+
+@pytest.mark.parametrize("mesh_axes", [
+    {"ep": 4},
+    {"ep": 2, "tp": 2},
+    {"dp": 2, "ep": 2, "tp": 2},
+])
+def test_ep_sharded_forward_matches_unsharded(mesh_axes):
+    cfg = ModelConfig(
+        arch=mfile.ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2,
+        n_heads=8, n_kv_heads=4, head_dim=8, vocab_size=128, seq_len=32,
+        norm_epsilon=1e-5, rope_theta=10000.0, rope_type=mfile.RopeType.LLAMA,
+        n_experts=E, n_active_experts=K)
+    B = 2 if "dp" in mesh_axes else 1
+    params = init_random_params(cfg, seed=31)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (B, 6)), dtype=jnp.int32)
+
+    ref, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg, tokens, jnp.int32(0), KVCache.create(cfg, batch_size=B))
+
+    plan = make_mesh(mesh_axes)
+    validate_ep(cfg, plan.axis_size("ep"))
+    sharded = shard_params(plan, params)
+    assert sharded.layers.we1.sharding.spec[1] == "ep"
+    kv0 = KVCache.create(cfg, batch_size=B)
+    kv = jax.device_put(kv0, kv_cache_sharding(plan, kv0))
+    with use_plan(plan):
+        got, _ = jax.jit(forward, static_argnums=1)(
+            sharded, cfg, tokens, jnp.int32(0), kv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_validate_ep():
+    cfg = ModelConfig(
+        arch=mfile.ArchType.LLAMA, dim=8, hidden_dim=16, n_layers=1,
+        n_heads=2, n_kv_heads=2, head_dim=4, vocab_size=32, seq_len=8,
+        norm_epsilon=1e-5, rope_theta=10000.0, rope_type=mfile.RopeType.LLAMA,
+        n_experts=6, n_active_experts=2)
+    validate_ep(cfg, 3)
+    with pytest.raises(ValueError):
+        validate_ep(cfg, 4)
+    from dataclasses import replace
+    with pytest.raises(ValueError):
+        validate_ep(replace(cfg, n_experts=0, n_active_experts=0), 2)
+
+
+def test_hf_plan_includes_router_and_dual_names():
+    from dllama_tpu.convert.hf import hf_tensor_plan
+
+    p = tiny_header_params(n_experts=2, n_active_experts=1)
+    p["weight_float_type"] = quants.Q40
+    plan = hf_tensor_plan(p)
+    keys = [it.keys for it in plan]
+    assert ("model.layers.0.block_sparse_moe.gate.weight",
+            "model.layers.0.mlp.gate.weight") in keys
+    assert ("model.layers.0.block_sparse_moe.experts.0.w3.weight",
+            "model.layers.0.mlp.experts.0.up_proj.weight") in keys
+    # dense mlp keys absent for MoE
+    assert not any("mlp.gate_proj" in k for ks in keys for k in ks)
+
+
+def test_hf_config_qwen3_moe_mapping(tmp_path):
+    import json
+
+    from dllama_tpu.convert.hf import load_hf_config
+
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "qwen3_moe", "hidden_act": "silu", "hidden_size": 64,
+        "intermediate_size": 96, "moe_intermediate_size": 48,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "max_position_embeddings": 128,
+        "vocab_size": 100, "num_experts": 8, "num_experts_per_tok": 2,
+        "rope_theta": 10000, "rms_norm_eps": 1e-6, "head_dim": 16,
+    }))
+    params = load_hf_config(tmp_path, quants.Q40)
+    assert params["n_experts"] == 8 and params["n_active_experts"] == 2
+    assert params["hidden_dim"] == 48  # moe_intermediate_size wins
+    assert params["moe_norm_topk"] == 0  # HF Qwen3MoeConfig default: False
