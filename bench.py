@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from dllama_tpu.formats.mfile import ArchType, RopeType
 from dllama_tpu.models import ModelConfig, forward
+from dllama_tpu.models.llama import greedy_step
 from dllama_tpu.runtime import KVCache
 
 # Llama 3.2 1B shapes (HF config), seq capped for bench
@@ -83,31 +84,27 @@ def main() -> None:
     params = jax.device_put(_fast_random_params(CFG))
     kv = KVCache.create(CFG, dtype=jnp.bfloat16)
 
+    # the engine's greedy fast path: forward + argmax fused into ONE dispatch
+    # per token — the exact production step (engine.next_token)
     step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
-
-    @jax.jit
-    def argmax_token(logits):
-        return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    greedy = jax.jit(greedy_step, static_argnums=1, donate_argnums=(4,))
 
     # prefill
     prompt = jnp.ones((1, PREFILL_LEN), dtype=jnp.int32)
     t0 = time.perf_counter()
     logits, kv = step(params, CFG, prompt, jnp.int32(0), kv)
-    token = argmax_token(logits)
+    token = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
     token.block_until_ready()
     prefill_compile_s = time.perf_counter() - t0
 
     # decode warmup (compile T=1 path)
-    tok2d = token[:, None]
-    logits, kv = step(params, CFG, tok2d, jnp.int32(PREFILL_LEN), kv)
-    token = argmax_token(logits)
+    token, kv = greedy(params, CFG, token[:, None], jnp.int32(PREFILL_LEN), kv)
     token.block_until_ready()
 
     t0 = time.perf_counter()
     pos = PREFILL_LEN + 1
     for i in range(DECODE_STEPS):
-        logits, kv = step(params, CFG, token[:, None], jnp.int32(pos + i), kv)
-        token = argmax_token(logits)
+        token, kv = greedy(params, CFG, token[:, None], jnp.int32(pos + i), kv)
     token.block_until_ready()
     dt = time.perf_counter() - t0
 

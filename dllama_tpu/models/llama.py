@@ -208,6 +208,16 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     return x, k_cache, v_cache
 
 
+def greedy_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                start_pos: jax.Array, kv: KVCache) -> tuple[jax.Array, KVCache]:
+    """Fused forward + argmax of the last position — the single-dispatch
+    greedy decode step (SURVEY.md §7.4 "single fused jitted step"). Shared by
+    the engine's fast path and bench.py so the benchmark measures the
+    production program."""
+    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), kv
+
+
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             start_pos: jax.Array, kv: KVCache) -> tuple[jax.Array, KVCache]:
     """Full forward: ``tokens [B, T]`` at absolute ``start_pos`` → logits.
@@ -293,7 +303,10 @@ def load_params_from_mfile(mf: ModelFile, cfg: ModelConfig,
         """[L, E, out, in] dense expert weights in compute dtype (cast
         per-tensor before stacking to keep host peak memory at the target
         dtype, not f32)."""
-        target = jnp.dtype(cfg.compute_dtype)  # ml_dtypes-backed, numpy-compatible
+        # honor weight_mode like matmul_weight does (bf16 halves the footprint
+        # of what is the bulk of an MoE checkpoint); "auto" follows compute dtype
+        target = jnp.dtype(dense_dtype if weight_mode != "auto"
+                           else cfg.compute_dtype)
         first = mf.tensor_f32(f"{name}.0.0")
         out = np.empty((h.n_layers, h.n_experts) + first.shape, dtype=target)
         for l in range(h.n_layers):

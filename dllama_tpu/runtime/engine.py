@@ -27,7 +27,7 @@ import numpy as np
 from ..formats.mfile import ModelFile
 from ..formats.quants import F32, Q80
 from ..models.config import ModelConfig
-from ..models.llama import Params, forward, load_params_from_mfile
+from ..models.llama import Params, forward, greedy_step, load_params_from_mfile
 from ..parallel.api import MeshPlan, make_mesh, use_plan
 from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
 from ..tokenizer.bpe import Tokenizer
@@ -117,6 +117,11 @@ class InferenceEngine:
         self.pos = 0
         # donate the KV cache (arg 4) so decode updates it in place
         self._step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
+        # greedy fast path: argmax fused into the step — ONE dispatch per
+        # token and a 4-byte host transfer instead of a full logits row;
+        # used by next_token() when temperature == 0
+        self._greedy_step = jax.jit(greedy_step, static_argnums=1,
+                                    donate_argnums=(4,))
 
     def _fresh_kv(self) -> KVCache:
         kv = KVCache.create(self.cfg)
@@ -135,15 +140,20 @@ class InferenceEngine:
 
     # -- low-level steps ----------------------------------------------------
 
-    def _forward(self, tokens_2d: np.ndarray, start_pos: int) -> jax.Array:
-        """Run one jitted step; returns logits [1, T, vocab] (device)."""
+    def _dispatch(self, step_fn, tokens_2d, start_pos: int):
+        """Run one jitted step under the active mesh plan; returns
+        (primary output, updated kv stored on self)."""
         from contextlib import nullcontext
 
         with (use_plan(self.plan) if self.plan is not None else nullcontext()):
-            logits, self.kv = self._step(
+            out, self.kv = step_fn(
                 self.params, self.cfg, jnp.asarray(tokens_2d, dtype=jnp.int32),
                 jnp.int32(start_pos), self.kv)
-        return logits
+        return out
+
+    def _forward(self, tokens_2d: np.ndarray, start_pos: int) -> jax.Array:
+        """Run one jitted step; returns logits [1, T, vocab] (device)."""
+        return self._dispatch(self._step, tokens_2d, start_pos)
 
     def prefill(self, token_ids: list[int]) -> tuple[np.ndarray, list[StepMetrics]]:
         """Evaluate the prompt in n_batches-sized chunks; returns logits of the
@@ -182,6 +192,21 @@ class InferenceEngine:
         self.pos += 1
         return np.asarray(logits[0, 0])
 
+    def next_token(self, token: int) -> int:
+        """The engine's next-token primitive: greedy fast path (fused
+        forward+argmax, one dispatch, 4-byte transfer) at temperature 0,
+        host-side sampler otherwise. All decode loops (CLI generate, API
+        server) should use this."""
+        if self.pos >= self.cfg.seq_len:
+            raise ValueError(f"position {self.pos} reached seq_len {self.cfg.seq_len}")
+        if self.sampler.temperature == 0.0:
+            nxt = self._dispatch(self._greedy_step, np.asarray([[token]]), self.pos)
+            self.pos += 1
+            return int(nxt[0])
+        logits = self._forward(np.asarray([[token]]), self.pos)
+        self.pos += 1
+        return self.sampler.sample(np.asarray(logits[0, 0]))
+
     # -- generation ---------------------------------------------------------
 
     def generate(self, prompt: str | list[int], max_tokens: int,
@@ -211,8 +236,7 @@ class InferenceEngine:
         limit = min(self.cfg.seq_len - self.pos, max_tokens)
         for _ in range(limit):
             t0 = time.perf_counter()
-            logits = self.decode_step(token)
-            token = self.sampler.sample(logits)
+            token = self.next_token(token)
             steps.append(StepMetrics("pred", (time.perf_counter() - t0) * 1000.0, 1))
             out_tokens.append(token)
             piece = self.tokenizer.decode(token) if self.tokenizer else None

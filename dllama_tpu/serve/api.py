@@ -127,8 +127,7 @@ class ApiState:
         n_completion = 0
         finish_reason = "length"
         while engine.pos < max_pred:
-            logits = engine.decode_step(token)
-            token = engine.sampler.sample(logits)
+            token = engine.next_token(token)
             n_completion += 1
             piece = tok.decode(token)
             res = detector.append(token, piece)

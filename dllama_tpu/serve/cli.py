@@ -144,8 +144,7 @@ def run_chat(args) -> int:
         detector.reset()
         tok.reset_decoder()
         while engine.pos < engine.cfg.seq_len:
-            logits = engine.decode_step(token)
-            token = engine.sampler.sample(logits)
+            token = engine.next_token(token)
             piece = tok.decode(token)
             res = detector.append(token, piece)
             if res == EosResult.NOT_EOS:
