@@ -18,6 +18,7 @@ mask (``s <= pos``), and every slot is rewritten by its real token's
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 
 import jax
@@ -143,8 +144,6 @@ class InferenceEngine:
     def _dispatch(self, step_fn, tokens_2d, start_pos: int):
         """Run one jitted step under the active mesh plan; returns
         (primary output, updated kv stored on self)."""
-        from contextlib import nullcontext
-
         with (use_plan(self.plan) if self.plan is not None else nullcontext()):
             out, self.kv = step_fn(
                 self.params, self.cfg, jnp.asarray(tokens_2d, dtype=jnp.int32),
@@ -203,9 +202,7 @@ class InferenceEngine:
             nxt = self._dispatch(self._greedy_step, np.asarray([[token]]), self.pos)
             self.pos += 1
             return int(nxt[0])
-        logits = self._forward(np.asarray([[token]]), self.pos)
-        self.pos += 1
-        return self.sampler.sample(np.asarray(logits[0, 0]))
+        return self.sampler.sample(self.decode_step(token))
 
     # -- generation ---------------------------------------------------------
 

@@ -49,6 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel device count (ring attention; "
                         "long-context — no reference equivalent)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a JAX/XLA profiler trace to DIR (the TPU-side "
+                        "Eval/Sync breakdown: per-op + collective time; view "
+                        "with TensorBoard or Perfetto). Replaces the "
+                        "reference's per-step-type executor timers")
     p.add_argument("--port", type=int, default=9990, help="api mode port")
     p.add_argument("--host", default="127.0.0.1", help="api mode bind host")
     # accepted for reference-flag compatibility; no-ops on TPU:
@@ -78,6 +83,8 @@ def make_engine(args) -> InferenceEngine:
 
 
 def run_inference(args) -> int:
+    from contextlib import nullcontext
+
     if args.prompt is None:
         raise SystemExit("Prompt is required")
     if args.steps == 0:
@@ -91,7 +98,17 @@ def run_inference(args) -> int:
         sys.stdout.write(piece if piece is not None else "")
         sys.stdout.flush()
 
-    result = engine.generate(ids, max_new, on_token=on_token, stop_on_eos=False)
+    if args.profile:
+        import jax
+
+        prof = jax.profiler.trace(args.profile)
+    else:
+        prof = nullcontext()
+    with prof:
+        result = engine.generate(ids, max_new, on_token=on_token,
+                                 stop_on_eos=False)
+    if args.profile:
+        print(f"🔬 profiler trace written to {args.profile}")
     print()
     n_eval = sum(s.n_tokens for s in result.steps if s.kind == "eval")
     n_pred = sum(s.n_tokens for s in result.steps if s.kind == "pred")
