@@ -80,10 +80,10 @@ class Params(NamedTuple):
 
 
 def _use_flash(cfg: ModelConfig, q_shape, kv_shape) -> bool:
-    """Trace-time choice of attention kernel. The Pallas kernel only runs in
-    single-device graphs for now: under a live mesh plan the auto-sharded
-    graph cannot partition a pallas_call (the TP/SP paths wrap their own
-    kernels in shard_map instead)."""
+    """Trace-time choice of the single-device attention kernel. Under a mesh
+    plan the auto-sharder cannot partition a pallas_call — the TP path wraps
+    the kernel in shard_map (flash_attention_sharded) and the SP path has its
+    own kernels (parallel/ring.py)."""
     from ..parallel.api import current_plan
 
     if cfg.attn_impl not in ("auto", "xla", "flash"):
@@ -95,12 +95,23 @@ def _use_flash(cfg: ModelConfig, q_shape, kv_shape) -> bool:
     if cfg.attn_impl == "flash":
         if not ok:
             raise ValueError(f"flash attention unsupported for q={q_shape}, S={s}")
-        if current_plan() is not None:
-            raise ValueError(
-                "attn_impl='flash' cannot run under a mesh plan: a pallas_call "
-                "is not partitionable by the auto-sharder (use 'auto')")
-        return True
+        return current_plan() is None
     return ok and _fa.default_enabled() and current_plan() is None
+
+
+def _sharded_flash(cfg: ModelConfig, plan, q, k_cache, v_cache, start_pos):
+    """TP-path Pallas attention via shard_map; None → caller uses the oracle.
+
+    ``attn_impl='flash'`` forces it (interpret mode off-TPU, for tests);
+    ``'auto'`` enables it on TPU backends only."""
+    if cfg.attn_impl == "xla":
+        return None
+    force = cfg.attn_impl == "flash"
+    if not force and not _fa.default_enabled():
+        return None
+    return _fa.flash_attention_sharded(
+        plan, q, k_cache, v_cache, start_pos, cfg.head_dim,
+        interpret=force and not _fa.default_enabled())
 
 
 def _hidden_act(cfg: ModelConfig, x: jax.Array) -> jax.Array:
@@ -187,10 +198,13 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
         att, k_cache, v_cache = sp_res
     else:
         k_cache, v_cache = update_layer(k_cache, v_cache, k, v, start_pos)
-        if _use_flash(cfg, q.shape, k_cache.shape):
-            att = flash_attention(q, k_cache, v_cache, start_pos, cfg.head_dim)
-        else:
-            att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
+        att = (_sharded_flash(cfg, plan, q, k_cache, v_cache, start_pos)
+               if plan is not None else None)
+        if att is None:
+            if _use_flash(cfg, q.shape, k_cache.shape):
+                att = flash_attention(q, k_cache, v_cache, start_pos, cfg.head_dim)
+            else:
+                att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
     att = constrain(att, "batch", None, "heads", None)
     x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo))
     x = constrain(x, "batch", None, None)

@@ -170,3 +170,43 @@ def supports(q_shape: tuple[int, ...], n_kv: int, s: int) -> bool:
 def default_enabled() -> bool:
     """Flash is the default on TPU backends; the XLA oracle elsewhere."""
     return jax.default_backend() == "tpu"
+
+
+def flash_attention_sharded(plan, q: jax.Array, k_cache: jax.Array,
+                            v_cache: jax.Array, start_pos: jax.Array,
+                            head_dim: int, *, interpret: bool = False):
+    """Tensor-parallel flash attention: the Pallas kernel inside a shard_map.
+
+    The auto-sharder cannot partition a ``pallas_call``, so under a mesh plan
+    the kernel runs manual-SPMD: q sharded on heads, head-major caches sharded
+    on kv-heads — the reference's per-node head shards (sliceMultiHeadAtt,
+    nn-core.cpp:265-272) — with zero collectives inside (attention is
+    embarrassingly parallel across heads). Composes with ``dp`` on the batch
+    dim. Returns ``None`` when the layout doesn't apply (caller falls back to
+    the XLA oracle); the ``sp`` path has its own kernels (parallel/ring.py).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    B, T, H, D = q.shape
+    n_kv, S = k_cache.shape[1], k_cache.shape[2]
+    tp = plan.axis_size("tp")
+    if plan.axis_size("sp") > 1 or tp <= 1:
+        return None
+    if H % tp != 0 or n_kv % tp != 0:
+        return None  # kv replication groups: oracle path handles those
+    if not supports((B, T, H // tp, D), n_kv // tp, S):
+        return None
+    dp_ax = plan.resolve("batch") if B % plan.axis_size("dp") == 0 else None
+
+    def local(q_l, k_l, v_l, sp0):
+        return flash_attention(q_l, k_l, v_l, sp0, head_dim,
+                               interpret=interpret)
+
+    fn = jax.shard_map(
+        local, mesh=plan.mesh,
+        in_specs=(P(dp_ax, None, "tp", None), P(dp_ax, "tp", None, None),
+                  P(dp_ax, "tp", None, None), P()),
+        out_specs=P(dp_ax, None, "tp", None),
+        check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, start_pos.astype(jnp.int32))

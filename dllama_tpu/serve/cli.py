@@ -43,6 +43,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--buffer-float-type", choices=["f32", "q80"], default="q80",
                    help="activation sync quantization parity mode")
     p.add_argument("--weight-mode", choices=["auto", "f32", "bf16"], default="auto")
+    p.add_argument("--compute-dtype", choices=["f32", "bf16"], default="f32",
+                   help="activation/KV-cache dtype: f32 for reference parity, "
+                        "bf16 for TPU serving throughput")
     p.add_argument("--nbatches", type=int, default=DEFAULT_N_BATCHES)
     p.add_argument("--tp", type=int, default=None,
                    help="tensor-parallel device count (reference: number of nodes)")
@@ -71,6 +74,7 @@ def make_engine(args) -> InferenceEngine:
         args.model, args.tokenizer,
         tp=args.tp, sp=args.sp, max_seq_len=args.max_seq_len,
         weight_mode=args.weight_mode,
+        compute_dtype="bfloat16" if args.compute_dtype == "bf16" else "float32",
         sync_type=Q80 if args.buffer_float_type == "q80" else F32,
         n_batches=args.nbatches,
         temperature=args.temperature, topp=args.topp, seed=seed,

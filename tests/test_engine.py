@@ -123,3 +123,17 @@ def test_sync_q80_parity_mode_changes_logits(model_files):
     lb, _ = eq.prefill(ids)
     assert not np.allclose(la, lb)  # quantization must have an effect
     assert np.abs(la - lb).max() < 0.5  # but a small one
+
+
+def test_bf16_compute_mode(model_files):
+    """Serving mode: bf16 activations + bf16 KV cache generate sane tokens
+    (not token-identical to f32 — different arithmetic — but deterministic)."""
+    import jax.numpy as jnp
+
+    e = make_engine(model_files, compute_dtype="bfloat16")
+    assert e.kv.k.dtype == jnp.bfloat16
+    r1 = e.generate("hello world", 6, stop_on_eos=False)
+    e2 = make_engine(model_files, compute_dtype="bfloat16")
+    r2 = e2.generate("hello world", 6, stop_on_eos=False)
+    assert r1.tokens == r2.tokens and len(r1.tokens) == 6
+    assert all(0 <= t < e.cfg.vocab_size for t in r1.tokens)
