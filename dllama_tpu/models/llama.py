@@ -173,9 +173,9 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
 
     # -- attention half (reference att segment, llm.cpp:226-366) -----------
     h = fq(rms_norm(x, lp.norm_att, cfg.norm_epsilon))
-    q = linear(h, lp.wq).reshape(B, T, cfg.n_heads, cfg.head_dim)
-    k = linear(h, lp.wk).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-    v = linear(h, lp.wv).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q = linear(h, lp.wq, out_axis="heads").reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = linear(h, lp.wk, out_axis="kv_heads").reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = linear(h, lp.wv, out_axis="kv_heads").reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     q = constrain(q, "batch", None, "heads", None)
     k = constrain(k, "batch", None, "kv_heads", None)
     v = constrain(v, "batch", None, "kv_heads", None)
@@ -206,7 +206,7 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
             else:
                 att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
     att = constrain(att, "batch", None, "heads", None)
-    x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo))
+    x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo, in_axis="heads"))
     x = constrain(x, "batch", None, None)
 
     # -- ffn half (reference ff segment, llm.cpp:369-439; MoE is new) ------
@@ -214,10 +214,10 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     if cfg.is_moe:
         x = x + fq(_moe_ffn(cfg, h, lp))
     else:
-        gate = _hidden_act(cfg, linear(h, lp.w1))
-        up = linear(h, lp.w3)
+        gate = _hidden_act(cfg, linear(h, lp.w1, out_axis="hidden"))
+        up = linear(h, lp.w3, out_axis="hidden")
         hidden = constrain(fq(gate * up), "batch", None, "hidden")
-        x = x + fq(linear(hidden, lp.w2))
+        x = x + fq(linear(hidden, lp.w2, in_axis="hidden"))
     x = constrain(x, "batch", None, None)
     return x, k_cache, v_cache
 
@@ -261,7 +261,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
     if cfg.sync_q80:  # final cast before the logits matmul (llm.cpp:445-486)
         x = fake_quant_q80(x)
-    logits = linear(x, params.logits).astype(jnp.float32)
+    logits = linear(x, params.logits, out_axis="vocab").astype(jnp.float32)
     logits = constrain(logits, "batch", None, "vocab")
     return logits, KVCache(k=new_k, v=new_v)
 

@@ -83,9 +83,13 @@ def _on_tpu() -> bool:
     return bool(devices) and "tpu" in devices[0].device_kind.lower()
 
 
-def _pallas_wanted(x: jax.Array, w: QuantizedWeight) -> bool:
+def _kernel_mode() -> str:
     # read per call so tests/debug sessions can flip it after import
-    mode = os.environ.get("DLLAMA_TPU_QUANT_KERNEL", "auto")  # auto|pallas|xla
+    return os.environ.get("DLLAMA_TPU_QUANT_KERNEL", "auto")  # auto|pallas|xla
+
+
+def _pallas_wanted(x: jax.Array, w: QuantizedWeight) -> bool:
+    mode = _kernel_mode()
     if mode == "xla":
         return False
     from .quant_matmul import supports
@@ -94,27 +98,55 @@ def _pallas_wanted(x: jax.Array, w: QuantizedWeight) -> bool:
     if mode == "pallas":
         return ok
     # auto: TPU only (the kernel uses pltpu memory spaces; CPU interpret is
-    # slow and GPU can't lower it), and only single-device for now — a
-    # pallas_call inside a GSPMD-partitioned graph needs a shard_map wrapper
-    # (planned; until then TP runs use the XLA dequant+dot path).
+    # slow and GPU can't lower it). Under a mesh plan the sharded entry in
+    # linear() handles dispatch; this plain path must stay out of
+    # GSPMD-partitioned graphs (the auto-sharder can't split a pallas_call).
     from ..parallel.api import current_plan
 
     return ok and _on_tpu() and current_plan() is None
 
 
-def linear(x: jax.Array, w: Weight) -> jax.Array:
+def _pallas_sharded(x: jax.Array, w: QuantizedWeight, out_axis: str | None,
+                    in_axis: str | None):
+    """Try the shard_map-wrapped kernel under the active plan; None → caller
+    falls back to XLA dequant+dot (auto-sharded via constraints)."""
+    mode = _kernel_mode()
+    if mode == "xla":
+        return None
+    if mode != "pallas" and not _on_tpu():
+        return None
+    if x.ndim != 3 or w.codes.ndim != 2:
+        return None  # stacked (scan-external) or 2-D activations: XLA path
+    from ..parallel.api import current_plan
+    from .quant_matmul import quant_matmul_sharded
+
+    return quant_matmul_sharded(
+        current_plan(), x, w, out_axis=out_axis, in_axis=in_axis,
+        interpret=mode == "pallas" and not _on_tpu())
+
+
+def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
+           in_axis: str | None = None) -> jax.Array:
     """``y[..., out] = x[..., in] @ w.T`` with dense or Q40 weight.
 
     Dense weights use the reference's on-disk ``[out, in]`` orientation
     (row-major, llm.cpp matmul weights); Q40 planes are K-major ``[in, out]``
-    (see QuantizedWeight). TP row/col split semantics stay auditable either
-    way: row-split = shard ``out``, col-split = shard ``in``. Q40 weights
-    dispatch to the Pallas kernel on TPU (override with
-    DLLAMA_TPU_QUANT_KERNEL=auto|pallas|xla); sharded cases and odd shapes
+    (see QuantizedWeight). ``out_axis``/``in_axis`` name the weight's logical
+    TP shard axis (row-split = shard ``out``, col-split = shard ``in`` — the
+    reference's sliceRowMatmul/sliceColMatmul split): under a mesh plan they
+    route Q40 weights to the shard_map-wrapped Pallas kernel
+    (quant_matmul_sharded); single-device Q40 dispatches the plain kernel.
+    Override with DLLAMA_TPU_QUANT_KERNEL=auto|pallas|xla; unsupported shapes
     fall back to XLA dequant+dot with identical f32 dequant values.
     """
     if isinstance(w, QuantizedWeight):
-        if _pallas_wanted(x, w):
+        from ..parallel.api import current_plan
+
+        if current_plan() is not None and (out_axis or in_axis):
+            y = _pallas_sharded(x, w, out_axis, in_axis)
+            if y is not None:
+                return y.astype(x.dtype)
+        elif _pallas_wanted(x, w):
             from .quant_matmul import quant_matmul
 
             return quant_matmul(x, w)

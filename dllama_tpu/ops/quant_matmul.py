@@ -132,6 +132,88 @@ def quant_matmul(x: jax.Array, w: QuantizedWeight, *, interpret: bool = False) -
     return out.reshape(*lead, N).astype(x.dtype)
 
 
+def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
+                         out_axis: str | None = None,
+                         in_axis: str | None = None, *,
+                         interpret: bool = False) -> jax.Array | None:
+    """Tensor-parallel Pallas quant matmul: the kernel inside a shard_map.
+
+    The auto-sharder cannot partition a ``pallas_call``, so under a mesh plan
+    the kernel runs manual-SPMD (same pattern as
+    ops.flash_attention.flash_attention_sharded). Two layouts, mirroring the
+    reference's weight slicers:
+
+    * **row-split** (``out_axis``; reference sliceRowMatmul,
+      nn-core.cpp:207-217): the K-major planes shard their N axis; each device
+      computes its slice of the output features, zero collectives.
+    * **col-split** (``in_axis``; reference sliceColMatmul,
+      nn-core.cpp:219-230): planes shard K, activations shard their feature
+      axis, and a ``psum`` reduces the partial sums — the reference's
+      SYNC_NODE_SLICES + OP_MERGE_ADD pair in one collective.
+
+    When the named axis doesn't resolve on this mesh (or the dim isn't
+    divisible — e.g. wk/wv under KV replication), the weight is replicated and
+    every device runs the full kernel, matching what param_shardings did at
+    load time. Returns ``None`` only when the *local* shapes don't fit the
+    kernel's tile grid (caller falls back to the XLA dequant+dot path).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    assert x.ndim == 3 and w.codes.ndim == 2, (x.shape, w.codes.shape)
+    assert (out_axis is None) or (in_axis is None)
+    B, T, K = x.shape
+    N = w.out_features
+
+    def _axis_n(sz: int, logical: str | None):
+        """Mesh axis for a logical name, or None when it can't divide ``sz``
+        — MeshPlan.sharding_for's degradation rule, so the specs here always
+        match the layout param_shardings chose at load time."""
+        if logical is None:
+            return None
+        m = plan.resolve(logical)
+        if m is None or sz % plan._axis_size(m) != 0:
+            return None
+        return m
+
+    dp_ax = _axis_n(B, "batch")
+    n_ax = _axis_n(N, out_axis)
+    k_ax = _axis_n(K, in_axis) if n_ax is None else None
+
+    def _sz(ax) -> int:
+        return 1 if ax is None else plan._axis_size(ax)
+
+    n_loc, k_loc = N // _sz(n_ax), K // _sz(k_ax)
+    b_loc = B // _sz(dp_ax)
+    local_w = QuantizedWeight(
+        scales=jax.ShapeDtypeStruct((k_loc // Q40_BLOCK_SIZE, n_loc), jnp.float32),
+        codes=jax.ShapeDtypeStruct((k_loc, n_loc), jnp.int8))
+    if not supports((b_loc, T, k_loc), local_w):
+        return None
+
+    if k_ax is not None:
+        def local(xl, sc, cd):
+            # f32 partials so the cross-device reduction doesn't round in bf16
+            part = quant_matmul(xl.astype(jnp.float32),
+                                QuantizedWeight(scales=sc, codes=cd),
+                                interpret=interpret)
+            return jax.lax.psum(part, k_ax)
+
+        fn = jax.shard_map(
+            local, mesh=plan.mesh,
+            in_specs=(P(dp_ax, None, k_ax), P(k_ax, None), P(k_ax, None)),
+            out_specs=P(dp_ax, None, None), check_vma=False)
+    else:
+        def local(xl, sc, cd):
+            return quant_matmul(xl, QuantizedWeight(scales=sc, codes=cd),
+                                interpret=interpret)
+
+        fn = jax.shard_map(
+            local, mesh=plan.mesh,
+            in_specs=(P(dp_ax, None, None), P(None, n_ax), P(None, n_ax)),
+            out_specs=P(dp_ax, None, n_ax), check_vma=False)
+    return fn(x, w.scales, w.codes)
+
+
 # Largest M the un-tiled batch axis may take: x block + out block + dequant
 # scratch must fit VMEM (~16MB) alongside double-buffered weight tiles.
 MAX_M = 512

@@ -1,0 +1,170 @@
+"""Multi-host SPMD driver — the reference's worker control protocol, TPU-style.
+
+Reference: the root broadcasts a tiny ``LlmControlPacket{position, batchSize}``
+before every forward and each worker co-executes the step
+(RootLlmInference::forward app.cpp:193-204, worker poll loop app.cpp:206-226,
+299-358). Under SPMD every process must run the *same jitted program in the
+same order* or the first collective deadlocks — so the control packet here is
+a fixed-shape int32 vector broadcast from process 0 with
+``multihost_utils.broadcast_one_to_all`` (a device collective riding
+DCN/gloo), carrying (program kind, token batch, position). Weights are loaded
+per-host from the local .m file: the reference's config/weight wire protocol
+(nn-network.cpp:621-901) is replaced by each host reading its own shards —
+the SPMD loader already places only the local partition of every array.
+
+Wire layout of a control packet (width ``3 + n_batches``):
+
+    [kind, T, start_pos, token_0 ... token_{n_batches-1}]
+
+Kinds: STOP ends the worker loop; STEP runs the full-forward program (prefill
+chunks, sampled decode, perplexity); GREEDY runs the fused greedy-decode
+program; RESET re-creates the KV cache (new conversation / perplexity run).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+if TYPE_CHECKING:
+    from ..runtime.engine import InferenceEngine
+
+CTRL_STOP = 0
+CTRL_STEP = 1
+CTRL_GREEDY = 2
+CTRL_RESET = 3
+
+
+def init_distributed(coordinator: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None,
+                     platform: str | None = None) -> None:
+    """``jax.distributed.initialize`` with this image's platform quirks handled.
+
+    ``platform="cpu"`` selects the virtual-CPU test cluster: pins
+    jax_platforms past the sitecustomize override (see tests/conftest.py) and
+    enables the gloo cross-process CPU collectives backend.
+    """
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+        if platform == "cpu":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    if coordinator is None:
+        jax.distributed.initialize()
+    else:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+
+
+class ControlCodec:
+    """Fixed-shape encode/decode so every broadcast has identical structure."""
+
+    def __init__(self, n_batches: int):
+        self.n_batches = n_batches
+        self.width = 3 + n_batches
+
+    def encode(self, kind: int, tokens_2d=None, start_pos: int = 0) -> np.ndarray:
+        buf = np.zeros(self.width, dtype=np.int32)
+        buf[0] = kind
+        if tokens_2d is not None:
+            flat = np.asarray(tokens_2d, dtype=np.int32).reshape(-1)
+            assert flat.size <= self.n_batches, (flat.size, self.n_batches)
+            buf[1] = flat.size
+            buf[2] = start_pos
+            buf[3:3 + flat.size] = flat
+        return buf
+
+    def decode(self, buf: np.ndarray) -> tuple[int, np.ndarray, int]:
+        buf = np.asarray(buf)
+        kind, t, start_pos = int(buf[0]), int(buf[1]), int(buf[2])
+        return kind, buf[3:3 + t].reshape(1, t), start_pos
+
+    def broadcast(self, buf: np.ndarray | None) -> np.ndarray:
+        """Process 0 sends ``buf``; every other process receives it."""
+        import jax
+        from jax.experimental import multihost_utils
+
+        is_source = jax.process_index() == 0
+        if buf is None:
+            buf = np.zeros(self.width, dtype=np.int32)
+        return np.asarray(
+            multihost_utils.broadcast_one_to_all(buf, is_source=is_source))
+
+
+def validate_cluster_config(engine: "InferenceEngine") -> None:
+    """Fail fast on root/worker flag mismatches.
+
+    Every process derives the control width and jitted programs from its OWN
+    flags; a mismatch (e.g. root --nbatches 64, worker default 32) would
+    otherwise deadlock the first shape-mismatched collective with no
+    diagnostic. The reference avoided this by shipping the whole config from
+    root (NnRootConfigWriter, nn-network.cpp:621-683); here a fingerprint is
+    broadcast once at engine init and compared."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    fp = np.array([
+        engine.n_batches, engine.tp, engine.sp, engine.cfg.seq_len,
+        engine.cfg.n_layers, engine.cfg.dim, engine.cfg.vocab_size,
+        1 if engine.cfg.sync_q80 else 0,
+        np.dtype(engine.cfg.compute_dtype).num,
+    ], dtype=np.int32)
+    root_fp = np.asarray(multihost_utils.broadcast_one_to_all(
+        fp, is_source=jax.process_index() == 0))
+    if not np.array_equal(fp, root_fp):
+        raise ValueError(
+            f"multihost config mismatch on process {jax.process_index()}: "
+            f"local [n_batches, tp, sp, seq_len, n_layers, dim, vocab, "
+            f"sync_q80, dtype] = {fp.tolist()} vs root {root_fp.tolist()} — "
+            f"start every process with identical model files and flags")
+
+
+def replicated_forward(params, cfg, tokens, start_pos, kv):
+    """Forward with fully-replicated logits: every process can read the full
+    logits row on host (the reference's gather-logits-to-root,
+    SYNC_NODE_SLICES_EXCEPT_ROOT, llm.cpp:484) — a vocab-sharded global array
+    would be non-addressable across processes."""
+    from ..models.llama import forward
+    from .api import constrain
+
+    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    return constrain(logits, None, None, None), kv
+
+
+def replicated_greedy(params, cfg, tokens, start_pos, kv):
+    import jax.numpy as jnp
+
+    from .api import constrain
+
+    logits, kv = replicated_forward(params, cfg, tokens, start_pos, kv)
+    tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    return constrain(tok, None), kv
+
+
+def worker_serve(engine: "InferenceEngine") -> int:
+    """Run the worker side: mirror every root dispatch until STOP.
+
+    The engine must have been built with ``multihost=True`` (non-root
+    processes never broadcast; they replay what arrives here). Returns the
+    number of steps served. Replaces runWorkerApp's inner loop
+    (app.cpp:325-356)."""
+    import jax
+
+    assert engine.multihost and jax.process_index() != 0
+    codec = engine._ctrl
+    served = 0
+    while True:
+        kind, tokens, start_pos = codec.decode(codec.broadcast(None))
+        if kind == CTRL_STOP:
+            return served
+        if kind == CTRL_RESET:
+            engine.reset()
+        elif kind == CTRL_GREEDY:
+            engine._dispatch(engine._greedy_step, tokens, start_pos)
+        else:
+            engine._dispatch(engine._step, tokens, start_pos)
+        served += 1

@@ -83,7 +83,8 @@ class InferenceEngine:
                  weight_mode: str = "auto", sync_type: int = F32,
                  compute_dtype: str = "float32",
                  n_batches: int = DEFAULT_N_BATCHES,
-                 temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5):
+                 temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5,
+                 multihost: bool = False):
         self.model_file = ModelFile.open(model_path, max_seq_len=max_seq_len,
                                          sync_type=sync_type)
         self.cfg = ModelConfig.from_header(self.model_file.header,
@@ -112,6 +113,19 @@ class InferenceEngine:
         if tp > 1:
             validate_tp(self.cfg, tp)
 
+        # multi-host SPMD (reference: root + workers co-executing,
+        # app.cpp:164-226): non-zero processes mirror dispatches via the
+        # control broadcast (parallel.multihost); logits come back replicated
+        # so every host can read them.
+        self.multihost = multihost
+        self._is_root = True
+        if multihost:
+            from ..parallel.multihost import ControlCodec, validate_cluster_config
+
+            self._is_root = jax.process_index() == 0
+            self._ctrl = ControlCodec(self.n_batches)
+            validate_cluster_config(self)  # fail fast before the weight load
+
         params = load_params_from_mfile(self.model_file, self.cfg, weight_mode)
         self.params: Params = (shard_params(self.plan, params)
                                if self.plan is not None else
@@ -119,12 +133,20 @@ class InferenceEngine:
         self.kv: KVCache = self._fresh_kv()
         self.pos = 0
         # donate the KV cache (arg 4) so decode updates it in place
-        self._step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
-        # greedy fast path: argmax fused into the step — ONE dispatch per
-        # token and a 4-byte host transfer instead of a full logits row;
-        # used by next_token() when temperature == 0
-        self._greedy_step = jax.jit(greedy_step, static_argnums=1,
-                                    donate_argnums=(4,))
+        if multihost:
+            from ..parallel.multihost import replicated_forward, replicated_greedy
+
+            self._step = jax.jit(replicated_forward, static_argnums=1,
+                                 donate_argnums=(4,))
+            self._greedy_step = jax.jit(replicated_greedy, static_argnums=1,
+                                        donate_argnums=(4,))
+        else:
+            self._step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
+            # greedy fast path: argmax fused into the step — ONE dispatch per
+            # token and a 4-byte host transfer instead of a full logits row;
+            # used by next_token() when temperature == 0
+            self._greedy_step = jax.jit(greedy_step, static_argnums=1,
+                                        donate_argnums=(4,))
 
     def _fresh_kv(self) -> KVCache:
         # cache rides the compute dtype: f32 for parity, bf16 halves HBM
@@ -135,12 +157,22 @@ class InferenceEngine:
         return kv
 
     def reset(self) -> None:
+        if self.multihost and self._is_root:
+            from ..parallel.multihost import CTRL_RESET
+
+            self._ctrl.broadcast(self._ctrl.encode(CTRL_RESET))
         self.kv = self._fresh_kv()
         self.pos = 0
         if self.tokenizer is not None:
             self.tokenizer.reset_decoder()
 
     def close(self) -> None:
+        if self.multihost and self._is_root:
+            # graceful shutdown: the reference's batchSize=0 stop packet
+            # (app.cpp:199-204)
+            from ..parallel.multihost import CTRL_STOP
+
+            self._ctrl.broadcast(self._ctrl.encode(CTRL_STOP))
         self.model_file.close()
 
     # -- low-level steps ----------------------------------------------------
@@ -148,6 +180,13 @@ class InferenceEngine:
     def _dispatch(self, step_fn, tokens_2d, start_pos: int):
         """Run one jitted step under the active mesh plan; returns
         (primary output, updated kv stored on self)."""
+        if self.multihost and self._is_root:
+            # the reference's LlmControlPacket broadcast (app.cpp:193-204):
+            # ship (program, tokens, position) so workers replay this dispatch
+            from ..parallel.multihost import CTRL_GREEDY, CTRL_STEP
+
+            kind = CTRL_GREEDY if step_fn is self._greedy_step else CTRL_STEP
+            self._ctrl.broadcast(self._ctrl.encode(kind, tokens_2d, start_pos))
         with (use_plan(self.plan) if self.plan is not None else nullcontext()):
             out, self.kv = step_fn(
                 self.params, self.cfg, jnp.asarray(tokens_2d, dtype=jnp.int32),

@@ -59,6 +59,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's per-step-type executor timers")
     p.add_argument("--port", type=int, default=9990, help="api mode port")
     p.add_argument("--host", default="127.0.0.1", help="api mode bind host")
+    # multi-host SPMD (replaces the reference's --workers TCP list; every
+    # process — root and workers — runs the same binary with the same model
+    # files, reference runWorkerApp → parallel.multihost):
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator address (process 0)")
+    p.add_argument("--nprocs", type=int, default=None,
+                   help="total process count for multi-host")
+    p.add_argument("--procid", type=int, default=None,
+                   help="this process's id (0 = root)")
     # accepted for reference-flag compatibility; no-ops on TPU:
     p.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
     p.add_argument("--workers", nargs="*", default=None, help=argparse.SUPPRESS)
@@ -66,7 +75,23 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def make_engine(args) -> InferenceEngine:
+def _maybe_init_distributed(args) -> bool:
+    """Join the jax.distributed cluster when multi-host flags are present;
+    returns True when running multi-host."""
+    import os
+
+    if args.nprocs is None or args.nprocs <= 1:
+        return False
+    from ..parallel.multihost import init_distributed
+
+    init_distributed(args.coordinator, args.nprocs, args.procid,
+                     platform=os.environ.get("JAX_PLATFORMS") or None)
+    return True
+
+
+def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
+    if multihost is None:
+        multihost = getattr(args, "_multihost", False)
     if not args.model or not args.tokenizer:
         raise SystemExit("--model and --tokenizer are required")
     seed = args.seed if args.seed is not None else int(time.time())
@@ -78,6 +103,7 @@ def make_engine(args) -> InferenceEngine:
         sync_type=Q80 if args.buffer_float_type == "q80" else F32,
         n_batches=args.nbatches,
         temperature=args.temperature, topp=args.topp, seed=seed,
+        multihost=multihost,
     )
     h = engine.model_file.header
     print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "
@@ -214,41 +240,48 @@ def run_perplexity(args) -> int:
 
 
 def run_worker(args) -> int:
-    """Multi-host worker: join the jax.distributed cluster and idle.
+    """Multi-host worker: join the cluster and co-execute the root's program.
 
-    On TPU pods every host runs the SAME program (SPMD); there is no separate
-    worker graph to receive over a wire (the reference's config/weight wire
-    protocol, nn-network.cpp:621-901, is replaced by each host loading its own
-    shard). This entry point exists so launch tooling has a uniform command.
+    Under SPMD every process must run the same jitted programs in the same
+    order (or process 0 deadlocks at the first collective), so the worker
+    builds the same engine from its local copy of the model files and then
+    replays each dispatch the root broadcasts — the TPU-native runWorkerApp
+    (reference: src/app.cpp:299-358; the config/weight wire protocol,
+    nn-network.cpp:621-901, is replaced by each host loading its own shards).
     """
     import jax
 
-    jax.distributed.initialize()
+    from ..parallel.multihost import init_distributed, worker_serve
+
+    if args.nprocs is None:
+        init_distributed()  # TPU pod: topology comes from the environment
+    else:
+        _maybe_init_distributed(args)
     print(f"⭕ worker: process {jax.process_index()} of {jax.process_count()}, "
           f"{jax.local_device_count()} local devices")
-    print("⭕ worker idle — run the root program on process 0")
-    try:
-        while True:
-            time.sleep(60)
-    except KeyboardInterrupt:
-        return 0
+    engine = make_engine(args, multihost=True)
+    served = worker_serve(engine)
+    print(f"⭕ worker done: served {served} dispatches")
+    return 0
 
 
 def main(argv=None) -> int:
     import os
 
     args = build_parser().parse_args(argv)
+    args._multihost = False
     if args.mode != "worker":
         # Honor an explicit JAX_PLATFORMS (e.g. the virtual CPU mesh:
         # JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8)
         # in case a site hook re-pinned the platform at interpreter start; only
         # possible before the backend initializes. Worker mode must not touch
-        # jax at all here: jax.distributed.initialize() requires a fresh
-        # backend.
+        # jax here: jax.distributed.initialize() requires a fresh backend.
         import jax
 
         envp = os.environ.get("JAX_PLATFORMS")
-        if envp:
+        # multi-host root: join the cluster BEFORE any backend use
+        args._multihost = _maybe_init_distributed(args)
+        if envp and not args._multihost:
             jax.config.update("jax_platforms", envp)
         need = max(1, (args.tp or 1)) * max(1, args.sp)
         if need > len(jax.devices()):

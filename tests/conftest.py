@@ -9,16 +9,45 @@ runs for real — just not over ICI.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the live session exposes a TPU
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# DLLAMA_TESTS_TPU=1 runs the @pytest.mark.tpu tier on real hardware
+# (pytest -m tpu); default is the 8-device virtual CPU mesh.
+_TPU_TIER = os.environ.get("DLLAMA_TESTS_TPU") == "1"
+
+if not _TPU_TIER:
+    os.environ["JAX_PLATFORMS"] = "cpu"  # force: the live session exposes a TPU
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-# The axon sitecustomize calls jax.config.update("jax_platforms", "axon,cpu")
-# at interpreter start, which overrides the env var — undo it here, before any
-# backend initializes.
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_TIER:
+    # The axon sitecustomize calls jax.config.update("jax_platforms",
+    # "axon,cpu") at interpreter start, which overrides the env var — undo it
+    # here, before any backend initializes.
+    jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test")
+    config.addinivalue_line(
+        "markers",
+        "tpu: needs real TPU hardware (run: DLLAMA_TESTS_TPU=1 pytest -m tpu)")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Deselect tpu-marked tests unless the TPU tier is active (they compile
+    real Pallas kernels; pointless and slow on the CPU mesh), and everything
+    else when it is."""
+    import pytest as _pytest
+
+    skip_tpu = _pytest.mark.skip(reason="TPU tier off (set DLLAMA_TESTS_TPU=1)")
+    skip_cpu = _pytest.mark.skip(reason="TPU tier on: only -m tpu tests run")
+    for item in items:
+        has_tpu = "tpu" in item.keywords
+        if has_tpu and not _TPU_TIER:
+            item.add_marker(skip_tpu)
+        elif _TPU_TIER and not has_tpu:
+            item.add_marker(skip_cpu)

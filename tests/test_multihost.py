@@ -1,0 +1,92 @@
+"""Two-process jax.distributed test of the multi-host worker path.
+
+The reference tests its distributed path with localhost TCP workers
+(examples/n-workers.sh, macbeth.sh); the SPMD equivalent spawns two python
+processes (1 virtual CPU device each, gloo collectives), process 1 running the
+real ``worker`` CLI mode and process 0 driving InferenceEngine in multihost
+mode. The root's transcript must match the committed reference-binary golden —
+cross-process AND cross-implementation parity in one test.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+import golden_assets
+
+REPO = Path(__file__).resolve().parent.parent
+PORT = 19917
+
+ROOT_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, sys.argv[1])
+    from dllama_tpu.parallel.multihost import init_distributed
+    init_distributed(sys.argv[2], 2, 0, platform="cpu")
+    from dllama_tpu.formats.quants import Q80
+    from dllama_tpu.runtime.engine import InferenceEngine
+    m, t, prompt, n_gen, seed = (sys.argv[3], sys.argv[4], sys.argv[5],
+                                 int(sys.argv[6]), int(sys.argv[7]))
+    eng = InferenceEngine(m, t, tp=2, sync_type=Q80, compute_dtype="float32",
+                          temperature=0.0, seed=seed, multihost=True)
+    ids = eng.tokenizer.encode(prompt, is_start=True)
+    drive = ids[:-1] + [0]  # reference CLI seed-token quirk (dllama.cpp:54)
+    res = eng.generate(drive, max_tokens=n_gen, stop_on_eos=False)
+    eng.tokenizer.reset_decoder()
+    pieces = [p if (p := eng.tokenizer.decode(tok)) is not None else "~"
+              for tok in res.tokens]
+    print("PIECES=" + "|".join(pieces), flush=True)
+    eng.close()
+""")
+
+
+@pytest.mark.slow
+def test_two_process_worker_matches_golden(tmp_path):
+    golden = golden_assets.load_golden("llama_q40")
+    if golden is None:
+        pytest.skip("no golden (run tools/golden_reference.py)")
+    m, t, m_sha, _ = golden_assets.build_assets("llama_q40", tmp_path)
+    if m_sha != golden["m_sha256"]:
+        pytest.skip("assets no longer match golden hashes")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1",
+               PYTHONPATH=str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    coord = f"127.0.0.1:{PORT}"
+    n_gen = min(8, len(golden["pieces"]))  # keep the 2-process run short
+
+    root = subprocess.Popen(
+        [sys.executable, "-c", ROOT_SCRIPT, str(REPO), coord, str(m), str(t),
+         golden["prompt"], str(n_gen), str(golden["sampler_seed"])],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "dllama_tpu", "worker",
+         "--coordinator", coord, "--nprocs", "2", "--procid", "1",
+         "--model", str(m), "--tokenizer", str(t), "--tp", "2",
+         "--temperature", "0.0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    try:
+        root_out, _ = root.communicate(timeout=600)
+        worker_out, _ = worker.communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        root.kill()
+        worker.kill()
+        raise
+    root_txt = root_out.decode(errors="replace")
+    worker_txt = worker_out.decode(errors="replace")
+    assert root.returncode == 0, f"root failed:\n{root_txt[-3000:]}"
+    assert worker.returncode == 0, f"worker failed:\n{worker_txt[-3000:]}"
+
+    pieces_line = [ln for ln in root_txt.splitlines() if ln.startswith("PIECES=")]
+    assert pieces_line, root_txt[-2000:]
+    got = pieces_line[0][len("PIECES="):].split("|")
+    assert got == golden["pieces"][:n_gen]
+    # the worker must have actually co-executed dispatches
+    assert "served" in worker_txt and "served 0" not in worker_txt, worker_txt[-1000:]

