@@ -28,7 +28,8 @@ from pathlib import Path
 
 import numpy as np
 
-from .quants import F32, Q40, tensor_bytes, dequantize_q40, unpack_q40
+from .quants import (F32, Q40, Q40_BLOCK_BYTES, Q40_BLOCK_SIZE,
+                     tensor_bytes, dequantize_q40, unpack_q40)
 
 MODEL_MAGIC = 0xA00ABCD
 
@@ -389,6 +390,70 @@ class ModelFile:
         rows, cols = rec.shape
         scales, codes = unpack_q40(self.raw(key), rows * cols)
         return (scales.reshape(rows, cols // 32), codes.reshape(rows, cols))
+
+    def tensor_f32_rows(self, key: str, lo: int, hi: int) -> np.ndarray:
+        """Rows ``[lo:hi)`` of a tensor, dequantized to f32.
+
+        Disk rows are the output dim and contiguous, so a row range is one
+        byte range — only those mmap pages are touched. This is the unit the
+        streaming loader reads (the reference's per-node row slice,
+        splitRowMatmulWeight, nn-core.cpp:276-292).
+        """
+        rec = self.tensors[key]
+        rows, cols = rec.shape if len(rec.shape) == 2 else (1, rec.shape[0])
+        assert 0 <= lo <= hi <= rows, (key, lo, hi, rows)
+        row_bytes = rec.n_bytes // rows
+        buf = memoryview(self._mm)[rec.offset + lo * row_bytes:
+                                   rec.offset + hi * row_bytes]
+        n = (hi - lo) * cols
+        if rec.float_type == F32:
+            arr = np.frombuffer(buf, dtype=np.float32, count=n).copy()
+        elif rec.float_type == Q40:
+            arr = dequantize_q40(buf, n)
+        else:
+            raise ValueError(f"unsupported tensor float type {rec.float_type}")
+        return arr.reshape(hi - lo, cols)
+
+    def tensor_q40_kmajor_sub(self, key: str, out_lo: int, out_hi: int,
+                              in_lo: int, in_hi: int) -> tuple[np.ndarray, np.ndarray]:
+        """A K-major sub-block of a Q40 weight:
+        ``scales f32 [(in_hi-in_lo)/32, out_hi-out_lo]``, ``codes int8 [in, out]``.
+
+        K-major column ranges are disk ROW ranges (contiguous); K-major row
+        ranges are disk column-block ranges (strided, 32-element granularity).
+        Only the selected blocks are copied out of the mmap, so peak host
+        memory is the slice, not the tensor — the loader's building block for
+        sharded weights.
+        """
+        rec = self.tensors[key]
+        assert rec.float_type == Q40, rec
+        rows, cols = rec.shape
+        assert 0 <= out_lo <= out_hi <= rows, (key, out_lo, out_hi)
+        assert 0 <= in_lo <= in_hi <= cols and in_lo % Q40_BLOCK_SIZE == 0 \
+            and in_hi % Q40_BLOCK_SIZE == 0, (key, in_lo, in_hi)
+        n_blk = cols // Q40_BLOCK_SIZE
+        blk_lo, blk_hi = in_lo // Q40_BLOCK_SIZE, in_hi // Q40_BLOCK_SIZE
+        row_bytes = rec.n_bytes // rows
+        sub_rows = memoryview(self._mm)[rec.offset + out_lo * row_bytes:
+                                        rec.offset + out_hi * row_bytes]
+        if blk_lo == 0 and blk_hi == n_blk:
+            sel = bytes(sub_rows)  # full-width fast path: one copy
+        else:
+            as_blocks = np.frombuffer(sub_rows, dtype=np.uint8).reshape(
+                out_hi - out_lo, n_blk, Q40_BLOCK_BYTES)
+            sel = np.ascontiguousarray(as_blocks[:, blk_lo:blk_hi]).tobytes()
+        n = (out_hi - out_lo) * (in_hi - in_lo)
+        from .. import native
+
+        if blk_lo == 0 and blk_hi == n_blk and native.available():
+            out = native.q40_repack_kmajor(sel, out_hi - out_lo, cols)
+            if out is not None:
+                return out
+        scales, codes = unpack_q40(sel, n)
+        scales = scales.reshape(out_hi - out_lo, (in_hi - in_lo) // Q40_BLOCK_SIZE)
+        codes = codes.reshape(out_hi - out_lo, in_hi - in_lo)
+        return (np.ascontiguousarray(scales.T.astype(np.float32)),
+                np.ascontiguousarray(codes.T))
 
     def tensor_q40_kmajor(self, key: str) -> tuple[np.ndarray, np.ndarray]:
         """Read a Q40 matmul weight as K-major device planes:

@@ -50,6 +50,10 @@ class ModelConfig:
     # in-graph (llm.cpp:258-265 casts; wire pipes SURVEY.md §2 #10) via
     # fake-quantization. Costs throughput; off for pure-TPU serving.
     sync_q80: bool = False
+    # MoE compute: "sparse" = sort-by-expert + lax.ragged_dot grouped matmul
+    # (O(k) experts per token); "dense" = all-experts einsum, gate-weighted
+    # (O(E), exact and simple — the test oracle); "auto" = sparse.
+    moe_impl: str = "auto"
 
     @property
     def q_dim(self) -> int:

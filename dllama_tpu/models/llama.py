@@ -65,11 +65,13 @@ class LayerParams(NamedTuple):
     norm_k: jax.Array | None
     # MoE (None for dense models). Expert weights are kept dense (compute
     # dtype): the quantized Pallas matmul path doesn't cover the stacked
-    # expert axis yet.
+    # expert axis yet. Layout is IN-major ("[.., in, out]") so
+    # ``lax.ragged_dot``'s grouped matmul consumes them with no per-step
+    # transpose (its rhs contracts axis 1).
     moe_gate: jax.Array | None = None  # [L, E, dim] router
-    we1: jax.Array | None = None       # [L, E, hidden_dim, dim] (gate)
-    we2: jax.Array | None = None       # [L, E, dim, hidden_dim] (down)
-    we3: jax.Array | None = None       # [L, E, hidden_dim, dim] (up)
+    we1: jax.Array | None = None       # [L, E, dim, hidden_dim] (gate)
+    we2: jax.Array | None = None       # [L, E, hidden_dim, dim] (down)
+    we3: jax.Array | None = None       # [L, E, dim, hidden_dim] (up)
 
 
 class Params(NamedTuple):
@@ -121,41 +123,154 @@ def _hidden_act(cfg: ModelConfig, x: jax.Array) -> jax.Array:
     return jax.nn.gelu(x, approximate=True)
 
 
-def _moe_ffn(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Array:
-    """Mixture-of-experts SwiGLU FFN — new capability (the reference parses
-    N_EXPERTS but its graph builder never emits expert ops, SURVEY.md §2.2).
-
-    Router: softmax over all expert logits, top-k, then either renormalize
-    the selected weights to sum to 1 (cfg.moe_norm_topk — Mixtral semantics,
-    and note renormalizing is identical to softmaxing the selected logits)
-    or keep the raw probabilities (Qwen3-MoE with HF norm_topk_prob false).
-    Compute is dense over the expert axis — every expert runs on every token,
-    weighted by the (sparse) gate — which is exact and shards cleanly: with
-    "experts" mapped to the ``ep`` mesh axis each device computes only its
-    local experts and XLA psums the combine. A grouped/megablocks-style
-    sparse matmul is a planned optimization.
-    """
-    E, k = cfg.n_experts, cfg.n_active_experts
-    logits = jnp.einsum("btd,ed->bte", h.astype(jnp.float32),
-                        lp.moe_gate.astype(jnp.float32))
+def _moe_router(cfg: ModelConfig, h: jax.Array, gate: jax.Array):
+    """Top-k routing (shared by both MoE impls): softmax over all expert
+    logits, top-k, then either renormalize the selected weights to sum to 1
+    (cfg.moe_norm_topk — Mixtral semantics; renormalizing equals softmaxing
+    the selected logits) or keep the raw probabilities (Qwen3-MoE with HF
+    norm_topk_prob false). Returns ``(weights [.., k], idx [.., k])``."""
+    logits = jnp.einsum("...d,ed->...e", h.astype(jnp.float32),
+                        gate.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)
-    top, idx = jax.lax.top_k(probs, k)
+    top, idx = jax.lax.top_k(probs, cfg.n_active_experts)
     if cfg.moe_norm_topk:
-        weights = top / jnp.sum(top, axis=-1, keepdims=True)
-    else:
-        weights = top
+        top = top / jnp.sum(top, axis=-1, keepdims=True)
+    return top, idx
+
+
+def _moe_ffn_dense(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Array:
+    """All-experts einsum, gate-weighted — O(E) FLOPs but exact and simple;
+    the oracle the sparse path is tested against, and the fallback when the
+    mesh shards the expert-hidden axis over tp."""
+    E = cfg.n_experts
+    weights, idx = _moe_router(cfg, h, lp.moe_gate)
     one_hot = jax.nn.one_hot(idx, E, dtype=jnp.float32)      # [B,T,k,E]
     gates = jnp.einsum("btke,btk->bte", one_hot, weights)    # sparse rows
     gates = constrain(gates, "batch", None, "experts")
 
     ht = h.astype(cfg.compute_dtype)
-    h1 = jnp.einsum("btd,ehd->bteh", ht, lp.we1)
-    h3 = jnp.einsum("btd,ehd->bteh", ht, lp.we3)
+    h1 = jnp.einsum("btd,edh->bteh", ht, lp.we1)
+    h3 = jnp.einsum("btd,edh->bteh", ht, lp.we3)
     a = _hidden_act(cfg, h1) * h3
     a = constrain(a, "batch", None, "experts", "hidden")
-    y = jnp.einsum("bteh,edh,bte->btd", a, lp.we2,
+    y = jnp.einsum("bteh,ehd,bte->btd", a, lp.we2,
                    gates.astype(cfg.compute_dtype))
     return y.astype(h.dtype)
+
+
+# Below this many (token, expert) rows the sparse path gathers per-row expert
+# weights instead of sorting into ragged groups: at decode (N·k ~ a few) the
+# gathered weights are tiny and the compute is exactly O(k) on EVERY backend,
+# whereas ragged_dot's fallback lowering is a masked dense over all groups.
+_MOE_GATHER_MAX_ROWS = 32
+
+
+def _moe_sparse_local(cfg: ModelConfig, x: jax.Array, idx: jax.Array,
+                      weights: jax.Array, we1, we2, we3,
+                      e_lo: jax.Array, e_local: int) -> jax.Array:
+    """Sparse MoE over this device's expert slice ``[e_lo, e_lo+e_local)``.
+
+    ``x [N, D]``, ``idx/weights [N, k]``. Rows routed to non-local experts are
+    clamped to expert 0 with weight 0 (computed-then-discarded — N·k rows per
+    device keeps shapes static; still O(k), not O(E), work per token).
+
+    Two regimes: decode-sized inputs gather the k experts' weight slices per
+    row (true O(k) FLOPs, small transient); prefill-sized inputs sort rows by
+    expert and run one ``lax.ragged_dot`` grouped matmul per projection.
+    """
+    N, k = idx.shape
+    flat_e = idx.reshape(N * k) - e_lo
+    valid = (flat_e >= 0) & (flat_e < e_local)
+    flat_e = jnp.where(valid, flat_e, 0)
+    flat_w = jnp.where(valid, weights.reshape(N * k), 0.0)
+    x_rep = x[jnp.arange(N * k, dtype=jnp.int32) // k]  # row per (token, k)
+
+    if N * k <= _MOE_GATHER_MAX_ROWS:
+        h1 = jnp.einsum("nd,ndh->nh", x_rep, we1[flat_e],
+                        preferred_element_type=jnp.float32)
+        h3 = jnp.einsum("nd,ndh->nh", x_rep, we3[flat_e],
+                        preferred_element_type=jnp.float32)
+        a = (_hidden_act(cfg, h1) * h3).astype(x.dtype)
+        y = jnp.einsum("nh,nhd->nd", a, we2[flat_e],
+                       preferred_element_type=jnp.float32)
+        y = y * flat_w[:, None]
+    else:
+        order = jnp.argsort(flat_e)                    # group rows by expert
+        xs = x_rep[order]
+        group_sizes = jnp.bincount(flat_e, length=e_local).astype(jnp.int32)
+
+        h1 = jax.lax.ragged_dot(xs, we1, group_sizes,
+                                preferred_element_type=jnp.float32)
+        h3 = jax.lax.ragged_dot(xs, we3, group_sizes,
+                                preferred_element_type=jnp.float32)
+        a = (_hidden_act(cfg, h1) * h3).astype(x.dtype)
+        y = jax.lax.ragged_dot(a, we2, group_sizes,
+                               preferred_element_type=jnp.float32)
+        y = y[jnp.argsort(order)] * flat_w[:, None]    # unsort to [N*k]
+    return jnp.sum(y.reshape(N, k, -1), axis=1).astype(x.dtype)
+
+
+def _moe_ffn_sparse(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Array:
+    """Sparse top-k dispatch: tokens sorted by expert, one ``lax.ragged_dot``
+    per projection — O(k/E) of the dense path's FFN FLOPs (the whole point of
+    MoE; beyond-reference capability, SURVEY.md §2.2). Runs inside shard_map
+    under a mesh: experts shard over ``ep`` (each device computes its local
+    expert groups, psum combines), batch shards over ``dp``."""
+    B, T, D = h.shape
+    weights, idx = _moe_router(cfg, h, lp.moe_gate)
+    x = h.astype(cfg.compute_dtype).reshape(B * T, D)
+    idx2 = idx.reshape(B * T, cfg.n_active_experts)
+    w2 = weights.astype(cfg.compute_dtype).reshape(B * T, cfg.n_active_experts)
+
+    plan = _current_plan()
+    if plan is None:
+        y = _moe_sparse_local(cfg, x, idx2, w2, lp.we1, lp.we2, lp.we3,
+                              jnp.int32(0), cfg.n_experts)
+        return y.reshape(B, T, D).astype(h.dtype)
+
+    from jax.sharding import PartitionSpec as P
+
+    ep_ax = plan.resolve("experts")
+    if ep_ax is not None and cfg.n_experts % plan._axis_size(ep_ax) != 0:
+        ep_ax = None
+    e_local = cfg.n_experts // (plan._axis_size(ep_ax) if ep_ax else 1)
+
+    def local(x_l, idx_l, w_l, we1, we2, we3):
+        e_lo = (jax.lax.axis_index(ep_ax) * e_local) if ep_ax else jnp.int32(0)
+        y = _moe_sparse_local(cfg, x_l, idx_l, w_l, we1, we2, we3, e_lo, e_local)
+        return jax.lax.psum(y, ep_ax) if ep_ax else y
+
+    fn = jax.shard_map(
+        local, mesh=plan.mesh,
+        in_specs=(P(), P(), P(),
+                  P(ep_ax, None, None), P(ep_ax, None, None), P(ep_ax, None, None)),
+        out_specs=P(),
+        check_vma=False)
+    y = fn(x, idx2, w2, lp.we1, lp.we2, lp.we3)
+    return y.reshape(B, T, D).astype(h.dtype)
+
+
+def _moe_ffn(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Array:
+    """Mixture-of-experts SwiGLU FFN — new capability (the reference parses
+    N_EXPERTS but its graph builder never emits expert ops, SURVEY.md §2.2).
+
+    cfg.moe_impl picks the compute: "sparse" (grouped ragged_dot, default) or
+    "dense" (all-experts oracle). The sparse path requires the expert-hidden
+    axis unsharded (it shards experts over ep instead); a mesh that maps
+    "hidden" onto tp falls back to dense, which shards both ways.
+    """
+    impl = cfg.moe_impl
+    plan = _current_plan()
+    if impl == "auto":
+        impl = "sparse"
+    if impl == "sparse" and plan is not None:
+        hid_ax = plan.resolve("hidden")
+        if hid_ax is not None and cfg.hidden_dim % plan._axis_size(hid_ax) == 0 \
+                and plan._axis_size(hid_ax) > 1:
+            impl = "dense"  # tp shards expert-hidden: dense einsum handles it
+    if impl == "sparse":
+        return _moe_ffn_sparse(cfg, h, lp)
+    return _moe_ffn_dense(cfg, h, lp)
 
 
 def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
@@ -281,82 +396,18 @@ def _stack_weights(ws: list[Any]) -> Any:
 
 
 def load_params_from_mfile(mf: ModelFile, cfg: ModelConfig,
-                           weight_mode: str = "auto") -> Params:
-    """Build device params from a .m file.
+                           weight_mode: str = "auto", plan=None) -> Params:
+    """Build device params from a .m file via the streaming loader.
 
     ``weight_mode``: ``"auto"`` keeps Q40 files quantized on device (planes),
-    ``"f32"``/``"bf16"`` dequantize to dense. This replaces the reference's
-    root-to-worker weight streaming (NnRootWeightLoader, SURVEY.md §2 #12):
-    under SPMD the per-device shard transfer happens in ``jax.device_put``
-    against the params' NamedShardings.
+    ``"f32"``/``"bf16"`` dequantize to dense. With ``plan`` the params come
+    back fully sharded — each device shard's bytes are read directly from the
+    mmap (runtime.weights), replacing the reference's root-to-worker weight
+    streaming (NnRootWeightLoader, SURVEY.md §2 #12) with bounded host memory.
     """
-    h = mf.header
-    quantized = h.weight_type == Q40 and weight_mode == "auto"
-    dense_dtype = jnp.bfloat16 if weight_mode == "bf16" else jnp.float32
+    from ..runtime.weights import load_params
 
-    def matmul_weight(key: str) -> Weight:
-        if quantized:
-            # disk layout is out-major; device layout is K-major (QuantizedWeight);
-            # the repack runs in native code when built (dllama_tpu/native)
-            scales, codes = mf.tensor_q40_kmajor(key)
-            return QuantizedWeight(scales=jnp.asarray(scales),
-                                   codes=jnp.asarray(codes))
-        return jnp.asarray(mf.tensor_f32(key), dtype=dense_dtype)
-
-    def f32(key: str) -> jax.Array:
-        return jnp.asarray(mf.tensor_f32(key))
-
-    moe = h.n_experts > 0
-    if moe and not mf.has_moe_router:
-        raise ValueError(
-            "MoE model file has no router tensors (written by the reference "
-            "converter, which never emits block_moe_gate) — reconvert with "
-            "python -m dllama_tpu.convert")
-
-    def expert_stack(name: str) -> jax.Array:
-        """[L, E, out, in] dense expert weights in compute dtype (cast
-        per-tensor before stacking to keep host peak memory at the target
-        dtype, not f32)."""
-        # honor weight_mode like matmul_weight does (bf16 halves the footprint
-        # of what is the bulk of an MoE checkpoint); "auto" follows compute dtype
-        target = jnp.dtype(dense_dtype if weight_mode != "auto"
-                           else cfg.compute_dtype)
-        first = mf.tensor_f32(f"{name}.0.0")
-        out = np.empty((h.n_layers, h.n_experts) + first.shape, dtype=target)
-        for l in range(h.n_layers):
-            for e in range(h.n_experts):
-                out[l, e] = mf.tensor_f32(f"{name}.{l}.{e}")
-        return jnp.asarray(out)
-
-    layers = LayerParams(
-        wq=_stack_weights([matmul_weight(f"block_matmul_q.{l}") for l in range(h.n_layers)]),
-        wk=_stack_weights([matmul_weight(f"block_matmul_k.{l}") for l in range(h.n_layers)]),
-        wv=_stack_weights([matmul_weight(f"block_matmul_v.{l}") for l in range(h.n_layers)]),
-        wo=_stack_weights([matmul_weight(f"block_matmul_wo.{l}") for l in range(h.n_layers)]),
-        w1=None if moe else _stack_weights(
-            [matmul_weight(f"block_matmul_w1.{l}") for l in range(h.n_layers)]),
-        w2=None if moe else _stack_weights(
-            [matmul_weight(f"block_matmul_w2.{l}") for l in range(h.n_layers)]),
-        w3=None if moe else _stack_weights(
-            [matmul_weight(f"block_matmul_w3.{l}") for l in range(h.n_layers)]),
-        norm_att=jnp.stack([f32(f"block_norm_0.{l}") for l in range(h.n_layers)]),
-        norm_ffn=jnp.stack([f32(f"block_norm_1.{l}") for l in range(h.n_layers)]),
-        norm_q=(jnp.stack([f32(f"block_norm_q.{l}") for l in range(h.n_layers)])
-                if h.arch_type == ArchType.QWEN3 else None),
-        norm_k=(jnp.stack([f32(f"block_norm_k.{l}") for l in range(h.n_layers)])
-                if h.arch_type == ArchType.QWEN3 else None),
-        moe_gate=(jnp.stack([f32(f"block_moe_gate.{l}") for l in range(h.n_layers)])
-                  if moe else None),
-        we1=expert_stack("block_expert_w1") if moe else None,
-        we2=expert_stack("block_expert_w2") if moe else None,
-        we3=expert_stack("block_expert_w3") if moe else None,
-    )
-    return Params(
-        embedding=f32("embedding"),
-        layers=layers,
-        final_norm=f32("final_norm"),
-        logits=matmul_weight("final_matmul_logits"),
-    )
+    return load_params(mf, cfg, weight_mode, plan)
 
 
 def init_random_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02,
@@ -389,11 +440,12 @@ def init_random_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02,
         norm_k=jnp.asarray(1.0 + rand(cfg.n_layers, cfg.head_dim)) if qwen3 else None,
         moe_gate=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.dim))
                   if moe else None),
-        we1=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.hidden_dim, cfg.dim),
+        # in-major expert layout (see LayerParams)
+        we1=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.dim, cfg.hidden_dim),
                          dtype=cfg.compute_dtype) if moe else None),
-        we2=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.dim, cfg.hidden_dim),
+        we2=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.hidden_dim, cfg.dim),
                          dtype=cfg.compute_dtype) if moe else None),
-        we3=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.hidden_dim, cfg.dim),
+        we3=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.dim, cfg.hidden_dim),
                          dtype=cfg.compute_dtype) if moe else None),
     )
     logits = rand(cfg.vocab_size, cfg.dim)

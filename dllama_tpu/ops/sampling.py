@@ -1,0 +1,75 @@
+"""On-device token sampling — the fused tail of the decode step.
+
+Replaces the reference's host-side sample-after-transfer (reference:
+``Sampler::sample`` over the gathered logits pipe, src/tokenizer.cpp:480-510;
+our host oracle is :mod:`dllama_tpu.tokenizer.sampler`): the temperature
+softmax, top-p truncation, and CDF pick all run on device inside the jitted
+decode step, so a sampled token costs one dispatch and a 4-byte device→host
+transfer — the same budget as greedy decode — instead of a vocab-row
+download every token.
+
+RNG stays host-side for reference parity: the xorshift* ``coin`` is computed
+on host (one u64 step per token, bit-exact with tokenizer.cpp:25-36) and
+passed in as a scalar. Semantics mirror the host oracle's reference quirks:
+
+* cutoff pre-filter ``(1-topp)/(n-1)`` before the descending sort
+  (tokenizer.cpp:432-441);
+* renormalization by the truncated cumulative mass (``coin * cumulative``,
+  tokenizer.cpp:455-459);
+* ties keep ascending-index order (stable sort — the reference qsort
+  comparator returns 0 for equal probs).
+
+Float caveat: cumulative sums here and in numpy may associate differently,
+so a coin landing exactly on a f32 boundary can pick a neighboring token;
+tests sample many draws and require exact agreement on the oracle's RNG
+stream (boundary hits are measure-zero in practice).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def topp_sample(probs: jax.Array, topp: jax.Array, coin: jax.Array) -> jax.Array:
+    """Nucleus pick over ``probs [V]``; returns a scalar int32 token id."""
+    n = probs.shape[0]
+    cutoff = (1.0 - topp) / (n - 1)
+    masked = jnp.where(probs >= cutoff, probs, 0.0)
+    order = jnp.argsort(-masked, stable=True)
+    ps = masked[order]
+    csum = jnp.cumsum(ps)
+    n_kept = jnp.count_nonzero(ps).astype(jnp.int32)
+    over = csum > topp
+    last = jnp.where(jnp.any(over), jnp.argmax(over),
+                     jnp.maximum(n_kept - 1, 0)).astype(jnp.int32)
+    cumulative = csum[last]
+    r = coin * cumulative
+    inner = jnp.cumsum(
+        jnp.where(jnp.arange(n, dtype=jnp.int32) <= last, ps, 0.0)) > r
+    pick = jnp.where(jnp.any(inner), jnp.argmax(inner), last).astype(jnp.int32)
+    return order[pick].astype(jnp.int32)
+
+
+def mult_sample(probs: jax.Array, coin: jax.Array) -> jax.Array:
+    """Multinomial CDF scan (reference: tokenizer.cpp:403-414)."""
+    cdf = jnp.cumsum(probs)
+    hit = coin < cdf
+    n = probs.shape[0]
+    return jnp.where(jnp.any(hit), jnp.argmax(hit), n - 1).astype(jnp.int32)
+
+
+def sampled_token(logits: jax.Array, temperature: jax.Array, topp: jax.Array,
+                  coin: jax.Array) -> jax.Array:
+    """Sample one token per row of ``logits [B, V]`` (temperature > 0 path;
+    the greedy path is models.llama.greedy_step). ``topp`` outside (0, 1)
+    selects plain multinomial, matching the host oracle."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32) / temperature, axis=-1)
+
+    def pick(row):
+        return jax.lax.cond(
+            (topp > 0.0) & (topp < 1.0),
+            lambda: topp_sample(row, topp, coin),
+            lambda: mult_sample(row, coin))
+
+    return jax.vmap(pick)(probs)

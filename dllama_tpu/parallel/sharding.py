@@ -63,15 +63,17 @@ def param_shardings(plan: MeshPlan, params: "Params") -> "Params":
         norm_q=None if lp.norm_q is None else plan.sharding(None, None),
         norm_k=None if lp.norm_k is None else plan.sharding(None, None),
         # MoE: experts over ep, expert-hidden over tp (new capability; the
-        # reference has no runtime MoE, SURVEY.md §2.2)
+        # reference has no runtime MoE, SURVEY.md §2.2). Expert weights are
+        # in-major (ragged_dot layout, see LayerParams): we1/we3 [L,E,D,H],
+        # we2 [L,E,H,D].
         moe_gate=None if lp.moe_gate is None else plan.sharding_for(
             tuple(lp.moe_gate.shape), None, "experts", None),
         we1=None if lp.we1 is None else plan.sharding_for(
-            tuple(lp.we1.shape), None, "experts", "hidden", None),
+            tuple(lp.we1.shape), None, "experts", None, "hidden"),
         we2=None if lp.we2 is None else plan.sharding_for(
-            tuple(lp.we2.shape), None, "experts", None, "hidden"),
+            tuple(lp.we2.shape), None, "experts", "hidden", None),
         we3=None if lp.we3 is None else plan.sharding_for(
-            tuple(lp.we3.shape), None, "experts", "hidden", None),
+            tuple(lp.we3.shape), None, "experts", None, "hidden"),
     )
     return Params(
         embedding=plan.sharding(None, None),

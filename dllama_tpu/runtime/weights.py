@@ -1,0 +1,265 @@
+"""Streaming weight loader — bounded host memory, shard-direct device placement.
+
+Replaces the stack-everything-then-device_put loader (round-1
+load_params_from_mfile) and the reference's root-to-worker weight streaming
+(NnRootWeightLoader, nn-network.cpp:809-854): every parameter becomes a global
+array via ``jax.make_array_from_callback``, whose callback reads ONLY the
+bytes of the requested device shard straight from the mmap (the .m slice
+readers in formats.mfile). Peak host memory is therefore one shard of one
+stacked tensor — not the model — and under multi-host each process reads only
+its own shards, which is exactly the per-node slice streaming the reference
+does over TCP, done by the filesystem instead.
+
+Layout notes:
+
+* stacked per-layer weights ``[L, ...]`` are assembled layer-by-layer inside
+  the callback (the scan-stacked axis never exists as a host copy of the
+  whole model);
+* Q40 planes are K-major (see ops.linear.QuantizedWeight): a shard of the
+  ``out`` axis is a contiguous disk row range; a shard of the ``in`` axis is
+  a 32-aligned block-column range — both are sliced out of the mmap without
+  materializing the full tensor (mfile.tensor_q40_kmajor_sub);
+* fully-replicated leaves are read once and ``device_put`` (the callback API
+  would re-read per device).
+
+405B-scale note (BASELINE config 5): this bounds *host* memory; weights still
+reside in HBM. The host-DRAM offload mode (weights stay host-side, streamed
+per-layer through a double buffer during forward) is designed to sit on top
+of these same slice readers — see PARITY.md.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..formats.mfile import ArchType, ModelFile
+from ..formats.quants import Q40, Q40_BLOCK_SIZE
+from ..ops.linear import QuantizedWeight
+from ..parallel.api import MeshPlan, make_tp_mesh
+
+if TYPE_CHECKING:
+    from ..models.config import ModelConfig
+    from ..models.llama import Params
+
+
+def _bounds(sl: slice, dim: int) -> tuple[int, int]:
+    lo, hi, step = sl.indices(dim)
+    assert step == 1, sl
+    return lo, hi
+
+
+def _layer_range(sl: slice, n_layers: int) -> range:
+    lo, hi = _bounds(sl, n_layers)
+    return range(lo, hi)
+
+
+def _make(shape: tuple[int, ...], dtype, sharding, cb: Callable) -> jax.Array:
+    """Global array from per-shard callback.
+
+    Multi-device fully-replicated leaves are read once and device_put (the
+    callback API would re-read per device); everything else — including the
+    single-device case — goes through the callback so only the shard bytes
+    ever exist on host."""
+    if sharding.is_fully_replicated and len(sharding.device_set) > 1:
+        full = cb(tuple(slice(None) for _ in shape))
+        return jax.device_put(jnp.asarray(full, dtype=dtype), sharding)
+    return jax.make_array_from_callback(
+        shape, sharding, lambda idx: np.asarray(cb(idx), dtype=dtype))
+
+
+class _StreamingLoader:
+    def __init__(self, mf: ModelFile, cfg: "ModelConfig", plan: MeshPlan | None,
+                 weight_mode: str):
+        self.mf = mf
+        self.cfg = cfg
+        self.h = mf.header
+        # a trivial 1-device mesh gives single-chip loads the same code path
+        self.plan = plan if plan is not None else make_tp_mesh(1)
+        self.quantized = self.h.weight_type == Q40 and weight_mode == "auto"
+        self.dense_dtype = jnp.bfloat16 if weight_mode == "bf16" else jnp.float32
+        self.weight_mode = weight_mode
+
+    # -- matmul weights -----------------------------------------------------
+
+    def matmul(self, name: str, out_dim: int, in_dim: int, *, stacked: bool,
+               out_axis: str | None, in_axis: str | None):
+        """One (possibly layer-stacked) matmul weight, quantized or dense."""
+        L = self.h.n_layers
+        key = (lambda l: f"{name}.{l}") if stacked else (lambda _l: name)
+        plan = self.plan
+
+        if self.quantized:
+            lead = (None,) if stacked else ()
+            cshape = ((L, in_dim, out_dim) if stacked else (in_dim, out_dim))
+            sshape = ((L, in_dim // Q40_BLOCK_SIZE, out_dim) if stacked
+                      else (in_dim // Q40_BLOCK_SIZE, out_dim))
+            c_sh = plan.sharding_for(cshape, *lead, in_axis, out_axis)
+            s_sh = plan.sharding_for(sshape, *lead, in_axis, out_axis)
+
+            def read(idx, want_scales: bool):
+                if stacked:
+                    l_sl, k_sl, n_sl = idx
+                    layers = _layer_range(l_sl, L)
+                else:
+                    k_sl, n_sl = idx
+                    layers = [None]
+                n_lo, n_hi = _bounds(n_sl, out_dim)
+                if want_scales:
+                    k_lo, k_hi = _bounds(k_sl, in_dim // Q40_BLOCK_SIZE)
+                    k_lo, k_hi = k_lo * Q40_BLOCK_SIZE, k_hi * Q40_BLOCK_SIZE
+                    k_al, k_ah = k_lo, k_hi
+                else:
+                    # codes shards may not be 32-aligned (a K smaller than
+                    # 32*tp still divides): read the aligned superset, trim
+                    k_lo, k_hi = _bounds(k_sl, in_dim)
+                    k_al = (k_lo // Q40_BLOCK_SIZE) * Q40_BLOCK_SIZE
+                    k_ah = -(-k_hi // Q40_BLOCK_SIZE) * Q40_BLOCK_SIZE
+                out = None
+                for i, l in enumerate(layers):
+                    k = key(l) if l is not None else name
+                    scales, codes = self.mf.tensor_q40_kmajor_sub(
+                        k, n_lo, n_hi, k_al, k_ah)
+                    part = (scales if want_scales
+                            else codes[k_lo - k_al:k_hi - k_al])
+                    if not stacked:
+                        return part
+                    if out is None:  # fill in place: peak = slice + 1 layer
+                        out = np.empty((len(layers),) + part.shape, part.dtype)
+                    out[i] = part
+                return out
+
+            return QuantizedWeight(
+                scales=_make(sshape, jnp.float32, s_sh,
+                             lambda idx: read(idx, True)),
+                codes=_make(cshape, jnp.int8, c_sh,
+                            lambda idx: read(idx, False)),
+            )
+
+        # dense: reference on-disk orientation [out, in] (row-major)
+        lead = (None,) if stacked else ()
+        shape = (L, out_dim, in_dim) if stacked else (out_dim, in_dim)
+        sh = plan.sharding_for(shape, *lead, out_axis, in_axis)
+
+        def read_dense(idx):
+            if stacked:
+                l_sl, o_sl, i_sl = idx
+                layers = _layer_range(l_sl, L)
+            else:
+                o_sl, i_sl = idx
+                layers = [None]
+            o_lo, o_hi = _bounds(o_sl, out_dim)
+            parts = [self.mf.tensor_f32_rows(key(l) if l is not None else name,
+                                             o_lo, o_hi)[:, i_sl]
+                     for l in layers]
+            return np.stack(parts) if stacked else parts[0]
+
+        return _make(shape, self.dense_dtype, sh, read_dense)
+
+    # -- small / dense tensors ---------------------------------------------
+
+    def stacked_f32(self, name: str, *shape_tail: int) -> jax.Array:
+        L = self.h.n_layers
+        shape = (L, *shape_tail)
+        sh = self.plan.sharding_for(shape, *([None] * len(shape)))
+
+        def read(idx):
+            layers = _layer_range(idx[0], L)
+            return np.stack([
+                self.mf.tensor_f32(f"{name}.{l}") for l in layers])
+
+        return _make(shape, jnp.float32, sh, read)
+
+    def f32(self, name: str, *shape: int) -> jax.Array:
+        sh = self.plan.sharding_for(tuple(shape), *([None] * len(shape)))
+        return _make(tuple(shape), jnp.float32, sh,
+                     lambda idx: self.mf.tensor_f32(name)[idx])
+
+    def expert_stack(self, name: str, out_dim: int, in_dim: int,
+                     out_axis: str | None, in_axis: str | None) -> jax.Array:
+        """[L, E, in, out] experts — IN-major, the lax.ragged_dot rhs layout
+        (see models.llama.LayerParams) — in compute dtype (bf16 by default:
+        experts are the bulk of an MoE checkpoint; a dense-f32 Mixtral would
+        be unloadable — advisor round-1 medium finding). Sharded experts→ep,
+        expert-hidden→tp; one (layer, expert) slice read at a time."""
+        L, E = self.h.n_layers, self.h.n_experts
+        target = jnp.dtype(self.dense_dtype if self.weight_mode != "auto"
+                           else self.cfg.compute_dtype)
+        shape = (L, E, in_dim, out_dim)
+        sh = self.plan.sharding_for(shape, None, "experts", in_axis, out_axis)
+
+        def read(idx):
+            l_sl, e_sl, i_sl, o_sl = idx
+            o_lo, o_hi = _bounds(o_sl, out_dim)
+            out = None
+            for li, l in enumerate(_layer_range(l_sl, L)):
+                for ei, e in enumerate(_layer_range(e_sl, E)):
+                    part = self.mf.tensor_f32_rows(
+                        f"{name}.{l}.{e}", o_lo, o_hi)[:, i_sl].T  # -> [in, out]
+                    if out is None:
+                        out = np.empty(
+                            (len(_layer_range(l_sl, L)), len(_layer_range(e_sl, E)))
+                            + part.shape, dtype=target)
+                    out[li, ei] = part
+            return out
+
+        return _make(shape, target, sh, read)
+
+
+def load_params(mf: ModelFile, cfg: "ModelConfig", weight_mode: str = "auto",
+                plan: MeshPlan | None = None) -> "Params":
+    """Build fully-placed (and, under a plan, fully-sharded) device params.
+
+    Drop-in successor of the round-1 stacking loader: same Params tree, but
+    host peak memory is bounded by one tensor shard and no second
+    ``device_put``/reshard pass is needed.
+    """
+    from ..models.llama import LayerParams, Params
+
+    h = mf.header
+    moe = h.n_experts > 0
+    if moe and not mf.has_moe_router:
+        raise ValueError(
+            "MoE model file has no router tensors (written by the reference "
+            "converter, which never emits block_moe_gate) — reconvert with "
+            "python -m dllama_tpu.convert")
+    ld = _StreamingLoader(mf, cfg, plan, weight_mode)
+    qwen3 = h.arch_type == ArchType.QWEN3
+
+    layers = LayerParams(
+        wq=ld.matmul("block_matmul_q", h.q_dim, h.dim, stacked=True,
+                     out_axis="heads", in_axis=None),
+        wk=ld.matmul("block_matmul_k", h.kv_dim, h.dim, stacked=True,
+                     out_axis="kv_heads", in_axis=None),
+        wv=ld.matmul("block_matmul_v", h.kv_dim, h.dim, stacked=True,
+                     out_axis="kv_heads", in_axis=None),
+        wo=ld.matmul("block_matmul_wo", h.dim, h.q_dim, stacked=True,
+                     out_axis=None, in_axis="heads"),
+        w1=None if moe else ld.matmul("block_matmul_w1", h.hidden_dim, h.dim,
+                                      stacked=True, out_axis="hidden", in_axis=None),
+        w2=None if moe else ld.matmul("block_matmul_w2", h.dim, h.hidden_dim,
+                                      stacked=True, out_axis=None, in_axis="hidden"),
+        w3=None if moe else ld.matmul("block_matmul_w3", h.hidden_dim, h.dim,
+                                      stacked=True, out_axis="hidden", in_axis=None),
+        norm_att=ld.stacked_f32("block_norm_0", h.dim),
+        norm_ffn=ld.stacked_f32("block_norm_1", h.dim),
+        norm_q=ld.stacked_f32("block_norm_q", h.head_dim) if qwen3 else None,
+        norm_k=ld.stacked_f32("block_norm_k", h.head_dim) if qwen3 else None,
+        moe_gate=ld.stacked_f32("block_moe_gate", h.n_experts, h.dim) if moe else None,
+        we1=(ld.expert_stack("block_expert_w1", h.hidden_dim, h.dim,
+                             "hidden", None) if moe else None),
+        we2=(ld.expert_stack("block_expert_w2", h.dim, h.hidden_dim,
+                             None, "hidden") if moe else None),
+        we3=(ld.expert_stack("block_expert_w3", h.hidden_dim, h.dim,
+                             "hidden", None) if moe else None),
+    )
+    return Params(
+        embedding=ld.f32("embedding", h.vocab_size, h.dim),
+        layers=layers,
+        final_norm=ld.f32("final_norm", h.dim),
+        logits=ld.matmul("final_matmul_logits", h.vocab_size, h.dim,
+                         stacked=False, out_axis="vocab", in_axis=None),
+    )

@@ -273,3 +273,94 @@ def test_hf_config_qwen3_moe_mapping(tmp_path):
     assert params["n_experts"] == 8 and params["n_active_experts"] == 2
     assert params["hidden_dim"] == 48  # moe_intermediate_size wins
     assert params["moe_norm_topk"] == 0  # HF Qwen3MoeConfig default: False
+
+
+# ---------------------------------------------------------------------------
+# sparse (ragged_dot) dispatch vs the dense all-experts oracle
+# ---------------------------------------------------------------------------
+
+from dataclasses import replace as _replace
+
+from dllama_tpu.models.llama import _moe_ffn, init_random_params
+from dllama_tpu.parallel.api import make_mesh, use_plan
+from dllama_tpu.parallel.sharding import shard_params
+
+
+def _sparse_dense_cfg(**kw):
+    base = dict(
+        arch=mfile.ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=1,
+        n_heads=4, n_kv_heads=2, head_dim=16, vocab_size=128, seq_len=32,
+        norm_epsilon=1e-5, rope_theta=10000.0, rope_type=mfile.RopeType.LLAMA,
+        n_experts=8, n_active_experts=2)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.mark.parametrize("norm_topk", [True, False])
+def test_sparse_matches_dense_oracle(norm_topk):
+    cfg = _sparse_dense_cfg(moe_norm_topk=norm_topk)
+    params = init_random_params(cfg, seed=21)
+    lp = jax.tree.map(lambda a: None if a is None else a[0], params.layers,
+                      is_leaf=lambda x: x is None)
+    rng = np.random.default_rng(3)
+    h = jnp.asarray(rng.standard_normal((2, 5, cfg.dim)), jnp.float32)
+
+    dense = _moe_ffn(_replace(cfg, moe_impl="dense"), h, lp)
+    sparse = _moe_ffn(_replace(cfg, moe_impl="sparse"), h, lp)
+    np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_sparse_ep_sharded_matches_dense():
+    """Sparse dispatch under an ep mesh (shard_map + psum combine)."""
+    cfg = _sparse_dense_cfg()
+    params = init_random_params(cfg, seed=22)
+    lp = jax.tree.map(lambda a: None if a is None else a[0], params.layers,
+                      is_leaf=lambda x: x is None)
+    rng = np.random.default_rng(4)
+    h = jnp.asarray(rng.standard_normal((1, 6, cfg.dim)), jnp.float32)
+
+    dense = _moe_ffn(_replace(cfg, moe_impl="dense"), h, lp)
+    plan = make_mesh({"ep": 4})
+    with use_plan(plan):
+        sparse = jax.jit(
+            lambda hh: _moe_ffn(_replace(cfg, moe_impl="sparse"), hh, lp))(h)
+    np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_sparse_flops_scale_with_k_not_E():
+    """The point of sparse dispatch: FFN cost ~ k/E of dense (VERDICT #6).
+    Measured on the decode-sized gather path, which is O(k) on every backend
+    (ragged_dot's CPU fallback lowering is a masked dense over all groups, so
+    the prefill path's savings only materialize on TPU)."""
+    cfg = _sparse_dense_cfg(dim=128, hidden_dim=256, n_experts=8,
+                            n_active_experts=2)
+    params = init_random_params(cfg, seed=23)
+    lp = jax.tree.map(lambda a: None if a is None else a[0], params.layers,
+                      is_leaf=lambda x: x is None)
+    h = jnp.ones((1, 8, cfg.dim), jnp.float32)  # N*k = 16 -> gather path
+
+    def flops(impl):
+        fn = jax.jit(lambda hh: _moe_ffn(_replace(cfg, moe_impl=impl), hh, lp))
+        return fn.lower(h).compile().cost_analysis()["flops"]
+
+    dense, sparse = flops("dense"), flops("sparse")
+    # dense FFN ~ N*E*3*D*H; sparse ~ N*k*3*D*H (+ routing/gather overhead).
+    # E/k = 4 here; require at least 2x measured reduction.
+    assert sparse < dense / 2, (sparse, dense)
+
+
+def test_sparse_ragged_path_matches_dense():
+    """Prefill-sized inputs take the sort+ragged_dot branch; same oracle."""
+    cfg = _sparse_dense_cfg()
+    params = init_random_params(cfg, seed=24)
+    lp = jax.tree.map(lambda a: None if a is None else a[0], params.layers,
+                      is_leaf=lambda x: x is None)
+    rng = np.random.default_rng(9)
+    h = jnp.asarray(rng.standard_normal((1, 40, cfg.dim)), jnp.float32)  # N*k=80
+
+    dense = _moe_ffn(_replace(cfg, moe_impl="dense"), h, lp)
+    sparse = _moe_ffn(_replace(cfg, moe_impl="sparse"), h, lp)
+    np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)

