@@ -347,6 +347,22 @@ def greedy_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), kv
 
 
+def sampled_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                 start_pos: jax.Array, kv: KVCache, temperature: jax.Array,
+                 topp: jax.Array, coin: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Fused forward + temperature/top-p sample of the last position — the
+    temperature>0 twin of :func:`greedy_step`: one dispatch and a 4-byte
+    transfer per sampled token instead of a vocab-row download (reference
+    samples on host after the logits gather, src/tokenizer.cpp:480-510).
+    ``temperature``/``topp``/``coin`` are traced f32 scalars (the host steps
+    its xorshift* RNG and passes the coin in), so per-request sampling knobs
+    never trigger a recompile."""
+    from ..ops.sampling import sampled_token
+
+    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    return sampled_token(logits[:, -1, :], temperature, topp, coin), kv
+
+
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             start_pos: jax.Array, kv: KVCache) -> tuple[jax.Array, KVCache]:
     """Full forward: ``tokens [B, T]`` at absolute ``start_pos`` → logits.

@@ -12,13 +12,16 @@ per-host from the local .m file: the reference's config/weight wire protocol
 (nn-network.cpp:621-901) is replaced by each host reading its own shards —
 the SPMD loader already places only the local partition of every array.
 
-Wire layout of a control packet (width ``3 + n_batches``):
+Wire layout of a control packet (width ``6 + n_batches``):
 
-    [kind, T, start_pos, token_0 ... token_{n_batches-1}]
+    [kind, T, start_pos, token_0 ... token_{n_batches-1}, temp, topp, coin]
 
-Kinds: STOP ends the worker loop; STEP runs the full-forward program (prefill
-chunks, sampled decode, perplexity); GREEDY runs the fused greedy-decode
-program; RESET re-creates the KV cache (new conversation / perplexity run).
+where the trailing three slots are f32 bit patterns (int32 view) used only by
+SAMPLED. Kinds: STOP ends the worker loop; STEP runs the full-forward program
+(prefill chunks, perplexity); GREEDY runs the fused greedy-decode program;
+SAMPLED runs the fused temperature/top-p decode (the host-side xorshift coin
+rides the packet so every process picks the same token); RESET re-creates the
+KV cache (new conversation / perplexity run).
 """
 
 from __future__ import annotations
@@ -34,6 +37,7 @@ CTRL_STOP = 0
 CTRL_STEP = 1
 CTRL_GREEDY = 2
 CTRL_RESET = 3
+CTRL_SAMPLED = 4
 
 
 def init_distributed(coordinator: str | None = None,
@@ -65,9 +69,10 @@ class ControlCodec:
 
     def __init__(self, n_batches: int):
         self.n_batches = n_batches
-        self.width = 3 + n_batches
+        self.width = 6 + n_batches  # 3 header + tokens + 3 f32 sampling slots
 
-    def encode(self, kind: int, tokens_2d=None, start_pos: int = 0) -> np.ndarray:
+    def encode(self, kind: int, tokens_2d=None, start_pos: int = 0,
+               scalars: tuple[float, float, float] | None = None) -> np.ndarray:
         buf = np.zeros(self.width, dtype=np.int32)
         buf[0] = kind
         if tokens_2d is not None:
@@ -76,12 +81,15 @@ class ControlCodec:
             buf[1] = flat.size
             buf[2] = start_pos
             buf[3:3 + flat.size] = flat
+        if scalars is not None:
+            buf[-3:] = np.asarray(scalars, dtype=np.float32).view(np.int32)
         return buf
 
-    def decode(self, buf: np.ndarray) -> tuple[int, np.ndarray, int]:
-        buf = np.asarray(buf)
+    def decode(self, buf: np.ndarray) -> tuple[int, np.ndarray, int, np.ndarray]:
+        buf = np.ascontiguousarray(buf)
         kind, t, start_pos = int(buf[0]), int(buf[1]), int(buf[2])
-        return kind, buf[3:3 + t].reshape(1, t), start_pos
+        scalars = buf[-3:].view(np.float32)
+        return kind, buf[3:3 + t].reshape(1, t), start_pos, scalars
 
     def broadcast(self, buf: np.ndarray | None) -> np.ndarray:
         """Process 0 sends ``buf``; every other process receives it."""
@@ -145,6 +153,18 @@ def replicated_greedy(params, cfg, tokens, start_pos, kv):
     return constrain(tok, None), kv
 
 
+def replicated_sampled(params, cfg, tokens, start_pos, kv,
+                       temperature, topp, coin):
+    """Fused sampled decode with a replicated token result (every host reads
+    the same pick; the coin arrived identically via the control packet)."""
+    from ..ops.sampling import sampled_token
+    from .api import constrain
+
+    logits, kv = replicated_forward(params, cfg, tokens, start_pos, kv)
+    tok = sampled_token(logits[:, -1, :], temperature, topp, coin)
+    return constrain(tok, None), kv
+
+
 def worker_serve(engine: "InferenceEngine") -> int:
     """Run the worker side: mirror every root dispatch until STOP.
 
@@ -158,13 +178,16 @@ def worker_serve(engine: "InferenceEngine") -> int:
     codec = engine._ctrl
     served = 0
     while True:
-        kind, tokens, start_pos = codec.decode(codec.broadcast(None))
+        kind, tokens, start_pos, scalars = codec.decode(codec.broadcast(None))
         if kind == CTRL_STOP:
             return served
         if kind == CTRL_RESET:
             engine.reset()
         elif kind == CTRL_GREEDY:
             engine._dispatch(engine._greedy_step, tokens, start_pos)
+        elif kind == CTRL_SAMPLED:
+            engine._dispatch(engine._sampled_step, tokens, start_pos,
+                             extras=tuple(scalars))
         else:
             engine._dispatch(engine._step, tokens, start_pos)
         served += 1

@@ -3,11 +3,12 @@
 Replaces the reference's RootLlmInference + NnExecutor + worker control flow
 (reference: src/app.cpp:164-226, nn-executor.cpp:134-187): instead of
 broadcasting a control packet and spin-barrier-stepping an op list on every
-node, the engine holds sharded params + KV cache and dispatches two jitted
-SPMD programs — a chunked prefill (the reference's nBatches positions-as-batch
-micro-batching, app.cpp:28) and a single-token decode step with donated KV
-buffers. Sampling runs on host for reference parity (Sampler semantics,
-tokenizer.cpp:480-510).
+node, the engine holds sharded params + KV cache and dispatches jitted SPMD
+programs — a chunked prefill (the reference's nBatches positions-as-batch
+micro-batching, app.cpp:28) and fused single-token decode steps (greedy
+argmax or temperature/top-p sample on device, ops.sampling) with donated KV
+buffers. The sampling semantics match the reference Sampler
+(tokenizer.cpp:480-510), with the xorshift* coin stepped on host.
 
 Padded prefill tails are safe without masking: pad-position garbage lands in
 KV slots strictly beyond the current position, is invisible to the causal
@@ -28,11 +29,17 @@ import numpy as np
 from ..formats.mfile import ModelFile
 from ..formats.quants import F32, Q80
 from ..models.config import ModelConfig
-from ..models.llama import Params, forward, greedy_step, load_params_from_mfile
+from ..models.llama import (
+    Params,
+    forward,
+    greedy_step,
+    load_params_from_mfile,
+    sampled_step,
+)
 from ..parallel.api import MeshPlan, make_mesh, use_plan
 from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
 from ..tokenizer.bpe import Tokenizer
-from ..tokenizer.sampler import Sampler
+from ..tokenizer.sampler import Sampler, xorshift_random_f32
 from .kvcache import KVCache
 
 DEFAULT_N_BATCHES = 32  # reference default nBatches (app.cpp:28)
@@ -84,7 +91,7 @@ class InferenceEngine:
                  compute_dtype: str = "float32",
                  n_batches: int = DEFAULT_N_BATCHES,
                  temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5,
-                 multihost: bool = False):
+                 multihost: bool = False, host_sampling: bool = False):
         self.model_file = ModelFile.open(model_path, max_seq_len=max_seq_len,
                                          sync_type=sync_type)
         self.cfg = ModelConfig.from_header(self.model_file.header,
@@ -92,6 +99,7 @@ class InferenceEngine:
         self.n_batches = min(n_batches, self.cfg.seq_len)
         self.tokenizer = Tokenizer.load(tokenizer_path) if tokenizer_path else None
         self.sampler = Sampler(self.cfg.vocab_size, temperature, topp, seed)
+        self.host_sampling = host_sampling
 
         n_dev = len(jax.devices())
         if tp is None:
@@ -134,19 +142,29 @@ class InferenceEngine:
         self.pos = 0
         # donate the KV cache (arg 4) so decode updates it in place
         if multihost:
-            from ..parallel.multihost import replicated_forward, replicated_greedy
+            from ..parallel.multihost import (
+                replicated_forward,
+                replicated_greedy,
+                replicated_sampled,
+            )
 
             self._step = jax.jit(replicated_forward, static_argnums=1,
                                  donate_argnums=(4,))
             self._greedy_step = jax.jit(replicated_greedy, static_argnums=1,
                                         donate_argnums=(4,))
+            self._sampled_step = jax.jit(replicated_sampled, static_argnums=1,
+                                         donate_argnums=(4,))
         else:
             self._step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
             # greedy fast path: argmax fused into the step — ONE dispatch per
             # token and a 4-byte host transfer instead of a full logits row;
-            # used by next_token() when temperature == 0
+            # used by next_token() when temperature == 0. The sampled twin
+            # fuses temperature/top-p on device the same way (temp/topp/coin
+            # are traced scalars, so knob changes never recompile).
             self._greedy_step = jax.jit(greedy_step, static_argnums=1,
                                         donate_argnums=(4,))
+            self._sampled_step = jax.jit(sampled_step, static_argnums=1,
+                                         donate_argnums=(4,))
 
     def _fresh_kv(self) -> KVCache:
         # cache rides the compute dtype: f32 for parity, bf16 halves HBM
@@ -177,20 +195,30 @@ class InferenceEngine:
 
     # -- low-level steps ----------------------------------------------------
 
-    def _dispatch(self, step_fn, tokens_2d, start_pos: int):
+    def _dispatch(self, step_fn, tokens_2d, start_pos: int, extras: tuple = ()):
         """Run one jitted step under the active mesh plan; returns
-        (primary output, updated kv stored on self)."""
+        (primary output, updated kv stored on self). ``extras`` are trailing
+        traced f32 scalars (the sampled step's temperature/topp/coin)."""
         if self.multihost and self._is_root:
             # the reference's LlmControlPacket broadcast (app.cpp:193-204):
-            # ship (program, tokens, position) so workers replay this dispatch
-            from ..parallel.multihost import CTRL_GREEDY, CTRL_STEP
+            # ship (program, tokens, position[, sampling scalars]) so workers
+            # replay this dispatch
+            from ..parallel.multihost import CTRL_GREEDY, CTRL_SAMPLED, CTRL_STEP
 
-            kind = CTRL_GREEDY if step_fn is self._greedy_step else CTRL_STEP
-            self._ctrl.broadcast(self._ctrl.encode(kind, tokens_2d, start_pos))
+            if step_fn is self._greedy_step:
+                kind = CTRL_GREEDY
+            elif step_fn is self._sampled_step:
+                kind = CTRL_SAMPLED
+            else:
+                kind = CTRL_STEP
+            self._ctrl.broadcast(self._ctrl.encode(
+                kind, tokens_2d, start_pos,
+                scalars=extras if kind == CTRL_SAMPLED else None))
         with (use_plan(self.plan) if self.plan is not None else nullcontext()):
             out, self.kv = step_fn(
                 self.params, self.cfg, jnp.asarray(tokens_2d, dtype=jnp.int32),
-                jnp.int32(start_pos), self.kv)
+                jnp.int32(start_pos), self.kv,
+                *(jnp.float32(e) for e in extras))
         return out
 
     def _forward(self, tokens_2d: np.ndarray, start_pos: int) -> jax.Array:
@@ -235,17 +263,27 @@ class InferenceEngine:
         return np.asarray(logits[0, 0])
 
     def next_token(self, token: int) -> int:
-        """The engine's next-token primitive: greedy fast path (fused
-        forward+argmax, one dispatch, 4-byte transfer) at temperature 0,
-        host-side sampler otherwise. All decode loops (CLI generate, API
-        server) should use this."""
+        """The engine's next-token primitive — always ONE fused dispatch and a
+        4-byte device→host transfer: forward+argmax at temperature 0,
+        forward+temperature/top-p sample otherwise (ops.sampling; the host
+        steps the xorshift* RNG and ships the coin in as a scalar). All decode
+        loops (CLI generate, API server) should use this. Set
+        ``host_sampling=True`` to fall back to the logits-download + numpy
+        oracle path (the parity reference)."""
         if self.pos >= self.cfg.seq_len:
             raise ValueError(f"position {self.pos} reached seq_len {self.cfg.seq_len}")
         if self.sampler.temperature == 0.0:
             nxt = self._dispatch(self._greedy_step, np.asarray([[token]]), self.pos)
             self.pos += 1
             return int(nxt[0])
-        return self.sampler.sample(self.decode_step(token))
+        if self.host_sampling:
+            return self.sampler.sample(self.decode_step(token))
+        coin, self.sampler.rng_state = xorshift_random_f32(self.sampler.rng_state)
+        nxt = self._dispatch(
+            self._sampled_step, np.asarray([[token]]), self.pos,
+            extras=(self.sampler.temperature, self.sampler.topp, coin))
+        self.pos += 1
+        return int(nxt[0])
 
     # -- generation ---------------------------------------------------------
 

@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="activation/KV-cache dtype: f32 for reference parity, "
                         "bf16 for TPU serving throughput")
     p.add_argument("--nbatches", type=int, default=DEFAULT_N_BATCHES)
+    p.add_argument("--host-sampling", action="store_true",
+                   help="sample on host from downloaded logits (parity oracle) "
+                        "instead of the fused on-device sampler")
     p.add_argument("--tp", type=int, default=None,
                    help="tensor-parallel device count (reference: number of nodes)")
     p.add_argument("--sp", type=int, default=1,
@@ -103,7 +106,7 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         sync_type=Q80 if args.buffer_float_type == "q80" else F32,
         n_batches=args.nbatches,
         temperature=args.temperature, topp=args.topp, seed=seed,
-        multihost=multihost,
+        multihost=multihost, host_sampling=args.host_sampling,
     )
     h = engine.model_file.header
     print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "

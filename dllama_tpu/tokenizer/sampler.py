@@ -3,8 +3,10 @@
 Behavior-compatible with the reference sampler (reference:
 src/tokenizer.cpp:389-510), including the xorshift* RNG so fixed-seed runs are
 reproducible against the reference (tokenizer.cpp:25-36). This host-side numpy
-sampler is the semantics oracle; the fused on-device sampler used by the
-decode loop lives in :mod:`dllama_tpu.ops.sampling` and is tested against it.
+sampler is the semantics oracle: the engine's decode loop normally uses the
+fused on-device sampler (:mod:`dllama_tpu.ops.sampling`, dispatched by
+``InferenceEngine.next_token``), and ``tests/test_sampling.py`` holds the two
+to exact agreement over the oracle's RNG stream.
 """
 
 from __future__ import annotations

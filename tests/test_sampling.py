@@ -1,0 +1,132 @@
+"""On-device sampler parity: ops.sampling vs the host numpy oracle
+(tokenizer.sampler), and the engine's fused sampled-decode path vs the
+logits-download + host-sample path. Reference semantics: Sampler::sample,
+src/tokenizer.cpp:424-510."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.ops.sampling import sampled_token
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.tokenizer.sampler import Sampler, softmax, xorshift_random_f32
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+VOCAB = 257  # odd size: exercises the cutoff denominator (n-1)
+
+
+@pytest.fixture(scope="module")
+def jit_sampled():
+    return jax.jit(sampled_token)
+
+
+def _draws(jit_sampled, logits_rows, temperature, topp, seed):
+    """Run both samplers over the same xorshift stream; return (device, host)."""
+    host = Sampler(VOCAB, temperature, topp, seed)
+    state = seed
+    dev_picks, host_picks = [], []
+    for row in logits_rows:
+        coin, state = xorshift_random_f32(state)
+        tok = jit_sampled(jnp.asarray(row)[None, :], jnp.float32(temperature),
+                          jnp.float32(topp), jnp.float32(coin))
+        dev_picks.append(int(tok[0]))
+        host_picks.append(host.sample(row))
+    assert host.rng_state == state  # same stream consumed
+    return dev_picks, host_picks
+
+
+@pytest.mark.parametrize("temperature,topp", [
+    (0.7, 0.9),    # nucleus path
+    (1.3, 0.05),   # aggressive truncation (cutoff filter dominates)
+    (0.9, 1.0),    # topp >= 1 -> multinomial path
+    (1.0, 0.0),    # topp <= 0 -> multinomial path
+])
+def test_device_matches_host_oracle_500_draws(jit_sampled, temperature, topp):
+    """>=500 draws on the oracle's RNG stream must agree exactly
+    (VERDICT round-2 next #2)."""
+    rng = np.random.default_rng(42)
+    rows = rng.standard_normal((500, VOCAB)).astype(np.float32) * 3.0
+    dev, host = _draws(jit_sampled, rows, temperature, topp, seed=0xB1A5)
+    assert dev == host
+
+
+def test_device_matches_host_on_peaked_logits(jit_sampled):
+    """Near-one-hot rows: truncation keeps ~1 candidate; picks must agree."""
+    rng = np.random.default_rng(7)
+    rows = rng.standard_normal((100, VOCAB)).astype(np.float32)
+    rows[np.arange(100), rng.integers(0, VOCAB, 100)] += 25.0
+    dev, host = _draws(jit_sampled, rows, 0.8, 0.9, seed=99)
+    assert dev == host
+
+
+def test_sampled_token_is_distributionally_sane(jit_sampled):
+    """Token frequencies track the softmax for a fixed small distribution."""
+    logits = np.zeros(8, dtype=np.float32)
+    logits[3] = 2.0
+    logits[5] = 1.0
+    p = softmax(logits / 1.0)
+    state = 1234
+    counts = np.zeros(8)
+    for _ in range(2000):
+        coin, state = xorshift_random_f32(state)
+        tok = jit_sampled(jnp.asarray(logits)[None, :], jnp.float32(1.0),
+                          jnp.float32(1.0), jnp.float32(coin))
+        counts[int(tok[0])] += 1
+    freq = counts / counts.sum()
+    np.testing.assert_allclose(freq, p, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# engine integration: the fused path is what next_token actually dispatches
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("sampling")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(5)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=64), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    return str(mpath), str(tpath)
+
+
+def test_engine_fused_sampled_decode_matches_host_path(model_files):
+    """generate() at temperature>0 via the fused on-device sampler must emit
+    the same tokens as the host-sampler fallback on the same seed."""
+    fused = InferenceEngine(*model_files, temperature=0.8, topp=0.9, seed=321)
+    assert not fused.host_sampling
+    rf = fused.generate("hello world", 16, stop_on_eos=False)
+
+    host = InferenceEngine(*model_files, temperature=0.8, topp=0.9, seed=321,
+                           host_sampling=True)
+    rh = host.generate("hello world", 16, stop_on_eos=False)
+    assert rf.tokens == rh.tokens
+    # both consumed the same number of RNG steps
+    assert fused.sampler.rng_state == host.sampler.rng_state
+
+
+def test_engine_sampled_decode_under_tp(model_files):
+    """The fused sampled step must survive a tp mesh plan (sharded logits
+    feed the on-device sampler) and stay identical to tp=1."""
+    base = InferenceEngine(*model_files, temperature=0.8, topp=0.9, seed=11, tp=1)
+    rb = base.generate("hello world", 8, stop_on_eos=False)
+    tp = InferenceEngine(*model_files, temperature=0.8, topp=0.9, seed=11, tp=4)
+    rt = tp.generate("hello world", 8, stop_on_eos=False)
+    assert rb.tokens == rt.tokens
+
+
+def test_sampling_knob_change_does_not_recompile(model_files):
+    """temperature/topp are traced scalars: changing them between calls must
+    reuse the compiled sampled step. (The jit cache is shared across engines
+    built on the same function, so assert no NEW entries, not a count of 1.)"""
+    e = InferenceEngine(*model_files, temperature=0.8, topp=0.9, seed=1)
+    e.generate("hello", 2, stop_on_eos=False)
+    compiled_before = e._sampled_step._cache_size()
+    e.sampler.set_temp(1.2)
+    e.sampler.topp = 0.5
+    e.generate("world", 2, stop_on_eos=False)
+    assert e._sampled_step._cache_size() == compiled_before
