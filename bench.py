@@ -61,8 +61,20 @@ def emit(result: dict) -> None:
     sys.stdout.flush()
 
 
-def probe_backend(platform: str | None) -> tuple[bool, str]:
-    """Bounded-wait backend probe in a subprocess; returns (ok, detail).
+def _tail(b) -> str:
+    if not b:
+        return ""
+    if isinstance(b, bytes):
+        b = b.decode(errors="replace")
+    return b[-600:]
+
+
+def probe_once(platform: str | None, attempts: list) -> str | None:
+    """One backend-probe subprocess; returns the device-info JSON line on
+    success, None on failure. Every attempt's forensics (rc, duration,
+    partial stdout/stderr — including a timed-out child's captured output)
+    land in ``attempts`` so BENCH_rN.json can pin an environment-side hang
+    even when nothing succeeds (VERDICT round-2 next #1).
 
     The platform override is applied INSIDE the child (after interpreter
     startup): this image's sitecustomize rewrites JAX_PLATFORMS on every
@@ -72,24 +84,51 @@ def probe_backend(platform: str | None) -> tuple[bool, str]:
         f"import jax; jax.config.update('jax_platforms', {platform!r}); "
         if platform else "")
     code = (
-        f"{setenv}import jax, json; d = jax.devices(); "
+        f"{setenv}import jax, json, sys; "
+        "print('probe: importing done', file=sys.stderr, flush=True); "
+        "d = jax.devices(); "
         "print(json.dumps({'platform': d[0].platform, "
         "'kind': d[0].device_kind, 'n': len(d)}))"
     )
-    last = ""
-    for attempt in range(PROBE_RETRIES):
-        try:
-            out = subprocess.run([sys.executable, "-c", code],
-                                 capture_output=True, timeout=PROBE_TIMEOUT_S)
-            lines = out.stdout.decode(errors="replace").strip().splitlines()
-            if out.returncode == 0 and lines:
-                return True, lines[-1]
-            last = (out.stderr.decode(errors="replace")[-500:]
-                    or f"probe rc={out.returncode}, empty stdout")
-        except subprocess.TimeoutExpired:
-            last = f"backend init exceeded {PROBE_TIMEOUT_S}s (attempt {attempt + 1})"
+    rec: dict = {"platform_arg": platform}
+    t0 = time.monotonic()
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, timeout=PROBE_TIMEOUT_S)
+        rec.update(rc=out.returncode, stdout=_tail(out.stdout),
+                   stderr=_tail(out.stderr))
+        lines = out.stdout.decode(errors="replace").strip().splitlines()
+        if out.returncode == 0 and lines:
+            rec["ok"] = True
+            attempts.append(rec)
+            return lines[-1]
+    except subprocess.TimeoutExpired as e:
+        # keep the timed-out child's partial output — the key forensic:
+        # "importing done + silence" = backend init hang, not our code
+        rec.update(timeout_s=PROBE_TIMEOUT_S, stdout=_tail(e.stdout),
+                   stderr=_tail(e.stderr))
+    rec["ok"] = False
+    rec["duration_s"] = round(time.monotonic() - t0, 1)
+    attempts.append(rec)
+    return None
+
+
+def probe_backend(platform: str | None, attempts: list) -> tuple[bool, str]:
+    """Probe schedule: default platform x PROBE_RETRIES, then explicit
+    'axon' and 'tpu' overrides (the live chip rides the axon plugin; if the
+    default resolution wedges, an explicit pin may not). Returns (ok, detail):
+    detail is the device-info JSON on success, else a summary string."""
+    plans: list = [platform] * PROBE_RETRIES
+    if platform is None:
+        plans += ["axon", "tpu"]
+    for p in plans:
+        info = probe_once(p, attempts)
+        if info is not None:
+            return True, info
         time.sleep(5)
-    return False, last
+    fails = [a.get("stderr") or f"rc={a.get('rc')}" if "timeout_s" not in a
+             else f"init exceeded {a['timeout_s']}s" for a in attempts]
+    return False, f"{len(attempts)} probe attempts failed; last: {fails[-1]}"
 
 
 # ---------------------------------------------------------------------------
@@ -235,9 +274,26 @@ def main() -> None:
     if force_platform:
         os.environ["JAX_PLATFORMS"] = force_platform
 
-    ok, detail = probe_backend(force_platform)
+    attempts: list = []
+    ok, detail = probe_backend(force_platform, attempts)
+    if not ok:
+        # late-window retry: the round-2 hang looked like a transient
+        # backend-side lock; give the chip one more chance after a long wait
+        wait = min(300.0, max(0.0, STAGE_DEADLINE_S / 2))
+        time.sleep(wait)
+        info = probe_once(force_platform, attempts)
+        if info is not None:
+            ok, detail = True, info
     if not ok:
         result["error"] = f"backend unavailable: {detail}"
+        result["probe_attempts"] = attempts
+        result["env"] = {
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS"),
+            "accel_devices": sorted(
+                f for f in os.listdir("/dev") if f.startswith(("accel", "vfio"))
+            ) if os.path.isdir("/dev") else [],
+        }
+        result["elapsed_s"] = round(time.monotonic() - t_start, 1)
         emit(result)
         return
 
@@ -247,6 +303,8 @@ def main() -> None:
         info = {"platform": "unknown", "kind": "unknown", "n": 0}
     result["platform"] = info.get("platform")
     result["device_kind"] = info.get("kind")
+    if len(attempts) > 1:  # flaky init is itself a finding worth recording
+        result["probe_attempts"] = attempts
 
     import jax
 
@@ -322,6 +380,31 @@ def main() -> None:
     else:
         result["error"] = head_res.get("error", "no result")
     result["stages"] = stages
+
+    # chip is alive: spend any remaining window on the @pytest.mark.tpu tier
+    # (the error-bound claims that have never run on hardware) and embed the
+    # outcome — VERDICT round-2 next #1.
+    if on_tpu and time.monotonic() < deadline and not result.get("error"):
+        budget = min(420.0, deadline + 120 - time.monotonic())
+        try:
+            env = dict(os.environ, DLLAMA_TESTS_TPU="1")
+            env.pop("JAX_PLATFORMS", None)
+            env.pop("XLA_FLAGS", None)
+            tp = subprocess.run(
+                [sys.executable, "-m", "pytest", "tests", "-m", "tpu", "-q",
+                 "--no-header", "-p", "no:cacheprovider"],
+                capture_output=True, timeout=budget,
+                cwd=os.path.dirname(os.path.abspath(__file__)), env=env)
+            result["tpu_test_tier"] = {
+                "rc": tp.returncode,
+                "tail": _tail(tp.stdout)[-400:],
+            }
+        except subprocess.TimeoutExpired as e:
+            result["tpu_test_tier"] = {"rc": None, "timeout_s": budget,
+                                       "tail": _tail(e.stdout)[-400:]}
+        except Exception as e:  # noqa: BLE001
+            result["tpu_test_tier"] = {"rc": None, "tail": f"{type(e).__name__}: {e}"}
+
     result["elapsed_s"] = round(time.monotonic() - t_start, 1)
     wd.cancel()
     emit(result)
