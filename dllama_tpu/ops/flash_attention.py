@@ -37,11 +37,19 @@ from jax.experimental.pallas import tpu as pltpu
 _LANES = 128  # VPU lane width; scratch vectors are stored lane-broadcast
 
 
-def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
-            *, bs: int, kv_mul: int, t: int, scale: float):
+def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, *rest,
+            bs: int, kv_mul: int, t: int, scale: float, stats: bool):
+    if stats:
+        m_out_ref, l_out_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        m_ref, l_ref, acc_ref = rest
     s_idx = pl.program_id(2)
     ns = pl.num_programs(2)
-    start_pos = pos_ref[0, 0]
+    # query row r sits at absolute position q_pos0 + r // kv_mul; cache slot c
+    # of this call covers absolute position kv_pos0 + c (kv_pos0 != 0 when the
+    # caller holds a mid-sequence block, e.g. a ring-attention KV shard)
+    q_pos0 = pos_ref[0, 0]
+    kv_pos0 = pos_ref[0, 1]
 
     @pl.when(s_idx == 0)
     def _():
@@ -51,7 +59,7 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
 
     # Blocks past the newest position are entirely masked: skip their DMA'd
     # compute (their loads still stream, matching the oracle's byte traffic).
-    @pl.when(s_idx * bs <= start_pos + (t - 1))
+    @pl.when(kv_pos0 + s_idx * bs <= q_pos0 + (t - 1))
     def _():
         q = q_ref[0, 0].astype(jnp.float32)  # (TQ, D)
         k = k_ref[0, 0].astype(jnp.float32)  # (BS, D)
@@ -63,16 +71,19 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
 
         tq = scores.shape[0]
         row_t = jax.lax.broadcasted_iota(jnp.int32, (tq, bs), 0) // kv_mul
-        col = s_idx * bs + jax.lax.broadcasted_iota(jnp.int32, (tq, bs), 1)
-        scores = jnp.where(col <= start_pos + row_t, scores, -jnp.inf)
+        col = kv_pos0 + s_idx * bs + jax.lax.broadcasted_iota(jnp.int32, (tq, bs), 1)
+        scores = jnp.where(col <= q_pos0 + row_t, scores, -jnp.inf)
 
-        # online softmax update; m/l live lane-broadcast in (TQ, 128) scratch
+        # online softmax update; m/l live lane-broadcast in (TQ, 128) scratch.
+        # A row can be fully masked so far when kv_pos0 > 0 (mid-sequence
+        # block): clamp m to keep exp() NaN-free (-inf rows stay acc=0, l=0).
         m_prev = jnp.max(m_ref[:], axis=-1, keepdims=True)  # (TQ, 1)
         l_prev = jnp.max(l_ref[:], axis=-1, keepdims=True)
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(scores - m_new)  # fully-masked rows: m_new=m_prev finite after block 0
-        corr = jnp.exp(m_prev - m_new)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - m_safe)  # scores=-inf → 0, never NaN
+        corr = jnp.exp(m_prev - m_safe)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
 
         pv = jax.lax.dot_general(  # (TQ, D)
@@ -84,8 +95,16 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
 
     @pl.when(s_idx == ns - 1)
     def _():
-        l = jnp.max(l_ref[:], axis=-1, keepdims=True)
-        out_ref[0, 0] = acc_ref[:] / l  # block 0 guarantees l >= 1 visible col
+        if stats:
+            # unnormalized block results for cross-block online-softmax
+            # combining (ring attention / flash-decoding LSE merge)
+            out_ref[0, 0] = acc_ref[:]
+            m_out_ref[0, 0] = m_ref[:]
+            l_out_ref[0, 0] = l_ref[:]
+        else:
+            l = jnp.max(l_ref[:], axis=-1, keepdims=True)
+            l = jnp.where(l == 0.0, 1.0, l)  # kv_pos0=0 ⇒ l>=1; belt anyway
+            out_ref[0, 0] = acc_ref[:] / l
 
 
 def _pick_bs(s: int) -> int | None:
@@ -95,22 +114,33 @@ def _pick_bs(s: int) -> int | None:
     return None
 
 
-@functools.partial(jax.jit, static_argnames=("head_dim", "t", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("head_dim", "t", "interpret", "stats"))
 def _call(q_g: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-          start_pos: jax.Array, head_dim: int, t: int, interpret: bool) -> jax.Array:
+          start_pos: jax.Array, head_dim: int, t: int, interpret: bool,
+          kv_pos0: jax.Array | int = 0, stats: bool = False):
     B, n_kv, TQ, D = q_g.shape
     S = k_cache.shape[2]
     bs = _pick_bs(S)
     kv_mul = TQ // t
-    pos = jnp.reshape(start_pos.astype(jnp.int32), (1, 1))
+    pos = jnp.stack([jnp.int32(start_pos), jnp.int32(kv_pos0)]).reshape(1, 2)
 
     kernel = functools.partial(_kernel, bs=bs, kv_mul=kv_mul, t=t,
-                               scale=1.0 / (head_dim ** 0.5))
-    return pl.pallas_call(
+                               scale=1.0 / (head_dim ** 0.5), stats=stats)
+    out_shape = [jax.ShapeDtypeStruct((B, n_kv, TQ, D), jnp.float32)]
+    out_specs = [pl.BlockSpec((1, 1, TQ, D), lambda b, h, s: (b, h, 0, 0),
+                              memory_space=pltpu.VMEM)]
+    if stats:
+        # lane-broadcast running max / sum, one (TQ, 128) slab per (b, h)
+        stat_spec = pl.BlockSpec((1, 1, TQ, _LANES), lambda b, h, s: (b, h, 0, 0),
+                                 memory_space=pltpu.VMEM)
+        out_shape += [jax.ShapeDtypeStruct((B, n_kv, TQ, _LANES), jnp.float32)] * 2
+        out_specs += [stat_spec, stat_spec]
+    res = pl.pallas_call(
         kernel,
         grid=(B, n_kv, S // bs),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, h, s: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2), lambda b, h, s: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, TQ, D), lambda b, h, s: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, bs, D), lambda b, h, s: (b, h, s, 0),
@@ -118,9 +148,8 @@ def _call(q_g: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             pl.BlockSpec((1, 1, bs, D), lambda b, h, s: (b, h, s, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1, TQ, D), lambda b, h, s: (b, h, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, n_kv, TQ, D), jnp.float32),
+        out_specs=out_specs if stats else out_specs[0],
+        out_shape=out_shape if stats else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((TQ, _LANES), jnp.float32),  # running max
             pltpu.VMEM((TQ, _LANES), jnp.float32),  # running sum
@@ -128,6 +157,10 @@ def _call(q_g: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         ],
         interpret=interpret,
     )(pos, q_g, k_cache, v_cache)
+    if stats:
+        acc, m, l = res
+        return acc, m[..., 0], l[..., 0]  # de-broadcast the lane dim
+    return res
 
 
 def flash_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
@@ -153,6 +186,24 @@ def flash_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                .transpose(0, 2, 1, 3, 4)
                .reshape(B, T, n_heads, D)
                .astype(q.dtype))
+
+
+def flash_block_stats(q_g: jax.Array, k_block: jax.Array, v_block: jax.Array,
+                      q_pos0: jax.Array, kv_pos0: jax.Array, head_dim: int,
+                      t: int, *, interpret: bool = False):
+    """Unnormalized blockwise attention over a mid-sequence KV block — the
+    Pallas building block for ring attention / flash-decoding merges
+    (parallel/ring.py).
+
+    ``q_g: [B, n_kv, T*kv_mul, D]`` GQA-folded queries whose row ``r`` sits at
+    absolute position ``q_pos0 + r // kv_mul``; ``k/v_block: [B, n_kv, Sb, D]``
+    covering absolute positions ``[kv_pos0, kv_pos0 + Sb)``. Returns
+    ``(acc [B,n_kv,TQ,D], m [B,n_kv,TQ], l [B,n_kv,TQ])`` in the usual
+    online-softmax algebra (fully-masked rows: acc=0, l=0, m=-inf), ready for
+    cross-block combining.
+    """
+    return _call(q_g.astype(jnp.float32), k_block, v_block, q_pos0, head_dim,
+                 t, interpret, kv_pos0=kv_pos0, stats=True)
 
 
 MAX_TQ = 2048  # scores tile (TQ, bs) + acc must fit VMEM comfortably

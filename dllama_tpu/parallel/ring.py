@@ -128,12 +128,35 @@ def _append_kv(k_shard, v_shard, new_k, new_v, start_pos, t_global,
 # ---------------------------------------------------------------------------
 
 
+def _kernel_block_stats(qg, k, v, q_pos0, kv_pos0, head_dim: int,
+                        interpret: bool):
+    """One KV block through the Pallas flash kernel, results in ring layout.
+
+    ``qg: [B, Tl, n_kv, kv_mul, hd]`` → fold GQA into kernel query rows
+    (``[B, n_kv, Tl*kv_mul, hd]``, row = t*kv_mul + m — the same layout
+    ops.flash_attention uses), call the stats-mode kernel, unfold."""
+    from ..ops.flash_attention import flash_block_stats
+
+    B, Tl, n_kv, kv_mul, hd = qg.shape
+    q_hm = qg.transpose(0, 2, 1, 3, 4).reshape(B, n_kv, Tl * kv_mul, hd)
+    acc, m, l = flash_block_stats(q_hm, k, v, q_pos0, kv_pos0, head_dim, Tl,
+                                  interpret=interpret)
+    acc = acc.reshape(B, n_kv, Tl, kv_mul, hd).transpose(0, 2, 1, 3, 4)
+    m = m.reshape(B, n_kv, Tl, kv_mul).transpose(0, 2, 1, 3)
+    l = l.reshape(B, n_kv, Tl, kv_mul).transpose(0, 2, 1, 3)
+    return acc, m, l
+
+
 def _ring_attention_local(qg, k_shard, v_shard, q_positions, head_dim: int,
-                          n_sp: int):
+                          n_sp: int, use_kernel: bool = False,
+                          interpret: bool = False):
     """Ring pass: rotate KV blocks, accumulate online softmax.
 
     ``qg: [B, Tl, n_kv, kv_mul, hd]`` local queries, ``q_positions: [B, Tl]``
     absolute positions, ``k/v_shard: [B, n_kv, Sl, hd]`` local cache block.
+    With ``use_kernel`` each block runs the Pallas flash kernel (VMEM-blocked
+    MXU attention) instead of the XLA einsum; the cross-block combine is
+    identical.
     """
     B, Tl, n_kv, kv_mul, hd = qg.shape
     s_local = k_shard.shape[2]
@@ -147,9 +170,15 @@ def _ring_attention_local(qg, k_shard, v_shard, q_positions, head_dim: int,
     def fold_block(r, m, l, acc, k, v):
         # after r forward rotations this block originated on rank (idx - r)
         src = jnp.mod(idx - r, n_sp)
-        kv_pos = src * s_local + jnp.arange(s_local, dtype=jnp.int32)
-        mask = kv_pos[None, None, :] <= q_positions[:, :, None]
-        bacc, bm, bl = _block_attn(qg, k, v, mask, head_dim)
+        if use_kernel:
+            # model positions are affine (start_pos + arange), so row 0's
+            # position fully determines the causal mask inside the kernel
+            bacc, bm, bl = _kernel_block_stats(
+                qg, k, v, q_positions[0, 0], src * s_local, head_dim, interpret)
+        else:
+            kv_pos = src * s_local + jnp.arange(s_local, dtype=jnp.int32)
+            mask = kv_pos[None, None, :] <= q_positions[:, :, None]
+            bacc, bm, bl = _block_attn(qg, k, v, mask, head_dim)
         return _combine(m, l, acc, bm, bl, bacc)
 
     def step(r, carry):
@@ -167,15 +196,21 @@ def _ring_attention_local(qg, k_shard, v_shard, q_positions, head_dim: int,
     return acc, l
 
 
-def _merge_attention_local(qg, k_shard, v_shard, q_positions, head_dim: int):
+def _merge_attention_local(qg, k_shard, v_shard, q_positions, head_dim: int,
+                           use_kernel: bool = False, interpret: bool = False):
     """Flash-decoding pass: one local block + LSE merge over the ring.
 
     Queries (and their positions) are replicated across ``sp``."""
     s_local = k_shard.shape[2]
     idx = lax.axis_index(AXIS)
-    kv_pos = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
-    mask = kv_pos[None, None, :] <= q_positions[:, :, None]
-    acc, m, l = _block_attn(qg, k_shard, v_shard, mask, head_dim)
+    if use_kernel:
+        acc, m, l = _kernel_block_stats(qg, k_shard, v_shard,
+                                        q_positions[0, 0], idx * s_local,
+                                        head_dim, interpret)
+    else:
+        kv_pos = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
+        mask = kv_pos[None, None, :] <= q_positions[:, :, None]
+        acc, m, l = _block_attn(qg, k_shard, v_shard, mask, head_dim)
 
     gm = lax.pmax(m, AXIS)
     gm_safe = jnp.where(jnp.isfinite(gm), gm, 0.0)
@@ -208,9 +243,39 @@ def sp_supported(plan: "MeshPlan", q_shape, kv_shape) -> bool:
     return True
 
 
+def _kernel_eligible(plan: "MeshPlan", q_shape, kv_shape,
+                     attn_impl: str) -> tuple[bool, bool]:
+    """Whether the per-block Pallas kernel applies inside the sp shard_map;
+    returns (use_kernel, interpret). 'flash' forces it (interpret mode off
+    TPU, the test path); 'auto' enables it on TPU backends."""
+    from ..ops import flash_attention as _fa
+
+    if attn_impl == "xla":
+        return False, False
+    n_sp = plan.axis_size("sp")
+    tp = max(1, plan.axis_size("tp"))
+    dp = max(1, plan.axis_size("dp"))
+    B, T, H, hd = q_shape
+    n_kv, S = kv_shape[1], kv_shape[2]
+    q_sharded = T % n_sp == 0 and T > 1
+    t_local = T // n_sp if q_sharded else T
+    shapes_ok = _fa.supports((B // dp, t_local, H // tp, hd), n_kv // tp,
+                             S // n_sp)
+    if not shapes_ok:
+        if attn_impl == "flash":
+            raise ValueError(
+                f"attn_impl='flash' with sp={n_sp}: kernel unsupported for "
+                f"q={q_shape}, S_local={S // n_sp} (needs S/sp % 128 == 0)")
+        return False, False
+    if attn_impl == "flash":
+        return True, not _fa.default_enabled()
+    return _fa.default_enabled(), False
+
+
 def sp_attention(plan: "MeshPlan", q: jax.Array, k_cache: jax.Array,
                  v_cache: jax.Array, new_k: jax.Array, new_v: jax.Array,
-                 positions: jax.Array, start_pos: jax.Array, head_dim: int):
+                 positions: jax.Array, start_pos: jax.Array, head_dim: int,
+                 attn_impl: str = "auto"):
     """Fused sequence-parallel KV append + causal GQA attention.
 
     Args (global, auto-sharded views):
@@ -219,6 +284,9 @@ def sp_attention(plan: "MeshPlan", q: jax.Array, k_cache: jax.Array,
       new_k/v:  [B, T, n_kv, hd]      this step's rows (post-rope, time-major)
       positions:[B, T]                absolute position of each query row
       start_pos: scalar               absolute position of row 0
+      attn_impl: per-block compute — 'auto' (Pallas flash kernel on TPU, XLA
+                 einsum elsewhere), 'flash' (force kernel; interpret mode off
+                 TPU), 'xla' (force einsum)
 
     Returns ``(att [B, T, n_heads, hd], k_cache, v_cache)`` or ``None`` when
     the path doesn't apply (caller falls back to the dense path).
@@ -231,6 +299,8 @@ def sp_attention(plan: "MeshPlan", q: jax.Array, k_cache: jax.Array,
     B, T, H, hd = q.shape
     n_kv = k_cache.shape[1]
     q_sharded = T % n_sp == 0 and T > 1
+    use_kernel, interpret = _kernel_eligible(plan, q.shape, k_cache.shape,
+                                             attn_impl)
 
     dp_ax = plan.resolve("batch") if B % plan.axis_size("dp") == 0 else None
     tp_ax = plan.resolve("heads") if H % plan.axis_size("tp") == 0 else None
@@ -248,9 +318,11 @@ def sp_attention(plan: "MeshPlan", q: jax.Array, k_cache: jax.Array,
         kv_mul = Hl // n_kv_l
         qg = q_l.reshape(Bl, Tl, n_kv_l, kv_mul, hd).astype(jnp.float32)
         if q_sharded:
-            acc, l = _ring_attention_local(qg, k_l, v_l, pos_l, head_dim, n_sp)
+            acc, l = _ring_attention_local(qg, k_l, v_l, pos_l, head_dim, n_sp,
+                                           use_kernel, interpret)
         else:
-            acc, l = _merge_attention_local(qg, k_l, v_l, pos_l, head_dim)
+            acc, l = _merge_attention_local(qg, k_l, v_l, pos_l, head_dim,
+                                            use_kernel, interpret)
         out = _finish(acc, l, q_l.dtype).reshape(Bl, Tl, Hl, hd)
         return out, k_l, v_l
 

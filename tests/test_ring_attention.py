@@ -148,3 +148,83 @@ def test_sp_unsupported_falls_back():
     plan = make_mesh({"sp": 8})
     # cache seq 20 not divisible by 8 → path must decline
     assert not sp_supported(plan, (1, 4, 8, 16), (1, 4, 20, 16))
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel inside the ring (VERDICT round-2 #5): per-block flash kernel
+# (interpret mode on CPU) must match the einsum block path exactly — same
+# online-softmax algebra, same collectives, kernel-computed blocks.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh_axes,T,start_pos", [
+    ({"sp": 2}, 8, 0),            # prefill, ring path (s_local = 128)
+    ({"sp": 2}, 1, 130),          # decode, merge path, history in shard 2
+    ({"sp": 2, "tp": 2}, 4, 7),   # sp × tp ring
+    ({"sp": 2}, 3, 100),          # T not divisible by sp → merge, T>1
+])
+def test_sp_attention_kernel_matches_oracle(mesh_axes, T, start_pos):
+    """attn_impl='flash' forces the Pallas block kernel (interpret on CPU)
+    inside the sp shard_map; outputs must match the dense oracle."""
+    B, H, n_kv, hd = 1, 8, 4, 16
+    S = 256  # S / sp = 128: one kernel block per shard
+    rng = np.random.default_rng(1000 + T + start_pos)
+    q, new_k, new_v, k_cache, v_cache, positions = _rand_case(
+        rng, B, T, H, n_kv, S, hd, start_pos)
+
+    ref_out, ref_k, ref_v = _oracle(q, new_k, new_v, k_cache, v_cache,
+                                    positions, start_pos, hd)
+
+    plan = make_mesh(mesh_axes)
+    out, got_k, got_v = jax.jit(
+        lambda *a: sp_attention(plan, *a, head_dim=hd, attn_impl="flash"))(
+        q, k_cache, v_cache, new_k, new_v, positions, jnp.int32(start_pos))
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v), atol=1e-6)
+
+
+def test_sp_kernel_forced_on_unsupported_shape_raises():
+    """attn_impl='flash' with an sp shard too small for the kernel must fail
+    loudly, not silently fall back (the advisor's forced-flash rule)."""
+    plan = make_mesh({"sp": 8})
+    rng = np.random.default_rng(0)
+    q, new_k, new_v, k_cache, v_cache, positions = _rand_case(
+        rng, 1, 8, 8, 4, 32, 16, 0)  # s_local = 4: no 128-block fits
+    with pytest.raises(ValueError, match="flash"):
+        sp_attention(plan, q, k_cache, v_cache, new_k, new_v, positions,
+                     jnp.int32(0), head_dim=16, attn_impl="flash")
+
+
+def test_forward_sp_with_kernel_matches_unsharded():
+    """Full model forward with attn_impl='flash' on an sp mesh (kernel inside
+    the ring) vs the unsharded xla forward — the determinism property the
+    VERDICT asked to keep on the kernel path."""
+    cfg = _cfg(seq_len=256, attn_impl="flash")
+    cfg_ref = _cfg(seq_len=256, attn_impl="xla")
+    params = init_random_params(cfg, seed=29)
+    rng = np.random.default_rng(17)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 8)), dtype=jnp.int32)
+
+    ref_logits, ref_kv = jax.jit(forward, static_argnums=1)(
+        params, cfg_ref, prompt, jnp.int32(0), KVCache.create(cfg_ref))
+    nxt = jnp.argmax(ref_logits[:, -1:], axis=-1).astype(jnp.int32)
+    ref_logits2, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg_ref, nxt, jnp.int32(8), ref_kv)
+
+    plan = make_mesh({"sp": 2})
+    sharded = shard_params(plan, params)
+    kv0 = KVCache.create(cfg)
+    kv = jax.device_put(kv0, kv_cache_sharding(plan, kv0))
+    with use_plan(plan):
+        logits, kv = jax.jit(forward, static_argnums=1)(
+            sharded, cfg, prompt, jnp.int32(0), kv)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                                   rtol=2e-5, atol=2e-6)
+        nxt2 = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+        logits2, _ = jax.jit(forward, static_argnums=1)(
+            sharded, cfg, nxt2, jnp.int32(8), kv)
+    np.testing.assert_allclose(np.asarray(logits2), np.asarray(ref_logits2),
+                               rtol=2e-5, atol=2e-6)
