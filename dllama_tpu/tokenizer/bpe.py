@@ -10,12 +10,16 @@ Differences from the reference, by design:
 
 * lookup uses hash maps instead of ``bsearch`` over a sorted array;
 * the merge loop keeps the reference's "highest score wins, leftmost on tie"
-  policy but scans pairs with dict lookups;
+  policy but runs on a heap + doubly-linked list (O(n log n)) instead of the
+  reference's rescan-per-merge (O(n²), tokenizer.cpp:349-377) — same output
+  on every input, proven by tests/test_tokenizer.py's equivalence suite;
 * unresolvable bytes raise ``ValueError`` instead of ``assert`` (the
   reference aborts — llm vocabularies always cover all bytes in practice).
 """
 
 from __future__ import annotations
+
+import heapq
 
 from ..formats.tfile import TokenizerData, read_tfile
 
@@ -105,23 +109,58 @@ class Tokenizer:
         if buf:
             raise ValueError(f"unresolvable bytes in input: {bytes(buf)!r}")
 
-        # Greedy merge: each round merge the single best-scoring adjacent pair
-        # (leftmost on ties), exactly like tokenizer.cpp:349-377.
-        while True:
-            best_score = -1e10
-            best_idx = -1
-            best_id = -1
-            for j in range(len(tokens) - 1):
-                merged = self.vocab[tokens[j]] + self.vocab[tokens[j + 1]]
-                mid = self._regular.get(merged)
-                if mid is not None and self.scores[mid] > best_score:
-                    best_score = self.scores[mid]
-                    best_idx = j
-                    best_id = mid
-            if best_idx == -1:
-                break
-            tokens[best_idx:best_idx + 2] = [best_id]
-        return tokens
+        return self._merge(tokens)
+
+    def _merge(self, tokens: list[int]) -> list[int]:
+        """Greedy merge: repeatedly merge the best-scoring adjacent pair,
+        leftmost on ties — the reference's policy (tokenizer.cpp:349-377,
+        strict ``>`` comparison ⇒ first max wins), on a lazy-deletion heap
+        over a doubly-linked token list. A heap entry is
+        ``(-score, left_pos, left_ver, right_ver, right_pos, merged_id)``;
+        node versions invalidate entries whose endpoints merged since."""
+        n = len(tokens)
+        if n < 2:
+            return tokens
+        ids = list(tokens)
+        prev = list(range(-1, n - 1))
+        nxt = list(range(1, n + 1))
+        nxt[-1] = -1
+        alive = [True] * n
+        ver = [0] * n
+        heap: list = []
+        lookup = self._regular.get
+        vocab, scores = self.vocab, self.scores
+
+        def push(j: int) -> None:
+            k = nxt[j]
+            if k == -1:
+                return
+            mid = lookup(vocab[ids[j]] + vocab[ids[k]])
+            if mid is not None:
+                heapq.heappush(heap, (-scores[mid], j, ver[j], ver[k], k, mid))
+
+        for j in range(n - 1):
+            push(j)
+        while heap:
+            _, j, vj, vk, k, mid = heapq.heappop(heap)
+            if (not alive[j] or not alive[k] or ver[j] != vj or ver[k] != vk
+                    or nxt[j] != k):
+                continue  # stale: an endpoint merged since this pair was seen
+            ids[j] = mid
+            ver[j] += 1
+            alive[k] = False
+            nxt[j] = nxt[k]
+            if nxt[k] != -1:
+                prev[nxt[k]] = j
+            if prev[j] != -1:
+                push(prev[j])
+            push(j)
+        out: list[int] = []
+        j = 0
+        while j != -1:  # node 0 is always the surviving head
+            out.append(ids[j])
+            j = nxt[j]
+        return out
 
     # -- streaming decode ---------------------------------------------------
 
