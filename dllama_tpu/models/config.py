@@ -54,6 +54,13 @@ class ModelConfig:
     # (O(k) experts per token); "dense" = all-experts einsum, gate-weighted
     # (O(E), exact and simple — the test oracle); "auto" = sparse.
     moe_impl: str = "auto"
+    # Host-DRAM weight offload (70B/405B, BASELINE config 5): per-layer
+    # weights live in pinned host memory and stream to device memory inside
+    # the scan (layer ℓ+1's transfer overlaps layer ℓ's compute under XLA's
+    # latency-hiding scheduler). Set via --weight-mode offload; the loader
+    # places the layer stack host-side to match. No reference equivalent —
+    # the reference keeps shards resident (SURVEY.md §7.4).
+    offload: bool = False
 
     @property
     def q_dim(self) -> int:

@@ -308,7 +308,7 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
         from ..parallel.ring import sp_attention
 
         sp_res = sp_attention(plan, q, k_cache, v_cache, k, v, positions,
-                              start_pos, cfg.head_dim)
+                              start_pos, cfg.head_dim, attn_impl=cfg.attn_impl)
     if sp_res is not None:
         att, k_cache, v_cache = sp_res
     else:
@@ -382,6 +382,10 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     def body(carry, xs):
         x = carry
         lp, k_l, v_l = xs
+        if cfg.offload:
+            # weights stream host → device per layer; XLA prefetches the next
+            # layer's transfer while this layer computes (cfg.offload docs)
+            lp = jax.device_put(lp, jax.memory.Space.Device)
         x, k_l, v_l = _layer_step(cfg, x, lp, k_l, v_l, cos, sin,
                                   start_pos, positions)
         return x, (k_l, v_l)

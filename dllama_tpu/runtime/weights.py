@@ -79,9 +79,23 @@ class _StreamingLoader:
         self.h = mf.header
         # a trivial 1-device mesh gives single-chip loads the same code path
         self.plan = plan if plan is not None else make_tp_mesh(1)
-        self.quantized = self.h.weight_type == Q40 and weight_mode == "auto"
+        # "offload" keeps the quantized-on-device semantics of "auto" but
+        # places the per-layer stacks in pinned host memory (cfg.offload
+        # streams them through the scan; ModelConfig.offload docs)
+        self.offload = weight_mode == "offload"
+        self.quantized = (self.h.weight_type == Q40
+                          and weight_mode in ("auto", "offload"))
         self.dense_dtype = jnp.bfloat16 if weight_mode == "bf16" else jnp.float32
         self.weight_mode = weight_mode
+        self._host_scope = False
+
+    def _sharding(self, shape, *axes):
+        """Build the target sharding; inside a host-placed scope (the layer
+        stacks under offload) the arrays land in pinned host memory."""
+        sh = self.plan.sharding_for(shape, *axes)
+        if self.offload and self._host_scope:
+            sh = sh.with_memory_kind("pinned_host")
+        return sh
 
     # -- matmul weights -----------------------------------------------------
 
@@ -90,15 +104,14 @@ class _StreamingLoader:
         """One (possibly layer-stacked) matmul weight, quantized or dense."""
         L = self.h.n_layers
         key = (lambda l: f"{name}.{l}") if stacked else (lambda _l: name)
-        plan = self.plan
 
         if self.quantized:
             lead = (None,) if stacked else ()
             cshape = ((L, in_dim, out_dim) if stacked else (in_dim, out_dim))
             sshape = ((L, in_dim // Q40_BLOCK_SIZE, out_dim) if stacked
                       else (in_dim // Q40_BLOCK_SIZE, out_dim))
-            c_sh = plan.sharding_for(cshape, *lead, in_axis, out_axis)
-            s_sh = plan.sharding_for(sshape, *lead, in_axis, out_axis)
+            c_sh = self._sharding(cshape, *lead, in_axis, out_axis)
+            s_sh = self._sharding(sshape, *lead, in_axis, out_axis)
 
             def read(idx, want_scales: bool):
                 if stacked:
@@ -142,7 +155,7 @@ class _StreamingLoader:
         # dense: reference on-disk orientation [out, in] (row-major)
         lead = (None,) if stacked else ()
         shape = (L, out_dim, in_dim) if stacked else (out_dim, in_dim)
-        sh = plan.sharding_for(shape, *lead, out_axis, in_axis)
+        sh = self._sharding(shape, *lead, out_axis, in_axis)
 
         def read_dense(idx):
             if stacked:
@@ -164,7 +177,7 @@ class _StreamingLoader:
     def stacked_f32(self, name: str, *shape_tail: int) -> jax.Array:
         L = self.h.n_layers
         shape = (L, *shape_tail)
-        sh = self.plan.sharding_for(shape, *([None] * len(shape)))
+        sh = self._sharding(shape, *([None] * len(shape)))
 
         def read(idx):
             layers = _layer_range(idx[0], L)
@@ -186,10 +199,11 @@ class _StreamingLoader:
         be unloadable — advisor round-1 medium finding). Sharded experts→ep,
         expert-hidden→tp; one (layer, expert) slice read at a time."""
         L, E = self.h.n_layers, self.h.n_experts
-        target = jnp.dtype(self.dense_dtype if self.weight_mode != "auto"
+        target = jnp.dtype(self.dense_dtype
+                           if self.weight_mode not in ("auto", "offload")
                            else self.cfg.compute_dtype)
         shape = (L, E, in_dim, out_dim)
-        sh = self.plan.sharding_for(shape, None, "experts", in_axis, out_axis)
+        sh = self._sharding(shape, None, "experts", in_axis, out_axis)
 
         def read(idx):
             l_sl, e_sl, i_sl, o_sl = idx
@@ -229,6 +243,10 @@ def load_params(mf: ModelFile, cfg: "ModelConfig", weight_mode: str = "auto",
     ld = _StreamingLoader(mf, cfg, plan, weight_mode)
     qwen3 = h.arch_type == ArchType.QWEN3
 
+    # Under offload only the per-layer stacks go host-side: they are the
+    # O(model) bytes and stream through the scan; embedding / final norm /
+    # logits are used outside it and stay resident in device memory.
+    ld._host_scope = True
     layers = LayerParams(
         wq=ld.matmul("block_matmul_q", h.q_dim, h.dim, stacked=True,
                      out_axis="heads", in_axis=None),
@@ -256,6 +274,7 @@ def load_params(mf: ModelFile, cfg: "ModelConfig", weight_mode: str = "auto",
         we3=(ld.expert_stack("block_expert_w3", h.hidden_dim, h.dim,
                              "hidden", None) if moe else None),
     )
+    ld._host_scope = False
     return Params(
         embedding=ld.f32("embedding", h.vocab_size, h.dim),
         layers=layers,

@@ -1,0 +1,120 @@
+"""Host-DRAM weight offload (weight_mode="offload", ModelConfig.offload).
+
+The 70B/405B capability (BASELINE config 5, SURVEY.md §7.4 "new design
+needed"): per-layer weight stacks live in pinned host memory and stream
+through the forward scan, so HBM holds only ~2 layers of weights + KV +
+activations at a time. The reference has no analogue (it mmaps shards
+resident, nn-network.cpp:809-854).
+
+CPU-tier tests prove placement (layer stacks in pinned_host, everything else
+in device memory) and exact value parity with the resident path; the
+tpu-marked test proves the device-memory claim on real hardware via the
+compiled executable's memory analysis (device args exclude the layer stacks).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime.engine import InferenceEngine
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("offload")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(31)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=48,
+                                               n_layers=4), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    return str(mpath), str(tpath)
+
+
+def _mem_kinds(tree):
+    return {leaf.sharding.memory_kind
+            for leaf in jax.tree.leaves(tree) if hasattr(leaf, "sharding")}
+
+
+def test_offload_places_layer_stacks_host_side(model_files):
+    e = InferenceEngine(*model_files, weight_mode="offload", tp=1)
+    assert e.cfg.offload
+    assert _mem_kinds(e.params.layers) == {"pinned_host"}
+    # non-scan params stay resident
+    assert e.params.embedding.sharding.memory_kind != "pinned_host"
+    assert _mem_kinds(e.params.logits) != {"pinned_host"}
+    assert _mem_kinds(e.kv) != {"pinned_host"}
+
+
+def test_offload_matches_resident_path_exactly(model_files):
+    """Same Q40 planes, same math — the streamed forward must be
+    value-identical to the resident forward (greedy tokens AND logits)."""
+    res = InferenceEngine(*model_files, weight_mode="auto", tp=1)
+    off = InferenceEngine(*model_files, weight_mode="offload", tp=1)
+
+    ids = res.tokenizer.encode("hello world")
+    la, _ = res.prefill(ids)
+    lb, _ = off.prefill(ids)
+    np.testing.assert_array_equal(la, lb)
+
+    r1 = res.generate(ids[-1:], 8, stop_on_eos=False)
+    r2 = off.generate(ids[-1:], 8, stop_on_eos=False)
+    assert r1.tokens == r2.tokens
+
+
+def test_offload_under_tp(model_files):
+    """Offload composes with tensor parallelism: host-placed sharded stacks,
+    same tokens as the resident tp run."""
+    res = InferenceEngine(*model_files, weight_mode="auto", tp=4)
+    off = InferenceEngine(*model_files, weight_mode="offload", tp=4)
+    assert _mem_kinds(off.params.layers) == {"pinned_host"}
+    ra = res.generate("hello world", 6, stop_on_eos=False)
+    rb = off.generate("hello world", 6, stop_on_eos=False)
+    assert ra.tokens == rb.tokens
+
+
+def test_offload_sampled_decode(model_files):
+    """The fused on-device sampler runs unchanged over streamed weights."""
+    res = InferenceEngine(*model_files, weight_mode="auto", tp=1,
+                          temperature=0.8, seed=5)
+    off = InferenceEngine(*model_files, weight_mode="offload", tp=1,
+                          temperature=0.8, seed=5)
+    ra = res.generate("hello world", 8, stop_on_eos=False)
+    rb = off.generate("hello world", 8, stop_on_eos=False)
+    assert ra.tokens == rb.tokens
+
+
+@pytest.mark.tpu
+def test_offload_device_args_exclude_layer_weights_tpu():
+    """On real hardware the compiled step's DEVICE argument bytes must
+    exclude the host-resident layer stacks — the executable-level proof that
+    a model bigger than HBM can run (its per-layer slices stream in)."""
+    from dllama_tpu.formats.mfile import ArchType, RopeType
+    from dllama_tpu.models import ModelConfig
+    from dllama_tpu.models.llama import forward, init_random_params
+    from dllama_tpu.runtime import KVCache
+
+    cfg = ModelConfig(arch=ArchType.LLAMA, dim=1024, hidden_dim=2816,
+                      n_layers=8, n_heads=16, n_kv_heads=8, head_dim=64,
+                      vocab_size=4096, seq_len=256, norm_epsilon=1e-5,
+                      rope_theta=10000.0, rope_type=RopeType.LLAMA,
+                      offload=True)
+    params = init_random_params(cfg, seed=0)
+    dev = jax.devices()[0]
+    from jax.sharding import SingleDeviceSharding
+
+    host = SingleDeviceSharding(dev, memory_kind="pinned_host")
+    params = params._replace(
+        layers=jax.device_put(params.layers, host))
+    kv = KVCache.create(cfg)
+    tokens = jnp.zeros((1, 1), dtype=jnp.int32)
+
+    compiled = (jax.jit(forward, static_argnums=1)
+                .lower(params, cfg, tokens, jnp.int32(0), kv).compile())
+    ma = compiled.memory_analysis()
+    layer_bytes = sum(leaf.nbytes for leaf in jax.tree.leaves(params.layers))
+    assert ma.host_argument_size_in_bytes >= layer_bytes * 0.9
+    assert ma.argument_size_in_bytes < layer_bytes
