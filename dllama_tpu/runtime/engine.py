@@ -96,10 +96,17 @@ class InferenceEngine:
                                          sync_type=sync_type)
         self.cfg = ModelConfig.from_header(self.model_file.header,
                                            compute_dtype=compute_dtype)
+        if weight_mode == "offload":
+            # host-DRAM weight streaming (70B/405B): the forward scan pulls
+            # each layer's weights from pinned host memory (ModelConfig.offload)
+            from dataclasses import replace as _replace
+
+            self.cfg = _replace(self.cfg, offload=True)
         self.n_batches = min(n_batches, self.cfg.seq_len)
         self.tokenizer = Tokenizer.load(tokenizer_path) if tokenizer_path else None
         self.sampler = Sampler(self.cfg.vocab_size, temperature, topp, seed)
         self.host_sampling = host_sampling
+        self.weight_mode = weight_mode
 
         n_dev = len(jax.devices())
         if tp is None:
@@ -178,7 +185,7 @@ class InferenceEngine:
         if self.multihost and self._is_root:
             from ..parallel.multihost import CTRL_RESET
 
-            self._ctrl.broadcast(self._ctrl.encode(CTRL_RESET))
+            self._ctrl.send(self._ctrl.encode(CTRL_RESET))
         self.kv = self._fresh_kv()
         self.pos = 0
         if self.tokenizer is not None:
@@ -190,7 +197,7 @@ class InferenceEngine:
             # (app.cpp:199-204)
             from ..parallel.multihost import CTRL_STOP
 
-            self._ctrl.broadcast(self._ctrl.encode(CTRL_STOP))
+            self._ctrl.send(self._ctrl.encode(CTRL_STOP))
         self.model_file.close()
 
     # -- low-level steps ----------------------------------------------------
@@ -211,7 +218,7 @@ class InferenceEngine:
                 kind = CTRL_SAMPLED
             else:
                 kind = CTRL_STEP
-            self._ctrl.broadcast(self._ctrl.encode(
+            self._ctrl.send(self._ctrl.encode(
                 kind, tokens_2d, start_pos,
                 scalars=extras if kind == CTRL_SAMPLED else None))
         with (use_plan(self.plan) if self.plan is not None else nullcontext()):

@@ -42,7 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--buffer-float-type", choices=["f32", "q80"], default="q80",
                    help="activation sync quantization parity mode")
-    p.add_argument("--weight-mode", choices=["auto", "f32", "bf16"], default="auto")
+    p.add_argument("--weight-mode",
+                   choices=["auto", "f32", "bf16", "offload"], default="auto",
+                   help="auto: Q40 planes resident on device; f32/bf16: "
+                        "dequantized dense; offload: Q40 planes in host DRAM, "
+                        "streamed per layer during forward (70B/405B on "
+                        "small-HBM chips)")
     p.add_argument("--compute-dtype", choices=["f32", "bf16"], default="f32",
                    help="activation/KV-cache dtype: f32 for reference parity, "
                         "bf16 for TPU serving throughput")
@@ -71,6 +76,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="total process count for multi-host")
     p.add_argument("--procid", type=int, default=None,
                    help="this process's id (0 = root)")
+    p.add_argument("--worker-timeout", type=float, default=None, metavar="SEC",
+                   help="worker mode: exit if no control packet arrives for "
+                        "SEC seconds (root presumed dead; default: wait "
+                        "forever, matching a long-idle root)")
+    p.add_argument("--worker-reserve", action="store_true",
+                   help="worker mode: on root loss, re-exec this process and "
+                        "wait for a new root at the same coordinator address "
+                        "(the reference's runWorkerApp outer loop, "
+                        "app.cpp:299-358)")
     # accepted for reference-flag compatibility; no-ops on TPU:
     p.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
     p.add_argument("--workers", nargs="*", default=None, help=argparse.SUPPRESS)
@@ -254,7 +268,7 @@ def run_worker(args) -> int:
     """
     import jax
 
-    from ..parallel.multihost import init_distributed, worker_serve
+    from ..parallel.multihost import RootLostError, init_distributed, worker_serve
 
     if args.nprocs is None:
         init_distributed()  # TPU pod: topology comes from the environment
@@ -263,7 +277,25 @@ def run_worker(args) -> int:
     print(f"⭕ worker: process {jax.process_index()} of {jax.process_count()}, "
           f"{jax.local_device_count()} local devices")
     engine = make_engine(args, multihost=True)
-    served = worker_serve(engine)
+    try:
+        served = worker_serve(engine, timeout_s=args.worker_timeout)
+    except RootLostError as e:
+        # Exit/re-exec IMMEDIATELY: the jax distributed client's error-polling
+        # thread LOG(FATAL)s the process moments after a coordinator loss, so
+        # any cleanup here races an abort. os._exit / execv beat it in
+        # practice; either way the worker is down within the bound.
+        import os
+
+        print(f"⭕ {e}", flush=True)
+        if args.worker_reserve:
+            # jax.distributed cannot re-initialize in-process: re-exec for a
+            # clean client that blocks waiting for the next root to bind the
+            # coordinator port — the reference worker's outer while(true)
+            # re-serve (app.cpp:299-358) at process granularity.
+            print("⭕ re-serving: waiting for a new root", flush=True)
+            os.execv(sys.executable,
+                     [sys.executable, "-m", "dllama_tpu", *sys.argv[1:]])
+        os._exit(3)
     print(f"⭕ worker done: served {served} dispatches")
     return 0
 
