@@ -166,12 +166,19 @@ def fake_quant_q80(x: jax.Array) -> jax.Array:
     """In-graph Q80 quantize→dequantize of the trailing axis.
 
     Numerically mirrors the reference *runtime* path quantizeF32toQ80 +
-    dequantizeQ80toF32 (src/nn/nn-quants.cpp:158-192 scalar): the int8 code is
-    ``roundf(x / d)`` with the UNROUNDED f32 scale ``d = absmax/127`` (half
-    away from zero), while the dequant multiply uses the f16-rounded stored
-    scale. Used when the engine runs in "sync q80" parity mode so activations
-    passing a sync point carry the same quantization the reference's wire
-    format applies.
+    dequantizeQ80toF32: the int8 code is ``round(x / d)`` with the UNROUNDED
+    f32 scale ``d = absmax/127``, while the dequant multiply uses the
+    f16-rounded stored scale. Used when the engine runs in "sync q80" parity
+    mode so activations passing a sync point carry the same quantization the
+    reference's wire format applies.
+
+    Rounding mode: the reference is ISA-inconsistent — its AVX2 path rounds
+    half-to-EVEN (_MM_FROUND_TO_NEAREST_INT, nn-quants.cpp:139) while the
+    NEON (+0.5-then-truncate, :97-100) and scalar roundf (:169) paths round
+    half-away-from-zero; the repo's own macbeth.sh:6 flags this CPU
+    dependence. We round half-to-even: it matches the x86 build the committed
+    goldens were generated with, and it's IEEE/TPU-native (XLA lowers
+    jnp.round to round_nearest_even directly).
     """
     orig_shape = x.shape
     orig_dtype = x.dtype
@@ -181,7 +188,6 @@ def fake_quant_q80(x: jax.Array) -> jax.Array:
     amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
     d = amax / 127.0
     inv = jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 0.0)
-    scaled = g * inv
-    q = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)  # roundf semantics
+    q = jnp.round(g * inv)  # half-to-even (see docstring)
     d16 = d.astype(jnp.float16).astype(jnp.float32)
     return (q * d16).reshape(orig_shape).astype(orig_dtype)

@@ -184,8 +184,12 @@ class ControlCodec:
             import jax
 
             try:
+                # allow_overwrite: the default (False) would raise
+                # ALREADY_EXISTS on every update after the first, silently
+                # freezing the GC watermark forever
                 self._client().key_value_set(
-                    f"dllama/ack/{jax.process_index()}", str(self.seq))
+                    f"dllama/ack/{jax.process_index()}", str(self.seq),
+                    allow_overwrite=True)
             except Exception:  # noqa: BLE001 — watermark is best-effort
                 pass
         return np.frombuffer(data, dtype=np.int32).copy()

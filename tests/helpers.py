@@ -10,8 +10,10 @@ from dllama_tpu.formats import mfile, quants, tfile
 def tiny_header_params(arch=mfile.ArchType.LLAMA, dim=64, n_layers=2, n_heads=4,
                        n_kv_heads=2, hidden_dim=96, vocab_size=128, seq_len=64,
                        head_dim=0, weight_type=quants.Q40, rope_type=mfile.RopeType.LLAMA,
-                       n_experts=0, n_active_experts=0):
-    return {
+                       n_experts=0, n_active_experts=0, **extra):
+    """``extra`` adds/overrides raw header keys (e.g. rope_scaling_factor —
+    the .m header stores them as ints, reference llm.cpp:85-88)."""
+    params = {
         "version": 1,
         "arch_type": int(arch),
         "dim": dim,
@@ -30,6 +32,8 @@ def tiny_header_params(arch=mfile.ArchType.LLAMA, dim=64, n_layers=2, n_heads=4,
         "n_experts": n_experts,
         "n_active_experts": n_active_experts,
     }
+    params.update(extra)
+    return params
 
 
 def write_tensor(f, x: np.ndarray, float_type: int) -> None:

@@ -46,6 +46,8 @@ def _engine_for(variant: str, tmp_path, tp: int) -> tuple[InferenceEngine, dict]
     ("llama_q40", 2),  # TP must not change tokens (reference TP invariance)
     ("llama_f32", 1),
     ("qwen3_q40", 1),
+    ("llama31_q40", 1),    # rope-scaling math vs the reference, not our oracle
+    ("llama_deep_f32", 1),  # 8 layers × 292 pieces: accumulation-order drift
 ])
 def test_transcript_matches_reference(variant, tp, tmp_path):
     eng, golden = _engine_for(variant, tmp_path, tp)
@@ -80,6 +82,6 @@ def test_perplexity_matches_reference(variant, tmp_path):
         ids = eng.tokenizer.encode(golden["perplexity"]["prompt"], is_start=True)
         ppl = eng.perplexity(ids)
         want = golden["perplexity"]["perplexity"]
-        assert ppl == pytest.approx(want, rel=5e-3), (ppl, want)
+        assert ppl == pytest.approx(want, rel=1e-3), (ppl, want)
     finally:
         eng.close()

@@ -103,9 +103,13 @@ class _FakeKVClient:
         self.store: dict = {}
 
     def key_value_set_bytes(self, k, v):
+        if k in self.store:  # coordination-service semantics
+            raise RuntimeError("ALREADY_EXISTS")
         self.store[k] = v
 
-    def key_value_set(self, k, v):
+    def key_value_set(self, k, v, allow_overwrite=False):
+        if k in self.store and not allow_overwrite:
+            raise RuntimeError("ALREADY_EXISTS")
         self.store[k] = v
 
     def blocking_key_value_get_bytes(self, k, ms):
@@ -128,8 +132,11 @@ def test_ctrl_gc_never_outruns_a_silent_worker(monkeypatch):
     finding: blind lag-based GC deleted keys a stalled worker hadn't read)."""
     from dllama_tpu.parallel import multihost as mh
 
+    import jax
+
     fake = _FakeKVClient()
     monkeypatch.setattr(mh.ControlCodec, "_client", staticmethod(lambda: fake))
+    monkeypatch.setattr(jax, "process_count", lambda: 2)  # 1 silent worker
     codec = mh.ControlCodec(4)
     for _ in range(3 * mh._ACK_EVERY):
         codec.send(codec.encode(mh.CTRL_RESET))
@@ -162,6 +169,28 @@ def test_ctrl_gc_respects_watermark(monkeypatch):
     worker.seq = mh._ACK_EVERY
     kind, tokens, pos, _ = worker.decode(worker.recv(timeout_s=1))
     assert (kind, tokens.tolist(), pos) == (mh.CTRL_GREEDY, [[7]], 3)
+
+
+def test_worker_watermark_advances_past_first_publish(monkeypatch):
+    """The ack key is OVERWRITTEN on every publish: the coordination service
+    raises ALREADY_EXISTS without allow_overwrite=True, which would silently
+    freeze the watermark at its first value (code-review finding)."""
+    import jax
+
+    from dllama_tpu.parallel import multihost as mh
+
+    fake = _FakeKVClient()
+    monkeypatch.setattr(mh.ControlCodec, "_client", staticmethod(lambda: fake))
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    n = 2 * mh._ACK_EVERY
+    root = mh.ControlCodec(4)
+    worker = mh.ControlCodec(4)
+    monkeypatch.setattr(mh.ControlCodec, "_gc", lambda self: None)  # keep keys
+    for _ in range(n):
+        root.send(root.encode(mh.CTRL_GREEDY, [[1]], 0))
+    for _ in range(n):
+        worker.recv(timeout_s=1)
+    assert fake.store["dllama/ack/1"] == str(n)  # advanced, not frozen at 256
 
 
 # root that exercises sp=2 ring attention AND fused sampled decode over the

@@ -39,12 +39,13 @@ import golden_assets  # noqa: E402
 PRED_RE = re.compile(r"^🔶 Pred.*")
 
 
-def run_inference(bin_path: str, m: Path, t: Path, buffer_ft: str) -> list[str]:
+def run_inference(bin_path: str, m: Path, t: Path, buffer_ft: str,
+                  steps: int) -> list[str]:
     cmd = [
         bin_path, "inference",
         "--model", str(m), "--tokenizer", str(t),
         "--prompt", golden_assets.PROMPT,
-        "--steps", str(golden_assets.STEPS),
+        "--steps", str(steps),
         "--seed", str(golden_assets.SAMPLER_SEED),
         "--temperature", "0.0",
         "--nthreads", "1",
@@ -99,12 +100,14 @@ def main() -> None:
         tmp = Path(td)
         for variant, spec in golden_assets.VARIANTS.items():
             m, t, m_sha, t_sha = golden_assets.build_assets(variant, tmp)
-            pieces = run_inference(args.bin, m, t, spec["buffer_float_type"])
+            steps = golden_assets.variant_steps(variant)
+            pieces = run_inference(args.bin, m, t, spec["buffer_float_type"],
+                                   steps)
             ppl = run_perplexity(args.bin, m, t, spec["buffer_float_type"])
             golden = {
                 "variant": variant,
                 "prompt": golden_assets.PROMPT,
-                "steps": golden_assets.STEPS,
+                "steps": steps,
                 "sampler_seed": golden_assets.SAMPLER_SEED,
                 "temperature": 0.0,
                 "buffer_float_type": spec["buffer_float_type"],
