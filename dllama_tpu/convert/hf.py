@@ -113,6 +113,17 @@ def load_hf_config(folder: str | Path, weight_float_type: int) -> dict:
         # follows norm_topk_prob (HF Qwen3MoeConfig default: False)
         if model_type == "qwen3_moe":
             params["moe_norm_topk"] = int(bool(cfg.get("norm_topk_prob", False)))
+            # Mixed dense/MoE stacks (some layers plain MLP) can't be
+            # expressed in the .m layer plan, which assumes every layer is
+            # MoE — converting one would write expert tensors for layers the
+            # checkpoint doesn't have (advisor round-1 finding). Reject.
+            sparse_step = int(cfg.get("decoder_sparse_step") or 1)
+            mlp_only = list(cfg.get("mlp_only_layers") or [])
+            if sparse_step != 1 or mlp_only:
+                raise ValueError(
+                    f"qwen3_moe with mixed dense/MoE layers is unsupported: "
+                    f"decoder_sparse_step={sparse_step}, "
+                    f"mlp_only_layers={mlp_only} — every layer must be MoE")
         else:
             params["moe_norm_topk"] = 1
 

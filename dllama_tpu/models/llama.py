@@ -104,16 +104,27 @@ def _use_flash(cfg: ModelConfig, q_shape, kv_shape) -> bool:
 def _sharded_flash(cfg: ModelConfig, plan, q, k_cache, v_cache, start_pos):
     """TP-path Pallas attention via shard_map; None → caller uses the oracle.
 
-    ``attn_impl='flash'`` forces it (interpret mode off-TPU, for tests);
-    ``'auto'`` enables it on TPU backends only."""
+    ``attn_impl='flash'`` forces it (interpret mode off-TPU, for tests) and
+    FAILS LOUDLY when the plan/shape can't take the kernel — a forced mode
+    silently falling back to the oracle hid exactly the configurations the
+    user asked to exercise (advisor round-1 finding); ``'auto'`` enables it
+    on TPU backends only."""
     if cfg.attn_impl == "xla":
         return None
     force = cfg.attn_impl == "flash"
     if not force and not _fa.default_enabled():
         return None
-    return _fa.flash_attention_sharded(
+    res = _fa.flash_attention_sharded(
         plan, q, k_cache, v_cache, start_pos, cfg.head_dim,
         interpret=force and not _fa.default_enabled())
+    if res is None and force:
+        raise ValueError(
+            f"attn_impl='flash' forced but the sharded kernel does not apply "
+            f"(plan axes {dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))}, "
+            f"q={q.shape}, kv={k_cache.shape}; kv-replication groups and "
+            f"non-128-multiple cache lengths use the XLA oracle — drop "
+            f"attn_impl or use 'auto')")
+    return res
 
 
 def _hidden_act(cfg: ModelConfig, x: jax.Array) -> jax.Array:

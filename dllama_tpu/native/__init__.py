@@ -23,7 +23,6 @@ from pathlib import Path
 import numpy as np
 
 _DIR = Path(__file__).resolve().parent
-_SO = _DIR / "libdllama_native.so"
 
 _lib: ctypes.CDLL | None = None
 _tried = False
@@ -40,11 +39,39 @@ def default_threads() -> int:
     return max(1, os.cpu_count() or 1)
 
 
+def _host_signature() -> str:
+    """Identity of the CPU the .so was built for: -march=native code moved to
+    a different host (shared FS, container image reuse) can SIGILL the whole
+    process, which ctypes cannot catch (advisor round-1 finding). The
+    signature is EMBEDDED IN THE .so FILENAME, so check-and-load is atomic:
+    a foreign host's build has a different name and is simply never opened —
+    no tag file to race, no rebuild ping-pong invalidating other hosts'
+    builds on a shared FS."""
+    import hashlib
+    import platform
+
+    parts = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    parts.append(line.strip())
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def _so_path() -> Path:
+    return _DIR / f"libdllama_native.{_host_signature()}.so"
+
+
 def _stale() -> bool:
-    if not _SO.exists():
+    so = _so_path()
+    if not so.exists():
         return True
     try:
-        return (_DIR / "quants.cpp").stat().st_mtime > _SO.stat().st_mtime
+        return (_DIR / "quants.cpp").stat().st_mtime > so.stat().st_mtime
     except OSError:
         return True
 
@@ -60,7 +87,7 @@ def _build() -> bool:
             capture_output=True, text=True, timeout=120)
         if proc.returncode != 0 or not (_DIR / tmp).exists():
             return False
-        os.replace(_DIR / tmp, _SO)
+        os.replace(_DIR / tmp, _so_path())
         return True
     except (OSError, subprocess.TimeoutExpired):
         return False
@@ -70,17 +97,19 @@ def _build() -> bool:
 
 def get_lib() -> ctypes.CDLL | None:
     """The loaded native library, (re)building it on first call when missing
-    or older than its source; None if that fails."""
+    or older than its source; None if that fails. Only ever dlopens a .so
+    whose filename carries THIS host's CPU signature — a build from another
+    machine (shared FS) is invisible rather than a SIGILL risk."""
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
     _tried = True
     if os.environ.get("DLLAMA_NO_NATIVE"):
         return None
-    if _stale() and not _build() and not _SO.exists():
+    if _stale() and not _build() and not _so_path().exists():
         return None
     try:
-        lib = ctypes.CDLL(str(_SO))
+        lib = ctypes.CDLL(str(_so_path()))
     except OSError:
         return None
     for name, argtypes in {

@@ -120,6 +120,12 @@ class RangeNotSatisfiable(Exception):
     the part on disk is already complete."""
 
 
+class RangeIgnored(Exception):
+    """The server returned 200 to a Range request: it will always send the
+    whole file, so resuming is impossible — restart the part from byte 0
+    instead of retrying the identical doomed request."""
+
+
 def _urllib_fetch(url: str, start: int) -> Iterator[bytes]:
     from urllib.error import HTTPError
     from urllib.request import Request, urlopen
@@ -135,7 +141,7 @@ def _urllib_fetch(url: str, start: int) -> Iterator[bytes]:
         raise
     with resp:
         if start > 0 and resp.status != 206:
-            raise OSError(f"server ignored Range request (status {resp.status})")
+            raise RangeIgnored(f"status {resp.status} for bytes={start}-")
         while True:
             chunk = resp.read(CHUNK)
             if not chunk:
@@ -156,6 +162,11 @@ def _download_part(url: str, part_path: Path, fetch: Fetch,
         except RangeNotSatisfiable:
             # resuming past EOF: this part finished in an earlier run
             return
+        except RangeIgnored as e:
+            # retrying the same Range request would fail identically
+            # (advisor round-1 finding) — restart the part from byte 0
+            log(f"server ignored Range resume ({e}); restarting part from 0")
+            part_path.unlink(missing_ok=True)
         except Exception as e:  # noqa: BLE001 - any transport error retries
             log(f"retry {attempt + 1}/{ATTEMPTS} after error at "
                 f"byte {start}: {e}")

@@ -348,3 +348,37 @@ class TestTokenizerConverters:
         assert data.vocab[3] == b" x"
         assert data.bos_id == 4 and data.eos_token_ids == [5]
         assert data.chat_template == "{{ messages }}"
+
+
+class TestQwen3MoeMixedConfigs:
+    """Mixed dense/MoE stacks can't be expressed in the .m layer plan —
+    conversion must reject them instead of writing a wrong model
+    (advisor round-1 finding)."""
+
+    def _cfg(self, **extra):
+        return {
+            "model_type": "qwen3_moe", "hidden_act": "silu", "hidden_size": 64,
+            "intermediate_size": 96, "moe_intermediate_size": 48,
+            "num_hidden_layers": 4, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "max_position_embeddings": 64,
+            "vocab_size": 128, "rope_theta": 10000.0, "rms_norm_eps": 1e-5,
+            "num_experts": 8, "num_experts_per_tok": 2, **extra,
+        }
+
+    def _load(self, tmp_path, cfg):
+        d = tmp_path / "moe"
+        d.mkdir(exist_ok=True)
+        (d / "config.json").write_text(json.dumps(cfg))
+        return load_hf_config(d, quants.Q40)
+
+    def test_all_moe_accepted(self, tmp_path):
+        params = self._load(tmp_path, self._cfg())
+        assert params["n_experts"] == 8
+
+    def test_mlp_only_layers_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="mlp_only_layers"):
+            self._load(tmp_path, self._cfg(mlp_only_layers=[0, 1]))
+
+    def test_sparse_step_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="decoder_sparse_step"):
+            self._load(tmp_path, self._cfg(decoder_sparse_step=2))

@@ -79,3 +79,24 @@ def test_forward_tp_with_forced_flash_matches_unsharded():
             sharded, cfg, tokens, jnp.int32(0), kv)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_forced_flash_under_unsupported_plan_raises():
+    """attn_impl='flash' under a plan the sharded kernel can't take (kv
+    heads not divisible by tp → replication groups) must fail loudly, not
+    silently run the oracle (advisor round-1 finding)."""
+    cfg = ModelConfig(
+        arch=mfile.ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2,
+        n_heads=8, n_kv_heads=2, head_dim=8, vocab_size=128, seq_len=128,
+        norm_epsilon=1e-5, rope_theta=10000.0, rope_type=mfile.RopeType.LLAMA,
+        attn_impl="flash")
+    params = init_random_params(cfg, seed=1)
+    tokens = jnp.asarray([[3, 1]], dtype=jnp.int32)
+    plan = make_tp_mesh(8)  # n_kv=2 % 8 != 0: kernel declines
+    sharded = shard_params(plan, params)
+    kv0 = KVCache.create(cfg)
+    kv = jax.device_put(kv0, kv_cache_sharding(plan, kv0))
+    with use_plan(plan):
+        with pytest.raises(ValueError, match="forced"):
+            jax.jit(forward, static_argnums=1)(
+                sharded, cfg, tokens, jnp.int32(0), kv)

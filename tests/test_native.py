@@ -86,3 +86,20 @@ def test_dispatch_uses_native():
     x = rng.standard_normal(32 * 8).astype(np.float32)
     assert quants.quantize_q40(x) == quants.quantize_q40_np(x)
     assert quants.quantize_q80(x) == quants.quantize_q80_np(x)
+
+
+def test_stale_on_host_signature_change(monkeypatch, tmp_path):
+    """A .so built on another CPU (-march=native, shared FS) must be
+    rebuilt, not dlopened into a potential SIGILL (advisor round-1
+    finding)."""
+    from dllama_tpu import native
+
+    if native.get_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    assert not native._stale()  # fresh build on this host
+    assert native._so_path().exists()
+    # another CPU -> different signature -> different filename: that host's
+    # loader neither sees nor dlopens this build (atomic check-and-load)
+    monkeypatch.setattr(native, "_host_signature", lambda: "otherhost")
+    assert native._stale()
+    assert not native._so_path().exists()

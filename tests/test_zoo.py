@@ -150,3 +150,30 @@ def test_download_model_layout_and_run_script(tmp_path):
 def test_cli_unknown_model(capsys):
     assert zoo.main(["nope"]) == 1
     assert "Available models" in capsys.readouterr().out
+
+
+class _RangeIgnoringStore(FlakyStore):
+    """Origin that answers every ranged request with the full body (HTTP 200
+    semantics) — resume is impossible."""
+
+    def fetch(self, url: str, start: int):
+        self.range_starts[url].append(start)
+        if start > 0:
+            raise zoo.RangeIgnored(f"status 200 for bytes={start}-")
+        data = self.payloads[url]
+        if self.failures[url] > 0:
+            self.failures[url] -= 1
+            yield data[: len(data) // 2]
+            raise OSError("connection reset (simulated)")
+        yield data
+
+
+def test_range_ignoring_server_restarts_part_from_zero(tmp_path):
+    """A 200-to-Range origin must trigger a restart-from-byte-0, not 8
+    identical doomed resume attempts (advisor round-1 finding)."""
+    store = _RangeIgnoringStore({"u0": b"A" * 64}, failures=1)
+    out = zoo.download_file(["u0"], tmp_path / "f.m", fetch=store.fetch,
+                            log=lambda s: None)
+    assert out.read_bytes() == b"A" * 64
+    # one initial attempt (0), one failed resume (32), one clean restart (0)
+    assert store.range_starts["u0"] == [0, 32, 0]
