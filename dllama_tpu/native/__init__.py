@@ -77,10 +77,13 @@ def _stale() -> bool:
 
 
 def _build() -> bool:
-    """Build to a per-process temp name and rename into place: concurrent
-    first-use builds (pytest workers, multi-process launches) each produce a
-    valid .so and the atomic replace keeps the last one."""
-    tmp = f"libdllama_native.so.tmp.{os.getpid()}"
+    """Build to a per-(host, process) temp name and rename into place:
+    concurrent first-use builds (pytest workers, multi-process launches) each
+    produce a valid .so and the atomic replace keeps the last one. The host
+    signature in the temp name keeps two hosts with colliding pids (pid
+    namespaces on a shared volume) from interleaving builds and renaming a
+    foreign binary under this host's signed name."""
+    tmp = f"libdllama_native.so.tmp.{_host_signature()}.{os.getpid()}"
     try:
         proc = subprocess.run(
             ["make", "-C", str(_DIR), "-s", f"SO={tmp}"],

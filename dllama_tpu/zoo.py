@@ -151,11 +151,19 @@ def _urllib_fetch(url: str, start: int) -> Iterator[bytes]:
 
 def _download_part(url: str, part_path: Path, fetch: Fetch,
                    log: Callable[[str], None]) -> None:
-    """Download one URL to ``part_path``, resuming from its current size."""
+    """Download one URL to ``part_path``, resuming from its current size.
+
+    A server that answers ranged requests with 200 is remembered for the
+    whole part: every later attempt restarts from byte 0 directly instead of
+    burning attempts on resume probes known to be doomed."""
+    no_resume = False
     for attempt in range(ATTEMPTS):
-        start = part_path.stat().st_size if part_path.exists() else 0
+        if no_resume:
+            start = 0
+        else:
+            start = part_path.stat().st_size if part_path.exists() else 0
         try:
-            with open(part_path, "ab") as f:
+            with open(part_path, "wb" if start == 0 else "ab") as f:
                 for chunk in fetch(url, start):
                     f.write(chunk)
             return
@@ -166,7 +174,7 @@ def _download_part(url: str, part_path: Path, fetch: Fetch,
             # retrying the same Range request would fail identically
             # (advisor round-1 finding) — restart the part from byte 0
             log(f"server ignored Range resume ({e}); restarting part from 0")
-            part_path.unlink(missing_ok=True)
+            no_resume = True
         except Exception as e:  # noqa: BLE001 - any transport error retries
             log(f"retry {attempt + 1}/{ATTEMPTS} after error at "
                 f"byte {start}: {e}")

@@ -177,3 +177,14 @@ def test_range_ignoring_server_restarts_part_from_zero(tmp_path):
     assert out.read_bytes() == b"A" * 64
     # one initial attempt (0), one failed resume (32), one clean restart (0)
     assert store.range_starts["u0"] == [0, 32, 0]
+
+
+def test_range_ignored_is_remembered_across_attempts(tmp_path):
+    """After the first 200-to-Range answer, later retries restart from 0
+    directly — no further doomed resume probes burning attempts."""
+    store = _RangeIgnoringStore({"u0": b"B" * 64}, failures=2)
+    out = zoo.download_file(["u0"], tmp_path / "f.m", fetch=store.fetch,
+                            log=lambda s: None)
+    assert out.read_bytes() == b"B" * 64
+    # fail@0, doomed resume@32 (once), then from-0 restarts only
+    assert store.range_starts["u0"] == [0, 32, 0, 0]
