@@ -47,7 +47,10 @@ def _engine_for(variant: str, tmp_path, tp: int) -> tuple[InferenceEngine, dict]
     ("llama_f32", 1),
     ("qwen3_q40", 1),
     ("llama31_q40", 1),    # rope-scaling math vs the reference, not our oracle
+    ("llama31_q40", 2),
+    ("qwen3_q40", 2),
     ("llama_deep_f32", 1),  # 8 layers × 292 pieces: accumulation-order drift
+    pytest.param("llama_macbeth_f32", 1, marks=pytest.mark.slow),  # 2049 steps
 ])
 def test_transcript_matches_reference(variant, tp, tmp_path):
     eng, golden = _engine_for(variant, tmp_path, tp)
