@@ -257,6 +257,29 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     dt = time.perf_counter() - t0
     out["decode_tok_per_s"] = round(batch * decode_steps / dt, 2)
     out["decode_ms_per_step"] = round(1000.0 * dt / decode_steps, 3)
+
+    # fused sampled decode (temperature/top-p on device, ops.sampling): the
+    # serving path at temperature>0 — same dispatch budget as greedy
+    if batch == 1 and time.monotonic() < deadline:
+        from dllama_tpu.models.llama import sampled_step
+
+        sampled = jax.jit(sampled_step, static_argnums=1, donate_argnums=(4,))
+        n = max(8, decode_steps // 2)
+        pos += decode_steps
+        token, kv = sampled(params, cfg, token[:, None], jnp.int32(pos), kv,
+                            jnp.float32(0.8), jnp.float32(0.9), jnp.float32(0.5))
+        jax.block_until_ready(token)
+        if time.monotonic() > deadline:
+            return out  # keep the measured prefill/decode numbers
+        pos += 1
+        t0 = time.perf_counter()
+        for i in range(n):
+            token, kv = sampled(params, cfg, token[:, None],
+                                jnp.int32(pos + i), kv, jnp.float32(0.8),
+                                jnp.float32(0.9), jnp.float32(0.5))
+        jax.block_until_ready(token)
+        dt = time.perf_counter() - t0
+        out["sampled_decode_tok_per_s"] = round(n / dt, 2)
     return out
 
 

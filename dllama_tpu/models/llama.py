@@ -111,6 +111,11 @@ def _sharded_flash(cfg: ModelConfig, plan, q, k_cache, v_cache, start_pos):
     on TPU backends only."""
     if cfg.attn_impl == "xla":
         return None
+    if plan.axis_size("pp") > 1:
+        # inside the manual pp shard_map a nested pallas shard_map can't
+        # partition; per-stage attention uses the XLA oracle (validate_pp
+        # rejects forced 'flash' up front)
+        return None
     force = cfg.attn_impl == "flash"
     if not force and not _fa.default_enabled():
         return None
@@ -234,7 +239,10 @@ def _moe_ffn_sparse(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Arra
     w2 = weights.astype(cfg.compute_dtype).reshape(B * T, cfg.n_active_experts)
 
     plan = _current_plan()
-    if plan is None:
+    if plan is None or plan.axis_size("pp") > 1:
+        # no mesh, or already inside the manual pp shard_map (nesting another
+        # shard_map is unsupported): run the sparse path stage-locally with
+        # the full expert set
         y = _moe_sparse_local(cfg, x, idx2, w2, lp.we1, lp.we2, lp.we3,
                               jnp.int32(0), cfg.n_experts)
         return y.reshape(B, T, D).astype(h.dtype)
@@ -315,7 +323,8 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
 
     sp_res = None
     plan = _current_plan()
-    if plan is not None and plan.axis_size("sp") > 1:
+    if plan is not None and plan.axis_size("sp") > 1 \
+            and plan.axis_size("pp") == 1:  # sp×pp nesting unsupported
         from ..parallel.ring import sp_attention
 
         sp_res = sp_attention(plan, q, k_cache, v_cache, k, v, positions,
@@ -382,6 +391,14 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     ``start_pos`` is a traced scalar so prefill chunks and decode steps reuse
     one compilation per ``T``.
     """
+    plan = _current_plan()
+    if plan is not None and plan.axis_size("pp") > 1:
+        # pipeline parallelism: layer stack sharded over pp, stages hand the
+        # activation along the ring (parallel/pipeline.py — new capability)
+        from ..parallel.pipeline import pp_forward
+
+        return pp_forward(plan, cfg, params, tokens, start_pos, kv)
+
     B, T = tokens.shape
     x = params.embedding[tokens].astype(cfg.compute_dtype)
     x = constrain(x, "batch", None, None)

@@ -35,6 +35,7 @@ DEFAULT_RULES: dict[str, str | tuple[str, ...] | None] = {
     "vocab": "tp",
     "q_dim": "tp",
     "experts": "ep",
+    "layers": "pp",  # pipeline stages: the stacked-layer axis (parallel/pipeline.py)
 }
 
 

@@ -1,0 +1,141 @@
+"""Pipeline parallelism — layer-stage sharding over a ``pp`` mesh axis.
+
+New capability: neither this framework (rounds 1-2) nor the reference has
+pipeline parallelism (SURVEY.md §2.2 "Pipeline parallelism: NO — every node
+holds a shard of every layer"). The reference's closest concept is
+``--gpu-segments``, which pins a segment range to a *local* device
+(app.cpp:113-120); here the layer stack itself is sharded across chips.
+
+Why it earns its place next to tp: tensor parallelism costs TWO all-reduces
+of a ``[B, T, dim]`` activation per LAYER; a pipeline forward costs
+``n_pp - 1`` activation permute rounds plus one activation all-reduce — per
+FORWARD, independent of depth. (Under SPMD every stage participates in each
+permute round, so total wire bytes are O(n_pp) activation copies per round;
+still ~``2·n_layers / n_pp`` times less activation traffic than tp.) That is
+the right trade on DCN-connected hosts — the modern form of the reference's
+Raspberry-Pis-over-Ethernet deployment — and it divides the weight/KV
+footprint by ``n_pp`` without the reference's ``2^n ≤ n_kv_heads`` shape
+constraints (any ``n_layers % pp == 0`` works).
+
+Design (TPU-native, single program): ``jax.shard_map`` manual over ``pp``
+only — ``tp``/``dp`` stay AUTO inside, so the exact same ``_layer_step``
+(with its logical-axis sharding constraints) runs within each stage.
+Each device holds ``n_layers / pp`` stacked layers + their KV slices; the
+forward runs ``pp`` ticks of [cond(stage == tick): scan local layers] →
+``ppermute`` the activation to the next stage, then a masked ``psum``
+replicates the last stage's output. Decode latency is the sum of stage
+times (inherent to pipelining at batch 1); microbatch interleaving over dp
+is future work.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+if TYPE_CHECKING:
+    from ..models.config import ModelConfig
+    from .api import MeshPlan
+
+AXIS = "pp"
+
+
+def _lead_pp_specs(tree):
+    """Full-rank specs: leading (layer) axis manual on pp, rest auto."""
+    return jax.tree.map(lambda a: P(AXIS, *([None] * (a.ndim - 1))), tree)
+
+
+def _repl_specs(tree):
+    return jax.tree.map(lambda a: P(*([None] * a.ndim)), tree)
+
+
+def pp_forward(plan: "MeshPlan", cfg: "ModelConfig", params, tokens, start_pos,
+               kv):
+    """Full forward with the layer stack sharded over ``pp``.
+
+    Same signature contract as models.llama.forward (which dispatches here
+    when the active mesh has a pp axis); returns (logits, KVCache)."""
+    from ..models.llama import _layer_step
+    from ..models.rope import build_rope_cache
+    from ..ops.linear import fake_quant_q80, linear
+    from ..ops.norms import rms_norm
+    from ..parallel.api import constrain
+    from ..runtime.kvcache import KVCache
+
+    n_pp = plan.axis_size(AXIS)
+    B, T = tokens.shape
+    x0 = params.embedding[tokens].astype(cfg.compute_dtype)
+    x0 = constrain(x0, "batch", None, None)
+
+    cos, sin = build_rope_cache(cfg)
+    positions = start_pos + jnp.arange(T, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (B, T))
+    perm = [(i, (i + 1) % n_pp) for i in range(n_pp)]
+
+    def local(x, layers_l, k_l, v_l, cos, sin, sp0, pos):
+        stage = lax.axis_index(AXIS)
+
+        def run(carry):
+            x, k_l, v_l = carry
+
+            def body(xc, xs):
+                lp, k1, v1 = xs
+                xo, k1, v1 = _layer_step(cfg, xc, lp, k1, v1, cos, sin,
+                                         sp0, pos)
+                return xo, (k1, v1)
+
+            x, (k_l, v_l) = lax.scan(body, x, (layers_l, k_l, v_l))
+            return x, k_l, v_l
+
+        def tick(s, carry):
+            x, k_l, v_l = carry
+            x, k_l, v_l = lax.cond(stage == s, run, lambda c: c,
+                                   (x, k_l, v_l))
+            # hand the activation to the next stage
+            x = lax.ppermute(x, AXIS, perm)
+            return x, k_l, v_l
+
+        # n_pp - 1 permute rounds; the final stage's output skips the wasted
+        # last hop and goes straight into the masked psum, which replicates
+        # it so every stage computes identical logits
+        x, k_l, v_l = lax.fori_loop(0, n_pp - 1, tick, (x, k_l, v_l))
+        x, k_l, v_l = lax.cond(stage == n_pp - 1, run, lambda c: c,
+                               (x, k_l, v_l))
+        x = lax.psum(jnp.where(stage == n_pp - 1, x, jnp.zeros_like(x)), AXIS)
+        return x, k_l, v_l
+
+    fn = jax.shard_map(
+        local, mesh=plan.mesh,
+        in_specs=(_repl_specs(x0), _lead_pp_specs(params.layers),
+                  P(AXIS, None, None, None, None),
+                  P(AXIS, None, None, None, None),
+                  _repl_specs(cos), _repl_specs(sin), P(), _repl_specs(positions)),
+        out_specs=(_repl_specs(x0), P(AXIS, None, None, None, None),
+                   P(AXIS, None, None, None, None)),
+        axis_names={AXIS}, check_vma=False)
+    x, new_k, new_v = fn(x0, params.layers, kv.k, kv.v, cos, sin,
+                         jnp.int32(start_pos), positions)
+
+    x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
+    if cfg.sync_q80:
+        x = fake_quant_q80(x)
+    logits = linear(x, params.logits, out_axis="vocab").astype(jnp.float32)
+    logits = constrain(logits, "batch", None, "vocab")
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+def validate_pp(cfg: "ModelConfig", pp: int) -> None:
+    """Pipeline divisibility and composition rules."""
+    if cfg.n_layers % pp != 0:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp={pp}")
+    if cfg.offload:
+        raise ValueError("pp does not compose with --weight-mode offload yet "
+                         "(per-stage host streaming is future work)")
+    if cfg.attn_impl == "flash":
+        raise ValueError(
+            "attn_impl='flash' under pp is unsupported (the Pallas kernel "
+            "can't nest inside the manual pp shard_map); use 'auto' or 'xla'")

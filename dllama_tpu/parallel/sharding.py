@@ -35,8 +35,9 @@ if TYPE_CHECKING:  # imported lazily at runtime (models imports parallel.api)
 def _weight_sharding(plan: MeshPlan, w, out_axis: str | None, in_axis: str | None,
                      stacked: bool):
     """Sharding for one matmul weight: dense ``[L?, out, in]`` or K-major Q40
-    planes ``[L?, in, out]`` / ``[L?, in/32, out]``."""
-    lead = (None,) if stacked else ()
+    planes ``[L?, in, out]`` / ``[L?, in/32, out]``. The stacked layer axis
+    maps to the ``pp`` pipeline axis when the mesh has one."""
+    lead = ("layers",) if stacked else ()
     if isinstance(w, QuantizedWeight):
         return QuantizedWeight(
             scales=plan.sharding_for(tuple(w.scales.shape), *lead, in_axis, out_axis),
@@ -58,22 +59,24 @@ def param_shardings(plan: MeshPlan, params: "Params") -> "Params":
         w1=None if lp.w1 is None else _weight_sharding(plan, lp.w1, "hidden", None, True),
         w2=None if lp.w2 is None else _weight_sharding(plan, lp.w2, None, "hidden", True),
         w3=None if lp.w3 is None else _weight_sharding(plan, lp.w3, "hidden", None, True),
-        norm_att=plan.sharding(None, None),
-        norm_ffn=plan.sharding(None, None),
-        norm_q=None if lp.norm_q is None else plan.sharding(None, None),
-        norm_k=None if lp.norm_k is None else plan.sharding(None, None),
+        norm_att=plan.sharding_for(tuple(lp.norm_att.shape), "layers", None),
+        norm_ffn=plan.sharding_for(tuple(lp.norm_ffn.shape), "layers", None),
+        norm_q=None if lp.norm_q is None else plan.sharding_for(
+            tuple(lp.norm_q.shape), "layers", None),
+        norm_k=None if lp.norm_k is None else plan.sharding_for(
+            tuple(lp.norm_k.shape), "layers", None),
         # MoE: experts over ep, expert-hidden over tp (new capability; the
         # reference has no runtime MoE, SURVEY.md §2.2). Expert weights are
         # in-major (ragged_dot layout, see LayerParams): we1/we3 [L,E,D,H],
         # we2 [L,E,H,D].
         moe_gate=None if lp.moe_gate is None else plan.sharding_for(
-            tuple(lp.moe_gate.shape), None, "experts", None),
+            tuple(lp.moe_gate.shape), "layers", "experts", None),
         we1=None if lp.we1 is None else plan.sharding_for(
-            tuple(lp.we1.shape), None, "experts", None, "hidden"),
+            tuple(lp.we1.shape), "layers", "experts", None, "hidden"),
         we2=None if lp.we2 is None else plan.sharding_for(
-            tuple(lp.we2.shape), None, "experts", "hidden", None),
+            tuple(lp.we2.shape), "layers", "experts", "hidden", None),
         we3=None if lp.we3 is None else plan.sharding_for(
-            tuple(lp.we3.shape), None, "experts", None, "hidden"),
+            tuple(lp.we3.shape), "layers", "experts", None, "hidden"),
     )
     return Params(
         embedding=plan.sharding(None, None),
@@ -93,7 +96,7 @@ def kv_cache_sharding(plan: MeshPlan, kv: "KVCache") -> "KVCache":
     groups; the reference instead caps nodes at nKvHeads)."""
     from ..runtime.kvcache import KVCache
 
-    s = plan.sharding_for(tuple(kv.k.shape), None, "batch", "kv_heads", "seq", None)
+    s = plan.sharding_for(tuple(kv.k.shape), "layers", "batch", "kv_heads", "seq", None)
     return KVCache(k=s, v=s)
 
 

@@ -86,7 +86,8 @@ class InferenceEngine:
     """Owns config, params, KV cache, and the jitted step functions."""
 
     def __init__(self, model_path: str, tokenizer_path: str | None = None, *,
-                 tp: int | None = None, sp: int = 1, max_seq_len: int = 0,
+                 tp: int | None = None, sp: int = 1, pp: int = 1,
+                 max_seq_len: int = 0,
                  weight_mode: str = "auto", sync_type: int = F32,
                  compute_dtype: str = "float32",
                  n_batches: int = DEFAULT_N_BATCHES,
@@ -111,11 +112,11 @@ class InferenceEngine:
         n_dev = len(jax.devices())
         if tp is None:
             # largest power-of-2 device count the model's shapes accept
-            # (after reserving the sp axis)
+            # (after reserving the sp and pp axes)
             tp = 1
-            while (sp * tp * 2 <= n_dev and _tp_ok(self.cfg, tp * 2)):
+            while (pp * sp * tp * 2 <= n_dev and _tp_ok(self.cfg, tp * 2)):
                 tp *= 2
-        self.tp, self.sp = tp, sp
+        self.tp, self.sp, self.pp = tp, sp, pp
         if sp > 1 and self.cfg.seq_len % sp != 0:
             # sp = sequence parallelism: KV cache seq-sharded, ring attention
             # (parallel/ring.py) — long-context capability with no reference
@@ -123,7 +124,17 @@ class InferenceEngine:
             raise ValueError(
                 f"seq_len {self.cfg.seq_len} not divisible by sp={sp} "
                 f"(adjust --max-seq-len)")
-        axes = {name: n for name, n in (("sp", sp), ("tp", tp)) if n > 1}
+        if pp > 1:
+            # pp = pipeline parallelism: layer stages (parallel/pipeline.py);
+            # another new capability (SURVEY.md §2.2: reference has none)
+            from ..parallel.pipeline import validate_pp
+
+            validate_pp(self.cfg, pp)
+            if sp > 1:
+                raise ValueError("pp does not compose with sp yet "
+                                 "(nested shard_maps)")
+        axes = {name: n
+                for name, n in (("pp", pp), ("sp", sp), ("tp", tp)) if n > 1}
         self.plan: MeshPlan | None = make_mesh(axes) if axes else None
         if tp > 1:
             validate_tp(self.cfg, tp)

@@ -106,7 +106,7 @@ class _StreamingLoader:
         key = (lambda l: f"{name}.{l}") if stacked else (lambda _l: name)
 
         if self.quantized:
-            lead = (None,) if stacked else ()
+            lead = ("layers",) if stacked else ()  # pipeline axis when present
             cshape = ((L, in_dim, out_dim) if stacked else (in_dim, out_dim))
             sshape = ((L, in_dim // Q40_BLOCK_SIZE, out_dim) if stacked
                       else (in_dim // Q40_BLOCK_SIZE, out_dim))
@@ -153,7 +153,7 @@ class _StreamingLoader:
             )
 
         # dense: reference on-disk orientation [out, in] (row-major)
-        lead = (None,) if stacked else ()
+        lead = ("layers",) if stacked else ()
         shape = (L, out_dim, in_dim) if stacked else (out_dim, in_dim)
         sh = self._sharding(shape, *lead, out_axis, in_axis)
 
@@ -177,7 +177,7 @@ class _StreamingLoader:
     def stacked_f32(self, name: str, *shape_tail: int) -> jax.Array:
         L = self.h.n_layers
         shape = (L, *shape_tail)
-        sh = self._sharding(shape, *([None] * len(shape)))
+        sh = self._sharding(shape, "layers", *([None] * len(shape_tail)))
 
         def read(idx):
             layers = _layer_range(idx[0], L)
@@ -203,7 +203,7 @@ class _StreamingLoader:
                            if self.weight_mode not in ("auto", "offload")
                            else self.cfg.compute_dtype)
         shape = (L, E, in_dim, out_dim)
-        sh = self._sharding(shape, None, "experts", in_axis, out_axis)
+        sh = self._sharding(shape, "layers", "experts", in_axis, out_axis)
 
         def read(idx):
             l_sl, e_sl, i_sl, o_sl = idx

@@ -60,6 +60,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel device count (ring attention; "
                         "long-context — no reference equivalent)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stage count (layer stages; "
+                        "pp-1 activation hand-offs + one activation "
+                        "all-reduce per forward vs tp's 2 all-reduces per "
+                        "layer — the low-bandwidth scale-out axis; no "
+                        "reference equivalent)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a JAX/XLA profiler trace to DIR (the TPU-side "
                         "Eval/Sync breakdown: per-op + collective time; view "
@@ -114,7 +120,7 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
     seed = args.seed if args.seed is not None else int(time.time())
     engine = InferenceEngine(
         args.model, args.tokenizer,
-        tp=args.tp, sp=args.sp, max_seq_len=args.max_seq_len,
+        tp=args.tp, sp=args.sp, pp=args.pp, max_seq_len=args.max_seq_len,
         weight_mode=args.weight_mode,
         compute_dtype="bfloat16" if args.compute_dtype == "bf16" else "float32",
         sync_type=Q80 if args.buffer_float_type == "q80" else F32,
@@ -125,7 +131,8 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
     h = engine.model_file.header
     print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "
           f"Heads: {h.n_heads}/{h.n_kv_heads}  SeqLen: {h.seq_len}")
-    print(f"🕸️ TP devices: {engine.tp}  SP devices: {engine.sp}")
+    print(f"🕸️ TP devices: {engine.tp}  SP devices: {engine.sp}  "
+          f"PP stages: {engine.pp}")
     return engine
 
 
@@ -318,10 +325,10 @@ def main(argv=None) -> int:
         args._multihost = _maybe_init_distributed(args)
         if envp and not args._multihost:
             jax.config.update("jax_platforms", envp)
-        need = max(1, (args.tp or 1)) * max(1, args.sp)
+        need = max(1, (args.tp or 1)) * max(1, args.sp) * max(1, args.pp)
         if need > len(jax.devices()):
             raise SystemExit(
-                f"requested tp×sp = {need} devices but only "
+                f"requested tp×sp×pp = {need} devices but only "
                 f"{len(jax.devices())} visible (for a virtual mesh: "
                 f"JAX_PLATFORMS=cpu "
                 f"XLA_FLAGS=--xla_force_host_platform_device_count={need})")

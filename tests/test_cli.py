@@ -1,0 +1,65 @@
+"""CLI-mode tests driven in-process (reference flows: dllama.cpp
+inference/chat). The API and worker modes have their own test files; this
+covers the inference printout contract and the chat REPL loop (template
+render → prefill → sampled decode → EOS/seq-len stop) end to end."""
+
+import io
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.serve import cli
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+LLAMA3_SNIPPET = (
+    "{% set content = '<|start_header_id|>' + message['role'] + "
+    "'<|end_header_id|>\n\n' + message['content'] | trim + '<|eot_id|>' %}")
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(77)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=192), rng)
+    data = byte_vocab_tokenizer()
+    data.chat_template = LLAMA3_SNIPPET  # autodetects as llama3
+    tfile.write_tfile(tpath, data)
+    return str(mpath), str(tpath)
+
+
+def test_inference_mode_prints_reference_style_stats(model_files, capsys):
+    m, t = model_files
+    rc = cli.main(["inference", "--model", m, "--tokenizer", t,
+                   "--prompt", "hello world", "--steps", "16",
+                   "--temperature", "0.0", "--seed", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Evaluation" in out and "Prediction" in out
+    assert "tokens/s" in out and "nTokens" in out
+
+
+def test_inference_requires_prompt_and_steps(model_files):
+    m, t = model_files
+    with pytest.raises(SystemExit):
+        cli.main(["inference", "--model", m, "--tokenizer", t, "--steps", "4"])
+    with pytest.raises(SystemExit):
+        cli.main(["inference", "--model", m, "--tokenizer", t,
+                  "--prompt", "hi"])
+
+
+def test_chat_mode_replies_and_exits_on_eof(model_files, capsys, monkeypatch):
+    """One user turn through the real REPL: template render, prefill, fused
+    sampled decode, stream until EOS or the context cap, clean EOF exit
+    (reference: dllama.cpp:174-258)."""
+    m, t = model_files
+    monkeypatch.setattr("sys.stdin", io.StringIO("hello\n"))
+    rc = cli.main(["chat", "--model", m, "--tokenizer", t,
+                   "--temperature", "0.8", "--seed", "3",
+                   "--max-seq-len", "128"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "🤖" in out  # the assistant turn streamed something
+    assert "context is full" not in out.split("🤖")[0]  # prompt fit

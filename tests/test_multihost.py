@@ -59,9 +59,7 @@ def test_two_process_worker_matches_golden(tmp_path):
     if m_sha != golden["m_sha256"]:
         pytest.skip("assets no longer match golden hashes")
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=1",
-               PYTHONPATH=str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env = _two_proc_env()
     coord = f"127.0.0.1:{PORT}"
     n_gen = min(8, len(golden["pieces"]))  # keep the 2-process run short
 
@@ -319,8 +317,19 @@ def tiny_files(tmp_path_factory):
 
 
 def _two_proc_env():
+    import getpass
+    import tempfile
+
+    # persistent compile cache: the 2-process tests re-jit the same tiny
+    # programs in every subprocess; cache hits keep the whole multihost suite
+    # inside the CI window. User-scoped path: a world-shared one breaks
+    # silently (cache disabled) for the second user on a machine.
+    cache = os.path.join(tempfile.gettempdir(),
+                         f"dllama-xla-cache-{getpass.getuser()}")
     return dict(os.environ, JAX_PLATFORMS="cpu",
                 XLA_FLAGS="--xla_force_host_platform_device_count=1",
+                JAX_COMPILATION_CACHE_DIR=cache,
+                JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.5",
                 PYTHONPATH=str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", ""))
 
 
