@@ -280,6 +280,29 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
         jax.block_until_ready(token)
         dt = time.perf_counter() - t0
         out["sampled_decode_tok_per_s"] = round(n / dt, 2)
+        pos += n  # loop wrote rows [pos, pos + n); next free slot is pos + n
+
+    # multi-step fused decode (decode_chunk): K tokens per dispatch — the
+    # dispatch-overhead-free decode rate (engine --decode-chunk)
+    if batch == 1 and time.monotonic() < deadline:
+        from dllama_tpu.models.llama import greedy_steps
+
+        gsteps = jax.jit(greedy_steps, static_argnums=(1, 5),
+                         donate_argnums=(4,))
+        K = 32
+        toks, kv = gsteps(params, cfg, token, jnp.int32(pos), kv, K)  # compile
+        jax.block_until_ready(toks)
+        if time.monotonic() > deadline:
+            return out
+        pos += K
+        rounds = max(1, decode_steps // K)
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            toks, kv = gsteps(params, cfg, toks[:, -1], jnp.int32(pos + r * K),
+                              kv, K)
+        jax.block_until_ready(toks)
+        dt = time.perf_counter() - t0
+        out["chunked_decode_tok_per_s"] = round(rounds * K / dt, 2)
     return out
 
 

@@ -367,6 +367,49 @@ def greedy_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), kv
 
 
+def greedy_steps(params: Params, cfg: ModelConfig, token: jax.Array,
+                 start_pos: jax.Array, kv: KVCache,
+                 n_steps: int) -> tuple[jax.Array, KVCache]:
+    """``n_steps`` fused greedy decode steps in ONE dispatch: the sampled
+    token feeds the next forward on device (lax.scan), so the host pays one
+    dispatch + one ``4·n_steps``-byte transfer per CHUNK instead of per
+    token. Output is bit-identical to ``n_steps`` single greedy_step calls
+    (greedy is deterministic); the caller truncates at EOS — tokens past it
+    are discarded work, not divergence. ``token: [B]`` seeds the chunk;
+    returns ``(tokens [B, n_steps], kv)``."""
+
+    def body(carry, i):
+        token, kv = carry
+        nxt, kv = greedy_step(params, cfg, token[:, None], start_pos + i, kv)
+        return (nxt, kv), nxt
+
+    (_, kv), toks = jax.lax.scan(
+        body, (token, kv), jnp.arange(n_steps, dtype=jnp.int32))
+    return jnp.moveaxis(toks, 0, 1), kv  # [B, n_steps]
+
+
+def sampled_steps(params: Params, cfg: ModelConfig, token: jax.Array,
+                  start_pos: jax.Array, kv: KVCache, temperature: jax.Array,
+                  topp: jax.Array, coins: jax.Array,
+                  n_steps: int) -> tuple[jax.Array, KVCache]:
+    """The temperature>0 twin of :func:`greedy_steps`: ``coins [n_steps]``
+    are the host xorshift draws for the whole chunk (the host rewinds its
+    RNG to the number of tokens actually kept after EOS truncation, so the
+    stream stays bit-identical to single-step decode)."""
+
+    def body(carry, xs):
+        token, kv = carry
+        i, coin = xs
+        nxt, kv = sampled_step(params, cfg, token[:, None], start_pos + i, kv,
+                               temperature, topp, coin)
+        return (nxt, kv), nxt
+
+    (_, kv), toks = jax.lax.scan(
+        body, (token, kv),
+        (jnp.arange(n_steps, dtype=jnp.int32), coins))
+    return jnp.moveaxis(toks, 0, 1), kv
+
+
 def sampled_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
                  start_pos: jax.Array, kv: KVCache, temperature: jax.Array,
                  topp: jax.Array, coin: jax.Array) -> tuple[jax.Array, KVCache]:

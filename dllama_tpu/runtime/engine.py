@@ -33,8 +33,10 @@ from ..models.llama import (
     Params,
     forward,
     greedy_step,
+    greedy_steps,
     load_params_from_mfile,
     sampled_step,
+    sampled_steps,
 )
 from ..parallel.api import MeshPlan, make_mesh, use_plan
 from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
@@ -92,7 +94,8 @@ class InferenceEngine:
                  compute_dtype: str = "float32",
                  n_batches: int = DEFAULT_N_BATCHES,
                  temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5,
-                 multihost: bool = False, host_sampling: bool = False):
+                 multihost: bool = False, host_sampling: bool = False,
+                 decode_chunk: int = 1):
         self.model_file = ModelFile.open(model_path, max_seq_len=max_seq_len,
                                          sync_type=sync_type)
         self.cfg = ModelConfig.from_header(self.model_file.header,
@@ -108,6 +111,13 @@ class InferenceEngine:
         self.sampler = Sampler(self.cfg.vocab_size, temperature, topp, seed)
         self.host_sampling = host_sampling
         self.weight_mode = weight_mode
+        # multi-step fused decode: K tokens per dispatch (lax.scan feeds the
+        # picked token back on device; models.llama.greedy_steps). Output is
+        # identical to single-step — EOS overshoot is truncated on host and
+        # the sampler RNG rewound to the kept count. Multihost stays at 1
+        # (the control channel ships one packet per dispatch).
+        self.decode_chunk = 1 if (multihost or host_sampling) \
+            else max(1, decode_chunk)
 
         n_dev = len(jax.devices())
         if tp is None:
@@ -183,6 +193,10 @@ class InferenceEngine:
                                         donate_argnums=(4,))
             self._sampled_step = jax.jit(sampled_step, static_argnums=1,
                                          donate_argnums=(4,))
+            self._greedy_steps = jax.jit(greedy_steps, static_argnums=(1, 5),
+                                         donate_argnums=(4,))
+            self._sampled_steps = jax.jit(sampled_steps, static_argnums=(1, 8),
+                                          donate_argnums=(4,))
 
     def _fresh_kv(self) -> KVCache:
         # cache rides the compute dtype: f32 for parity, bf16 halves HBM
@@ -303,6 +317,44 @@ class InferenceEngine:
         self.pos += 1
         return int(nxt[0])
 
+    def decode_chunk_tokens(self, token: int, k: int) -> list[int]:
+        """``k`` decode steps in ONE dispatch (multi-step fused decode).
+
+        Returns all ``k`` tokens; the caller decides how many to keep (EOS
+        truncation) and then calls :meth:`commit_chunk` with that count —
+        until committed, ``self.pos`` and the sampler RNG are NOT advanced.
+        Overshoot KV rows beyond the committed count are invisible (causal
+        mask) and rewritten by the next tokens at those positions — the same
+        safety argument as padded prefill tails (module docstring)."""
+        assert not self.multihost and not self.host_sampling
+        k = min(k, self.cfg.seq_len - self.pos)
+        assert k >= 1
+        tok0 = jnp.asarray([token], dtype=jnp.int32)
+        with (use_plan(self.plan) if self.plan is not None else nullcontext()):
+            if self.sampler.temperature == 0.0:
+                toks, self.kv = self._greedy_steps(
+                    self.params, self.cfg, tok0, jnp.int32(self.pos),
+                    self.kv, k)
+            else:
+                coins = np.empty(k, dtype=np.float32)
+                st = self.sampler.rng_state
+                for i in range(k):
+                    coins[i], st = xorshift_random_f32(st)
+                toks, self.kv = self._sampled_steps(
+                    self.params, self.cfg, tok0, jnp.int32(self.pos), self.kv,
+                    jnp.float32(self.sampler.temperature),
+                    jnp.float32(self.sampler.topp), jnp.asarray(coins), k)
+        return [int(t) for t in np.asarray(toks[0])]
+
+    def commit_chunk(self, n_keep: int) -> None:
+        """Advance position and sampler RNG by the kept prefix of a chunk."""
+        self.pos += n_keep
+        if self.sampler.temperature != 0.0:
+            st = self.sampler.rng_state
+            for _ in range(n_keep):
+                _, st = xorshift_random_f32(st)
+            self.sampler.rng_state = st
+
     # -- generation ---------------------------------------------------------
 
     def generate(self, prompt: str | list[int], max_tokens: int,
@@ -330,18 +382,47 @@ class InferenceEngine:
         pieces: list[str] = []
         token = ids[-1]
         limit = min(self.cfg.seq_len - self.pos, max_tokens)
-        for _ in range(limit):
-            t0 = time.perf_counter()
-            token = self.next_token(token)
-            steps.append(StepMetrics("pred", (time.perf_counter() - t0) * 1000.0, 1))
-            out_tokens.append(token)
-            piece = self.tokenizer.decode(token) if self.tokenizer else None
+
+        def emit(tok: int) -> bool:
+            """Record/stream one token; True when generation should stop."""
+            out_tokens.append(tok)
+            piece = self.tokenizer.decode(tok) if self.tokenizer else None
             if piece is not None:
                 pieces.append(piece)
             if on_token is not None:
-                on_token(token, piece)
-            if stop_on_eos and self.tokenizer is not None and self.tokenizer.is_eos(token):
-                break
+                on_token(tok, piece)
+            return (stop_on_eos and self.tokenizer is not None
+                    and self.tokenizer.is_eos(tok))
+
+        stop = False
+        while len(out_tokens) < limit and not stop:
+            # Full-size chunks only: n_steps is a static jit argument, so a
+            # smaller tail chunk would compile a fresh program mid-generation
+            # (a multi-second stall on TPU). Tails run the single-step path.
+            k = self.decode_chunk
+            if (limit - len(out_tokens) < k
+                    or self.cfg.seq_len - self.pos < k):
+                k = 1
+            t0 = time.perf_counter()
+            if k <= 1:
+                token = self.next_token(token)
+                steps.append(StepMetrics(
+                    "pred", (time.perf_counter() - t0) * 1000.0, 1))
+                stop = emit(token)
+                continue
+            chunk = self.decode_chunk_tokens(token, k)
+            n_keep = len(chunk)
+            if stop_on_eos and self.tokenizer is not None:
+                for j, tok in enumerate(chunk):
+                    if self.tokenizer.is_eos(tok):
+                        n_keep = j + 1
+                        break
+            self.commit_chunk(n_keep)
+            steps.append(StepMetrics(
+                "pred", (time.perf_counter() - t0) * 1000.0, n_keep))
+            for tok in chunk[:n_keep]:
+                stop = emit(tok)
+            token = chunk[n_keep - 1]
         return GenerationResult(tokens=out_tokens, text="".join(pieces),
                                 prompt_tokens=len(ids), steps=steps)
 

@@ -52,6 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="activation/KV-cache dtype: f32 for reference parity, "
                         "bf16 for TPU serving throughput")
     p.add_argument("--nbatches", type=int, default=DEFAULT_N_BATCHES)
+    p.add_argument("--decode-chunk", type=int, default=1, metavar="K",
+                   help="fuse K decode steps into one dispatch (tokens feed "
+                        "back on device; output identical to K=1, EOS "
+                        "overshoot discarded). Cuts per-token dispatch "
+                        "overhead; streaming granularity becomes K tokens")
     p.add_argument("--host-sampling", action="store_true",
                    help="sample on host from downloaded logits (parity oracle) "
                         "instead of the fused on-device sampler")
@@ -127,6 +132,7 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         n_batches=args.nbatches,
         temperature=args.temperature, topp=args.topp, seed=seed,
         multihost=multihost, host_sampling=args.host_sampling,
+        decode_chunk=args.decode_chunk,
     )
     h = engine.model_file.header
     print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "

@@ -1,0 +1,99 @@
+"""Multi-step fused decode (decode_chunk): K tokens per dispatch must be
+OUTPUT-IDENTICAL to single-step decode — greedy and sampled, including EOS
+truncation mid-chunk and the sampler-RNG rewind that keeps the xorshift
+stream bit-identical afterwards."""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime.engine import InferenceEngine
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("chunk")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(13)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    return str(mpath), str(tpath)
+
+
+@pytest.mark.parametrize("temperature,chunk", [
+    (0.0, 8), (0.0, 5), (0.8, 8), (0.8, 3),
+])
+def test_chunked_matches_single_step(model_files, temperature, chunk):
+    single = InferenceEngine(*model_files, temperature=temperature, seed=21)
+    r1 = single.generate("hello world", 20, stop_on_eos=False)
+    chunked = InferenceEngine(*model_files, temperature=temperature, seed=21,
+                              decode_chunk=chunk)
+    r2 = chunked.generate("hello world", 20, stop_on_eos=False)
+    assert r1.tokens == r2.tokens
+    assert single.pos == chunked.pos
+    assert single.sampler.rng_state == chunked.sampler.rng_state
+    # chunking actually reduced the number of dispatches
+    preds = [s.n_tokens for s in r2.steps if s.kind == "pred"]
+    assert len(preds) < len([s for s in r1.steps if s.kind == "pred"])
+    # tails smaller than the chunk run single-step (no fresh compile of a
+    # second chunk size): every multi-token dispatch is exactly `chunk` wide
+    assert all(n == chunk or n == 1 for n in preds), preds
+
+
+def _force_eos_on(engine, token_id):
+    orig = engine.tokenizer.is_eos
+    engine.tokenizer.is_eos = lambda t: t == token_id or orig(t)
+
+
+def test_eos_mid_chunk_truncates_and_rewinds_rng(model_files):
+    """EOS landing mid-chunk: kept tokens, position, and the sampler RNG
+    state must all match the single-step run — and a CONTINUED generation
+    after the EOS must also match (the rewind proof)."""
+    probe = InferenceEngine(*model_files, temperature=0.8, seed=5)
+    burn = probe.generate("hello world", 12, stop_on_eos=False)
+    eos_tok = burn.tokens[6]  # a token known to appear mid-stream
+
+    single = InferenceEngine(*model_files, temperature=0.8, seed=5)
+    _force_eos_on(single, eos_tok)
+    chunked = InferenceEngine(*model_files, temperature=0.8, seed=5,
+                              decode_chunk=8)
+    _force_eos_on(chunked, eos_tok)
+
+    r1 = single.generate("hello world", 12, stop_on_eos=True)
+    r2 = chunked.generate("hello world", 12, stop_on_eos=True)
+    assert r1.tokens == r2.tokens and r1.tokens[-1] == eos_tok
+    assert single.pos == chunked.pos
+    assert single.sampler.rng_state == chunked.sampler.rng_state
+
+    c1 = single.generate([r1.tokens[-1]], 6, stop_on_eos=False)
+    c2 = chunked.generate([r2.tokens[-1]], 6, stop_on_eos=False)
+    assert c1.tokens == c2.tokens
+
+
+def test_greedy_eos_mid_chunk(model_files):
+    probe = InferenceEngine(*model_files, temperature=0.0)
+    burn = probe.generate("hello world", 12, stop_on_eos=False)
+    eos_tok = burn.tokens[4]
+
+    single = InferenceEngine(*model_files, temperature=0.0)
+    _force_eos_on(single, eos_tok)
+    chunked = InferenceEngine(*model_files, temperature=0.0, decode_chunk=8)
+    _force_eos_on(chunked, eos_tok)
+    r1 = single.generate("hello world", 12, stop_on_eos=True)
+    r2 = chunked.generate("hello world", 12, stop_on_eos=True)
+    assert r1.tokens == r2.tokens and r1.tokens[-1] == eos_tok
+    assert single.pos == chunked.pos
+    # overshoot rows beyond the EOS must be invisible: continue and compare
+    c1 = single.generate([r1.tokens[-1]], 6, stop_on_eos=False)
+    c2 = chunked.generate([r2.tokens[-1]], 6, stop_on_eos=False)
+    assert c1.tokens == c2.tokens
+
+
+def test_chunk_under_tp_matches(model_files):
+    base = InferenceEngine(*model_files, temperature=0.0, tp=1)
+    rb = base.generate("hello world", 12, stop_on_eos=False)
+    tp = InferenceEngine(*model_files, temperature=0.0, tp=4, decode_chunk=4)
+    rt = tp.generate("hello world", 12, stop_on_eos=False)
+    assert rb.tokens == rt.tokens
