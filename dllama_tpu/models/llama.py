@@ -321,10 +321,12 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     q = apply_rope(q, cos, sin, positions, cfg.rope_type)
     k = apply_rope(k, cos, sin, positions, cfg.rope_type)
 
+    ragged = start_pos.ndim > 0  # per-row positions (batched serving)
     sp_res = None
     plan = _current_plan()
     if plan is not None and plan.axis_size("sp") > 1 \
-            and plan.axis_size("pp") == 1:  # sp×pp nesting unsupported
+            and plan.axis_size("pp") == 1 \
+            and not ragged:  # sp×pp nesting / sp×ragged unsupported
         from ..parallel.ring import sp_attention
 
         sp_res = sp_attention(plan, q, k_cache, v_cache, k, v, positions,
@@ -333,13 +335,20 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
         att, k_cache, v_cache = sp_res
     else:
         k_cache, v_cache = update_layer(k_cache, v_cache, k, v, start_pos)
-        att = (_sharded_flash(cfg, plan, q, k_cache, v_cache, start_pos)
-               if plan is not None else None)
-        if att is None:
-            if _use_flash(cfg, q.shape, k_cache.shape):
-                att = flash_attention(q, k_cache, v_cache, start_pos, cfg.head_dim)
-            else:
-                att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
+        if ragged:
+            # per-row positions: the flash kernels derive causality from a
+            # single affine start_pos; the oracle masks on the positions
+            # array and handles any per-row depth
+            att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
+        else:
+            att = (_sharded_flash(cfg, plan, q, k_cache, v_cache, start_pos)
+                   if plan is not None else None)
+            if att is None:
+                if _use_flash(cfg, q.shape, k_cache.shape):
+                    att = flash_attention(q, k_cache, v_cache, start_pos,
+                                          cfg.head_dim)
+                else:
+                    att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
     att = constrain(att, "batch", None, "heads", None)
     x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo, in_axis="heads"))
     x = constrain(x, "batch", None, None)
@@ -431,11 +440,18 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     """Full forward: ``tokens [B, T]`` at absolute ``start_pos`` → logits.
 
     Returns float32 logits ``[B, T, vocab]`` and the updated cache. Jittable;
-    ``start_pos`` is a traced scalar so prefill chunks and decode steps reuse
-    one compilation per ``T``.
+    ``start_pos`` is a traced scalar (all rows at one position) or a ``[B]``
+    vector — per-row positions for ragged batched serving
+    (runtime/serving.py), where each slot of the batch is its own sequence
+    at its own depth. One compilation per ``T`` either way.
     """
+    start_pos = jnp.asarray(start_pos, dtype=jnp.int32)
+    ragged = start_pos.ndim > 0
     plan = _current_plan()
     if plan is not None and plan.axis_size("pp") > 1:
+        if ragged:
+            raise ValueError("per-row positions (batched serving) do not "
+                             "compose with pp yet")
         # pipeline parallelism: layer stack sharded over pp, stages hand the
         # activation along the ring (parallel/pipeline.py — new capability)
         from ..parallel.pipeline import pp_forward
@@ -447,7 +463,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     x = constrain(x, "batch", None, None)
 
     cos, sin = build_rope_cache(cfg)
-    positions = start_pos + jnp.arange(T, dtype=jnp.int32)[None, :]
+    arange = jnp.arange(T, dtype=jnp.int32)[None, :]
+    positions = (start_pos[:, None] if ragged else start_pos) + arange
     positions = jnp.broadcast_to(positions, (B, T))
 
     def body(carry, xs):

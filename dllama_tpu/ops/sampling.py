@@ -61,15 +61,28 @@ def mult_sample(probs: jax.Array, coin: jax.Array) -> jax.Array:
 
 def sampled_token(logits: jax.Array, temperature: jax.Array, topp: jax.Array,
                   coin: jax.Array) -> jax.Array:
-    """Sample one token per row of ``logits [B, V]`` (temperature > 0 path;
-    the greedy path is models.llama.greedy_step). ``topp`` outside (0, 1)
-    selects plain multinomial, matching the host oracle."""
-    probs = jax.nn.softmax(logits.astype(jnp.float32) / temperature, axis=-1)
+    """Sample one token per row of ``logits [B, V]``.
 
-    def pick(row):
+    ``temperature``/``topp``/``coin`` are scalars (the single-sequence
+    engine; temperature > 0 guaranteed by the caller) or ``[B]`` vectors
+    (ragged batched serving): per-row knobs, with ``temperature <= 0`` rows
+    taking the greedy argmax — one fused program covers a mixed batch.
+    ``topp`` outside (0, 1) selects plain multinomial, matching the host
+    oracle."""
+    logits = logits.astype(jnp.float32)
+    B = logits.shape[0]
+    temp = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(temperature)), (B,))
+    topp_v = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(topp)), (B,))
+    coin_v = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(coin)), (B,))
+    safe_t = jnp.where(temp > 0.0, temp, 1.0)
+    probs = jax.nn.softmax(logits / safe_t[:, None], axis=-1)
+
+    def pick(row, tp, cn):
         return jax.lax.cond(
-            (topp > 0.0) & (topp < 1.0),
-            lambda: topp_sample(row, topp, coin),
-            lambda: mult_sample(row, coin))
+            (tp > 0.0) & (tp < 1.0),
+            lambda: topp_sample(row, tp, cn),
+            lambda: mult_sample(row, cn))
 
-    return jax.vmap(pick)(probs)
+    sampled = jax.vmap(pick)(probs, topp_v, coin_v)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(temp > 0.0, sampled, greedy)

@@ -50,12 +50,21 @@ def update_layer(k_layer: jax.Array, v_layer: jax.Array, new_k: jax.Array,
     """Write ``new_k/new_v: [B, T, n_kv, hd]`` at ``start_pos`` (OP_SHIFT).
 
     The new rows arrive time-major from the QKV matmuls and are laid down
-    head-major into the cache.
-    """
-    zero = jnp.zeros((), dtype=jnp.int32)
-    idx = (zero, zero, start_pos.astype(jnp.int32), zero)
-    new_k = jnp.swapaxes(new_k, 1, 2)  # [B, n_kv, T, hd]
-    new_v = jnp.swapaxes(new_v, 1, 2)
-    k_layer = jax.lax.dynamic_update_slice(k_layer, new_k.astype(k_layer.dtype), idx)
-    v_layer = jax.lax.dynamic_update_slice(v_layer, new_v.astype(v_layer.dtype), idx)
-    return k_layer, v_layer
+    head-major into the cache. ``start_pos`` is a scalar (all rows at the
+    same position — the single-sequence engine) or a ``[B]`` vector
+    (per-row positions — ragged batched serving, runtime/serving.py)."""
+    new_k = jnp.swapaxes(new_k, 1, 2).astype(k_layer.dtype)  # [B, n_kv, T, hd]
+    new_v = jnp.swapaxes(new_v, 1, 2).astype(v_layer.dtype)
+    start_pos = start_pos.astype(jnp.int32)
+    if start_pos.ndim == 0:
+        zero = jnp.zeros((), dtype=jnp.int32)
+        idx = (zero, zero, start_pos, zero)
+        return (jax.lax.dynamic_update_slice(k_layer, new_k, idx),
+                jax.lax.dynamic_update_slice(v_layer, new_v, idx))
+
+    def row(cache_b, rows_b, pos_b):  # [n_kv, S, hd], [n_kv, T, hd], scalar
+        zero = jnp.zeros((), dtype=jnp.int32)
+        return jax.lax.dynamic_update_slice(cache_b, rows_b, (zero, pos_b, zero))
+
+    return (jax.vmap(row)(k_layer, new_k, start_pos),
+            jax.vmap(row)(v_layer, new_v, start_pos))

@@ -1,0 +1,270 @@
+"""Continuous batched serving — multiple independent sequences, one program.
+
+New capability with no reference analogue (the reference is strictly
+single-sequence: one KV cache, one position, SURVEY.md §2.2 "prefill
+micro-batching ... Not multi-request batching"). Decode on TPU at batch 1 is
+HBM-bandwidth-bound — the whole weight set streams per token for ONE row of
+output — so serving throughput scales almost linearly with concurrent
+sequences until compute saturates. This module adds that axis:
+
+* a fixed pool of ``n_slots`` sequence slots sharing one KV cache
+  ``[L, n_slots, n_kv, S, hd]`` and ONE jitted ragged decode step (per-row
+  positions, per-row temperature/top-p/coin — temp 0 rows take argmax), so
+  a mixed greedy/sampled batch is a single dispatch;
+* per-slot prefill that gathers the slot's cache column, runs the ordinary
+  chunked prefill on it, and scatters it back — new requests join without
+  recompiling anything (all shapes static);
+* a :class:`BatchScheduler` that queues requests beyond the pool, retires
+  slots on EOS/limits, and streams tokens per request — the engine-room of
+  an OpenAI-style serving front end (serve/api.py ``--batch-slots``).
+
+Determinism: each request carries its own xorshift seed and consumes its own
+coin stream, so a request's output is independent of what shares the batch
+with it (tested in test_serving.py) — the serving twin of the reference's
+fixed-seed reproducibility.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import forward, sampled_step
+from ..tokenizer.sampler import xorshift_random_f32
+from .kvcache import KVCache
+
+if TYPE_CHECKING:
+    from .engine import InferenceEngine
+
+_MASK64 = (1 << 64) - 1
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt_ids: list[int]
+    max_tokens: int
+    temperature: float = 0.0
+    topp: float = 0.9
+    seed: int = 0xB1A5
+    stop_on_eos: bool = True
+    on_token: Callable[[int, str | None], None] | None = None
+    # filled by the generator:
+    tokens: list[int] = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+    # set by the CLIENT to stop decoding early (e.g. a stop STRING matched in
+    # the emitted text — the raw-token EOS check can't see those); the slot
+    # is retired at the next step boundary
+    cancel: threading.Event = field(default_factory=threading.Event)
+    rng_state: int = 0
+    error: str | None = None
+    decoder: object = None  # per-request streaming UTF-8 decoder
+
+    def __post_init__(self):
+        self.rng_state = self.seed & _MASK64
+
+
+class BatchedGenerator:
+    """Slot pool + the ragged batched decode step. Not thread-safe by itself
+    (the scheduler serializes access)."""
+
+    def __init__(self, engine: "InferenceEngine", n_slots: int = 4):
+        if engine.sp > 1 or engine.pp > 1:
+            raise ValueError("batched serving composes with tp/dp only "
+                             "(ragged positions over sp/pp is future work)")
+        if engine.multihost:
+            raise ValueError("batched serving is single-host for now")
+        self.eng = engine
+        self.cfg = engine.cfg
+        self.n_slots = n_slots
+        dtype = jnp.dtype(self.cfg.compute_dtype)
+        kv = KVCache.create(self.cfg, batch_size=n_slots, dtype=dtype)
+        if engine.plan is not None:
+            from ..parallel.sharding import kv_cache_sharding
+
+            kv = jax.device_put(kv, kv_cache_sharding(engine.plan, kv))
+        self.kv = kv
+        self.pos = np.zeros(n_slots, dtype=np.int32)
+        self.next_token = np.zeros(n_slots, dtype=np.int32)
+        self.slots: list[Request | None] = [None] * n_slots
+
+        # one fused ragged step: forward + per-row sample (greedy rows mixed
+        # in via temperature 0); same jitted function family as the engine's
+        self._step = jax.jit(sampled_step, static_argnums=1,
+                             donate_argnums=(4,))
+        self._prefill_fwd = jax.jit(forward, static_argnums=1,
+                                    donate_argnums=(4,))
+        # slot-column gather/scatter for per-slot prefill
+        self._take = jax.jit(
+            lambda kv, b: KVCache(
+                k=jax.lax.dynamic_slice_in_dim(kv.k, b, 1, axis=1),
+                v=jax.lax.dynamic_slice_in_dim(kv.v, b, 1, axis=1)))
+        self._put = jax.jit(
+            lambda kv, col, b: KVCache(
+                k=jax.lax.dynamic_update_slice_in_dim(kv.k, col.k, b, axis=1),
+                v=jax.lax.dynamic_update_slice_in_dim(kv.v, col.v, b, axis=1)),
+            donate_argnums=(0,))
+
+    # -- slot lifecycle -----------------------------------------------------
+
+    def free_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+    @property
+    def n_active(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    def admit(self, req: Request, slot: int) -> None:
+        """Prefill the request's prompt into ``slot`` and arm it for decode.
+
+        The slot's cache column is gathered to a [L, 1, ...] view, prefilled
+        with the ordinary chunked forward (scalar positions), and scattered
+        back — other slots keep decoding between scheduler steps untouched."""
+        ids = req.prompt_ids
+        assert ids, "empty prompt"
+        if len(ids) >= self.cfg.seq_len:
+            raise ValueError(f"prompt of {len(ids)} tokens exceeds seq_len "
+                             f"{self.cfg.seq_len}")
+        col = self._take(self.kv, slot)
+        pos = 0
+        n_b = self.eng.n_batches
+        rest = ids[:-1]
+        i = 0
+        while i < len(rest):
+            chunk = rest[i:i + n_b]
+            pad_to = min(n_b, self.cfg.seq_len - pos)
+            padded = chunk + [0] * (pad_to - len(chunk))
+            _, col = self._prefill_fwd(self.eng.params, self.cfg,
+                                       jnp.asarray([padded], dtype=jnp.int32),
+                                       jnp.int32(pos), col)
+            pos += len(chunk)
+            i += len(chunk)
+        self.kv = self._put(self.kv, col, slot)
+        self.pos[slot] = pos
+        self.next_token[slot] = ids[-1]
+        if self.eng.tokenizer is not None:
+            # per-request streaming decoder: a shallow copy shares the vocab
+            # tables but owns its UTF-8 carry-over, so interleaved slots
+            # can't corrupt each other's multi-byte sequences
+            import copy
+
+            req.decoder = copy.copy(self.eng.tokenizer)
+            req.decoder._pending = bytearray()
+        self.slots[slot] = req
+
+    def _retire(self, slot: int) -> None:
+        req = self.slots[slot]
+        self.slots[slot] = None
+        req.done.set()
+
+    # -- the batched step ---------------------------------------------------
+
+    def step(self) -> int:
+        """One ragged decode step for every active slot; returns the number
+        of tokens emitted. Inactive slots ride along as temp-0 rows writing
+        into their own (unused) cache positions — static shapes, one
+        compiled program regardless of occupancy."""
+        for i, s in enumerate(self.slots):  # client-cancelled slots retire
+            if s is not None and s.cancel.is_set():
+                self._retire(i)
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return 0
+        temps = np.zeros(self.n_slots, dtype=np.float32)
+        topps = np.zeros(self.n_slots, dtype=np.float32)
+        coins = np.zeros(self.n_slots, dtype=np.float32)
+        for i in active:
+            req = self.slots[i]
+            temps[i] = req.temperature
+            topps[i] = req.topp
+            if req.temperature > 0.0:
+                coins[i], req.rng_state = xorshift_random_f32(req.rng_state)
+
+        nxt, self.kv = self._step(
+            self.eng.params, self.cfg,
+            jnp.asarray(self.next_token[:, None]),
+            jnp.asarray(self.pos), self.kv,
+            jnp.asarray(temps), jnp.asarray(topps), jnp.asarray(coins))
+        nxt = np.asarray(nxt)
+
+        emitted = 0
+        tok = self.eng.tokenizer
+        for i in active:
+            req = self.slots[i]
+            t = int(nxt[i])
+            self.pos[i] += 1
+            self.next_token[i] = t
+            req.tokens.append(t)
+            emitted += 1
+            piece = req.decoder.decode(t) if req.decoder is not None else None
+            if req.on_token is not None:
+                req.on_token(t, piece)
+            eos = (req.stop_on_eos and tok is not None and tok.is_eos(t))
+            if (eos or len(req.tokens) >= req.max_tokens
+                    or self.pos[i] >= self.cfg.seq_len):
+                self._retire(i)
+        return emitted
+
+
+class BatchScheduler:
+    """Thread-safe front end: queue beyond the slot pool + a step loop.
+
+    HTTP handler threads call :meth:`generate` (blocking) or submit+wait;
+    a single background thread owns the generator and runs admit/step."""
+
+    def __init__(self, engine: "InferenceEngine", n_slots: int = 4):
+        self.gen = BatchedGenerator(engine, n_slots)
+        self._queue: list[Request] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._next_rid = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, prompt_ids: list[int], max_tokens: int, *,
+               temperature: float = 0.0, topp: float = 0.9,
+               seed: int = 0xB1A5, stop_on_eos: bool = True,
+               on_token=None) -> Request:
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            req = Request(rid=rid, prompt_ids=list(prompt_ids),
+                          max_tokens=max_tokens, temperature=temperature,
+                          topp=topp, seed=seed, stop_on_eos=stop_on_eos,
+                          on_token=on_token)
+            self._queue.append(req)
+        self._wake.set()
+        return req
+
+    def generate(self, prompt_ids: list[int], max_tokens: int,
+                 **kw) -> list[int]:
+        req = self.submit(prompt_ids, max_tokens, **kw)
+        req.done.wait()
+        return req.tokens
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=30)
+
+    def _loop(self) -> None:
+        while not self._stop:
+            with self._lock:
+                while self._queue and self.gen.free_slots():
+                    req = self._queue.pop(0)
+                    try:
+                        self.gen.admit(req, self.gen.free_slots()[0])
+                    except Exception as e:  # noqa: BLE001 — reject, don't wedge
+                        req.error = f"{type(e).__name__}: {e}"
+                        req.done.set()
+            if self.gen.n_active == 0:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            self.gen.step()

@@ -10,18 +10,27 @@ Endpoint-compatible with the reference server (reference: src/dllama-api.cpp):
   conversation continues from its cached position instead of re-prefilling
   (dllama-api.cpp:294-339).
 
-Built on http.server (stdlib) rather than hand-parsed sockets; single-threaded
-by design — the engine serializes on one accelerator anyway, matching the
-reference's accept loop.
+Built on http.server (stdlib) rather than hand-parsed sockets. Two serving
+modes:
+
+* default: single-threaded, one sequence at a time with the NaiveCache —
+  matching the reference's accept loop;
+* ``--batch-slots N``: a ThreadingHTTPServer front end over the continuous
+  batching scheduler (runtime/serving.py) — N concurrent sequences share one
+  ragged decode program, requests beyond the pool queue, every request's
+  output is identical to a solo run. New capability; the reference is
+  strictly one-request-at-a-time. (Prefix KV reuse is per-engine state and
+  is disabled in batched mode.)
 """
 
 from __future__ import annotations
 
 import json
+import queue
 import time
 import uuid
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, HTTPServer
+from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
 from ..runtime.engine import InferenceEngine
 from ..tokenizer.chat import ChatItem, ChatTemplateGenerator, EosDetector, EosResult
@@ -58,6 +67,37 @@ class NaiveCache:
         for m in messages:
             self.items.append(CachedMessage(m.get("role", ""), m.get("content", ""),
                                             end_pos))
+
+
+class _EosGate:
+    """EosDetector + text accumulation + delta emission, shared by both
+    serving modes so EOS/stop-string semantics can't drift between them."""
+
+    def __init__(self, tok, stop_pieces, emit=None):
+        max_stop = max((len(s) for s in stop_pieces), default=0)
+        self.detector = EosDetector(tok.eos_token_ids, stop_pieces,
+                                    max_stop, max_stop)
+        self.emit = emit
+        self.parts: list[str] = []
+
+    def _out(self, d: str) -> None:
+        if d:
+            self.parts.append(d)
+            if self.emit:
+                self.emit(d)
+
+    def feed(self, token: int, piece: str | None) -> bool:
+        """Process one decoded token; True when a stop sequence completed."""
+        res = self.detector.append(token, piece)
+        if res in (EosResult.NOT_EOS, EosResult.EOS):
+            self._out(self.detector.get_delta())
+            self.detector.reset()
+        return res == EosResult.EOS
+
+    def flush_tail(self) -> None:
+        """Emit text still buffered as a MAYBE_EOS prefix when generation
+        ends by length — otherwise up to max_stop chars silently vanish."""
+        self._out(self.detector.get_delta())
 
 
 class ApiState:
@@ -110,49 +150,116 @@ class ApiState:
                        prompt_end + max_tokens if max_tokens > 0 else engine.cfg.seq_len)
         self.cache.push(delta, prompt_end)
 
-        text_parts: list[str] = []
+        gate = _EosGate(tok, self.stop_pieces, emit)
         if prompt.public_prompt:
-            text_parts.append(prompt.public_prompt)
-            if emit:
-                emit(prompt.public_prompt)
+            gate._out(prompt.public_prompt)
 
         if len(ids) > 1:
             engine.prefill(ids[: prompt_end - start_pos])
         token = ids[prompt_end - start_pos] if prompt_end - start_pos < len(ids) else ids[-1]
         tok.reset_decoder()
-        detector = EosDetector(tok.eos_token_ids, self.stop_pieces,
-                               max((len(s) for s in self.stop_pieces), default=0),
-                               max((len(s) for s in self.stop_pieces), default=0))
 
         n_completion = 0
         finish_reason = "length"
         while engine.pos < max_pred:
             token = engine.next_token(token)
             n_completion += 1
-            piece = tok.decode(token)
-            res = detector.append(token, piece)
-            if res in (EosResult.NOT_EOS, EosResult.EOS):
-                d = detector.get_delta()
-                if d:
-                    text_parts.append(d)
-                    if emit:
-                        emit(d)
-                detector.reset()
-            if res == EosResult.EOS:
+            if gate.feed(token, tok.decode(token)):
                 finish_reason = "stop"
                 break
+        if finish_reason == "length":
+            gate.flush_tail()
 
-        self.cache.push([{"role": "assistant", "content": "".join(text_parts)}],
+        self.cache.push([{"role": "assistant", "content": "".join(gate.parts)}],
                         engine.pos)
         return {
-            "text": "".join(text_parts),
+            "text": "".join(gate.parts),
             "finish_reason": finish_reason,
             "prompt_tokens": len(ids),
             "completion_tokens": n_completion,
         }
 
 
-def _completion_json(state: ApiState, out: dict) -> dict:
+class BatchedApiState:
+    """Continuous-batching twin of :class:`ApiState`: same ``complete``
+    contract, requests fan into the BatchScheduler and decode concurrently.
+    Handler threads block on a per-request queue fed by the scheduler
+    thread's ``on_token`` callback."""
+
+    def __init__(self, engine: InferenceEngine, n_slots: int,
+                 model_name: str = "dllama-tpu"):
+        from ..runtime.serving import BatchScheduler
+
+        self.engine = engine
+        self.model_name = model_name
+        tok = engine.tokenizer
+        eos_piece = (tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
+                     if tok.eos_token_ids else "")
+        self.template = ChatTemplateGenerator(tok.chat_template, eos=eos_piece)
+        self.stop_pieces = [tok.vocab[t].decode("utf-8", "replace")
+                            for t in tok.eos_token_ids]
+        self.sched = BatchScheduler(engine, n_slots)
+
+    def close(self) -> None:
+        self.sched.close()
+
+    def complete(self, body: dict, emit=None) -> dict:
+        tok = self.engine.tokenizer
+        messages = body.get("messages", [])
+        if not messages:
+            raise ValueError("messages required")
+        items = [ChatItem(m.get("role", "user"), m.get("content", ""))
+                 for m in messages]
+        prompt = self.template.generate(items, append_generation_prompt=True)
+        ids = tok.encode(prompt.content, is_start=True, add_special_tokens=True)
+        max_tokens = int(body.get("max_tokens") or 0)
+        if max_tokens <= 0:
+            max_tokens = max(1, self.engine.cfg.seq_len - len(ids))
+
+        sampler = self.engine.sampler  # CLI flags are the per-request defaults
+        q: queue.Queue = queue.Queue()
+        req = self.sched.submit(
+            ids, max_tokens,
+            temperature=float(body.get("temperature", sampler.temperature)),
+            topp=float(body.get("top_p", sampler.topp)),
+            seed=int(body.get("seed", 0xB1A5)),
+            stop_on_eos=True,
+            on_token=lambda t, p: q.put((t, p)))
+
+        gate = _EosGate(tok, self.stop_pieces, emit)
+        if prompt.public_prompt:
+            gate._out(prompt.public_prompt)
+        n_completion = 0
+        finish_reason = "length"
+        while True:
+            try:
+                t, piece = q.get(timeout=0.1)
+            except queue.Empty:
+                if req.done.is_set() and q.empty():
+                    break
+                continue
+            n_completion += 1
+            if gate.feed(t, piece):
+                # stop STRING matched (spelled by ordinary tokens — the
+                # scheduler's raw-eos check can't see it): cancel the slot
+                # so it stops burning batch steps, and stop consuming
+                finish_reason = "stop"
+                req.cancel.set()
+                break
+        req.done.wait()
+        if finish_reason == "length":
+            gate.flush_tail()
+        if req.error:
+            raise ValueError(req.error)
+        return {
+            "text": "".join(gate.parts),
+            "finish_reason": finish_reason,
+            "prompt_tokens": len(ids),
+            "completion_tokens": n_completion,
+        }
+
+
+def _completion_json(state, out: dict) -> dict:
     return {
         "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
         "object": "chat.completion",
@@ -253,8 +360,15 @@ def run_api_server(args) -> int:
     from .cli import make_engine
 
     engine = make_engine(args)
-    state = ApiState(engine)
-    server = HTTPServer((args.host, args.port), make_handler(state))
+    n_slots = getattr(args, "batch_slots", 0) or 0
+    if n_slots > 1:
+        state: ApiState | BatchedApiState = BatchedApiState(engine, n_slots)
+        server = ThreadingHTTPServer((args.host, args.port),
+                                     make_handler(state))
+        print(f"🕸️ continuous batching: {n_slots} slots")
+    else:
+        state = ApiState(engine)
+        server = HTTPServer((args.host, args.port), make_handler(state))
     print(f"🕸️ listening on http://{args.host}:{args.port}")
     try:
         server.serve_forever()
@@ -262,5 +376,7 @@ def run_api_server(args) -> int:
         pass
     finally:
         server.server_close()
+        if isinstance(state, BatchedApiState):
+            state.close()
         engine.close()
     return 0

@@ -78,6 +78,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's per-step-type executor timers")
     p.add_argument("--port", type=int, default=9990, help="api mode port")
     p.add_argument("--host", default="127.0.0.1", help="api mode bind host")
+    p.add_argument("--batch-slots", type=int, default=0, metavar="N",
+                   help="api mode: continuous batching over N concurrent "
+                        "sequence slots (one ragged decode program; requests "
+                        "queue beyond the pool). 0/1 = single-sequence mode "
+                        "with prefix KV reuse")
     # multi-host SPMD (replaces the reference's --workers TCP list; every
     # process — root and workers — runs the same binary with the same model
     # files, reference runWorkerApp → parallel.multihost):

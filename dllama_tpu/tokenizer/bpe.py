@@ -236,5 +236,10 @@ class Tokenizer:
                 checkpoint_src = i
         self._pending = bytearray(src[checkpoint_src:])
         if checkpoint > 0:
-            return out[:checkpoint].decode("utf-8")
+            # errors="replace": the structural scan above validates lead/
+            # continuation SHAPE only — a length-complete sequence can still
+            # be invalid UTF-8 (overlong like f0 88 8f 83, surrogates,
+            # > U+10FFFF). Those become U+FFFD instead of crashing the
+            # stream, consistent with the byte-level recovery path.
+            return out[:checkpoint].decode("utf-8", errors="replace")
         return None

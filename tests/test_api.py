@@ -113,3 +113,110 @@ def test_unknown_route(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(url + "/nope", timeout=30)
     assert e.value.code == 404
+
+
+# -- continuous batching mode (--batch-slots; runtime/serving.py) ----------
+
+
+@pytest.fixture(scope="module")
+def batched_server(tmp_path_factory):
+    from http.server import ThreadingHTTPServer
+
+    from dllama_tpu.serve.api import BatchedApiState
+
+    d = tmp_path_factory.mktemp("api_batched")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(9)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    td = byte_vocab_tokenizer()
+    td.chat_template = "<|start_header_id|>"  # detected as llama3
+    tfile.write_tfile(tpath, td)
+    engine = InferenceEngine(str(mpath), str(tpath), temperature=0.0, seed=3)
+    state = BatchedApiState(engine, n_slots=2)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}", state
+    httpd.shutdown()
+    state.close()
+
+
+def test_batched_concurrent_requests_complete_and_are_deterministic(batched_server):
+    """4 concurrent HTTP requests through 2 slots: all finish, and identical
+    request bodies (same seed) produce identical completions regardless of
+    what shared the batch."""
+    url, _ = batched_server
+    bodies = [
+        {"messages": [{"role": "user", "content": "hello"}],
+         "max_tokens": 6, "temperature": 0},
+        {"messages": [{"role": "user", "content": "world"}],
+         "max_tokens": 6, "temperature": 0.8, "seed": 5},
+        {"messages": [{"role": "user", "content": "hello"}],
+         "max_tokens": 6, "temperature": 0},
+        {"messages": [{"role": "user", "content": "hi there"}],
+         "max_tokens": 4, "temperature": 0},
+    ]
+    results: dict[int, dict] = {}
+    errs: list = []
+
+    def call(i):
+        try:
+            with _post(url, bodies[i]) as r:
+                results[i] = json.loads(r.read())
+        except Exception as e:  # noqa: BLE001
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errs, errs
+    assert len(results) == 4
+    for i, data in results.items():
+        assert data["usage"]["completion_tokens"] >= 1, i
+    # identical bodies 0 and 2 → identical text (batch-composition invariant)
+    a = results[0]["choices"][0]["message"]["content"]
+    b = results[2]["choices"][0]["message"]["content"]
+    assert a == b
+
+
+def test_batched_sse_stream(batched_server):
+    url, _ = batched_server
+    with _post(url, {"messages": [{"role": "user", "content": "hello"}],
+                     "max_tokens": 5, "temperature": 0, "stream": True}) as r:
+        raw = r.read().decode()
+    assert "data: [DONE]" in raw
+    chunks = [json.loads(ln[len("data: "):]) for ln in raw.splitlines()
+              if ln.startswith("data: ") and "[DONE]" not in ln]
+    assert any(c["choices"][0]["delta"].get("content") for c in chunks)
+
+
+def test_eos_gate_flushes_maybe_eos_tail():
+    """Generation ending by LENGTH with a buffered stop-piece prefix must
+    flush that text instead of silently truncating (review finding)."""
+    from dllama_tpu.serve.api import _EosGate
+
+    class FakeTok:
+        eos_token_ids = [999]
+
+    gate = _EosGate(FakeTok(), ["<|eot|>"])
+    assert not gate.feed(1, "hi ")
+    assert not gate.feed(2, "<|eo")  # MAYBE_EOS: buffered, not emitted
+    assert "".join(gate.parts) == "hi "
+    gate.flush_tail()
+    assert "".join(gate.parts) == "hi <|eo"
+
+
+def test_batched_defaults_to_engine_sampler_settings(batched_server):
+    """A body without 'temperature' must use the engine's CLI temperature
+    (here 0.0 → greedy): two such requests give identical text even without
+    a seed, and match an explicit temperature=0 request."""
+    url, _ = batched_server
+    body = {"messages": [{"role": "user", "content": "abc"}], "max_tokens": 5}
+    with _post(url, body) as r:
+        a = json.loads(r.read())["choices"][0]["message"]["content"]
+    with _post(url, dict(body, temperature=0)) as r:
+        b = json.loads(r.read())["choices"][0]["message"]["content"]
+    assert a == b

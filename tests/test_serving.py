@@ -1,0 +1,162 @@
+"""Continuous batched serving (runtime/serving.py).
+
+THE correctness property: a request's output is byte-identical to running it
+alone on the single-sequence engine — batch composition, admission order, and
+slot reuse must be invisible. This extends the node-count-invariance test
+philosophy (SURVEY.md §4) to the serving axis the reference doesn't have."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.runtime.serving import BatchedGenerator, BatchScheduler, Request
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+PATHS = {}
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    d = tmp_path_factory.mktemp("serving")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(41)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    PATHS["m"], PATHS["t"] = str(mpath), str(tpath)
+    return InferenceEngine(str(mpath), str(tpath), tp=1)
+
+
+def solo(temperature=0.0, seed=7):
+    """Fresh single-sequence engine on the same files — the oracle."""
+    return InferenceEngine(PATHS["m"], PATHS["t"], tp=1,
+                           temperature=temperature, seed=seed)
+
+
+def test_batched_matches_solo_mixed_greedy_and_sampled(engine):
+    """Four concurrent requests — different prompts, lengths, greedy and
+    sampled, different seeds — each must equal its solo run."""
+    prompts = ["hello world", "hello", " world hello world", "hell"]
+    specs = [dict(temperature=0.0, seed=1), dict(temperature=0.8, seed=2),
+             dict(temperature=0.0, seed=3), dict(temperature=1.2, seed=4)]
+    n = 10
+
+    want = []
+    for p, s in zip(prompts, specs):
+        e = solo(temperature=s["temperature"], seed=s["seed"])
+        want.append(e.generate(p, n, stop_on_eos=False).tokens)
+
+    gen = BatchedGenerator(engine, n_slots=4)
+    reqs = []
+    for i, (p, s) in enumerate(zip(prompts, specs)):
+        ids = engine.tokenizer.encode(p, is_start=True)
+        r = Request(rid=i, prompt_ids=ids, max_tokens=n, stop_on_eos=False,
+                    temperature=s["temperature"], topp=0.9, seed=s["seed"])
+        gen.admit(r, i)
+        reqs.append(r)
+    while gen.n_active:
+        gen.step()
+    for r, w in zip(reqs, want):
+        assert r.tokens == w, r.rid
+
+
+def test_batched_slot_reuse_and_staggered_admission(engine):
+    """Requests admitted mid-flight into freed slots must still match solo
+    runs (stale KV from the previous occupant must be invisible)."""
+    n_long, n_short = 12, 4
+    want_long = solo().generate("hello world", n_long, stop_on_eos=False).tokens
+    want_a = solo(temperature=0.9, seed=9).generate(
+        "hello", n_short, stop_on_eos=False).tokens
+    want_b = solo(temperature=0.9, seed=9).generate(
+        " world", n_short, stop_on_eos=False).tokens
+
+    gen = BatchedGenerator(engine, n_slots=2)
+    enc = lambda p: engine.tokenizer.encode(p, is_start=True)
+    r_long = Request(rid=0, prompt_ids=enc("hello world"),
+                     max_tokens=n_long, stop_on_eos=False)
+    r_a = Request(rid=1, prompt_ids=enc("hello"), max_tokens=n_short,
+                  stop_on_eos=False, temperature=0.9, seed=9)
+    gen.admit(r_long, 0)
+    gen.admit(r_a, 1)
+    while not r_a.done.is_set():
+        gen.step()
+    # slot 1 freed mid-run of r_long: admit r_b into it
+    r_b = Request(rid=2, prompt_ids=enc(" world"), max_tokens=n_short,
+                  stop_on_eos=False, temperature=0.9, seed=9)
+    gen.admit(r_b, 1)
+    while gen.n_active:
+        gen.step()
+    assert r_long.tokens == want_long
+    assert r_a.tokens == want_a
+    assert r_b.tokens == want_b
+
+
+def test_scheduler_queues_beyond_slots(engine):
+    """6 requests through 2 slots: all complete, each equals its solo run."""
+    sched = BatchScheduler(engine, n_slots=2)
+    try:
+        prompts = ["hello", " world", "hello world", "hell", "he", " w"]
+        n = 5
+        want = [solo().generate(p, n, stop_on_eos=False).tokens
+                for p in prompts]
+        reqs = [sched.submit(engine.tokenizer.encode(p, is_start=True), n,
+                             stop_on_eos=False) for p in prompts]
+        for r, w in zip(reqs, want):
+            assert r.done.wait(timeout=300)
+            assert r.error is None
+            assert r.tokens == w
+    finally:
+        sched.close()
+
+
+def test_scheduler_rejects_oversized_prompt(engine):
+    sched = BatchScheduler(engine, n_slots=2)
+    try:
+        r = sched.submit(list(range(1, 200)), 4)  # > seq_len 96
+        assert r.done.wait(timeout=60)
+        assert r.error is not None and "seq_len" in r.error
+    finally:
+        sched.close()
+
+
+def test_streaming_decoders_are_independent(engine):
+    """Interleaved slots must not corrupt each other's UTF-8 streaming."""
+    gen = BatchedGenerator(engine, n_slots=2)
+    pieces: dict[int, list] = {0: [], 1: []}
+    enc = lambda p: engine.tokenizer.encode(p, is_start=True)
+    for rid, prompt in ((0, "hello"), (1, " world")):
+        r = Request(rid=rid, prompt_ids=enc(prompt), max_tokens=6,
+                    stop_on_eos=False,
+                    on_token=lambda t, p, rid=rid: pieces[rid].append(p))
+        gen.admit(r, rid)
+        if rid == 0:
+            gen.step()  # stagger so decoders interleave
+    while gen.n_active:
+        gen.step()
+    # every emitted piece decodes through the request's own stream
+    for rid in (0, 1):
+        assert len([p for p in pieces[rid] if p is not None]) > 0
+
+
+def test_cancel_retires_slot_next_step(engine):
+    """Client-side cancel (stop-string matched in the text layer) frees the
+    slot at the next step boundary while other slots continue."""
+    gen = BatchedGenerator(engine, n_slots=2)
+    enc = lambda p: engine.tokenizer.encode(p, is_start=True)
+    r0 = Request(rid=0, prompt_ids=enc("hello"), max_tokens=50,
+                 stop_on_eos=False)
+    r1 = Request(rid=1, prompt_ids=enc(" world"), max_tokens=6,
+                 stop_on_eos=False)
+    gen.admit(r0, 0)
+    gen.admit(r1, 1)
+    gen.step()
+    r0.cancel.set()
+    gen.step()
+    assert r0.done.is_set() and len(r0.tokens) == 1  # no token after cancel
+    while gen.n_active:
+        gen.step()
+    assert len(r1.tokens) == 6  # neighbor unaffected

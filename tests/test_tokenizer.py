@@ -348,3 +348,24 @@ def test_encode_100k_chars_under_2s(tok):
     dt = time.perf_counter() - t0
     assert dt < 2.0, f"encode took {dt:.2f}s"
     assert tok.decode_all(ids) == text
+
+
+def test_decode_overlong_utf8_replaced_not_crash(tok):
+    """A length-complete but INVALID UTF-8 sequence (overlong f0 88 8f 83)
+    must stream as replacement characters, not raise — regression for a
+    crash surfaced by random-token serving streams."""
+    out = []
+    for b in (0xF0, 0x88, 0x8F, 0x83, ord("A")):
+        p = tok.decode(b)
+        if p is not None:
+            out.append(p)
+    s = "".join(out)
+    assert "A" in s and "�" in s
+
+
+def test_decode_surrogate_bytes_replaced(tok):
+    # ed a0 80 is a UTF-8-encoded surrogate half: structurally complete,
+    # strictly invalid
+    out = [p for b in (0xED, 0xA0, 0x80, ord("B")) if (p := tok.decode(b))]
+    s = "".join(out)
+    assert "B" in s and "�" in s
