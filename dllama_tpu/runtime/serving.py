@@ -69,6 +69,19 @@ class Request:
         self.rng_state = self.seed & _MASK64
 
 
+@dataclass
+class _Admission:
+    """In-flight incremental prefill of one request into one slot.
+
+    ``pos`` doubles as the prompt cursor: exactly ``pos`` prompt tokens have
+    been prefilled, at positions ``[0, pos)``."""
+
+    req: Request
+    slot: int
+    col: KVCache  # the slot's gathered cache column, being filled
+    pos: int = 0
+
+
 class BatchedGenerator:
     """Slot pool + the ragged batched decode step. Not thread-safe by itself
     (the scheduler serializes access)."""
@@ -119,34 +132,38 @@ class BatchedGenerator:
     def n_active(self) -> int:
         return sum(s is not None for s in self.slots)
 
-    def admit(self, req: Request, slot: int) -> None:
-        """Prefill the request's prompt into ``slot`` and arm it for decode.
-
-        The slot's cache column is gathered to a [L, 1, ...] view, prefilled
-        with the ordinary chunked forward (scalar positions), and scattered
-        back — other slots keep decoding between scheduler steps untouched."""
+    def begin_admit(self, req: Request, slot: int) -> "_Admission":
+        """Start admitting a request into ``slot``: the slot's cache column
+        is gathered to a [L, 1, ...] view and prefilled INCREMENTALLY — one
+        n_batches chunk per :meth:`continue_admit` call — so a long prompt
+        never stalls the active slots' decode steps (the scheduler
+        interleaves chunks with :meth:`step`)."""
         ids = req.prompt_ids
         assert ids, "empty prompt"
         if len(ids) >= self.cfg.seq_len:
             raise ValueError(f"prompt of {len(ids)} tokens exceeds seq_len "
                              f"{self.cfg.seq_len}")
-        col = self._take(self.kv, slot)
-        pos = 0
-        n_b = self.eng.n_batches
-        rest = ids[:-1]
-        i = 0
-        while i < len(rest):
-            chunk = rest[i:i + n_b]
-            pad_to = min(n_b, self.cfg.seq_len - pos)
+        return _Admission(req=req, slot=slot, col=self._take(self.kv, slot))
+
+    def continue_admit(self, adm: "_Admission") -> bool:
+        """Run one prefill chunk; True when the slot is armed for decode."""
+        rest = adm.req.prompt_ids[:-1]
+        if adm.pos < len(rest):
+            n_b = self.eng.n_batches
+            chunk = rest[adm.pos:adm.pos + n_b]
+            pad_to = min(n_b, self.cfg.seq_len - adm.pos)
             padded = chunk + [0] * (pad_to - len(chunk))
-            _, col = self._prefill_fwd(self.eng.params, self.cfg,
-                                       jnp.asarray([padded], dtype=jnp.int32),
-                                       jnp.int32(pos), col)
-            pos += len(chunk)
-            i += len(chunk)
-        self.kv = self._put(self.kv, col, slot)
-        self.pos[slot] = pos
-        self.next_token[slot] = ids[-1]
+            _, adm.col = self._prefill_fwd(
+                self.eng.params, self.cfg,
+                jnp.asarray([padded], dtype=jnp.int32),
+                jnp.int32(adm.pos), adm.col)
+            adm.pos += len(chunk)
+            if adm.pos < len(rest):
+                return False
+        self.kv = self._put(self.kv, adm.col, adm.slot)
+        self.pos[adm.slot] = adm.pos
+        self.next_token[adm.slot] = adm.req.prompt_ids[-1]
+        req = adm.req
         if self.eng.tokenizer is not None:
             # per-request streaming decoder: a shallow copy shares the vocab
             # tables but owns its UTF-8 carry-over, so interleaved slots
@@ -155,7 +172,14 @@ class BatchedGenerator:
 
             req.decoder = copy.copy(self.eng.tokenizer)
             req.decoder._pending = bytearray()
-        self.slots[slot] = req
+        self.slots[adm.slot] = req
+        return True
+
+    def admit(self, req: Request, slot: int) -> None:
+        """Admit in one go (tests / non-interleaved callers)."""
+        adm = self.begin_admit(req, slot)
+        while not self.continue_admit(adm):
+            pass
 
     def _retire(self, slot: int) -> None:
         req = self.slots[slot]
@@ -220,6 +244,7 @@ class BatchScheduler:
     def __init__(self, engine: "InferenceEngine", n_slots: int = 4):
         self.gen = BatchedGenerator(engine, n_slots)
         self._queue: list[Request] = []
+        self._admissions: list[_Admission] = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._next_rid = 0
@@ -255,15 +280,38 @@ class BatchScheduler:
 
     def _loop(self) -> None:
         while not self._stop:
+            reserved = {a.slot for a in self._admissions}
             with self._lock:
-                while self._queue and self.gen.free_slots():
+                # start admissions into free, unreserved slots
+                while self._queue:
+                    free = [s for s in self.gen.free_slots()
+                            if s not in reserved]
+                    if not free:
+                        break
                     req = self._queue.pop(0)
                     try:
-                        self.gen.admit(req, self.gen.free_slots()[0])
+                        adm = self.gen.begin_admit(req, free[0])
                     except Exception as e:  # noqa: BLE001 — reject, don't wedge
                         req.error = f"{type(e).__name__}: {e}"
                         req.done.set()
-            if self.gen.n_active == 0:
+                        continue
+                    self._admissions.append(adm)
+                    reserved.add(adm.slot)
+            # ONE prefill chunk per in-flight admission per loop tick, so a
+            # long prompt interleaves with (not stalls) active decode steps
+            for adm in list(self._admissions):
+                if adm.req.cancel.is_set():
+                    self._admissions.remove(adm)
+                    adm.req.done.set()
+                    continue
+                try:
+                    if self.gen.continue_admit(adm):
+                        self._admissions.remove(adm)
+                except Exception as e:  # noqa: BLE001 — reject, don't wedge
+                    self._admissions.remove(adm)
+                    adm.req.error = f"{type(e).__name__}: {e}"
+                    adm.req.done.set()
+            if self.gen.n_active == 0 and not self._admissions:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue

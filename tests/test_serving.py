@@ -160,3 +160,43 @@ def test_cancel_retires_slot_next_step(engine):
     while gen.n_active:
         gen.step()
     assert len(r1.tokens) == 6  # neighbor unaffected
+
+
+def test_incremental_prefill_interleaves_with_decode(tmp_path_factory):
+    """A long prompt admitted mid-flight must NOT stall active decodes: with
+    chunked admission, the active slot emits tokens BETWEEN the newcomer's
+    prefill chunks — and both outputs still match their solo runs."""
+    d = tmp_path_factory.mktemp("serving_inc")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(41)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    # tiny n_batches: the long prompt needs many prefill chunks
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1, n_batches=4)
+    long_ids = [int(x) for x in np.random.default_rng(3).integers(1, 200, 40)]
+
+    solo_a = InferenceEngine(str(mpath), str(tpath), tp=1, n_batches=4)
+    want_a = solo_a.generate("hello world", 16, stop_on_eos=False).tokens
+    solo_b = InferenceEngine(str(mpath), str(tpath), tp=1, n_batches=4)
+    want_b = solo_b.generate(long_ids, 4, stop_on_eos=False).tokens
+
+    gen = BatchedGenerator(eng, n_slots=2)
+    r_a = Request(rid=0, prompt_ids=eng.tokenizer.encode("hello world",
+                                                         is_start=True),
+                  max_tokens=16, stop_on_eos=False)
+    gen.admit(r_a, 0)
+    gen.step()  # r_a decoding
+    a_before = len(r_a.tokens)
+
+    r_b = Request(rid=1, prompt_ids=long_ids, max_tokens=4, stop_on_eos=False)
+    adm = gen.begin_admit(r_b, 1)
+    interleaved = 0
+    while not gen.continue_admit(adm):
+        gen.step()  # active slot keeps decoding between prefill chunks
+        interleaved += 1
+    assert interleaved >= 5  # 39 prompt tokens / 4 per chunk
+    assert len(r_a.tokens) > a_before  # r_a made progress during admission
+    while gen.n_active:
+        gen.step()
+    assert r_a.tokens == want_a
+    assert r_b.tokens == want_b
