@@ -20,12 +20,19 @@ constraints (any ``n_layers % pp == 0`` works).
 Design (TPU-native, single program): ``jax.shard_map`` manual over ``pp``
 only — ``tp``/``dp`` stay AUTO inside, so the exact same ``_layer_step``
 (with its logical-axis sharding constraints) runs within each stage.
-Each device holds ``n_layers / pp`` stacked layers + their KV slices; the
-forward runs ``pp`` ticks of [cond(stage == tick): scan local layers] →
-``ppermute`` the activation to the next stage, then a masked ``psum``
-replicates the last stage's output. Decode latency is the sum of stage
-times (inherent to pipelining at batch 1); microbatch interleaving over dp
-is future work.
+Each device holds ``n_layers / pp`` stacked layers + their KV slices. Two
+schedules, chosen statically by batch shape:
+
+* **sequential** (B not divisible by pp, incl. single-sequence decode):
+  ``pp`` ticks of [cond(stage == tick): scan local layers] → ``ppermute``
+  the activation onward; latency is the sum of stage times (inherent to
+  batch-1 pipelining).
+* **GPipe microbatch** (B >= pp and divisible): the batch splits into pp
+  microbatches flowing through the stages concurrently — stage d computes
+  microbatch j-d at tick j, stage 0 injects a fresh microbatch each tick,
+  the last stage accumulates outputs; utilization M/(M+pp-1).
+
+A masked ``psum`` replicates the final output either way.
 """
 
 from __future__ import annotations
@@ -76,20 +83,76 @@ def pp_forward(plan: "MeshPlan", cfg: "ModelConfig", params, tokens, start_pos,
     positions = jnp.broadcast_to(positions, (B, T))
     perm = [(i, (i + 1) % n_pp) for i in range(n_pp)]
 
+    # GPipe microbatching: with B divisible by n_pp (and per-row positions
+    # not in play), the batch splits into n_pp microbatches that flow through
+    # the stages concurrently — stage d works on microbatch j-d at tick j, so
+    # utilization is M/(M+n_pp-1) instead of the sequential schedule's 1/n_pp.
+    microbatched = n_pp > 1 and B % n_pp == 0
+
     def local(x, layers_l, k_l, v_l, cos, sin, sp0, pos):
         stage = lax.axis_index(AXIS)
 
-        def run(carry):
-            x, k_l, v_l = carry
-
+        def run_layers(x, k, v, pos_rows):
             def body(xc, xs):
                 lp, k1, v1 = xs
                 xo, k1, v1 = _layer_step(cfg, xc, lp, k1, v1, cos, sin,
-                                         sp0, pos)
+                                         sp0, pos_rows)
                 return xo, (k1, v1)
 
-            x, (k_l, v_l) = lax.scan(body, x, (layers_l, k_l, v_l))
+            x, (k, v) = lax.scan(body, x, (layers_l, k, v))
+            return x, k, v
+
+        if microbatched:
+            M = n_pp
+            mbs = B // M
+            zero = jnp.int32(0)
+
+            def tick(j, carry):
+                x_cur, k_l, v_l, out_acc = carry
+                m = j - stage                     # this stage's microbatch
+                active = (m >= 0) & (m < M)
+                row0 = jnp.clip(m, 0, M - 1) * mbs
+                # stage 0's input is the injected microbatch j (where m == j,
+                # so row0 indexes it); later stages consume what the ring
+                # delivered last tick
+                inject = lax.dynamic_slice_in_dim(x, row0, mbs, axis=0)
+                x_use = jnp.where(stage == 0, inject, x_cur)
+                k_mb = lax.dynamic_slice_in_dim(k_l, row0, mbs, axis=1)
+                v_mb = lax.dynamic_slice_in_dim(v_l, row0, mbs, axis=1)
+                pos_mb = lax.dynamic_slice_in_dim(pos, row0, mbs, axis=0)
+
+                def run(c):
+                    x_use, k_mb, v_mb = c
+                    return run_layers(x_use, k_mb, v_mb, pos_mb)
+
+                x_new, k_new, v_new = lax.cond(
+                    active, run, lambda c: c, (x_use, k_mb, v_mb))
+                # inactive ticks write back the unchanged slices — a no-op,
+                # so no extra select is needed around the updates
+                k_l = lax.dynamic_update_slice(
+                    k_l, k_new, (zero, row0, zero, zero, zero))
+                v_l = lax.dynamic_update_slice(
+                    v_l, v_new, (zero, row0, zero, zero, zero))
+                # the last stage produced microbatch m's final activation
+                out_acc = jnp.where(
+                    active & (stage == n_pp - 1),
+                    lax.dynamic_update_slice(out_acc, x_new, (row0, zero, zero)),
+                    out_acc)
+                x_cur = lax.ppermute(x_new, AXIS, perm)
+                return x_cur, k_l, v_l, out_acc
+
+            x0 = jnp.zeros((mbs, T, x.shape[2]), dtype=x.dtype)
+            out0 = jnp.zeros_like(x)
+            _, k_l, v_l, out_acc = lax.fori_loop(
+                0, M + n_pp - 1, tick, (x0, k_l, v_l, out0))
+            x = lax.psum(
+                jnp.where(stage == n_pp - 1, out_acc, jnp.zeros_like(out_acc)),
+                AXIS)
             return x, k_l, v_l
+
+        def run(carry):
+            x, k_l, v_l = carry
+            return run_layers(x, k_l, v_l, pos)
 
         def tick(s, carry):
             x, k_l, v_l = carry
