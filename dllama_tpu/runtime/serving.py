@@ -27,6 +27,7 @@ fixed-seed reproducibility.
 from __future__ import annotations
 
 import threading
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable
 
@@ -35,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.llama import forward, sampled_step
+from ..parallel.api import use_plan
 from ..tokenizer.sampler import xorshift_random_f32
 from .kvcache import KVCache
 
@@ -145,6 +147,10 @@ class BatchedGenerator:
                              f"{self.cfg.seq_len}")
         return _Admission(req=req, slot=slot, col=self._take(self.kv, slot))
 
+    def _plan_ctx(self):
+        return (use_plan(self.eng.plan) if self.eng.plan is not None
+                else nullcontext())
+
     def continue_admit(self, adm: "_Admission") -> bool:
         """Run one prefill chunk; True when the slot is armed for decode."""
         rest = adm.req.prompt_ids[:-1]
@@ -153,10 +159,11 @@ class BatchedGenerator:
             chunk = rest[adm.pos:adm.pos + n_b]
             pad_to = min(n_b, self.cfg.seq_len - adm.pos)
             padded = chunk + [0] * (pad_to - len(chunk))
-            _, adm.col = self._prefill_fwd(
-                self.eng.params, self.cfg,
-                jnp.asarray([padded], dtype=jnp.int32),
-                jnp.int32(adm.pos), adm.col)
+            with self._plan_ctx():
+                _, adm.col = self._prefill_fwd(
+                    self.eng.params, self.cfg,
+                    jnp.asarray([padded], dtype=jnp.int32),
+                    jnp.int32(adm.pos), adm.col)
             adm.pos += len(chunk)
             if adm.pos < len(rest):
                 return False
@@ -209,11 +216,12 @@ class BatchedGenerator:
             if req.temperature > 0.0:
                 coins[i], req.rng_state = xorshift_random_f32(req.rng_state)
 
-        nxt, self.kv = self._step(
-            self.eng.params, self.cfg,
-            jnp.asarray(self.next_token[:, None]),
-            jnp.asarray(self.pos), self.kv,
-            jnp.asarray(temps), jnp.asarray(topps), jnp.asarray(coins))
+        with self._plan_ctx():
+            nxt, self.kv = self._step(
+                self.eng.params, self.cfg,
+                jnp.asarray(self.next_token[:, None]),
+                jnp.asarray(self.pos), self.kv,
+                jnp.asarray(temps), jnp.asarray(topps), jnp.asarray(coins))
         nxt = np.asarray(nxt)
 
         emitted = 0

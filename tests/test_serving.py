@@ -5,8 +5,6 @@ alone on the single-sequence engine — batch composition, admission order, and
 slot reuse must be invisible. This extends the node-count-invariance test
 philosophy (SURVEY.md §4) to the serving axis the reference doesn't have."""
 
-import threading
-
 import numpy as np
 import pytest
 
@@ -196,6 +194,35 @@ def test_incremental_prefill_interleaves_with_decode(tmp_path_factory):
         interleaved += 1
     assert interleaved >= 5  # 39 prompt tokens / 4 per chunk
     assert len(r_a.tokens) > a_before  # r_a made progress during admission
+    while gen.n_active:
+        gen.step()
+    assert r_a.tokens == want_a
+    assert r_b.tokens == want_b
+
+
+def test_batched_under_tp_matches_solo(tmp_path_factory):
+    """Batched serving composes with tensor parallelism: tp=4 engine, mixed
+    batch, each request equals its solo tp=4 run."""
+    d = tmp_path_factory.mktemp("serving_tp")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(41)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    eng = InferenceEngine(str(mpath), str(tpath), tp=4)
+
+    s1 = InferenceEngine(str(mpath), str(tpath), tp=4)
+    want_a = s1.generate("hello world", 8, stop_on_eos=False).tokens
+    s2 = InferenceEngine(str(mpath), str(tpath), tp=4, temperature=0.8, seed=6)
+    want_b = s2.generate("hello", 8, stop_on_eos=False).tokens
+
+    gen = BatchedGenerator(eng, n_slots=2)
+    enc = lambda p: eng.tokenizer.encode(p, is_start=True)
+    r_a = Request(rid=0, prompt_ids=enc("hello world"), max_tokens=8,
+                  stop_on_eos=False)
+    r_b = Request(rid=1, prompt_ids=enc("hello"), max_tokens=8,
+                  stop_on_eos=False, temperature=0.8, seed=6)
+    gen.admit(r_a, 0)
+    gen.admit(r_b, 1)
     while gen.n_active:
         gen.step()
     assert r_a.tokens == want_a
