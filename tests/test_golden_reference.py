@@ -50,6 +50,7 @@ def _engine_for(variant: str, tmp_path, tp: int) -> tuple[InferenceEngine, dict]
     ("llama31_q40", 2),
     ("qwen3_q40", 2),
     ("llama_deep_f32", 1),  # 8 layers × 292 pieces: accumulation-order drift
+    ("qwen3_deep_f32", 1),  # deep per-head-norm + neox-rope coverage
     pytest.param("llama_macbeth_f32", 1, marks=pytest.mark.slow),  # 2049 steps
 ])
 def test_transcript_matches_reference(variant, tp, tmp_path):
