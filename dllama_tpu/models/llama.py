@@ -376,25 +376,39 @@ def greedy_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), kv
 
 
+def scan_decode(step1, token: jax.Array, start_pos: jax.Array, kv: KVCache,
+                n_steps: int, coins: jax.Array | None = None):
+    """The one multi-step decode scan shared by every chunked variant
+    (greedy/sampled × plain/replicated): feeds each picked token into the
+    next forward on device. ``step1(tokens_2d, pos, kv[, coin])`` is the
+    single-step function; returns ``(tokens [B, n_steps], kv)``."""
+
+    def body(carry, xs):
+        token, kv = carry
+        if coins is None:
+            nxt, kv = step1(token[:, None], start_pos + xs, kv)
+        else:
+            i, coin = xs
+            nxt, kv = step1(token[:, None], start_pos + i, kv, coin)
+        return (nxt, kv), nxt
+
+    xs = jnp.arange(n_steps, dtype=jnp.int32)
+    (_, kv), toks = jax.lax.scan(
+        body, (token, kv), xs if coins is None else (xs, coins))
+    return jnp.moveaxis(toks, 0, 1), kv  # [B, n_steps]
+
+
 def greedy_steps(params: Params, cfg: ModelConfig, token: jax.Array,
                  start_pos: jax.Array, kv: KVCache,
                  n_steps: int) -> tuple[jax.Array, KVCache]:
-    """``n_steps`` fused greedy decode steps in ONE dispatch: the sampled
-    token feeds the next forward on device (lax.scan), so the host pays one
-    dispatch + one ``4·n_steps``-byte transfer per CHUNK instead of per
-    token. Output is bit-identical to ``n_steps`` single greedy_step calls
-    (greedy is deterministic); the caller truncates at EOS — tokens past it
-    are discarded work, not divergence. ``token: [B]`` seeds the chunk;
-    returns ``(tokens [B, n_steps], kv)``."""
-
-    def body(carry, i):
-        token, kv = carry
-        nxt, kv = greedy_step(params, cfg, token[:, None], start_pos + i, kv)
-        return (nxt, kv), nxt
-
-    (_, kv), toks = jax.lax.scan(
-        body, (token, kv), jnp.arange(n_steps, dtype=jnp.int32))
-    return jnp.moveaxis(toks, 0, 1), kv  # [B, n_steps]
+    """``n_steps`` fused greedy decode steps in ONE dispatch — one dispatch
+    + one ``4·n_steps``-byte transfer per CHUNK instead of per token. Output
+    is bit-identical to ``n_steps`` single greedy_step calls (greedy is
+    deterministic); the caller truncates at EOS — tokens past it are
+    discarded work, not divergence. ``token: [B]`` seeds the chunk."""
+    return scan_decode(
+        lambda t, p, kv: greedy_step(params, cfg, t, p, kv),
+        token, start_pos, kv, n_steps)
 
 
 def sampled_steps(params: Params, cfg: ModelConfig, token: jax.Array,
@@ -405,18 +419,10 @@ def sampled_steps(params: Params, cfg: ModelConfig, token: jax.Array,
     are the host xorshift draws for the whole chunk (the host rewinds its
     RNG to the number of tokens actually kept after EOS truncation, so the
     stream stays bit-identical to single-step decode)."""
-
-    def body(carry, xs):
-        token, kv = carry
-        i, coin = xs
-        nxt, kv = sampled_step(params, cfg, token[:, None], start_pos + i, kv,
-                               temperature, topp, coin)
-        return (nxt, kv), nxt
-
-    (_, kv), toks = jax.lax.scan(
-        body, (token, kv),
-        (jnp.arange(n_steps, dtype=jnp.int32), coins))
-    return jnp.moveaxis(toks, 0, 1), kv
+    return scan_decode(
+        lambda t, p, kv, c: sampled_step(params, cfg, t, p, kv,
+                                         temperature, topp, c),
+        token, start_pos, kv, n_steps, coins=coins)
 
 
 def sampled_step(params: Params, cfg: ModelConfig, tokens: jax.Array,

@@ -42,6 +42,12 @@ CTRL_STEP = 1
 CTRL_GREEDY = 2
 CTRL_RESET = 3
 CTRL_SAMPLED = 4
+# chunked decode (engine --decode-chunk under multihost): ONE packet per K
+# tokens instead of per token — the control-channel RPC amortizes with the
+# dispatch. Payload layout: token in slot 3, the K sampled-path coins as f32
+# bits in slots 4..4+K, temp/topp in the trailing scalar slots.
+CTRL_GREEDY_CHUNK = 5
+CTRL_SAMPLED_CHUNK = 6
 
 
 class RootLostError(RuntimeError):
@@ -115,6 +121,33 @@ class ControlCodec:
         kind, t, start_pos = int(buf[0]), int(buf[1]), int(buf[2])
         scalars = buf[-3:].view(np.float32)
         return kind, buf[3:3 + t].reshape(1, t), start_pos, scalars
+
+    def max_chunk(self) -> int:
+        """Largest decode chunk a packet can carry (coins fill the token
+        slots after the seed token)."""
+        return self.n_batches - 1
+
+    def encode_chunk(self, kind: int, token: int, start_pos: int,
+                     n_steps: int, coins=None,
+                     temp: float = 0.0, topp: float = 0.0) -> np.ndarray:
+        assert n_steps <= self.max_chunk(), (n_steps, self.n_batches)
+        buf = np.zeros(self.width, dtype=np.int32)
+        buf[0] = kind
+        buf[1] = n_steps
+        buf[2] = start_pos
+        buf[3] = token
+        if coins is not None:
+            buf[4:4 + n_steps] = np.asarray(coins, np.float32).view(np.int32)
+        buf[-3:-1] = np.asarray([temp, topp], np.float32).view(np.int32)
+        return buf
+
+    @staticmethod
+    def decode_chunk_packet(buf: np.ndarray):
+        buf = np.ascontiguousarray(buf)
+        k = int(buf[1])
+        coins = buf[4:4 + k].view(np.float32).copy()
+        temp, topp = buf[-3:-1].view(np.float32)
+        return int(buf[3]), int(buf[2]), k, coins, float(temp), float(topp)
 
     @staticmethod
     def _client():
@@ -279,6 +312,30 @@ def replicated_sampled(params, cfg, tokens, start_pos, kv,
     return constrain(tok, None), kv
 
 
+def replicated_greedy_steps(params, cfg, token, start_pos, kv, n_steps):
+    """Chunked decode with replicated output: the shared scan
+    (models.llama.scan_decode) over the replicated single step."""
+    from ..models.llama import scan_decode
+    from .api import constrain
+
+    toks, kv = scan_decode(
+        lambda t, p, kv: replicated_greedy(params, cfg, t, p, kv),
+        token, start_pos, kv, n_steps)
+    return constrain(toks, None, None), kv
+
+
+def replicated_sampled_steps(params, cfg, token, start_pos, kv, temperature,
+                             topp, coins, n_steps):
+    from ..models.llama import scan_decode
+    from .api import constrain
+
+    toks, kv = scan_decode(
+        lambda t, p, kv, c: replicated_sampled(params, cfg, t, p, kv,
+                                               temperature, topp, c),
+        token, start_pos, kv, n_steps, coins=coins)
+    return constrain(toks, None, None), kv
+
+
 def worker_serve(engine: "InferenceEngine", *,
                  timeout_s: float | None = None) -> int:
     """Run the worker side: mirror every root dispatch until STOP.
@@ -296,7 +353,8 @@ def worker_serve(engine: "InferenceEngine", *,
     codec = engine._ctrl
     served = 0
     while True:
-        kind, tokens, start_pos, scalars = codec.decode(codec.recv(timeout_s))
+        buf = codec.recv(timeout_s)
+        kind, tokens, start_pos, scalars = codec.decode(buf)
         if kind == CTRL_STOP:
             return served
         if kind == CTRL_RESET:
@@ -306,6 +364,10 @@ def worker_serve(engine: "InferenceEngine", *,
         elif kind == CTRL_SAMPLED:
             engine._dispatch(engine._sampled_step, tokens, start_pos,
                              extras=tuple(scalars))
+        elif kind in (CTRL_GREEDY_CHUNK, CTRL_SAMPLED_CHUNK):
+            token, sp0, k, coins, temp, topp = codec.decode_chunk_packet(buf)
+            engine._run_chunk(token, sp0, k, kind == CTRL_GREEDY_CHUNK,
+                              temp, topp, coins)
         else:
             engine._dispatch(engine._step, tokens, start_pos)
         served += 1

@@ -114,10 +114,15 @@ class InferenceEngine:
         # multi-step fused decode: K tokens per dispatch (lax.scan feeds the
         # picked token back on device; models.llama.greedy_steps). Output is
         # identical to single-step — EOS overshoot is truncated on host and
-        # the sampler RNG rewound to the kept count. Multihost stays at 1
-        # (the control channel ships one packet per dispatch).
-        self.decode_chunk = 1 if (multihost or host_sampling) \
-            else max(1, decode_chunk)
+        # the sampler RNG rewound to the kept count. Under multihost the
+        # chunk also amortizes the control channel: ONE packet per K tokens
+        # (coins ride the packet), capped by the packet's coin capacity.
+        self.decode_chunk = 1 if host_sampling else max(1, decode_chunk)
+        if multihost and self.decode_chunk > max(1, self.n_batches - 1):
+            raise ValueError(
+                f"decode_chunk {self.decode_chunk} exceeds the control "
+                f"packet's capacity of {self.n_batches - 1} coins "
+                f"(raise --nbatches or lower --decode-chunk)")
 
         n_dev = len(jax.devices())
         if tp is None:
@@ -173,7 +178,9 @@ class InferenceEngine:
             from ..parallel.multihost import (
                 replicated_forward,
                 replicated_greedy,
+                replicated_greedy_steps,
                 replicated_sampled,
+                replicated_sampled_steps,
             )
 
             self._step = jax.jit(replicated_forward, static_argnums=1,
@@ -182,6 +189,12 @@ class InferenceEngine:
                                         donate_argnums=(4,))
             self._sampled_step = jax.jit(replicated_sampled, static_argnums=1,
                                          donate_argnums=(4,))
+            self._greedy_steps = jax.jit(replicated_greedy_steps,
+                                         static_argnums=(1, 5),
+                                         donate_argnums=(4,))
+            self._sampled_steps = jax.jit(replicated_sampled_steps,
+                                          static_argnums=(1, 8),
+                                          donate_argnums=(4,))
         else:
             self._step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
             # greedy fast path: argmax fused into the step — ONE dispatch per
@@ -326,25 +339,43 @@ class InferenceEngine:
         Overshoot KV rows beyond the committed count are invisible (causal
         mask) and rewritten by the next tokens at those positions — the same
         safety argument as padded prefill tails (module docstring)."""
-        assert not self.multihost and not self.host_sampling
+        assert not self.host_sampling
         k = min(k, self.cfg.seq_len - self.pos)
         assert k >= 1
+        greedy = self.sampler.temperature == 0.0
+        coins = None
+        if not greedy:
+            coins = np.empty(k, dtype=np.float32)
+            st = self.sampler.rng_state
+            for i in range(k):
+                coins[i], st = xorshift_random_f32(st)
+        if self.multihost and self._is_root:
+            from ..parallel.multihost import CTRL_GREEDY_CHUNK, CTRL_SAMPLED_CHUNK
+
+            self._ctrl.send(self._ctrl.encode_chunk(
+                CTRL_GREEDY_CHUNK if greedy else CTRL_SAMPLED_CHUNK,
+                token, self.pos, k, coins=coins,
+                temp=self.sampler.temperature, topp=self.sampler.topp))
+        toks = self._run_chunk(token, self.pos, k, greedy,
+                               self.sampler.temperature, self.sampler.topp,
+                               coins)
+        return [int(t) for t in toks[0]]
+
+    def _run_chunk(self, token: int, start_pos: int, k: int, greedy: bool,
+                   temp: float, topp: float, coins) -> np.ndarray:
+        """Dispatch one fused K-step decode (root and worker replay path)."""
         tok0 = jnp.asarray([token], dtype=jnp.int32)
         with (use_plan(self.plan) if self.plan is not None else nullcontext()):
-            if self.sampler.temperature == 0.0:
+            if greedy:
                 toks, self.kv = self._greedy_steps(
-                    self.params, self.cfg, tok0, jnp.int32(self.pos),
+                    self.params, self.cfg, tok0, jnp.int32(start_pos),
                     self.kv, k)
             else:
-                coins = np.empty(k, dtype=np.float32)
-                st = self.sampler.rng_state
-                for i in range(k):
-                    coins[i], st = xorshift_random_f32(st)
                 toks, self.kv = self._sampled_steps(
-                    self.params, self.cfg, tok0, jnp.int32(self.pos), self.kv,
-                    jnp.float32(self.sampler.temperature),
-                    jnp.float32(self.sampler.topp), jnp.asarray(coins), k)
-        return [int(t) for t in np.asarray(toks[0])]
+                    self.params, self.cfg, tok0, jnp.int32(start_pos),
+                    self.kv, jnp.float32(temp), jnp.float32(topp),
+                    jnp.asarray(coins, dtype=jnp.float32), k)
+        return np.asarray(toks)
 
     def commit_chunk(self, n_keep: int) -> None:
         """Advance position and sampler RNG by the kept prefix of a chunk."""

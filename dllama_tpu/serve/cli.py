@@ -95,7 +95,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--worker-timeout", type=float, default=None, metavar="SEC",
                    help="worker mode: exit if no control packet arrives for "
                         "SEC seconds (root presumed dead; default: wait "
-                        "forever, matching a long-idle root)")
+                        "forever, matching a long-idle root). NOTE: size it "
+                        "for the INTER-PACKET gap — a root using "
+                        "--decode-chunk K sends one packet per K tokens")
     p.add_argument("--worker-reserve", action="store_true",
                    help="worker mode: on root loss, re-exec this process and "
                         "wait for a new root at the same coordinator address "

@@ -243,6 +243,59 @@ def test_two_process_sp_sampled_decode(tiny_files):
     assert "served" in wtxt and "served 0" not in wtxt, wtxt[-1000:]
 
 
+# root driving chunked sampled decode over the control channel: one packet
+# per K tokens, coins riding the packet
+CHUNK_ROOT_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, sys.argv[1])
+    from dllama_tpu.parallel.multihost import init_distributed
+    init_distributed(sys.argv[2], 2, 0, platform="cpu")
+    from dllama_tpu.runtime.engine import InferenceEngine
+    eng = InferenceEngine(sys.argv[3], sys.argv[4], tp=2, temperature=0.8,
+                          topp=0.9, seed=31, decode_chunk=4, multihost=True)
+    res = eng.generate([1, 2, 3], max_tokens=9, stop_on_eos=False)
+    print("TOKENS=" + ",".join(map(str, res.tokens)), flush=True)
+    eng.close()
+""")
+
+
+@pytest.mark.slow
+def test_two_process_chunked_decode(tiny_files):
+    """decode_chunk=4 under multihost: the root ships one packet per chunk
+    (coins included), the worker replays the fused K-step program, and the
+    tokens equal a single-process decode_chunk=1 run with the same seed."""
+    m, t = tiny_files
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    local = InferenceEngine(m, t, tp=1, temperature=0.8, topp=0.9, seed=31)
+    expect = local.generate([1, 2, 3], max_tokens=9, stop_on_eos=False).tokens
+
+    coord = f"127.0.0.1:{PORT + 5}"
+    root = _spawn_root(CHUNK_ROOT_SCRIPT, coord, m, t)
+    worker = _spawn_worker(coord, m, t, "--buffer-float-type", "f32",
+                           "--decode-chunk", "4")
+    try:
+        root_out, _ = root.communicate(timeout=420)
+        worker_out, _ = worker.communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        root.kill()
+        worker.kill()
+        raise
+    rtxt = root_out.decode(errors="replace")
+    wtxt = worker_out.decode(errors="replace")
+    assert root.returncode == 0, f"root failed:\n{rtxt[-3000:]}"
+    assert worker.returncode == 0, f"worker failed:\n{wtxt[-3000:]}"
+    line = [ln for ln in rtxt.splitlines() if ln.startswith("TOKENS=")]
+    assert line, rtxt[-2000:]
+    got = [int(x) for x in line[0][len("TOKENS="):].split(",")]
+    assert got == expect
+    # 9 tokens = 2 chunk packets (4+4) + 1 single-step tail + prefill, so
+    # far fewer dispatches than tokens
+    served = int(wtxt.split("served ")[-1].split()[0])
+    assert served < 9, wtxt[-500:]
+
+
 @pytest.mark.slow
 def test_fingerprint_mismatch_fails_fast_both_sides(tiny_files):
     """Root and worker started with different program-selecting flags
