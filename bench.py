@@ -315,6 +315,14 @@ def main() -> None:
         "vs_baseline": 0.0,
         "error": None,
     }
+    try:
+        result["git"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or None
+    except Exception:  # noqa: BLE001 — traceability only
+        result["git"] = None
 
     force_platform = os.environ.get("DLLAMA_BENCH_PLATFORM")  # e.g. "cpu" self-test
     if force_platform:
