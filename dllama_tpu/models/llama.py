@@ -116,6 +116,12 @@ def _sharded_flash(cfg: ModelConfig, plan, q, k_cache, v_cache, start_pos):
         # partition; per-stage attention uses the XLA oracle (validate_pp
         # rejects forced 'flash' up front)
         return None
+    if plan.axis_size("sp") > 1 and jnp.asarray(start_pos).ndim > 0:
+        # ragged decode under an sp mesh: the ring path owns sp attention
+        # but assumes affine positions, so per-row depths use the oracle —
+        # even when 'flash' is forced (this is the pre-ragged behavior, not
+        # a silently-missing kernel)
+        return None
     force = cfg.attn_impl == "flash"
     if not force and not _fa.default_enabled():
         return None
@@ -335,20 +341,16 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
         att, k_cache, v_cache = sp_res
     else:
         k_cache, v_cache = update_layer(k_cache, v_cache, k, v, start_pos)
-        if ragged:
-            # per-row positions: the flash kernels derive causality from a
-            # single affine start_pos; the oracle masks on the positions
-            # array and handles any per-row depth
-            att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
-        else:
-            att = (_sharded_flash(cfg, plan, q, k_cache, v_cache, start_pos)
-                   if plan is not None else None)
-            if att is None:
-                if _use_flash(cfg, q.shape, k_cache.shape):
-                    att = flash_attention(q, k_cache, v_cache, start_pos,
-                                          cfg.head_dim)
-                else:
-                    att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
+        # ragged (per-row positions) rides the same kernels: the flash
+        # kernel's position table is blocked per batch row
+        att = (_sharded_flash(cfg, plan, q, k_cache, v_cache, start_pos)
+               if plan is not None else None)
+        if att is None:
+            if _use_flash(cfg, q.shape, k_cache.shape):
+                att = flash_attention(q, k_cache, v_cache, start_pos,
+                                      cfg.head_dim)
+            else:
+                att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
     att = constrain(att, "batch", None, "heads", None)
     x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo, in_axis="heads"))
     x = constrain(x, "batch", None, None)

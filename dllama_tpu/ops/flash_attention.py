@@ -17,8 +17,10 @@ Layouts (chosen together with :mod:`dllama_tpu.runtime.kvcache`):
 
 Causality follows the reference's affine position rule: query row ``r``
 (source position ``start_pos + r // kv_mul``) sees cache slots
-``s <= start_pos + r // kv_mul``; positions are derived in-kernel from the
-``start_pos`` scalar, so no mask tensor is built.
+``s <= start_pos + r // kv_mul``; positions are derived in-kernel from a
+per-batch-row ``(q_pos0, kv_pos0)`` table in SMEM — a scalar ``start_pos``
+broadcasts, a ``[B]`` vector gives every sequence its own depth (ragged
+batched serving) — so no mask tensor is built.
 
 The XLA oracle in :mod:`dllama_tpu.ops.attention` is the semantics reference;
 parity is tested in tests/test_flash_attention.py (the way
@@ -47,7 +49,9 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, *rest,
     ns = pl.num_programs(2)
     # query row r sits at absolute position q_pos0 + r // kv_mul; cache slot c
     # of this call covers absolute position kv_pos0 + c (kv_pos0 != 0 when the
-    # caller holds a mid-sequence block, e.g. a ring-attention KV shard)
+    # caller holds a mid-sequence block, e.g. a ring-attention KV shard).
+    # pos_ref is blocked per batch row, so ragged batches (each sequence at
+    # its own depth — batched serving) read their own q_pos0.
     q_pos0 = pos_ref[0, 0]
     kv_pos0 = pos_ref[0, 1]
 
@@ -123,7 +127,13 @@ def _call(q_g: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     S = k_cache.shape[2]
     bs = _pick_bs(S)
     kv_mul = TQ // t
-    pos = jnp.stack([jnp.int32(start_pos), jnp.int32(kv_pos0)]).reshape(1, 2)
+    # per-batch-row position table [B, 2]: scalar start_pos broadcasts, a
+    # [B] vector (ragged batched serving) lands one row per sequence
+    q_pos = jnp.broadcast_to(
+        jnp.atleast_1d(jnp.asarray(start_pos, jnp.int32)), (B,))
+    kv_pos = jnp.broadcast_to(
+        jnp.atleast_1d(jnp.asarray(kv_pos0, jnp.int32)), (B,))
+    pos = jnp.stack([q_pos, kv_pos], axis=1)
 
     kernel = functools.partial(_kernel, bs=bs, kv_mul=kv_mul, t=t,
                                scale=1.0 / (head_dim ** 0.5), stats=stats)
@@ -140,7 +150,7 @@ def _call(q_g: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         kernel,
         grid=(B, n_kv, S // bs),
         in_specs=[
-            pl.BlockSpec((1, 2), lambda b, h, s: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2), lambda b, h, s: (b, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, TQ, D), lambda b, h, s: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, bs, D), lambda b, h, s: (b, h, s, 0),
@@ -253,11 +263,15 @@ def flash_attention_sharded(plan, q: jax.Array, k_cache: jax.Array,
         return flash_attention(q_l, k_l, v_l, sp0, head_dim,
                                interpret=interpret)
 
+    start_pos = jnp.asarray(start_pos, dtype=jnp.int32)
+    # scalar start_pos replicates; a [B] vector (ragged batched serving)
+    # shards with the batch rows
+    pos_spec = P(dp_ax) if start_pos.ndim else P()
     fn = jax.shard_map(
         local, mesh=plan.mesh,
         in_specs=(P(dp_ax, None, "tp", None), P(dp_ax, "tp", None, None),
-                  P(dp_ax, "tp", None, None), P()),
+                  P(dp_ax, "tp", None, None), pos_spec),
         out_specs=P(dp_ax, None, "tp", None),
         check_vma=False,
     )
-    return fn(q, k_cache, v_cache, start_pos.astype(jnp.int32))
+    return fn(q, k_cache, v_cache, start_pos)

@@ -58,3 +58,61 @@ def test_supports_gate():
     assert not supports((1, 1, 8, 64), 4, 100)      # S not tileable
     assert supports((1, 1, 8, 64), 4, 256)
     assert not supports((1, 2048, 8, 64), 1, 256)   # TQ too large
+
+
+def test_ragged_positions_match_oracle():
+    """Per-row start positions (batched serving): each batch row reads its
+    own q_pos0 from the per-row position table."""
+    B, T, H, n_kv, D, S = 4, 1, 8, 4, 64, 256
+    starts = jnp.asarray([0, 57, 130, 255 - T], dtype=jnp.int32)
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, n_kv, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, n_kv, S, D)), jnp.float32)
+    positions = starts[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    want = attention(q, k, v, positions, D)
+    got = flash_attention(q, k, v, starts, D, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ragged_forward_forced_flash_matches_oracle():
+    """Full model forward with a [B] start_pos vector under attn_impl='flash'
+    and a tp plan (the sharded kernel path threads interpret mode on CPU) vs
+    the attn_impl='xla' oracle — the batched-serving decode step keeps flash
+    on TPU."""
+    from dataclasses import replace
+
+    from dllama_tpu.formats import mfile
+    from dllama_tpu.models import ModelConfig, forward, init_random_params
+    from dllama_tpu.parallel import use_plan
+    from dllama_tpu.parallel.api import make_tp_mesh
+    from dllama_tpu.parallel.sharding import kv_cache_sharding, shard_params
+    from dllama_tpu.runtime import KVCache
+
+    cfg = ModelConfig(
+        arch=mfile.ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2,
+        n_heads=8, n_kv_heads=4, head_dim=8, vocab_size=128, seq_len=128,
+        norm_epsilon=1e-5, rope_theta=10000.0, rope_type=mfile.RopeType.LLAMA,
+        attn_impl="flash")
+    params = init_random_params(cfg, seed=5)
+    tokens = jnp.asarray([[3], [5], [7]], dtype=jnp.int32)
+    starts = jnp.asarray([2, 40, 99], dtype=jnp.int32)
+    kv0 = KVCache.create(cfg, batch_size=3)
+    # seed the caches with history so positions differ meaningfully
+    rng = np.random.default_rng(1)
+    kv0 = KVCache(k=jnp.asarray(rng.standard_normal(kv0.k.shape), jnp.float32),
+                  v=jnp.asarray(rng.standard_normal(kv0.v.shape), jnp.float32))
+
+    ref, _ = jax.jit(forward, static_argnums=1)(
+        params, replace(cfg, attn_impl="xla"), tokens, starts, kv0)
+
+    plan = make_tp_mesh(2)
+    sharded = shard_params(plan, params)
+    kv = jax.device_put(kv0, kv_cache_sharding(plan, kv0))
+    with use_plan(plan):
+        got, _ = jax.jit(forward, static_argnums=1)(
+            sharded, cfg, tokens, starts, kv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
