@@ -19,6 +19,7 @@ runWorkerApp, app.cpp:299-358).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -99,10 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "for the INTER-PACKET gap — a root using "
                         "--decode-chunk K sends one packet per K tokens")
     p.add_argument("--worker-reserve", action="store_true",
-                   help="worker mode: on root loss, re-exec this process and "
-                        "wait for a new root at the same coordinator address "
-                        "(the reference's runWorkerApp outer loop, "
-                        "app.cpp:299-358)")
+                   help="worker mode: run under a supervisor that respawns "
+                        "the worker on root loss and waits for a new root at "
+                        "the same coordinator address (the reference's "
+                        "runWorkerApp outer loop, app.cpp:299-358)")
     # accepted for reference-flag compatibility; no-ops on TPU:
     p.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
     p.add_argument("--workers", nargs="*", default=None, help=argparse.SUPPRESS)
@@ -113,8 +114,6 @@ def build_parser() -> argparse.ArgumentParser:
 def _maybe_init_distributed(args) -> bool:
     """Join the jax.distributed cluster when multi-host flags are present;
     returns True when running multi-host."""
-    import os
-
     if args.nprocs is None or args.nprocs <= 1:
         return False
     from ..parallel.multihost import init_distributed
@@ -276,6 +275,53 @@ def run_perplexity(args) -> int:
     return 0
 
 
+def _worker_supervisor(args) -> int:
+    """--worker-reserve outer loop — the reference worker's while(true)
+    re-serve (app.cpp:299-358) at process granularity: jax.distributed cannot
+    re-initialize in-process, and on coordinator loss the jax client's
+    error-polling thread can LOG(FATAL)-abort the worker before any Python
+    cleanup runs, so resilience must live OUTSIDE the process that holds the
+    distributed client.
+
+    Respawns only on root-loss-shaped exits — our diagnosed rc 3, or a
+    signal/abort death (the fatal-vs-handler race) — with growing backoff;
+    config/startup errors (argparse rc 2, generic rc 1) propagate instead of
+    hot-looping. SIGTERM/SIGINT forward to the child so killing the
+    supervisor never orphans the worker."""
+    import signal
+    import subprocess
+
+    child_env = dict(os.environ, DLLAMA_WORKER_CHILD="1")
+    cmd = [sys.executable, "-m", "dllama_tpu",
+           *getattr(args, "_argv", sys.argv[1:])]
+    state: dict = {"child": None}
+
+    def _forward(sig, _frame):
+        child = state["child"]
+        if child is not None and child.poll() is None:
+            child.terminate()
+        os._exit(128 + sig)
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+
+    backoff = 1.0
+    while True:
+        state["child"] = subprocess.Popen(cmd, env=child_env)
+        rc = state["child"].wait()
+        if rc == 0:
+            return 0  # clean STOP from the root
+        if not (rc == 3 or rc < 0 or rc == 134):
+            # argparse (2), bad model path, jax init errors, ...: permanent
+            print(f"⭕ worker failed rc={rc}; not a root-loss exit — giving "
+                  f"up", flush=True)
+            return rc
+        print(f"⭕ worker exited rc={rc}; re-serving: waiting for a new root",
+              flush=True)
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 30.0)
+
+
 def run_worker(args) -> int:
     """Multi-host worker: join the cluster and co-execute the root's program.
 
@@ -286,6 +332,9 @@ def run_worker(args) -> int:
     (reference: src/app.cpp:299-358; the config/weight wire protocol,
     nn-network.cpp:621-901, is replaced by each host loading its own shards).
     """
+    if args.worker_reserve and not os.environ.get("DLLAMA_WORKER_CHILD"):
+        return _worker_supervisor(args)
+
     import jax
 
     from ..parallel.multihost import RootLostError, init_distributed, worker_serve
@@ -300,30 +349,20 @@ def run_worker(args) -> int:
     try:
         served = worker_serve(engine, timeout_s=args.worker_timeout)
     except RootLostError as e:
-        # Exit/re-exec IMMEDIATELY: the jax distributed client's error-polling
-        # thread LOG(FATAL)s the process moments after a coordinator loss, so
-        # any cleanup here races an abort. os._exit / execv beat it in
-        # practice; either way the worker is down within the bound.
-        import os
-
+        # Exit IMMEDIATELY: the jax client's error-polling abort races any
+        # cleanup here. os._exit(3) usually wins; when it doesn't, the
+        # supervisor (above) treats the abort exit identically.
         print(f"⭕ {e}", flush=True)
-        if args.worker_reserve:
-            # jax.distributed cannot re-initialize in-process: re-exec for a
-            # clean client that blocks waiting for the next root to bind the
-            # coordinator port — the reference worker's outer while(true)
-            # re-serve (app.cpp:299-358) at process granularity.
-            print("⭕ re-serving: waiting for a new root", flush=True)
-            os.execv(sys.executable,
-                     [sys.executable, "-m", "dllama_tpu", *sys.argv[1:]])
         os._exit(3)
     print(f"⭕ worker done: served {served} dispatches")
     return 0
 
 
 def main(argv=None) -> int:
-    import os
-
     args = build_parser().parse_args(argv)
+    # raw argv for the worker supervisor's respawn command: honors explicit
+    # programmatic argv (tests call cli.main([...])), not the host process's
+    args._argv = list(argv) if argv is not None else sys.argv[1:]
     args._multihost = False
     if args.mode != "worker":
         # Honor an explicit JAX_PLATFORMS (e.g. the virtual CPU mesh:
