@@ -46,6 +46,12 @@ def test_quant_matmul_error_bound_on_hw(tpu_backend):
 
 
 def test_flash_attention_parity_on_hw(tpu_backend):
+    """Kernel vs XLA oracle on the MXU. At default matmul precision the MXU
+    runs one bf16 pass per f32 dot, so kernel-vs-oracle differences are
+    accumulation-order noise at bf16 scale (~2.5e-3 measured on v5e) — assert
+    a gross-error bound there. Under HIGHEST (3-pass f32 emulation) both
+    paths are f32-exact and agree to float epsilon."""
+    import jax
     import jax.numpy as jnp
 
     from dllama_tpu.ops.attention import attention
@@ -59,9 +65,14 @@ def test_flash_attention_parity_on_hw(tpu_backend):
     start = jnp.int32(17)
     positions = start + jnp.arange(T, dtype=jnp.int32)[None, :]
 
-    got = np.asarray(flash_attention(q, k, v, start, D))
-    want = np.asarray(attention(q, k, v, positions, D))
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(flash_attention(q, k, v, start, D))
+        want = np.asarray(attention(q, k, v, positions, D))
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    got_d = np.asarray(flash_attention(q, k, v, start, D))
+    want_d = np.asarray(attention(q, k, v, positions, D))
+    assert np.abs(got_d - want_d).max() < 2e-2
 
 
 def test_fused_greedy_decode_on_hw(tpu_backend):
