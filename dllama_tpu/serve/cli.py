@@ -283,18 +283,31 @@ def _worker_supervisor(args) -> int:
     cleanup runs, so resilience must live OUTSIDE the process that holds the
     distributed client.
 
-    Respawns only on root-loss-shaped exits — our diagnosed rc 3, or a
-    signal/abort death (the fatal-vs-handler race) — with growing backoff;
-    config/startup errors (argparse rc 2, generic rc 1) propagate instead of
-    hot-looping. SIGTERM/SIGINT forward to the child so killing the
-    supervisor never orphans the worker."""
+    Exit codes can't classify the death: the jax fatal fires on a C++ thread
+    and exits with a generic rc (observed: 1 — same as any Python traceback)
+    before our handlers run. Instead the child touches a phase-sentinel file
+    the moment it has joined the cluster; the supervisor respawns on ANY
+    nonzero exit that happened after the join (by then config/model/startup
+    are proven good and the only thing left to lose is the root) and
+    propagates pre-join failures (argparse rc 2, bad model path, jax init)
+    instead of hot-looping. Backoff resets once a child has served long
+    enough that the next death is a new incident, not the same flapping
+    root. SIGTERM/SIGINT forward to the child so killing the supervisor
+    never orphans the worker; delivery is blocked across the spawn itself so
+    a signal can't slip between fork/exec and the bookkeeping that lets the
+    handler find the child."""
     import signal
     import subprocess
+    import tempfile
 
-    child_env = dict(os.environ, DLLAMA_WORKER_CHILD="1")
+    phase_file = os.path.join(
+        tempfile.mkdtemp(prefix="dllama-worker-"), "joined")
+    child_env = dict(os.environ, DLLAMA_WORKER_CHILD="1",
+                     DLLAMA_WORKER_PHASE_FILE=phase_file)
     cmd = [sys.executable, "-m", "dllama_tpu",
            *getattr(args, "_argv", sys.argv[1:])]
     state: dict = {"child": None}
+    _SIGS = {signal.SIGTERM, signal.SIGINT}
 
     def _forward(sig, _frame):
         child = state["child"]
@@ -306,20 +319,47 @@ def _worker_supervisor(args) -> int:
     signal.signal(signal.SIGINT, _forward)
 
     backoff = 1.0
-    while True:
-        state["child"] = subprocess.Popen(cmd, env=child_env)
-        rc = state["child"].wait()
-        if rc == 0:
-            return 0  # clean STOP from the root
-        if not (rc == 3 or rc < 0 or rc == 134):
-            # argparse (2), bad model path, jax init errors, ...: permanent
-            print(f"⭕ worker failed rc={rc}; not a root-loss exit — giving "
-                  f"up", flush=True)
-            return rc
-        print(f"⭕ worker exited rc={rc}; re-serving: waiting for a new root",
-              flush=True)
-        time.sleep(backoff)
-        backoff = min(backoff * 2, 30.0)
+    try:
+        while True:
+            if os.path.exists(phase_file):
+                os.unlink(phase_file)
+            signal.pthread_sigmask(signal.SIG_BLOCK, _SIGS)
+            try:
+                # the blocked mask is inherited across exec — undo it in the
+                # child or terminate() forwarding could never be delivered
+                state["child"] = subprocess.Popen(
+                    cmd, env=child_env,
+                    preexec_fn=lambda: signal.pthread_sigmask(
+                        signal.SIG_UNBLOCK, _SIGS))
+            finally:
+                signal.pthread_sigmask(signal.SIG_UNBLOCK, _SIGS)
+            rc = state["child"].wait()
+            if rc == 0:
+                return 0  # clean STOP from the root
+            joined_at = (os.path.getmtime(phase_file)
+                         if os.path.exists(phase_file) else None)
+            abort_shaped = rc in (-signal.SIGABRT, 128 + signal.SIGABRT)
+            if joined_at is None and not abort_shaped:
+                # died before joining (or withdrew the sentinel on a Python
+                # startup error): argparse (2), bad model path, jax init
+                # failure, ... — permanent, don't hot-loop. A SIGABRT with no
+                # sentinel is the jax fatal racing the join window (root died
+                # mid-init): still root-loss-shaped, still respawn.
+                print(f"⭕ worker failed rc={rc} (startup/config, not root "
+                      f"loss) — giving up", flush=True)
+                return rc
+            if joined_at is not None and time.time() - joined_at > 60.0:
+                backoff = 1.0  # served a healthy root for a while: fresh
+                # incident, not the same flapping root (join time, not spawn
+                # time — model load must not count toward "served")
+            print(f"⭕ worker exited rc={rc}; re-serving: waiting for a new "
+                  f"root", flush=True)
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 30.0)
+    finally:
+        import shutil
+
+        shutil.rmtree(os.path.dirname(phase_file), ignore_errors=True)
 
 
 def run_worker(args) -> int:
@@ -345,7 +385,20 @@ def run_worker(args) -> int:
         _maybe_init_distributed(args)
     print(f"⭕ worker: process {jax.process_index()} of {jax.process_count()}, "
           f"{jax.local_device_count()} local devices")
-    engine = make_engine(args, multihost=True)
+    # Phase sentinel for the supervisor: present = this incarnation joined
+    # the cluster, so a later death is root-loss-shaped. A *Python* exception
+    # below (bad model path, loader failure) withdraws it before propagating;
+    # the jax C++ fatal on root death can't run this cleanup — which is
+    # exactly the distinction the supervisor needs.
+    phase = os.environ.get("DLLAMA_WORKER_PHASE_FILE")
+    if phase:
+        open(phase, "w").close()
+    try:
+        engine = make_engine(args, multihost=True)
+    except BaseException:  # incl. SystemExit from argument validation
+        if phase and os.path.exists(phase):
+            os.unlink(phase)
+        raise
     try:
         served = worker_serve(engine, timeout_s=args.worker_timeout)
     except RootLostError as e:
@@ -354,6 +407,9 @@ def run_worker(args) -> int:
         # supervisor (above) treats the abort exit identically.
         print(f"⭕ {e}", flush=True)
         os._exit(3)
+    # Other exceptions propagate with their traceback; the supervisor's
+    # phase sentinel (not the rc) classifies the death, so nothing is
+    # gained by flattening them to a bare exit code here.
     print(f"⭕ worker done: served {served} dispatches")
     return 0
 
