@@ -167,9 +167,38 @@ def matmul_param_count(cfg) -> int:
     return cfg.n_layers * per_layer + cfg.dim * cfg.vocab_size
 
 
+def _codes_kernel():
+    """Process-wide jitted Q40-code RNG (lazy: jax imports only on use).
+    A per-call closure would recompile every code shape for each of the
+    three bench_preset invocations — jit caches key on function identity."""
+    global _CODES_JIT
+    try:
+        return _CODES_JIT
+    except NameError:
+        pass
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnums=1)
+    def _codes(k, shape):
+        bits = jax.random.bits(k, shape, jnp.uint8)  # 1 B/elem of entropy
+        return (bits & jnp.uint8(0x0F)).astype(jnp.int8) - 8  # [-8, 8)
+
+    _CODES_JIT = _codes
+    return _codes
+
+
 def device_random_params(cfg):
     """Random Q40-plane params generated ON DEVICE (no host RAM spike, no
-    multi-GB host->device transfer: an 8B-shape Q40 stack is ~8.5 GB)."""
+    multi-GB host->device transfer: an 8B-shape Q40 stack is ~8.5 GB).
+
+    Each tensor is built inside one jit so XLA fuses the RNG + mask + cast
+    chain into the output buffer. The eager version OOM-wedged the chip:
+    `randint` drew uint32 bits — a 7.5 GB intermediate for the stacked
+    (32, 14336, 4096) ffn codes alone, on a 16 GB chip that already held
+    earlier planes (the round-1/2 'backend hang' during the 8B stage)."""
     import jax
     import jax.numpy as jnp
 
@@ -177,13 +206,14 @@ def device_random_params(cfg):
     from dllama_tpu.ops.linear import QuantizedWeight
 
     key = iter(jax.random.split(jax.random.PRNGKey(0), 32))
+    _codes = _codes_kernel()
 
     def qw(out, in_, stacked=True):
         shape_s = (cfg.n_layers, in_ // 32, out) if stacked else (in_ // 32, out)
         shape_c = (cfg.n_layers, in_, out) if stacked else (in_, out)
         scales = jax.random.uniform(next(key), shape_s, jnp.float32,
                                     minval=0.001, maxval=0.011)
-        codes = jax.random.randint(next(key), shape_c, -8, 8, dtype=jnp.int8)
+        codes = jax.block_until_ready(_codes(next(key), shape_c))
         return QuantizedWeight(scales=scales, codes=codes)
 
     ones = lambda *s: jnp.ones(s, dtype=jnp.float32)
@@ -207,8 +237,14 @@ def device_random_params(cfg):
 
 
 def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
-                 prefill_len: int = 256, batch: int = 1) -> dict:
-    """Measure decode tok/s (+ prefill tok/s for batch=1) for one preset."""
+                 prefill_len: int = 256, batch: int = 1,
+                 out: dict | None = None) -> dict:
+    """Measure decode tok/s (+ prefill tok/s for batch=1) for one preset.
+
+    ``out`` (when given) is filled INCREMENTALLY — including a ``phase``
+    breadcrumb before every potentially-blocking jax call — so the watchdog's
+    force-emitted JSON shows exactly where a wedged backend stopped
+    (round-2's empty ``stages`` left that unanswerable)."""
     import jax
     import jax.numpy as jnp
 
@@ -216,6 +252,8 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     from dllama_tpu.models.llama import greedy_step
     from dllama_tpu.runtime import KVCache
 
+    out = {} if out is None else out
+    out["phase"] = "params"
     cfg = model_cfg(preset)
     params = device_random_params(cfg)
     jax.block_until_ready(params)
@@ -224,9 +262,8 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
     greedy = jax.jit(greedy_step, static_argnums=1, donate_argnums=(4,))
 
-    out: dict = {}
-
     # prefill (chunked the way engine.prefill batches positions)
+    out["phase"] = "prefill_compile"
     chunk = min(prefill_len, 128)
     prompt = jnp.ones((batch, chunk), dtype=jnp.int32)
     logits, kv = step(params, cfg, prompt, jnp.int32(0), kv)  # compile
@@ -234,6 +271,7 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     if time.monotonic() > deadline:
         raise TimeoutError("deadline after prefill compile")
     n_chunks = max(1, prefill_len // chunk - 1)
+    out["phase"] = "prefill_measure"
     t0 = time.perf_counter()
     pos = chunk
     for i in range(n_chunks):
@@ -244,11 +282,13 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     out["prefill_tok_per_s"] = round(batch * n_chunks * chunk / dt, 2)
 
     # decode (fused greedy step; token never leaves the device)
+    out["phase"] = "decode_compile"
     token = jnp.ones((batch,), dtype=jnp.int32)
     token, kv = greedy(params, cfg, token[:, None], jnp.int32(pos), kv)  # compile
     jax.block_until_ready(token)
     if time.monotonic() > deadline:
         raise TimeoutError("deadline after decode compile")
+    out["phase"] = "decode_measure"
     pos += 1
     t0 = time.perf_counter()
     for i in range(decode_steps):
@@ -263,6 +303,7 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     if batch == 1 and time.monotonic() < deadline:
         from dllama_tpu.models.llama import sampled_step
 
+        out["phase"] = "sampled_decode"
         sampled = jax.jit(sampled_step, static_argnums=1, donate_argnums=(4,))
         n = max(8, decode_steps // 2)
         pos += decode_steps
@@ -287,6 +328,7 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     if batch == 1 and time.monotonic() < deadline:
         from dllama_tpu.models.llama import greedy_steps
 
+        out["phase"] = "chunked_decode"
         gsteps = jax.jit(greedy_steps, static_argnums=(1, 5),
                          donate_argnums=(4,))
         K = 32
@@ -303,6 +345,7 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
         jax.block_until_ready(toks)
         dt = time.perf_counter() - t0
         out["chunked_decode_tok_per_s"] = round(rounds * K / dt, 2)
+    out["phase"] = "done"
     return out
 
 
@@ -387,34 +430,47 @@ def main() -> None:
     import threading
 
     def _watchdog():
-        result.setdefault("stages", {})
-        result["error"] = (result.get("error")
-                           or f"watchdog: exceeded {STAGE_DEADLINE_S}s inside a stage")
-        result["elapsed_s"] = round(time.monotonic() - t_start, 1)
-        emit(result)
-        os._exit(0)
+        try:
+            result.setdefault("stages", {})
+            result["error"] = (result.get("error")
+                               or f"watchdog: exceeded {STAGE_DEADLINE_S}s inside a stage")
+            result["elapsed_s"] = round(time.monotonic() - t_start, 1)
+            # deep-copy first: the main thread mutates the shared stage dicts
+            # and a mid-encode mutation must not kill the line we exist to emit
+            try:
+                snapshot = json.loads(json.dumps(result, default=str))
+            except Exception:  # noqa: BLE001
+                snapshot = {"metric": result.get("metric"), "value": 0.0,
+                            "unit": "tok/s", "vs_baseline": 0.0,
+                            "error": result.get("error")}
+            emit(snapshot)
+        finally:
+            os._exit(0)
 
     wd = threading.Timer(max(1.0, deadline - time.monotonic() + 60), _watchdog)
     wd.daemon = True
     wd.start()
 
     stages: dict = {}
+    result["stages"] = stages  # shared upfront: the watchdog emits partials
     for preset in presets:
+        stages[preset] = st = {}
         try:
-            stages[preset] = bench_preset(preset, deadline)
+            bench_preset(preset, deadline, out=st)
         except Exception as e:  # noqa: BLE001 — always emit the line
-            stages[preset] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            st["error"] = f"{type(e).__name__}: {e}"[:300]
         if time.monotonic() > deadline:
             break
 
     # batched serving throughput for the headline preset (skip if tight)
     head = presets[0]
     if on_tpu and time.monotonic() < deadline and "error" not in stages.get(head, {"error": 1}):
+        stages[f"{head}_b16"] = st = {}
         try:
-            stages[f"{head}_b16"] = bench_preset(
-                head, deadline, decode_steps=32, prefill_len=128, batch=16)
+            bench_preset(head, deadline, decode_steps=32, prefill_len=128,
+                         batch=16, out=st)
         except Exception as e:  # noqa: BLE001
-            stages[f"{head}_b16"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            st["error"] = f"{type(e).__name__}: {e}"[:300]
 
     head_res = stages.get(head, {})
     cfg = model_cfg(head)
@@ -433,7 +489,6 @@ def main() -> None:
                 head_res["prefill_tok_per_s"] * 2 * n_params / (tflops * 1e12), 4)
     else:
         result["error"] = head_res.get("error", "no result")
-    result["stages"] = stages
 
     # chip is alive: spend any remaining window on the @pytest.mark.tpu tier
     # (the error-bound claims that have never run on hardware) and embed the
