@@ -136,35 +136,39 @@ def probe_backend(platform: str | None, attempts: list) -> tuple[bool, str]:
 # ---------------------------------------------------------------------------
 
 
+# plain-int shape table: the parent process computes rooflines from these
+# WITHOUT importing jax/dllama_tpu (a wedged PJRT plugin import would stall
+# the parent's emit path — measurement is the children's job)
+PRESETS = {
+    "8b": dict(dim=4096, hidden_dim=14336, n_layers=32, n_heads=32,
+               n_kv_heads=8, head_dim=128, vocab_size=128256, seq_len=1024),
+    "1b": dict(dim=2048, hidden_dim=8192, n_layers=16, n_heads=32,
+               n_kv_heads=8, head_dim=64, vocab_size=128256, seq_len=1024),
+    "tiny": dict(dim=256, hidden_dim=512, n_layers=2, n_heads=4,
+                 n_kv_heads=2, head_dim=64, vocab_size=2048, seq_len=256),
+}
+
+
 def model_cfg(preset: str):
     from dllama_tpu.formats.mfile import ArchType, RopeType
     from dllama_tpu.models import ModelConfig
 
-    common = dict(
-        arch=ArchType.LLAMA, vocab_size=128256, norm_epsilon=1e-5,
+    return ModelConfig(
+        arch=ArchType.LLAMA, norm_epsilon=1e-5,
         rope_theta=500000.0, rope_type=RopeType.LLAMA3_1,
         rope_scaling_factor=32.0, rope_scaling_low_freq_factor=1.0,
         rope_scaling_high_freq_factor=4.0, rope_scaling_orig_max_seq_len=8192,
-        compute_dtype="bfloat16", seq_len=1024,
-    )
-    if preset == "8b":  # Llama 3.1 8B
-        return ModelConfig(dim=4096, hidden_dim=14336, n_layers=32,
-                           n_heads=32, n_kv_heads=8, head_dim=128, **common)
-    if preset == "1b":  # Llama 3.2 1B
-        return ModelConfig(dim=2048, hidden_dim=8192, n_layers=16,
-                           n_heads=32, n_kv_heads=8, head_dim=64, **common)
-    if preset == "tiny":  # self-test shape (CPU)
-        c = dict(common, vocab_size=2048, seq_len=256)
-        return ModelConfig(dim=256, hidden_dim=512, n_layers=2,
-                           n_heads=4, n_kv_heads=2, head_dim=64, **c)
-    raise ValueError(preset)
+        compute_dtype="bfloat16", **PRESETS[preset])
 
 
-def matmul_param_count(cfg) -> int:
+def matmul_param_count(preset: str) -> int:
     """Weights touched per token (matmul planes; the HBM-bandwidth payload)."""
-    per_layer = (cfg.dim * cfg.q_dim + 2 * cfg.dim * cfg.kv_dim
-                 + cfg.q_dim * cfg.dim + 3 * cfg.dim * cfg.hidden_dim)
-    return cfg.n_layers * per_layer + cfg.dim * cfg.vocab_size
+    p = PRESETS[preset]
+    q_dim = p["n_heads"] * p["head_dim"]
+    kv_dim = p["n_kv_heads"] * p["head_dim"]
+    per_layer = (p["dim"] * q_dim + 2 * p["dim"] * kv_dim
+                 + q_dim * p["dim"] + 3 * p["dim"] * p["hidden_dim"])
+    return p["n_layers"] * per_layer + p["dim"] * p["vocab_size"]
 
 
 def _codes_kernel():
@@ -234,6 +238,96 @@ def device_random_params(cfg):
 # ---------------------------------------------------------------------------
 # measured stages
 # ---------------------------------------------------------------------------
+
+
+class _PhaseDict(dict):
+    """Stage-result dict that streams each phase transition to stdout as a
+    JSON line, so the parent process can pin a wedge to its exact phase even
+    when the child never returns."""
+
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        if k == "phase":
+            print(json.dumps({"phase": v}), flush=True)
+
+
+def stage_child(spec: str) -> None:
+    """``bench.py --stage <spec>`` child entry: run ONE measurement stage in
+    this process and print ``{"stage_result": ...}``. Isolation is the point:
+    a chip wedge (the round-1/2 failure) kills this child, not the bench —
+    the parent kills us at its per-stage budget and moves on.
+
+    spec: preset name, optionally ``@b16`` for the batched-serving variant."""
+    force = os.environ.get("DLLAMA_BENCH_PLATFORM")
+    if force:
+        import jax
+
+        jax.config.update("jax_platforms", force)  # sitecustomize-proof
+    preset, _, mod = spec.partition("@")
+    budget = float(os.environ.get("DLLAMA_BENCH_CHILD_BUDGET", STAGE_DEADLINE_S))
+    deadline = time.monotonic() + budget
+    kwargs = (dict(decode_steps=32, prefill_len=128, batch=16)
+              if mod == "b16" else {})
+    st = _PhaseDict()
+    try:
+        bench_preset(preset, deadline, out=st, **kwargs)
+    except Exception as e:  # noqa: BLE001 — the parent needs the line
+        st["error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps({"stage_result": dict(st)}), flush=True)
+
+
+def run_stage(spec: str, budget: float) -> dict:
+    """Run one stage in a subprocess with a hard kill at ``budget``."""
+    import threading
+    from collections import deque
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ,
+               DLLAMA_BENCH_CHILD_BUDGET=str(max(30.0, budget - 20.0)))
+    child = subprocess.Popen(
+        [sys.executable, os.path.join(here, "bench.py"), "--stage", spec],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=here)
+    rec: dict = {"phase": "spawn"}
+    err_tail: deque = deque(maxlen=30)
+
+    def read_out():
+        for line in child.stdout:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if "stage_result" in obj:
+                rec["result"] = obj["stage_result"]
+            elif "phase" in obj:
+                rec["phase"] = obj["phase"]
+
+    def read_err():  # drain: a full pipe would block the child
+        for line in child.stderr:
+            err_tail.append(line.rstrip())
+
+    threads = [threading.Thread(target=read_out, daemon=True),
+               threading.Thread(target=read_err, daemon=True)]
+    for th in threads:
+        th.start()
+    try:
+        child.wait(timeout=budget)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        rec["killed"] = f"stage killed at {budget:.0f}s budget"
+    for th in threads:
+        th.join(timeout=10)
+    if "result" in rec:
+        return rec["result"]
+    out = {"phase": rec.get("phase"),
+           "error": rec.get("killed")
+           or f"child rc={child.returncode} without a result"}
+    if err_tail:
+        out["stderr_tail"] = _tail("\n".join(list(err_tail)[-8:]))
+    return out
 
 
 def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
@@ -403,20 +497,25 @@ def main() -> None:
     if len(attempts) > 1:  # flaky init is itself a finding worth recording
         result["probe_attempts"] = attempts
 
-    import jax
-
-    if force_platform:
-        # the axon sitecustomize pins jax_platforms at interpreter start;
-        # the env var alone doesn't win (see tests/conftest.py)
-        jax.config.update("jax_platforms", force_platform)
+    # the parent stays jax-free: every measurement runs in a --stage child
+    # (stage_child re-pins jax_platforms there; sitecustomize would clobber
+    # a bare env var). A persistent compile cache amortizes child compiles
+    # across stages and across bench runs in the same image.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/dllama-xla-cache-bench")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
     on_tpu = "tpu" in str(info.get("kind", "")).lower() or info.get("platform") in ("tpu", "axon")
     tflops, gbps = detect_specs(str(info.get("kind", "")))
 
-    presets = ["8b", "1b"] if on_tpu else ["tiny"]
+    # 1b FIRST: the cheap preset banks a real number before the 8B shape —
+    # which once OOM-wedged the chip for the rest of the window — ever runs.
+    specs = ["1b", "8b", "8b@b16"] if on_tpu else ["tiny"]
     if os.environ.get("DLLAMA_BENCH_PRESET"):
-        presets = os.environ["DLLAMA_BENCH_PRESET"].split(",")
-    bad = [p for p in presets if p not in ("8b", "1b", "tiny")]
+        specs = os.environ["DLLAMA_BENCH_PRESET"].split(",")
+    bad = [s for s in specs
+           if s.partition("@")[0] not in PRESETS
+           or s.partition("@")[2] not in ("", "b16")]
     if bad:
         result["error"] = f"unknown preset(s) {bad}"
         emit(result)
@@ -453,28 +552,27 @@ def main() -> None:
 
     stages: dict = {}
     result["stages"] = stages  # shared upfront: the watchdog emits partials
-    for preset in presets:
-        stages[preset] = st = {}
-        try:
-            bench_preset(preset, deadline, out=st)
-        except Exception as e:  # noqa: BLE001 — always emit the line
-            st["error"] = f"{type(e).__name__}: {e}"[:300]
-        if time.monotonic() > deadline:
-            break
+    for spec in specs:
+        remaining = deadline - time.monotonic()
+        if remaining < 60:
+            stages[spec] = {"error": "window exhausted before stage ran"}
+            continue
+        base = spec.partition("@")[0]
+        if ("@" in spec and base in stages
+                and "decode_tok_per_s" not in stages[base]):
+            # the base preset ran THIS invocation and failed — don't repeat
+            # the failure at batch 16 (an explicit @b16-only run still runs)
+            stages[spec] = {"error": "skipped: base preset did not measure"}
+            continue
+        stages[spec] = run_stage(spec, min(STAGE_DEADLINE_S, remaining))
 
-    # batched serving throughput for the headline preset (skip if tight)
-    head = presets[0]
-    if on_tpu and time.monotonic() < deadline and "error" not in stages.get(head, {"error": 1}):
-        stages[f"{head}_b16"] = st = {}
-        try:
-            bench_preset(head, deadline, decode_steps=32, prefill_len=128,
-                         batch=16, out=st)
-        except Exception as e:  # noqa: BLE001
-            st["error"] = f"{type(e).__name__}: {e}"[:300]
-
+    # headline preference: the 8B BASELINE shape when it measured, else the
+    # largest preset that did (a banked 1b number beats a zero)
+    head = next((s for s in ("8b", "1b", "tiny")
+                 if "decode_tok_per_s" in stages.get(s, {})),
+                specs[0].partition("@")[0])
     head_res = stages.get(head, {})
-    cfg = model_cfg(head)
-    n_params = matmul_param_count(cfg)
+    n_params = matmul_param_count(head)
     weight_gb = n_params * (1 + 4 / 32) / 1e9  # Q40 planes: 1B codes + f32/32 scales
     if "decode_tok_per_s" in head_res:
         v = head_res["decode_tok_per_s"]
@@ -520,4 +618,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--stage":
+        stage_child(sys.argv[2])
+    else:
+        main()
