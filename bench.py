@@ -158,7 +158,10 @@ def model_cfg(preset: str):
         rope_theta=500000.0, rope_type=RopeType.LLAMA3_1,
         rope_scaling_factor=32.0, rope_scaling_low_freq_factor=1.0,
         rope_scaling_high_freq_factor=4.0, rope_scaling_orig_max_seq_len=8192,
-        compute_dtype="bfloat16", **PRESETS[preset])
+        compute_dtype="bfloat16",
+        # tools/perf_matrix.py sweeps kernel choices through these knobs
+        attn_impl=os.environ.get("DLLAMA_BENCH_ATTN", "auto"),
+        **PRESETS[preset])
 
 
 def matmul_param_count(preset: str) -> int:
@@ -318,6 +321,10 @@ def run_stage(spec: str, budget: float) -> dict:
     except subprocess.TimeoutExpired:
         child.kill()
         rec["killed"] = f"stage killed at {budget:.0f}s budget"
+        try:
+            child.wait(timeout=10)  # reap; readers see EOF
+        except subprocess.TimeoutExpired:
+            pass
     for th in threads:
         th.join(timeout=10)
     if "result" in rec:

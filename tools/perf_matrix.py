@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Kernel-choice perf sweep: one command turns a live-chip window into a
+comparison table instead of a single point.
+
+Runs ``bench.py --stage <preset>`` once per (quant kernel, attention impl)
+combo — each in its own subprocess (wedge-isolated, same as the bench) —
+and prints a JSON line per combo plus a final summary. The knobs:
+
+  DLLAMA_TPU_QUANT_KERNEL  pallas | xla   (ops/linear.py dispatch)
+  DLLAMA_BENCH_ATTN        flash  | xla   (ModelConfig.attn_impl)
+
+Usage:
+  python tools/perf_matrix.py [preset] [per-stage-budget-s]
+  # defaults: preset=1b (safe shape), budget=420
+
+The reference's analogue is its Eval-ms/Sync-ms per-token table
+(/root/reference/src/dllama.cpp:59-67); this sweep answers the TPU-side
+question the reference never had: which of XLA-fused dequant vs the Pallas
+kernel, and XLA attention vs the flash kernel, wins at each shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402 — the bench parent module is deliberately jax-free
+
+COMBOS = [
+    # (label, quant_kernel, attn_impl)
+    ("pallas+flash", "pallas", "flash"),
+    ("pallas+xla", "pallas", "xla"),
+    ("xla+flash", "xla", "flash"),
+    ("xla+xla", "xla", "xla"),
+    ("auto", None, None),  # production dispatch (what the engine ships)
+]
+
+
+def run_combo(preset: str, budget: float, quant: str | None,
+              attn: str | None) -> dict:
+    """Set the combo's knobs in this process's env and delegate to
+    bench.run_stage (subprocess isolation, live phase tracking, stderr tail,
+    kill+reap — no second implementation to drift)."""
+    for var, val in (("DLLAMA_TPU_QUANT_KERNEL", quant),
+                     ("DLLAMA_BENCH_ATTN", attn)):
+        if val:
+            os.environ[var] = val
+        else:
+            os.environ.pop(var, None)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/dllama-xla-cache-bench")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    return bench.run_stage(preset, budget)
+
+
+def main() -> None:
+    preset = sys.argv[1] if len(sys.argv) > 1 else "1b"
+    budget = float(sys.argv[2]) if len(sys.argv) > 2 else 420.0
+    rows: dict = {}
+    for label, quant, attn in COMBOS:
+        t0 = time.monotonic()
+        res = run_combo(preset, budget, quant, attn)
+        res["combo_s"] = round(time.monotonic() - t0, 1)
+        rows[label] = res
+        print(json.dumps({label: res}), flush=True)
+    print(json.dumps({"preset": preset, "matrix": rows}))
+    keys = ("decode_tok_per_s", "prefill_tok_per_s", "sampled_decode_tok_per_s",
+            "chunked_decode_tok_per_s")
+    print(f"\n{'combo':14s}" + "".join(f"{k.split('_tok')[0]:>18s}" for k in keys))
+    for label, res in rows.items():
+        cells = "".join(f"{res.get(k, '-'):>18}" for k in keys)
+        err = f"   ({res['error'][:40]})" if res.get("error") else ""
+        print(f"{label:14s}{cells}{err}")
+
+
+if __name__ == "__main__":
+    main()
