@@ -18,6 +18,7 @@ import os
 import sys
 
 from .hf import (
+    FLOAT_TYPE_BY_NAME,
     convert_hf,
     convert_meta_llama,
     default_output_name,
@@ -36,13 +37,13 @@ def main(argv: list[str] | None = None) -> int:
 
     hf = sub.add_parser("hf", help="HF safetensors dir -> .m")
     hf.add_argument("source")
-    hf.add_argument("float_type", choices=["f32", "q40", "q80"])
+    hf.add_argument("float_type", choices=sorted(FLOAT_TYPE_BY_NAME))
     hf.add_argument("name")
     hf.add_argument("--output", default=None)
 
     meta = sub.add_parser("llama", help="Meta consolidated.*.pth dir -> .m")
     meta.add_argument("source")
-    meta.add_argument("float_type", choices=["f32", "q40", "q80"])
+    meta.add_argument("float_type", choices=sorted(FLOAT_TYPE_BY_NAME))
     meta.add_argument("--output", default=None)
 
     th = sub.add_parser("tokenizer-hf", help="HF tokenizer dir -> .t")

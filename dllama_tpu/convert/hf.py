@@ -17,9 +17,9 @@ from typing import Callable, Iterable
 import numpy as np
 
 from ..formats.mfile import ArchType, HiddenAct, RopeType, write_header
-from ..formats.quants import F32, Q40, Q80, quantize_q40, quantize_q80
+from ..formats.quants import F16, F32, Q40, Q80, quantize_q40, quantize_q80
 
-FLOAT_TYPE_BY_NAME = {"f32": F32, "q40": Q40, "q80": Q80}
+FLOAT_TYPE_BY_NAME = {"f32": F32, "f16": F16, "q40": Q40, "q80": Q80}
 FLOAT_NAME_BY_TYPE = {v: k for k, v in FLOAT_TYPE_BY_NAME.items()}
 
 ARCH_BY_MODEL_TYPE = {
@@ -61,6 +61,8 @@ def encode_tensor(x: np.ndarray, float_type: int) -> bytes:
     flat = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
     if float_type == F32:
         return flat.tobytes()
+    if float_type == F16:
+        return flat.astype(np.float16).tobytes()
     if float_type == Q40:
         return quantize_q40(flat)
     if float_type == Q80:

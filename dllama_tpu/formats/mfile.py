@@ -28,10 +28,25 @@ from pathlib import Path
 
 import numpy as np
 
-from .quants import (F32, Q40, Q40_BLOCK_BYTES, Q40_BLOCK_SIZE,
-                     tensor_bytes, dequantize_q40, unpack_q40)
+from .quants import (F16, F32, Q40, Q40_BLOCK_BYTES, Q40_BLOCK_SIZE, Q80,
+                     QUANT_BLOCK_SIZE, dequantize_q40, dequantize_q80,
+                     tensor_bytes, unpack_q40)
 
 MODEL_MAGIC = 0xA00ABCD
+
+
+def _dequant_any(buf, n: int, float_type: int) -> np.ndarray:
+    """Decode ``n`` elements of any on-disk float type to an owning f32 array
+    (all four reference weight formats, converter/writer.py:6-17)."""
+    if float_type == F32:
+        return np.frombuffer(buf, dtype=np.float32, count=n).copy()
+    if float_type == F16:
+        return np.frombuffer(buf, dtype=np.float16, count=n).astype(np.float32)
+    if float_type == Q40:
+        return dequantize_q40(buf, n)
+    if float_type == Q80:
+        return dequantize_q80(buf, n)
+    raise ValueError(f"unsupported tensor float type {float_type}")
 
 
 class HeaderKey(enum.IntEnum):
@@ -370,12 +385,7 @@ class ModelFile:
         rec = self.tensors[key]
         buf = self.raw(key)
         n = int(np.prod(rec.shape))
-        if rec.float_type == F32:
-            arr = np.frombuffer(buf, dtype=np.float32, count=n).copy()
-        elif rec.float_type == Q40:
-            arr = dequantize_q40(buf, n)
-        else:
-            raise ValueError(f"unsupported tensor float type {rec.float_type}")
+        arr = _dequant_any(buf, n, rec.float_type)
         return arr.reshape(rec.shape)
 
     def tensor_q40_planes(self, key: str) -> tuple[np.ndarray, np.ndarray]:
@@ -406,17 +416,13 @@ class ModelFile:
         buf = memoryview(self._mm)[rec.offset + lo * row_bytes:
                                    rec.offset + hi * row_bytes]
         n = (hi - lo) * cols
-        if rec.float_type == F32:
-            arr = np.frombuffer(buf, dtype=np.float32, count=n).copy()
-        elif rec.float_type == Q40:
-            arr = dequantize_q40(buf, n)
-        else:
-            raise ValueError(f"unsupported tensor float type {rec.float_type}")
-        return arr.reshape(hi - lo, cols)
+        return _dequant_any(buf, n, rec.float_type).reshape(hi - lo, cols)
 
-    def tensor_q40_kmajor_sub(self, key: str, out_lo: int, out_hi: int,
-                              in_lo: int, in_hi: int) -> tuple[np.ndarray, np.ndarray]:
-        """A K-major sub-block of a Q40 weight:
+    def _quant_kmajor_sub(self, key: str, out_lo: int, out_hi: int,
+                          in_lo: int, in_hi: int, *, float_type: int,
+                          block_bytes: int,
+                          unpack) -> tuple[np.ndarray, np.ndarray]:
+        """Shared K-major sub-block reader for the block-quantized formats:
         ``scales f32 [(in_hi-in_lo)/32, out_hi-out_lo]``, ``codes int8 [in, out]``.
 
         K-major column ranges are disk ROW ranges (contiguous); K-major row
@@ -426,13 +432,13 @@ class ModelFile:
         sharded weights.
         """
         rec = self.tensors[key]
-        assert rec.float_type == Q40, rec
+        assert rec.float_type == float_type, rec
         rows, cols = rec.shape
         assert 0 <= out_lo <= out_hi <= rows, (key, out_lo, out_hi)
-        assert 0 <= in_lo <= in_hi <= cols and in_lo % Q40_BLOCK_SIZE == 0 \
-            and in_hi % Q40_BLOCK_SIZE == 0, (key, in_lo, in_hi)
-        n_blk = cols // Q40_BLOCK_SIZE
-        blk_lo, blk_hi = in_lo // Q40_BLOCK_SIZE, in_hi // Q40_BLOCK_SIZE
+        assert 0 <= in_lo <= in_hi <= cols and in_lo % QUANT_BLOCK_SIZE == 0 \
+            and in_hi % QUANT_BLOCK_SIZE == 0, (key, in_lo, in_hi)
+        n_blk = cols // QUANT_BLOCK_SIZE
+        blk_lo, blk_hi = in_lo // QUANT_BLOCK_SIZE, in_hi // QUANT_BLOCK_SIZE
         row_bytes = rec.n_bytes // rows
         sub_rows = memoryview(self._mm)[rec.offset + out_lo * row_bytes:
                                         rec.offset + out_hi * row_bytes]
@@ -440,20 +446,43 @@ class ModelFile:
             sel = bytes(sub_rows)  # full-width fast path: one copy
         else:
             as_blocks = np.frombuffer(sub_rows, dtype=np.uint8).reshape(
-                out_hi - out_lo, n_blk, Q40_BLOCK_BYTES)
+                out_hi - out_lo, n_blk, block_bytes)
             sel = np.ascontiguousarray(as_blocks[:, blk_lo:blk_hi]).tobytes()
         n = (out_hi - out_lo) * (in_hi - in_lo)
-        from .. import native
+        if float_type == Q40 and blk_lo == 0 and blk_hi == n_blk:
+            # single-pass nibble repack (the Q80 codes are already int8 —
+            # a native fast path would buy nothing there)
+            from .. import native
 
-        if blk_lo == 0 and blk_hi == n_blk and native.available():
-            out = native.q40_repack_kmajor(sel, out_hi - out_lo, cols)
-            if out is not None:
-                return out
-        scales, codes = unpack_q40(sel, n)
-        scales = scales.reshape(out_hi - out_lo, (in_hi - in_lo) // Q40_BLOCK_SIZE)
+            if native.available():
+                out = native.q40_repack_kmajor(sel, out_hi - out_lo, cols)
+                if out is not None:
+                    return out
+        scales, codes = unpack(sel, n)
+        scales = scales.reshape(out_hi - out_lo, (in_hi - in_lo) // QUANT_BLOCK_SIZE)
         codes = codes.reshape(out_hi - out_lo, in_hi - in_lo)
         return (np.ascontiguousarray(scales.T.astype(np.float32)),
                 np.ascontiguousarray(codes.T))
+
+    def tensor_q40_kmajor_sub(self, key: str, out_lo: int, out_hi: int,
+                              in_lo: int, in_hi: int) -> tuple[np.ndarray, np.ndarray]:
+        """A K-major sub-block of a Q40 weight (see _quant_kmajor_sub)."""
+        return self._quant_kmajor_sub(
+            key, out_lo, out_hi, in_lo, in_hi, float_type=Q40,
+            block_bytes=Q40_BLOCK_BYTES, unpack=unpack_q40)
+
+    def tensor_q80_kmajor_sub(self, key: str, out_lo: int, out_hi: int,
+                              in_lo: int, in_hi: int) -> tuple[np.ndarray, np.ndarray]:
+        """A K-major sub-block of a Q80 weight: 34-byte blocks (f16 scale +
+        32 int8), landing in the same QuantizedWeight plane layout Q40 uses
+        so every downstream path (XLA dequant-dot, Pallas kernel, TP
+        sharding) is shared. Reference analogue: the Q80 matmul kernels,
+        nn-cpu-ops.cpp."""
+        from .quants import Q80_BLOCK_BYTES, unpack_q80
+
+        return self._quant_kmajor_sub(
+            key, out_lo, out_hi, in_lo, in_hi, float_type=Q80,
+            block_bytes=Q80_BLOCK_BYTES, unpack=unpack_q80)
 
     def tensor_q40_kmajor(self, key: str) -> tuple[np.ndarray, np.ndarray]:
         """Read a Q40 matmul weight as K-major device planes:

@@ -25,8 +25,9 @@ from __future__ import annotations
 
 import numpy as np
 
-Q40_BLOCK_SIZE = 32
-Q80_BLOCK_SIZE = 32
+QUANT_BLOCK_SIZE = 32  # every block-quantized format shares this granularity
+Q40_BLOCK_SIZE = QUANT_BLOCK_SIZE
+Q80_BLOCK_SIZE = QUANT_BLOCK_SIZE
 Q40_BLOCK_BYTES = 2 + Q40_BLOCK_SIZE // 2  # f16 scale + 16 nibble bytes = 18
 Q80_BLOCK_BYTES = 2 + Q80_BLOCK_SIZE  # f16 scale + 32 int8 = 34
 
@@ -202,3 +203,18 @@ def dequantize_q80_np(buf: bytes | np.ndarray, n: int) -> np.ndarray:
     scales = raw[:, 0:2].copy().view(np.float16).reshape(-1).astype(np.float32)
     q = raw[:, 2:].view(np.int8)
     return (q.astype(np.float32) * scales[:, None]).reshape(-1)
+
+
+def unpack_q80(buf: bytes | np.ndarray, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Split ``n`` elements of Q80 wire bytes into separated planes:
+    ``scales float16 [n/32]``, ``codes int8 [n]`` — the same plane split
+    :func:`unpack_q40` does for Q40, so Q80 weights ride the identical
+    device layout (``w = codes * scales``, QuantizedWeight)."""
+    assert n % Q80_BLOCK_SIZE == 0, n
+    nblocks = n // Q80_BLOCK_SIZE
+    raw = np.frombuffer(buf, dtype=np.uint8, count=nblocks * Q80_BLOCK_BYTES).reshape(
+        nblocks, Q80_BLOCK_BYTES
+    )
+    scales = raw[:, 0:2].copy().view(np.float16).reshape(-1)
+    codes = np.ascontiguousarray(raw[:, 2:].view(np.int8)).reshape(-1)
+    return scales, codes

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..formats.mfile import ArchType, ModelFile
-from ..formats.quants import Q40, Q40_BLOCK_SIZE
+from ..formats.quants import Q40, Q80, QUANT_BLOCK_SIZE
 from ..ops.linear import QuantizedWeight
 from ..parallel.api import MeshPlan, make_tp_mesh
 
@@ -83,7 +83,9 @@ class _StreamingLoader:
         # places the per-layer stacks in pinned host memory (cfg.offload
         # streams them through the scan; ModelConfig.offload docs)
         self.offload = weight_mode == "offload"
-        self.quantized = (self.h.weight_type == Q40
+        # Q40 and Q80 share the QuantizedWeight plane layout (codes*scales);
+        # only the on-disk block decode differs (mfile.tensor_q*_kmajor_sub)
+        self.quantized = (self.h.weight_type in (Q40, Q80)
                           and weight_mode in ("auto", "offload"))
         self.dense_dtype = jnp.bfloat16 if weight_mode == "bf16" else jnp.float32
         self.weight_mode = weight_mode
@@ -108,8 +110,8 @@ class _StreamingLoader:
         if self.quantized:
             lead = ("layers",) if stacked else ()  # pipeline axis when present
             cshape = ((L, in_dim, out_dim) if stacked else (in_dim, out_dim))
-            sshape = ((L, in_dim // Q40_BLOCK_SIZE, out_dim) if stacked
-                      else (in_dim // Q40_BLOCK_SIZE, out_dim))
+            sshape = ((L, in_dim // QUANT_BLOCK_SIZE, out_dim) if stacked
+                      else (in_dim // QUANT_BLOCK_SIZE, out_dim))
             c_sh = self._sharding(cshape, *lead, in_axis, out_axis)
             s_sh = self._sharding(sshape, *lead, in_axis, out_axis)
 
@@ -122,20 +124,22 @@ class _StreamingLoader:
                     layers = [None]
                 n_lo, n_hi = _bounds(n_sl, out_dim)
                 if want_scales:
-                    k_lo, k_hi = _bounds(k_sl, in_dim // Q40_BLOCK_SIZE)
-                    k_lo, k_hi = k_lo * Q40_BLOCK_SIZE, k_hi * Q40_BLOCK_SIZE
+                    k_lo, k_hi = _bounds(k_sl, in_dim // QUANT_BLOCK_SIZE)
+                    k_lo, k_hi = k_lo * QUANT_BLOCK_SIZE, k_hi * QUANT_BLOCK_SIZE
                     k_al, k_ah = k_lo, k_hi
                 else:
                     # codes shards may not be 32-aligned (a K smaller than
                     # 32*tp still divides): read the aligned superset, trim
                     k_lo, k_hi = _bounds(k_sl, in_dim)
-                    k_al = (k_lo // Q40_BLOCK_SIZE) * Q40_BLOCK_SIZE
-                    k_ah = -(-k_hi // Q40_BLOCK_SIZE) * Q40_BLOCK_SIZE
+                    k_al = (k_lo // QUANT_BLOCK_SIZE) * QUANT_BLOCK_SIZE
+                    k_ah = -(-k_hi // QUANT_BLOCK_SIZE) * QUANT_BLOCK_SIZE
+                sub = (self.mf.tensor_q40_kmajor_sub
+                       if self.h.weight_type == Q40
+                       else self.mf.tensor_q80_kmajor_sub)
                 out = None
                 for i, l in enumerate(layers):
                     k = key(l) if l is not None else name
-                    scales, codes = self.mf.tensor_q40_kmajor_sub(
-                        k, n_lo, n_hi, k_al, k_ah)
+                    scales, codes = sub(k, n_lo, n_hi, k_al, k_ah)
                     part = (scales if want_scales
                             else codes[k_lo - k_al:k_hi - k_al])
                     if not stacked:

@@ -40,6 +40,8 @@ def write_tensor(f, x: np.ndarray, float_type: int) -> None:
     flat = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
     if float_type == quants.F32:
         f.write(flat.tobytes())
+    elif float_type == quants.F16:
+        f.write(flat.astype(np.float16).tobytes())
     elif float_type == quants.Q40:
         f.write(quants.quantize_q40(flat))
     elif float_type == quants.Q80:

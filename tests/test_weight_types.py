@@ -1,0 +1,109 @@
+"""All four reference weight formats load and run: f32 / f16 / q40 / q80.
+
+The reference runtime accepts any of its converter's float types
+(converter/writer.py:6-17; kernel dispatch nn-cpu-ops.cpp) — a user switching
+from it must be able to bring an f16 or q80 .m file here too. Q40 and Q80
+share the QuantizedWeight plane layout on device (codes*scales), so q80 rides
+every quantized path (XLA dequant-dot, Pallas kernel, TP sharding) unchanged;
+f16 loads dense.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import helpers
+from dllama_tpu.formats import mfile, quants
+from dllama_tpu.models import ModelConfig, forward
+from dllama_tpu.models.llama import load_params_from_mfile
+from dllama_tpu.ops.linear import QuantizedWeight, dequantize_weight
+from dllama_tpu.parallel.api import make_tp_mesh, use_plan
+from dllama_tpu.parallel.sharding import kv_cache_sharding
+from dllama_tpu.runtime import KVCache
+
+ALL_TYPES = [quants.F32, quants.F16, quants.Q40, quants.Q80]
+
+
+def _build(tmp_path, weight_type, seed=5):
+    rng = np.random.default_rng(seed)
+    hdr = helpers.tiny_header_params(weight_type=weight_type)
+    m = tmp_path / f"m{weight_type}.m"
+    dense = helpers.write_tiny_model(m, hdr, rng)
+    mf = mfile.ModelFile.open(m)
+    return mf, ModelConfig.from_header(mf.header), dense
+
+
+def _roundtrip(w: np.ndarray, weight_type: int) -> np.ndarray:
+    """The dense weights as the on-disk format represents them."""
+    flat = w.astype(np.float32).reshape(-1)
+    if weight_type == quants.F32:
+        return w.astype(np.float32)
+    if weight_type == quants.F16:
+        return flat.astype(np.float16).astype(np.float32).reshape(w.shape)
+    if weight_type == quants.Q40:
+        return quants.dequantize_q40(quants.quantize_q40(flat),
+                                     flat.size).reshape(w.shape)
+    return quants.dequantize_q80(quants.quantize_q80(flat),
+                                 flat.size).reshape(w.shape)
+
+
+@pytest.mark.parametrize("weight_type", ALL_TYPES)
+def test_loaded_weights_match_disk_representation(tmp_path, weight_type):
+    mf, cfg, dense = _build(tmp_path, weight_type)
+    params = load_params_from_mfile(mf, cfg)
+    lp = params.layers
+    quantized = weight_type in (quants.Q40, quants.Q80)
+    assert isinstance(lp.wq, QuantizedWeight) == quantized
+    for l in range(mf.header.n_layers):
+        want = _roundtrip(dense[f"block_matmul_q.{l}"], weight_type)
+        if quantized:
+            got = np.asarray(dequantize_weight(QuantizedWeight(
+                scales=lp.wq.scales[l], codes=lp.wq.codes[l]))).T
+        else:
+            got = np.asarray(lp.wq[l], np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    mf.close()
+
+
+def test_quantization_fidelity_ordering(tmp_path):
+    """Same model in every format: f16 ~= f32; q80 strictly closer than q40
+    (8-bit codes vs 4-bit). Runs the full forward, so the q80 matmul path is
+    exercised end to end."""
+    tokens = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], dtype=jnp.int32)
+    logits = {}
+    for wt in ALL_TYPES:
+        mf, cfg, _ = _build(tmp_path, wt)
+        params = load_params_from_mfile(mf, cfg)
+        out, _ = jax.jit(forward, static_argnums=1)(
+            params, cfg, tokens, jnp.int32(0), KVCache.create(cfg))
+        logits[wt] = np.asarray(out, np.float32)
+        mf.close()
+    ref = logits[quants.F32]
+    err = {wt: np.abs(logits[wt] - ref).max() for wt in ALL_TYPES}
+    assert err[quants.F16] < 0.02, err
+    assert err[quants.Q80] < err[quants.Q40], err
+    assert err[quants.Q80] < 0.1 and err[quants.Q40] < 1.0, err
+
+
+def test_q80_tp_sharded_matches_unsharded(tmp_path):
+    """Q80 planes through the TP shard loader: logits identical to the
+    single-device load (same guarantee test_parallel proves for Q40)."""
+    mf, cfg, _ = _build(tmp_path, quants.Q80)
+    tokens = jnp.asarray([[3, 1, 4, 1, 5]], dtype=jnp.int32)
+    params = load_params_from_mfile(mf, cfg)
+    ref, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg, tokens, jnp.int32(0), KVCache.create(cfg))
+
+    plan = make_tp_mesh(2)
+    sharded = load_params_from_mfile(mf, cfg, plan=plan)
+    kv = jax.device_put(KVCache.create(cfg),
+                        kv_cache_sharding(plan, KVCache.create(cfg)))
+    with use_plan(plan):
+        got, _ = jax.jit(forward, static_argnums=1)(
+            sharded, cfg, tokens, jnp.int32(0), kv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+    mf.close()
