@@ -33,7 +33,8 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
 from ..runtime.engine import InferenceEngine
-from ..tokenizer.chat import ChatItem, ChatTemplateGenerator, EosDetector, EosResult
+from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
+                              ChatTemplateType, EosDetector, EosResult)
 
 
 @dataclass
@@ -103,13 +104,15 @@ class _EosGate:
 class ApiState:
     """Engine + chat plumbing shared across requests."""
 
-    def __init__(self, engine: InferenceEngine, model_name: str = "dllama-tpu"):
+    def __init__(self, engine: InferenceEngine, model_name: str = "dllama-tpu",
+                 template_type: ChatTemplateType = ChatTemplateType.UNKNOWN):
         self.engine = engine
         self.model_name = model_name
         tok = engine.tokenizer
         eos_piece = (tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
                      if tok.eos_token_ids else "")
-        self.template = ChatTemplateGenerator(tok.chat_template, eos=eos_piece)
+        self.template = ChatTemplateGenerator(tok.chat_template, eos=eos_piece,
+                                              type=template_type)
         self.stop_pieces = [tok.vocab[t].decode("utf-8", "replace")
                             for t in tok.eos_token_ids]
         self.cache = NaiveCache()
@@ -187,7 +190,8 @@ class BatchedApiState:
     thread's ``on_token`` callback."""
 
     def __init__(self, engine: InferenceEngine, n_slots: int,
-                 model_name: str = "dllama-tpu"):
+                 model_name: str = "dllama-tpu",
+                 template_type: ChatTemplateType = ChatTemplateType.UNKNOWN):
         from ..runtime.serving import BatchScheduler
 
         self.engine = engine
@@ -195,7 +199,8 @@ class BatchedApiState:
         tok = engine.tokenizer
         eos_piece = (tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
                      if tok.eos_token_ids else "")
-        self.template = ChatTemplateGenerator(tok.chat_template, eos=eos_piece)
+        self.template = ChatTemplateGenerator(tok.chat_template, eos=eos_piece,
+                                              type=template_type)
         self.stop_pieces = [tok.vocab[t].decode("utf-8", "replace")
                             for t in tok.eos_token_ids]
         self.sched = BatchScheduler(engine, n_slots)
@@ -361,13 +366,15 @@ def run_api_server(args) -> int:
 
     engine = make_engine(args)
     n_slots = getattr(args, "batch_slots", 0) or 0
+    ttype = ChatTemplateType(getattr(args, "chat_template", None) or "unknown")
     if n_slots > 1:
-        state: ApiState | BatchedApiState = BatchedApiState(engine, n_slots)
+        state: ApiState | BatchedApiState = BatchedApiState(
+            engine, n_slots, template_type=ttype)
         server = ThreadingHTTPServer((args.host, args.port),
                                      make_handler(state))
         print(f"🕸️ continuous batching: {n_slots} slots")
     else:
-        state = ApiState(engine)
+        state = ApiState(engine, template_type=ttype)
         server = HTTPServer((args.host, args.port), make_handler(state))
     print(f"🕸️ listening on http://{args.host}:{args.port}")
     try:

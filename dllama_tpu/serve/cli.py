@@ -25,7 +25,8 @@ import time
 
 from ..formats.quants import F32, Q80
 from ..runtime.engine import DEFAULT_N_BATCHES, InferenceEngine
-from ..tokenizer.chat import ChatItem, ChatTemplateGenerator, EosDetector, EosResult
+from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
+                              ChatTemplateType, EosDetector, EosResult)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -40,6 +41,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-seq-len", type=int, default=0)
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument("--chat-template", default=None,
+                   choices=["llama2", "llama3", "deepSeek3", "chatml"],
+                   help="force the chat template family instead of "
+                        "auto-detecting from the tokenizer (reference "
+                        "--chat-template, app.cpp:17-22; chatml is ours)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--buffer-float-type", choices=["f32", "q80"], default="q80",
                    help="activation sync quantization parity mode")
@@ -197,7 +203,9 @@ def run_chat(args) -> int:
     tok = engine.tokenizer
     eos_piece = (tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
                  if tok.eos_token_ids else "")
-    template = ChatTemplateGenerator(tok.chat_template, eos=eos_piece)
+    template = ChatTemplateGenerator(
+        tok.chat_template, eos=eos_piece,
+        type=ChatTemplateType(args.chat_template or "unknown"))
     stop_pieces = [tok.vocab[t].decode("utf-8", "replace") for t in tok.eos_token_ids]
     max_stop = max((len(s) for s in stop_pieces), default=0)
     detector = EosDetector(tok.eos_token_ids, stop_pieces, max_stop, max_stop)

@@ -179,6 +179,17 @@ def test_chat_template_deepseek_public_prompt():
     assert out.public_prompt == "<think>\n"
 
 
+def test_chat_template_forced_overrides_detection():
+    """--chat-template semantics (reference app.cpp:17-22,109-110): an
+    explicit family wins over whatever the tokenizer's stored template says."""
+    chatml_tmpl = "{{ '<|im_start|>' + role }}"  # would auto-detect CHATML
+    g = ChatTemplateGenerator(chatml_tmpl, eos="</s>",
+                              type=ChatTemplateType.LLAMA2)
+    assert g.type == ChatTemplateType.LLAMA2
+    out = g.generate([ChatItem("user", "q")])
+    assert out.content.startswith("[INST]")
+
+
 def test_chat_template_unknown_raises():
     with pytest.raises(ValueError):
         ChatTemplateGenerator("no markers here", eos="")
