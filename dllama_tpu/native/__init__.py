@@ -71,7 +71,8 @@ def _stale() -> bool:
     if not so.exists():
         return True
     try:
-        return (_DIR / "quants.cpp").stat().st_mtime > so.stat().st_mtime
+        return any(src.stat().st_mtime > so.stat().st_mtime
+                   for src in _DIR.glob("*.cpp"))
     except OSError:
         return True
 
@@ -115,17 +116,22 @@ def get_lib() -> ctypes.CDLL | None:
         lib = ctypes.CDLL(str(_so_path()))
     except OSError:
         return None
-    for name, argtypes in {
-        "q40_quantize": (_c_f32p, ctypes.c_int64, _c_u8p, ctypes.c_int),
-        "q40_dequantize": (_c_u8p, ctypes.c_int64, _c_f32p, ctypes.c_int),
-        "q80_quantize": (_c_f32p, ctypes.c_int64, _c_u8p, ctypes.c_int),
-        "q80_dequantize": (_c_u8p, ctypes.c_int64, _c_f32p, ctypes.c_int),
-        "q40_repack_kmajor": (_c_u8p, ctypes.c_int64, ctypes.c_int64,
-                              _c_f32p, _c_i8p, ctypes.c_int),
+    for name, (argtypes, restype) in {
+        "q40_quantize": ((_c_f32p, ctypes.c_int64, _c_u8p, ctypes.c_int), None),
+        "q40_dequantize": ((_c_u8p, ctypes.c_int64, _c_f32p, ctypes.c_int), None),
+        "q80_quantize": ((_c_f32p, ctypes.c_int64, _c_u8p, ctypes.c_int), None),
+        "q80_dequantize": ((_c_u8p, ctypes.c_int64, _c_f32p, ctypes.c_int), None),
+        "q40_repack_kmajor": ((_c_u8p, ctypes.c_int64, ctypes.c_int64,
+                               _c_f32p, _c_i8p, ctypes.c_int), None),
+        "bpe_create": ((_c_u8p, ctypes.POINTER(ctypes.c_int64), _c_f32p,
+                        ctypes.c_int32, ctypes.c_int32), ctypes.c_void_p),
+        "bpe_destroy": ((ctypes.c_void_p,), None),
+        "bpe_merge": ((ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+                       ctypes.c_int64), ctypes.c_int64),
     }.items():
         fn = getattr(lib, name)
         fn.argtypes = list(argtypes)
-        fn.restype = None
+        fn.restype = restype
     _lib = lib
     return _lib
 
@@ -205,3 +211,58 @@ def q40_repack_kmajor(buf, rows: int, cols: int, nthreads: int | None = None
                           codes.ctypes.data_as(_c_i8p),
                           nthreads or default_threads())
     return scales, codes
+
+
+class BpeMerger:
+    """Handle-holding wrapper over the native BPE merge engine
+    (tokenizer.cpp): builds the vocab hash map once, then ``merge`` runs
+    allocation-light per call. Construct via :func:`bpe_merger` (None when
+    the library is unavailable or handle creation fails)."""
+
+    def __init__(self, lib: ctypes.CDLL, handle: int):
+        self._lib = lib
+        self._h = handle
+
+    def merge(self, tokens: list[int]) -> list[int] | None:
+        """Greedy-merge ``tokens`` (same output as bpe.Tokenizer._merge);
+        None signals the caller to fall back (bad ids, dead handle)."""
+        if self._h is None:
+            return None
+        n = len(tokens)
+        if n < 2:
+            return list(tokens)
+        arr = np.asarray(tokens, dtype=np.int32)
+        out_n = self._lib.bpe_merge(
+            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n)
+        if out_n < 0:
+            return None
+        return arr[:out_n].tolist()
+
+    def __del__(self):  # noqa: D105 — process-exit teardown may be partial
+        try:
+            if self._h is not None:
+                self._lib.bpe_destroy(self._h)
+                self._h = None
+        except Exception:  # pragma: no cover — interpreter shutdown
+            pass
+
+
+def bpe_merger(vocab: list[bytes], scores, n_regular: int) -> "BpeMerger | None":
+    """Build a native merge engine from the tokenizer tables, or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(vocab)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(p) for p in vocab], out=offsets[1:])
+    blob = np.frombuffer(b"".join(vocab), dtype=np.uint8) if offsets[n] \
+        else np.empty(0, dtype=np.uint8)
+    sc = np.ascontiguousarray(scores, dtype=np.float32)
+    if sc.size != n:
+        return None
+    h = lib.bpe_create(blob.ctypes.data_as(_c_u8p),
+                       offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                       sc.ctypes.data_as(_c_f32p), n, n_regular)
+    if not h:
+        return None
+    return BpeMerger(lib, h)

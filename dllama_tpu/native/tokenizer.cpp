@@ -1,0 +1,143 @@
+// Native BPE merge engine — the hot half of Tokenizer.encode.
+//
+// The reference's tokenizer is C++ (src/tokenizer.cpp:309-388: rescan-per-
+// merge over a bsearch'd sorted vocab, O(n²)); ours keeps the same greedy
+// policy — highest score wins, leftmost on ties — on a lazy-deletion heap
+// over a doubly-linked token list, exactly mirroring the Python fallback in
+// dllama_tpu/tokenizer/bpe.py::_merge (same entry ordering, so identical
+// output by construction, proven by the equivalence suite in
+// tests/test_tokenizer.py).
+//
+// C API: an opaque handle owns the regular-vocab hash map (bytes -> first
+// id, matching the reference's stably-ordered unique-key bsearch) and the
+// score table; merge calls then run allocation-light.
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct BpeHandle {
+    // backing store for vocab bytes; string_view keys point into it
+    std::string blob;
+    std::vector<std::string_view> pieces;   // id -> bytes
+    std::vector<float> scores;              // id -> merge score
+    std::unordered_map<std::string_view, int32_t> lookup;  // bytes -> first id
+};
+
+struct HeapEntry {
+    float neg_score;
+    int64_t j;        // left node index
+    int64_t ver_j;    // left node version at push time
+    int64_t ver_k;    // right node version at push time
+    int64_t k;        // right node index
+    int32_t mid;      // merged token id
+};
+
+// Python's heapq pops the lexicographically SMALLEST tuple
+// (-score, j, ver_j, ver_k, k, mid); priority_queue pops the LARGEST,
+// so the comparator is "a after b" == "a > b" lexicographically.
+struct EntryAfter {
+    bool operator()(const HeapEntry& a, const HeapEntry& b) const {
+        if (a.neg_score != b.neg_score) return a.neg_score > b.neg_score;
+        if (a.j != b.j) return a.j > b.j;
+        if (a.ver_j != b.ver_j) return a.ver_j > b.ver_j;
+        if (a.ver_k != b.ver_k) return a.ver_k > b.ver_k;
+        if (a.k != b.k) return a.k > b.k;
+        return a.mid > b.mid;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// vocab_bytes: concatenation of all n pieces; offsets: n+1 prefix offsets.
+// n_regular of the n ids participate in lookup (specials excluded).
+void* bpe_create(const uint8_t* vocab_bytes, const int64_t* offsets,
+                 const float* scores, int32_t n, int32_t n_regular) {
+    if (n <= 0 || n_regular < 0 || n_regular > n) return nullptr;
+    auto* h = new (std::nothrow) BpeHandle;
+    if (!h) return nullptr;
+    h->blob.assign(reinterpret_cast<const char*>(vocab_bytes),
+                   static_cast<size_t>(offsets[n]));
+    h->pieces.reserve(n);
+    h->scores.assign(scores, scores + n);
+    for (int32_t i = 0; i < n; i++) {
+        h->pieces.emplace_back(h->blob.data() + offsets[i],
+                               static_cast<size_t>(offsets[i + 1] - offsets[i]));
+    }
+    h->lookup.reserve(static_cast<size_t>(n_regular) * 2);
+    for (int32_t i = 0; i < n_regular; i++) {
+        h->lookup.emplace(h->pieces[i], i);  // emplace keeps the FIRST id
+    }
+    return h;
+}
+
+void bpe_destroy(void* handle) {
+    delete static_cast<BpeHandle*>(handle);
+}
+
+// In-place greedy merge of tokens[0..n); returns the merged length (<= n),
+// or -1 on bad arguments. Token ids must be < vocab size.
+int64_t bpe_merge(void* handle, int32_t* tokens, int64_t n) {
+    auto* h = static_cast<BpeHandle*>(handle);
+    if (!h || n < 0) return -1;
+    if (n < 2) return n;
+    const int64_t vocab_n = static_cast<int64_t>(h->pieces.size());
+    for (int64_t i = 0; i < n; i++) {
+        if (tokens[i] < 0 || tokens[i] >= vocab_n) return -1;
+    }
+
+    std::vector<int32_t> ids(tokens, tokens + n);
+    std::vector<int64_t> prev(n), nxt(n), ver(n, 0);
+    std::vector<uint8_t> alive(n, 1);
+    for (int64_t i = 0; i < n; i++) {
+        prev[i] = i - 1;
+        nxt[i] = (i + 1 < n) ? i + 1 : -1;
+    }
+
+    std::priority_queue<HeapEntry, std::vector<HeapEntry>, EntryAfter> heap;
+    std::string key;
+    auto push = [&](int64_t j) {
+        const int64_t k = nxt[j];
+        if (k == -1) return;
+        const std::string_view a = h->pieces[ids[j]], b = h->pieces[ids[k]];
+        key.assign(a.data(), a.size());
+        key.append(b.data(), b.size());
+        auto it = h->lookup.find(std::string_view(key));
+        if (it != h->lookup.end()) {
+            heap.push({-h->scores[it->second], j, ver[j], ver[k], k,
+                       it->second});
+        }
+    };
+
+    for (int64_t j = 0; j + 1 < n; j++) push(j);
+    while (!heap.empty()) {
+        const HeapEntry e = heap.top();
+        heap.pop();
+        const int64_t j = e.j, k = e.k;
+        if (!alive[j] || !alive[k] || ver[j] != e.ver_j || ver[k] != e.ver_k ||
+            nxt[j] != k) {
+            continue;  // stale: an endpoint merged since this pair was seen
+        }
+        ids[j] = e.mid;
+        ver[j]++;
+        alive[k] = 0;
+        nxt[j] = nxt[k];
+        if (nxt[k] != -1) prev[nxt[k]] = j;
+        if (prev[j] != -1) push(prev[j]);
+        push(j);
+    }
+
+    int64_t out = 0;
+    for (int64_t j = 0; j != -1; j = nxt[j]) tokens[out++] = ids[j];
+    return out;
+}
+
+}  // extern "C"

@@ -111,16 +111,39 @@ class Tokenizer:
 
         return self._merge(tokens)
 
+    def _native_merger(self):
+        """Lazily-built native merge engine (native/tokenizer.cpp), or None.
+        False caches 'tried and unavailable' so the fallback never re-probes."""
+        m = self.__dict__.get("_bpe_native")
+        if m is None:
+            from .. import native
+
+            m = (native.bpe_merger(self.vocab, self.scores,
+                                   self.regular_vocab_size)
+                 if native.available() else None) or False
+            self._bpe_native = m
+        return m or None
+
     def _merge(self, tokens: list[int]) -> list[int]:
         """Greedy merge: repeatedly merge the best-scoring adjacent pair,
         leftmost on ties — the reference's policy (tokenizer.cpp:349-377,
         strict ``>`` comparison ⇒ first max wins), on a lazy-deletion heap
         over a doubly-linked token list. A heap entry is
         ``(-score, left_pos, left_ver, right_ver, right_pos, merged_id)``;
-        node versions invalidate entries whose endpoints merged since."""
+        node versions invalidate entries whose endpoints merged since.
+
+        The same algorithm also exists natively (native/tokenizer.cpp, the
+        C++ twin of the reference's C++ encode) and is preferred when built;
+        this Python path is the portable fallback and the equivalence oracle.
+        """
         n = len(tokens)
         if n < 2:
             return tokens
+        nat = self._native_merger()
+        if nat is not None:
+            out = nat.merge(tokens)
+            if out is not None:
+                return out
         ids = list(tokens)
         prev = list(range(-1, n - 1))
         nxt = list(range(1, n + 1))

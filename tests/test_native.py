@@ -103,3 +103,57 @@ def test_stale_on_host_signature_change(monkeypatch, tmp_path):
     monkeypatch.setattr(native, "_host_signature", lambda: "otherhost")
     assert native._stale()
     assert not native._so_path().exists()
+
+
+# -- native BPE merge engine (tokenizer.cpp) --------------------------------
+
+
+def _merge_rich_tokenizer():
+    import test_tokenizer
+
+    return test_tokenizer._merge_rich_tokenizer()
+
+
+def test_bpe_native_matches_python_heap():
+    """Native merge vs the Python heap fallback on the tie-heavy vocab —
+    identical output on every random input (both must equal the reference's
+    rescan policy; test_tokenizer proves heap == rescan)."""
+    t_nat = _merge_rich_tokenizer()
+    t_py = _merge_rich_tokenizer()
+    t_py._bpe_native = False  # pin the Python path
+    assert t_nat._native_merger() is not None, "native merger did not build"
+    rng = np.random.default_rng(7)
+    alphabet = "abcd "
+    for _ in range(300):
+        n = int(rng.integers(0, 64))
+        s = "".join(alphabet[i] for i in rng.integers(0, len(alphabet), n))
+        base = [t_nat._regular[bytes([b])] for b in s.encode()]
+        assert t_nat._merge(list(base)) == t_py._merge(list(base)), repr(s)
+
+
+def test_bpe_native_rejects_bad_ids():
+    t = _merge_rich_tokenizer()
+    m = t._native_merger()
+    assert m is not None
+    assert m.merge([0, 10 ** 6]) is None  # out-of-vocab id → fallback signal
+    assert m.merge([5]) == [5]
+    assert m.merge([]) == []
+
+
+def test_bpe_native_encode_is_fast():
+    """100k chars through the full encode (native merge) well under the
+    2s bound the Python path is held to — same corpus and vocab as
+    test_tokenizer.test_encode_100k_chars_under_2s."""
+    import time
+
+    from helpers import byte_vocab_tokenizer
+    from dllama_tpu.tokenizer.bpe import Tokenizer
+
+    t = Tokenizer(byte_vocab_tokenizer())
+    assert t._native_merger() is not None
+    text = "hello world " * 8500
+    t0 = time.perf_counter()
+    ids = t.encode(text)
+    dt = time.perf_counter() - t0
+    assert t.decode_all(ids) == text
+    assert dt < 1.5, f"native-backed encode took {dt:.2f}s"
