@@ -70,12 +70,27 @@ class NaiveCache:
                                             end_pos))
 
 
+def _request_stops(base: list[str], body: dict) -> list[str]:
+    """Tokenizer stop pieces + the request's OpenAI ``stop`` strings (str or
+    list). The reference parses this field but never feeds it to its
+    detector (dllama-api.cpp:509-513 vs :537-539) — honoring it is ours."""
+    req = body.get("stop")
+    if isinstance(req, str):
+        req = [req]
+    if not isinstance(req, list):
+        return base
+    return base + [s for s in req if isinstance(s, str) and s]
+
+
 class _EosGate:
     """EosDetector + text accumulation + delta emission, shared by both
     serving modes so EOS/stop-string semantics can't drift between them."""
 
     def __init__(self, tok, stop_pieces, emit=None):
-        max_stop = max((len(s) for s in stop_pieces), default=0)
+        # padding is in BYTES (the detector buffers UTF-8): a multi-byte
+        # request stop with char-sized padding could be scanned past and
+        # leak to the client (review finding)
+        max_stop = max((len(s.encode("utf-8")) for s in stop_pieces), default=0)
         self.detector = EosDetector(tok.eos_token_ids, stop_pieces,
                                     max_stop, max_stop)
         self.emit = emit
@@ -153,7 +168,9 @@ class ApiState:
                        prompt_end + max_tokens if max_tokens > 0 else engine.cfg.seq_len)
         self.cache.push(delta, prompt_end)
 
-        gate = _EosGate(tok, self.stop_pieces, emit)
+        stops = _request_stops(self.stop_pieces, body)
+        custom_stops = len(stops) > len(self.stop_pieces)
+        gate = _EosGate(tok, stops, emit)
         if prompt.public_prompt:
             gate._out(prompt.public_prompt)
 
@@ -173,8 +190,15 @@ class ApiState:
         if finish_reason == "length":
             gate.flush_tail()
 
-        self.cache.push([{"role": "assistant", "content": "".join(gate.parts)}],
-                        engine.pos)
+        if not (custom_stops and finish_reason == "stop"):
+            # a custom-stop finish leaves the hidden stop text and an
+            # unterminated assistant turn in KV — a cached continuation from
+            # engine.pos would decode against malformed context. Skip the
+            # push; the next request re-prefills the assistant text from the
+            # prompt cache point instead (correct, merely less cached).
+            self.cache.push(
+                [{"role": "assistant", "content": "".join(gate.parts)}],
+                engine.pos)
         return {
             "text": "".join(gate.parts),
             "finish_reason": finish_reason,
@@ -231,7 +255,7 @@ class BatchedApiState:
             stop_on_eos=True,
             on_token=lambda t, p: q.put((t, p)))
 
-        gate = _EosGate(tok, self.stop_pieces, emit)
+        gate = _EosGate(tok, _request_stops(self.stop_pieces, body), emit)
         if prompt.public_prompt:
             gate._out(prompt.public_prompt)
         n_completion = 0

@@ -77,6 +77,26 @@ def test_chat_completion_sse_stream(server):
     assert parsed[-1]["choices"][0]["finish_reason"] in ("stop", "length")
 
 
+def test_request_stop_strings(server):
+    """OpenAI ``stop`` per request: generation ends at the first custom stop
+    string, which is excluded from the returned text. (The reference parses
+    this field but never honors it — dllama-api.cpp:509-513.)"""
+    url, _ = server
+    base = {"messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 12, "temperature": 0}
+    with _post(url, base) as r:
+        full = json.loads(r.read())["choices"][0]["message"]["content"]
+    assert len(full) >= 4, full
+    stop = full[2:4]  # a substring the greedy run provably emits
+    with _post(url, {**base, "stop": stop}) as r:
+        data = json.loads(r.read())
+    choice = data["choices"][0]
+    assert choice["finish_reason"] == "stop"
+    got = choice["message"]["content"]
+    assert stop not in got
+    assert got == full[:full.index(stop)]
+
+
 def test_naive_cache_prefix_reuse(server):
     url, state = server
     convo = [{"role": "user", "content": "hi"}]
@@ -191,6 +211,23 @@ def test_batched_sse_stream(batched_server):
     chunks = [json.loads(ln[len("data: "):]) for ln in raw.splitlines()
               if ln.startswith("data: ") and "[DONE]" not in ln]
     assert any(c["choices"][0]["delta"].get("content") for c in chunks)
+
+
+def test_batched_request_stop_strings(batched_server):
+    """Custom stop strings under continuous batching: the slot is cancelled
+    at the match and the stop text is excluded."""
+    url, _ = batched_server
+    base = {"messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 12, "temperature": 0}
+    with _post(url, base) as r:
+        full = json.loads(r.read())["choices"][0]["message"]["content"]
+    assert len(full) >= 4, full
+    stop = full[2:4]
+    with _post(url, {**base, "stop": [stop]}) as r:
+        data = json.loads(r.read())
+    choice = data["choices"][0]
+    assert choice["finish_reason"] == "stop"
+    assert choice["message"]["content"] == full[:full.index(stop)]
 
 
 def test_eos_gate_flushes_maybe_eos_tail():
