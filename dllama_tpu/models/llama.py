@@ -378,6 +378,31 @@ def greedy_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), kv
 
 
+def verify_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                start_pos: jax.Array, kv: KVCache
+                ) -> tuple[jax.Array, jax.Array, KVCache]:
+    """Speculative greedy verify: ONE forward over ``tokens [B, K+1]`` (the
+    real next input followed by K drafted tokens) at positions
+    ``start_pos..start_pos+K``; ``preds[:, t]`` is the greedy argmax after
+    consuming ``tokens[:, :t+1]`` and ``n_acc`` is the longest draft prefix
+    the model agrees with (``tokens[:, i+1] == preds[:, i]``). The caller
+    emits ``preds[:, :n_acc+1]`` — exactly what n_acc+1 sequential
+    greedy_step calls would produce, for one dispatch whose HBM cost is a
+    single decode step (weights dominate; the K extra rows ride the same
+    weight reads on the MXU).
+
+    KV safety is the decode-chunk argument (engine module docstring): rows
+    written for rejected drafts sit at positions > the committed point,
+    invisible to the causal mask, and the next dispatch's K+1 writes start
+    exactly where the stale region starts. No reference analogue — the
+    reference decodes strictly one token per step (dllama.cpp:88-99)."""
+    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+    ok = (tokens[:, 1:] == preds[:, :-1]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(ok, axis=-1), axis=-1)  # [B]
+    return n_acc, preds, kv
+
+
 def scan_decode(step1, token: jax.Array, start_pos: jax.Array, kv: KVCache,
                 n_steps: int, coins: jax.Array | None = None):
     """The one multi-step decode scan shared by every chunked variant

@@ -48,6 +48,9 @@ CTRL_SAMPLED = 4
 # bits in slots 4..4+K, temp/topp in the trailing scalar slots.
 CTRL_GREEDY_CHUNK = 5
 CTRL_SAMPLED_CHUNK = 6
+# speculative verify: tokens = [seed, draft_1..draft_K] in the ordinary
+# token slots; workers co-execute the same verify dispatch
+CTRL_SPEC_VERIFY = 7
 
 
 class RootLostError(RuntimeError):
@@ -300,6 +303,19 @@ def replicated_greedy(params, cfg, tokens, start_pos, kv):
     return constrain(tok, None), kv
 
 
+def replicated_verify(params, cfg, tokens, start_pos, kv):
+    """Speculative verify with replicated (host-addressable) results."""
+    import jax.numpy as jnp
+
+    from .api import constrain
+
+    logits, kv = replicated_forward(params, cfg, tokens, start_pos, kv)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    ok = (tokens[:, 1:] == preds[:, :-1]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(ok, axis=-1), axis=-1)
+    return constrain(n_acc, None), constrain(preds, None, None), kv
+
+
 def replicated_sampled(params, cfg, tokens, start_pos, kv,
                        temperature, topp, coin):
     """Fused sampled decode with a replicated token result (every host reads
@@ -368,6 +384,8 @@ def worker_serve(engine: "InferenceEngine", *,
             token, sp0, k, coins, temp, topp = codec.decode_chunk_packet(buf)
             engine._run_chunk(token, sp0, k, kind == CTRL_GREEDY_CHUNK,
                               temp, topp, coins)
+        elif kind == CTRL_SPEC_VERIFY:
+            engine._run_verify(tokens, start_pos)
         else:
             engine._dispatch(engine._step, tokens, start_pos)
         served += 1

@@ -37,6 +37,7 @@ from ..models.llama import (
     load_params_from_mfile,
     sampled_step,
     sampled_steps,
+    verify_step,
 )
 from ..parallel.api import MeshPlan, make_mesh, use_plan
 from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
@@ -95,7 +96,7 @@ class InferenceEngine:
                  n_batches: int = DEFAULT_N_BATCHES,
                  temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5,
                  multihost: bool = False, host_sampling: bool = False,
-                 decode_chunk: int = 1):
+                 decode_chunk: int = 1, spec_lookup: int = 0):
         self.model_file = ModelFile.open(model_path, max_seq_len=max_seq_len,
                                          sync_type=sync_type)
         self.cfg = ModelConfig.from_header(self.model_file.header,
@@ -123,6 +124,19 @@ class InferenceEngine:
                 f"decode_chunk {self.decode_chunk} exceeds the control "
                 f"packet's capacity of {self.n_batches - 1} coins "
                 f"(raise --nbatches or lower --decode-chunk)")
+        # prompt-lookup speculative decode (greedy only): verify K drafted
+        # tokens per dispatch (models.llama.verify_step), drafts from the
+        # token history (runtime.speculative.NgramProposer). Output is
+        # bit-identical to plain greedy; K+1 tokens must fit a control
+        # packet's token slots under multihost.
+        self.spec_lookup = 0 if host_sampling else max(0, spec_lookup)
+        if self.spec_lookup and self.decode_chunk > 1:
+            raise ValueError("--spec-lookup and --decode-chunk are exclusive "
+                             "(both multiply tokens per dispatch)")
+        if multihost and self.spec_lookup + 1 > self.n_batches:
+            raise ValueError(
+                f"spec_lookup {self.spec_lookup} exceeds the control packet's "
+                f"{self.n_batches} token slots (raise --nbatches)")
 
         n_dev = len(jax.devices())
         if tp is None:
@@ -195,6 +209,10 @@ class InferenceEngine:
             self._sampled_steps = jax.jit(replicated_sampled_steps,
                                           static_argnums=(1, 8),
                                           donate_argnums=(4,))
+            from ..parallel.multihost import replicated_verify
+
+            self._verify_step = jax.jit(replicated_verify, static_argnums=1,
+                                        donate_argnums=(4,))
         else:
             self._step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
             # greedy fast path: argmax fused into the step — ONE dispatch per
@@ -210,6 +228,8 @@ class InferenceEngine:
                                          donate_argnums=(4,))
             self._sampled_steps = jax.jit(sampled_steps, static_argnums=(1, 8),
                                           donate_argnums=(4,))
+            self._verify_step = jax.jit(verify_step, static_argnums=1,
+                                        donate_argnums=(4,))
 
     def _fresh_kv(self) -> KVCache:
         # cache rides the compute dtype: f32 for parity, bf16 halves HBM
@@ -377,6 +397,33 @@ class InferenceEngine:
                     jnp.asarray(coins, dtype=jnp.float32), k)
         return np.asarray(toks)
 
+    def speculative_tokens(self, token: int, drafts: list[int]) -> list[int]:
+        """One speculative verify dispatch (greedy only): returns the
+        accepted run of 1..K+1 tokens — exactly what that many single greedy
+        steps would emit. Uncommitted like :meth:`decode_chunk_tokens`: the
+        caller truncates at EOS and calls :meth:`commit_chunk` with the kept
+        count (each kept token corresponds to one consumed input position).
+        Rejected-draft KV rows sit beyond the committed point: causal-masked,
+        then overwritten by the next dispatch's K+1 writes, which start
+        exactly where they begin."""
+        assert self.sampler.temperature == 0.0 and not self.host_sampling
+        toks = np.asarray([[token, *drafts]], dtype=np.int32)
+        assert self.pos + toks.shape[1] <= self.cfg.seq_len
+        if self.multihost and self._is_root:
+            from ..parallel.multihost import CTRL_SPEC_VERIFY
+
+            self._ctrl.send(self._ctrl.encode(CTRL_SPEC_VERIFY, toks, self.pos))
+        n_acc, preds = self._run_verify(toks, self.pos)
+        return [int(t) for t in preds[0, : n_acc + 1]]
+
+    def _run_verify(self, tokens_2d, start_pos: int):
+        """Dispatch one verify step (root and worker replay path)."""
+        with (use_plan(self.plan) if self.plan is not None else nullcontext()):
+            n_acc, preds, self.kv = self._verify_step(
+                self.params, self.cfg, jnp.asarray(tokens_2d, jnp.int32),
+                jnp.int32(start_pos), self.kv)
+        return int(np.asarray(n_acc)[0]), np.asarray(preds)
+
     def commit_chunk(self, n_keep: int) -> None:
         """Advance position and sampler RNG by the kept prefix of a chunk."""
         self.pos += n_keep
@@ -425,11 +472,37 @@ class InferenceEngine:
             return (stop_on_eos and self.tokenizer is not None
                     and self.tokenizer.is_eos(tok))
 
+        proposer = None
+        if self.spec_lookup and self.sampler.temperature == 0.0:
+            from .speculative import NgramProposer
+
+            proposer = NgramProposer(self.spec_lookup)
+            proposer.extend(ids)
+
         stop = False
         while len(out_tokens) < limit and not stop:
             # Full-size chunks only: n_steps is a static jit argument, so a
             # smaller tail chunk would compile a fresh program mid-generation
             # (a multi-second stall on TPU). Tails run the single-step path.
+            if (proposer is not None
+                    and self.cfg.seq_len - self.pos >= self.spec_lookup + 1):
+                t0 = time.perf_counter()
+                run = self.speculative_tokens(token, proposer.draft())
+                run = run[: limit - len(out_tokens)]
+                n_keep = len(run)
+                if stop_on_eos and self.tokenizer is not None:
+                    for j, tok in enumerate(run):
+                        if self.tokenizer.is_eos(tok):
+                            n_keep = j + 1
+                            break
+                self.commit_chunk(n_keep)  # greedy: positions only
+                steps.append(StepMetrics(
+                    "pred", (time.perf_counter() - t0) * 1000.0, n_keep))
+                for tok in run[:n_keep]:
+                    stop = emit(tok)
+                proposer.extend(run[:n_keep])
+                token = run[n_keep - 1]
+                continue
             k = self.decode_chunk
             if (limit - len(out_tokens) < k
                     or self.cfg.seq_len - self.pos < k):

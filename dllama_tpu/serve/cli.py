@@ -64,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "back on device; output identical to K=1, EOS "
                         "overshoot discarded). Cuts per-token dispatch "
                         "overhead; streaming granularity becomes K tokens")
+    p.add_argument("--spec-lookup", type=int, default=0, metavar="K",
+                   help="prompt-lookup speculative decode (greedy only): "
+                        "verify K history-drafted tokens per dispatch; "
+                        "output identical to plain greedy, accepted drafts "
+                        "multiply decode throughput (HBM cost of a verify "
+                        "is one decode step)")
     p.add_argument("--host-sampling", action="store_true",
                    help="sample on host from downloaded logits (parity oracle) "
                         "instead of the fused on-device sampler")
@@ -145,6 +151,7 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         temperature=args.temperature, topp=args.topp, seed=seed,
         multihost=multihost, host_sampling=args.host_sampling,
         decode_chunk=args.decode_chunk,
+        spec_lookup=getattr(args, "spec_lookup", 0),
     )
     h = engine.model_file.header
     print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "
@@ -207,7 +214,8 @@ def run_chat(args) -> int:
         tok.chat_template, eos=eos_piece,
         type=ChatTemplateType(args.chat_template or "unknown"))
     stop_pieces = [tok.vocab[t].decode("utf-8", "replace") for t in tok.eos_token_ids]
-    max_stop = max((len(s) for s in stop_pieces), default=0)
+    # padding in BYTES — the detector buffers UTF-8 (see api._EosGate)
+    max_stop = max((len(s.encode("utf-8")) for s in stop_pieces), default=0)
     detector = EosDetector(tok.eos_token_ids, stop_pieces, max_stop, max_stop)
 
     first = True

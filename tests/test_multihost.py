@@ -357,6 +357,66 @@ CLEAN_ROOT_SCRIPT = textwrap.dedent("""
 """)
 
 
+SPEC_ROOT_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, sys.argv[1])
+    from dllama_tpu.parallel.multihost import init_distributed
+    init_distributed(sys.argv[2], 2, 0, platform="cpu")
+    from dllama_tpu.formats.quants import Q80
+    from dllama_tpu.runtime.engine import InferenceEngine
+    eng = InferenceEngine(sys.argv[3], sys.argv[4], tp=2, temperature=0.0,
+                          sync_type=Q80, multihost=True)
+    plain = eng.generate([1, 2, 3, 1, 2], max_tokens=8, stop_on_eos=False)
+    eng.close()
+    print("PLAIN=" + ",".join(map(str, plain.tokens)), flush=True)
+""")
+
+SPEC2_ROOT_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, sys.argv[1])
+    from dllama_tpu.parallel.multihost import init_distributed
+    init_distributed(sys.argv[2], 2, 0, platform="cpu")
+    from dllama_tpu.formats.quants import Q80
+    from dllama_tpu.runtime.engine import InferenceEngine
+    eng = InferenceEngine(sys.argv[3], sys.argv[4], tp=2, temperature=0.0,
+                          sync_type=Q80, multihost=True, spec_lookup=2)
+    spec = eng.generate([1, 2, 3, 1, 2], max_tokens=8, stop_on_eos=False)
+    eng.close()
+    print("SPEC=" + ",".join(map(str, spec.tokens)), flush=True)
+""")
+
+
+def test_two_process_speculative_decode(tiny_files):
+    """Speculative verify packets (CTRL_SPEC_VERIFY) across the control
+    channel: the worker co-executes the verify dispatches and the transcript
+    matches the plain-greedy 2-process run."""
+    m, t = tiny_files
+    coord = f"127.0.0.1:{PORT + 6}"
+    tokens = {}
+    for script, key, extra in [(SPEC_ROOT_SCRIPT, "PLAIN=", ()),
+                               (SPEC2_ROOT_SCRIPT, "SPEC=",
+                                ("--spec-lookup", "2"))]:
+        root = _spawn_root(script, coord, m, t)
+        worker = _spawn_worker(coord, m, t, *extra)
+        try:
+            root_out, _ = root.communicate(timeout=300)
+            worker_out, _ = worker.communicate(timeout=120)
+        finally:
+            for p in (root, worker):
+                if p.poll() is None:
+                    p.kill()
+        rtxt = root_out.decode(errors="replace")
+        wtxt = worker_out.decode(errors="replace")
+        assert root.returncode == 0, f"root failed:\n{rtxt[-3000:]}"
+        assert worker.returncode == 0, f"worker failed:\n{wtxt[-3000:]}"
+        line = [ln for ln in rtxt.splitlines() if ln.startswith(key)]
+        assert line, rtxt[-2000:]
+        tokens[key] = line[0][len(key):]
+    assert tokens["PLAIN="] == tokens["SPEC="], tokens
+
+
 @pytest.fixture(scope="module")
 def tiny_files(tmp_path_factory):
     d = tmp_path_factory.mktemp("resilience")

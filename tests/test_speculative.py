@@ -1,0 +1,171 @@
+"""Prompt-lookup speculative decode: exactness and acceptance.
+
+Speculative greedy must be BIT-IDENTICAL to plain greedy on every input —
+the verify step accepts exactly the prefix the model itself would have
+produced (models.llama.verify_step) — while a self-repeating prompt must
+show real multi-token acceptance (fewer dispatches than tokens). The
+reference has no speculative path (one token per step, dllama.cpp:88-99);
+this is a TPU-economics feature: decode is HBM-bound, so tokens per weight
+read is the lever.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import quants, tfile
+from dllama_tpu.models import ModelConfig, init_random_params
+from dllama_tpu.models.llama import greedy_step, verify_step
+from dllama_tpu.runtime import KVCache
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.runtime.speculative import NgramProposer
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+# -- proposer ---------------------------------------------------------------
+
+
+def test_proposer_drafts_previous_continuation():
+    p = NgramProposer(3)
+    p.extend([1, 2, 3, 4, 9, 1, 2])  # trailing bigram (1,2) seen before at ..3,4
+    assert p.draft() == [3, 4, 9]
+
+
+def test_proposer_pads_short_continuation():
+    p = NgramProposer(4)
+    p.extend([1, 2, 3, 1, 2])  # earlier (1,2) is followed only by [3, 1, 2]
+    assert p.draft() == [3, 1, 2, 2]
+
+
+def test_proposer_no_signal_repeats_last():
+    p = NgramProposer(2)
+    p.extend([5, 6, 7])
+    assert p.draft() == [7, 7]
+    assert NgramProposer(2).draft() == [0, 0]
+
+
+def test_proposer_self_overlap():
+    p = NgramProposer(3)
+    p.extend([8, 8, 8, 8])  # overlapping (8,8): drafts self-extension
+    assert p.draft() == [8, 8, 8]
+
+
+# -- verify_step vs sequential greedy ---------------------------------------
+
+
+def _cfg():
+    from dllama_tpu.formats import mfile
+
+    return ModelConfig(
+        arch=mfile.ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=16, vocab_size=256, seq_len=64,
+        norm_epsilon=1e-5, rope_theta=10000.0, rope_type=mfile.RopeType.LLAMA)
+
+
+@pytest.mark.parametrize("trial", range(3))
+def test_verify_matches_sequential_greedy(trial):
+    cfg = _cfg()
+    params = init_random_params(cfg, seed=trial)
+    rng = np.random.default_rng(trial)
+    token = int(rng.integers(0, cfg.vocab_size))
+    drafts = [int(t) for t in rng.integers(0, cfg.vocab_size, 4)]
+    pos = 0
+
+    # sequential oracle
+    kv = KVCache.create(cfg)
+    step = jax.jit(greedy_step, static_argnums=1)
+    seq = []
+    t = token
+    for i in range(len(drafts) + 1):
+        nxt, kv = step(params, cfg, jnp.asarray([[t]]), jnp.int32(pos + i), kv)
+        seq.append(int(nxt[0]))
+        t = seq[-1]
+
+    # one verify dispatch
+    kv2 = KVCache.create(cfg)
+    ver = jax.jit(verify_step, static_argnums=1)
+    n_acc, preds, _ = ver(params, cfg,
+                          jnp.asarray([[token, *drafts]], jnp.int32),
+                          jnp.int32(pos), kv2)
+    n_acc = int(n_acc[0])
+    preds = np.asarray(preds)[0]
+
+    # the accepted run equals the sequential transcript prefix
+    assert [int(x) for x in preds[: n_acc + 1]] == seq[: n_acc + 1]
+    # acceptance is exactly the longest draft prefix matching the oracle
+    expect_acc = 0
+    for i, d in enumerate(drafts):
+        if d == seq[i]:
+            expect_acc += 1
+        else:
+            break
+    assert n_acc == expect_acc
+
+
+# -- engine end-to-end ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("spec")
+    tok = byte_vocab_tokenizer()
+    hdr = tiny_header_params(vocab_size=tok.vocab_size, seq_len=128,
+                             weight_type=quants.Q40)
+    write_tiny_model(d / "m.m", hdr, np.random.default_rng(11))
+    tfile.write_tfile(d / "t.t", tok)
+    return str(d / "m.m"), str(d / "t.t")
+
+
+def _gen(model_files, prompt, steps, **kw):
+    m, t = model_files
+    eng = InferenceEngine(m, t, temperature=0.0, **kw)
+    try:
+        out = eng.generate(prompt, steps, stop_on_eos=False)
+    finally:
+        eng.close()
+    return out
+
+
+@pytest.mark.parametrize("prompt", ["the quick brown fox", "ababababababab"])
+def test_speculative_identical_to_plain_greedy(model_files, prompt):
+    plain = _gen(model_files, prompt, 48)
+    spec = _gen(model_files, prompt, 48, spec_lookup=4)
+    assert spec.tokens == plain.tokens
+    assert spec.text == plain.text
+
+
+def test_speculative_accepts_on_repetitive_output(model_files):
+    """Greedy decode on a tiny random model degenerates into a cycle; the
+    proposer must exploit it: strictly fewer dispatches than tokens."""
+    spec = _gen(model_files, "hello hello hello hello", 64, spec_lookup=4)
+    pred_steps = [s for s in spec.steps if s.kind == "pred"]
+    n_tokens = sum(s.n_tokens for s in pred_steps)
+    assert n_tokens == len(spec.tokens)
+    assert len(pred_steps) < n_tokens, (
+        f"no acceptance: {len(pred_steps)} dispatches for {n_tokens} tokens")
+
+
+def test_spec_and_chunk_are_exclusive(model_files):
+    m, t = model_files
+    with pytest.raises(ValueError, match="exclusive"):
+        InferenceEngine(m, t, temperature=0.0, spec_lookup=4, decode_chunk=8)
+
+
+def test_spec_ignored_at_temperature(model_files):
+    """temperature>0 keeps the sampled path (speculative is greedy-only)."""
+    m, t = model_files
+    eng = InferenceEngine(m, t, temperature=0.9, seed=7, spec_lookup=4)
+    try:
+        a = eng.generate("the quick", 24, stop_on_eos=False).tokens
+    finally:
+        eng.close()
+    eng2 = InferenceEngine(m, t, temperature=0.9, seed=7)
+    try:
+        b = eng2.generate("the quick", 24, stop_on_eos=False).tokens
+    finally:
+        eng2.close()
+    assert a == b
