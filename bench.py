@@ -446,6 +446,30 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
         jax.block_until_ready(toks)
         dt = time.perf_counter() - t0
         out["chunked_decode_tok_per_s"] = round(rounds * K / dt, 2)
+
+    # speculative verify cost: ms for a K=4 verify dispatch vs a plain decode
+    # step. On an HBM-bound chip the ratio should approach 1.0 — that ratio
+    # times the workload's acceptance rate is the --spec-lookup speedup.
+    if batch == 1 and time.monotonic() < deadline:
+        from dllama_tpu.models.llama import verify_step
+
+        out["phase"] = "spec_verify"
+        ver = jax.jit(verify_step, static_argnums=1, donate_argnums=(4,))
+        vt = jnp.ones((1, 5), jnp.int32)
+        _, _, kv = ver(params, cfg, vt, jnp.int32(pos), kv)  # compile
+        jax.block_until_ready(kv.k)
+        if time.monotonic() < deadline:
+            n = 16
+            t0 = time.perf_counter()
+            for i in range(n):
+                n_acc, preds, kv = ver(params, cfg, vt,
+                                       jnp.int32(pos + 5 * (i + 1)), kv)
+            jax.block_until_ready(preds)
+            out["verify_k4_ms"] = round(
+                1000.0 * (time.perf_counter() - t0) / n, 3)
+            if "decode_ms_per_step" in out and out["decode_ms_per_step"]:
+                out["verify_k4_over_decode"] = round(
+                    out["verify_k4_ms"] / out["decode_ms_per_step"], 3)
     out["phase"] = "done"
     return out
 

@@ -129,7 +129,10 @@ class InferenceEngine:
         # token history (runtime.speculative.NgramProposer). Output is
         # bit-identical to plain greedy; K+1 tokens must fit a control
         # packet's token slots under multihost.
-        self.spec_lookup = 0 if host_sampling else max(0, spec_lookup)
+        self.spec_lookup = max(0, spec_lookup)
+        if self.spec_lookup and host_sampling:
+            raise ValueError("--spec-lookup requires the fused device path "
+                             "(drop --host-sampling)")
         if self.spec_lookup and self.decode_chunk > 1:
             raise ValueError("--spec-lookup and --decode-chunk are exclusive "
                              "(both multiply tokens per dispatch)")

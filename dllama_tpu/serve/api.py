@@ -179,9 +179,32 @@ class ApiState:
         token = ids[prompt_end - start_pos] if prompt_end - start_pos < len(ids) else ids[-1]
         tok.reset_decoder()
 
+        proposer = None
+        if engine.spec_lookup and engine.sampler.temperature == 0.0:
+            from ..runtime.speculative import NgramProposer
+
+            proposer = NgramProposer(engine.spec_lookup)
+            proposer.extend(ids)
+
         n_completion = 0
         finish_reason = "length"
         while engine.pos < max_pred:
+            if (proposer is not None
+                    and max_pred - engine.pos >= engine.spec_lookup + 1):
+                run = engine.speculative_tokens(token, proposer.draft())
+                n_keep, stopped = len(run), False
+                for j, t in enumerate(run):
+                    if gate.feed(t, tok.decode(t)):
+                        n_keep, stopped = j + 1, True
+                        break
+                engine.commit_chunk(n_keep)
+                n_completion += n_keep
+                proposer.extend(run[:n_keep])
+                token = run[n_keep - 1]
+                if stopped:
+                    finish_reason = "stop"
+                    break
+                continue
             token = engine.next_token(token)
             n_completion += 1
             if gate.feed(token, tok.decode(token)):
@@ -397,6 +420,9 @@ def run_api_server(args) -> int:
         server = ThreadingHTTPServer((args.host, args.port),
                                      make_handler(state))
         print(f"🕸️ continuous batching: {n_slots} slots")
+        if engine.spec_lookup:
+            print("🚧 --spec-lookup is per-sequence and does not apply to "
+                  "the batched scheduler; ignoring it for this server")
     else:
         state = ApiState(engine, template_type=ttype)
         server = HTTPServer((args.host, args.port), make_handler(state))

@@ -26,7 +26,7 @@ import time
 from ..formats.quants import F32, Q80
 from ..runtime.engine import DEFAULT_N_BATCHES, InferenceEngine
 from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
-                              ChatTemplateType, EosDetector, EosResult)
+                              ChatTemplateType)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -213,10 +213,13 @@ def run_chat(args) -> int:
     template = ChatTemplateGenerator(
         tok.chat_template, eos=eos_piece,
         type=ChatTemplateType(args.chat_template or "unknown"))
+    from .api import _EosGate  # function-level: api imports make_engine from us
+
     stop_pieces = [tok.vocab[t].decode("utf-8", "replace") for t in tok.eos_token_ids]
-    # padding in BYTES — the detector buffers UTF-8 (see api._EosGate)
-    max_stop = max((len(s.encode("utf-8")) for s in stop_pieces), default=0)
-    detector = EosDetector(tok.eos_token_ids, stop_pieces, max_stop, max_stop)
+
+    def _print_delta(d: str) -> None:
+        sys.stdout.write(d)
+        sys.stdout.flush()
 
     first = True
     while True:
@@ -240,29 +243,16 @@ def run_chat(args) -> int:
 
         _, _ = engine.prefill(ids[:-1]) if len(ids) > 1 else (None, [])
         token = ids[-1]
-        detector.reset()
+        gate = _EosGate(tok, stop_pieces, emit=_print_delta)
         tok.reset_decoder()
-        while engine.pos < engine.cfg.seq_len:
+        stopped = False
+        while engine.pos < engine.cfg.seq_len and not stopped:
             token = engine.next_token(token)
-            piece = tok.decode(token)
-            res = detector.append(token, piece)
-            if res == EosResult.NOT_EOS:
-                delta = detector.get_delta()
-                if delta:
-                    sys.stdout.write(delta)
-                    sys.stdout.flush()
-                detector.reset()
-            elif res == EosResult.EOS:
-                delta = detector.get_delta()
-                if delta:
-                    sys.stdout.write(delta)
-                    sys.stdout.flush()
-                break
-        # flush anything still buffered as MAYBE_EOS when the loop exits on
-        # the seq_len bound rather than a stop match
-        tail = detector.get_delta()
-        if tail and engine.pos >= engine.cfg.seq_len:
-            sys.stdout.write(tail)
+            stopped = gate.feed(token, tok.decode(token))
+        if not stopped:
+            # flush anything still buffered as MAYBE_EOS when the loop exits
+            # on the seq_len bound rather than a stop match
+            gate.flush_tail()
             sys.stdout.flush()
         print()
     engine.close()

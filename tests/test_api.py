@@ -230,6 +230,30 @@ def test_batched_request_stop_strings(batched_server):
     assert choice["message"]["content"] == full[:full.index(stop)]
 
 
+def test_api_speculative_matches_plain(tmp_path):
+    """ApiState.complete with an engine built with spec_lookup: identical
+    text/usage to the plain engine (speculative greedy is exact)."""
+    mpath, tpath = tmp_path / "m.m", tmp_path / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(9))
+    td = byte_vocab_tokenizer()
+    td.chat_template = "<|start_header_id|>"
+    tfile.write_tfile(tpath, td)
+    body = {"messages": [{"role": "user", "content": "hello hello"}],
+            "max_tokens": 24, "temperature": 0}
+    results = []
+    for kw in ({}, {"spec_lookup": 4}):
+        eng = InferenceEngine(str(mpath), str(tpath), temperature=0.0, **kw)
+        try:
+            results.append(ApiState(eng).complete(dict(body)))
+        finally:
+            eng.close()
+    plain, spec = results
+    assert spec["text"] == plain["text"]
+    assert spec["completion_tokens"] == plain["completion_tokens"]
+    assert spec["finish_reason"] == plain["finish_reason"]
+
+
 def test_eos_gate_flushes_maybe_eos_tail():
     """Generation ending by LENGTH with a buffered stop-piece prefix must
     flush that text instead of silently truncating (review finding)."""
