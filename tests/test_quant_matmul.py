@@ -30,6 +30,28 @@ def test_kernel_matches_xla_oracle(m, n, k):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_kernel_q80_planes():
+    """Q80 weights land in the same (scales, int8-codes) planes — the kernel
+    consumes them unchanged (codes*scales; nothing 4-bit-specific). The
+    codes span the full int8 range here, unlike Q40's [-8, 7]."""
+    from dllama_tpu.formats.quants import quantize_q80, unpack_q80
+
+    rng = np.random.default_rng(5)
+    w = (rng.standard_normal((256, 512)) * 0.1).astype(np.float32)
+    scales, codes = unpack_q80(quantize_q80(w.reshape(-1)), w.size)
+    from dllama_tpu.ops.linear import QuantizedWeight
+
+    qw = QuantizedWeight(
+        scales=jnp.asarray(scales.reshape(256, 16).T.astype(np.float32)),
+        codes=jnp.asarray(np.ascontiguousarray(codes.reshape(256, 512).T)))
+    assert int(np.abs(np.asarray(qw.codes)).max()) > 8  # genuinely 8-bit
+    x = jnp.asarray(rng.standard_normal((4, 512)), jnp.float32)
+    want = linear(x, qw)
+    got = quant_matmul(x, qw, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_kernel_3d_batch():
     w = _mk(256, 512, seed=1)
     x = jnp.asarray(np.random.default_rng(2).standard_normal((2, 3, 512)), jnp.float32)
