@@ -1,8 +1,9 @@
 """Prompt-lookup speculative drafting for greedy decode.
 
 Drafts come from the token history itself — the K tokens that followed the
-most recent *earlier* occurrence of the current trailing bigram — so there is
-no draft model, no extra device memory, and no new failure mode: a bad draft
+most recent *earlier* occurrence of the current trailing n-gram (trigram
+first, bigram fallback) — so there is no draft model, no extra device
+memory, and no new failure mode: a bad draft
 costs nothing (the verify dispatch happens regardless and its HBM cost is one
 decode step), a good draft advances several positions at once. Greedy output
 is bit-identical to plain decode by construction (models.llama.verify_step
@@ -17,40 +18,49 @@ from __future__ import annotations
 
 
 class NgramProposer:
-    """Bigram-continuation draft table over the generation history.
+    """N-gram-continuation draft table over the generation history.
 
-    ``_latest`` maps each bigram to the index just past its most recent
-    occurrence; ``_prev`` keeps the occurrence before that. At draft time the
-    trailing bigram's ``_latest`` entry is (by construction) the tail itself,
-    so ``_prev`` is the most recent place the same bigram appeared earlier —
-    the continuation that followed it is the draft.
+    ``_latest`` maps each n-gram (n-tuples of different lengths can't
+    collide, so one flat table serves both) to the index just past its most
+    recent occurrence; ``_prev`` keeps the occurrence before that. At draft
+    time the trailing n-gram's ``_latest`` entry is (by construction) the
+    tail itself, so ``_prev`` is the most recent place the same n-gram
+    appeared earlier — the continuation that followed it is the draft.
+    Trigram matches are tried first: a longer match predicts the
+    continuation with higher precision, and a wrong draft costs nothing
+    while a right one saves a dispatch.
     """
+
+    _NS = (3, 2)
 
     def __init__(self, k: int):
         assert k >= 1
         self.k = k
         self.history: list[int] = []
-        self._latest: dict[tuple[int, int], int] = {}
-        self._prev: dict[tuple[int, int], int] = {}
+        self._latest: dict[tuple, int] = {}
+        self._prev: dict[tuple, int] = {}
 
     def extend(self, tokens) -> None:
         h = self.history
         for t in tokens:
             h.append(int(t))
-            if len(h) >= 2:
-                key = (h[-2], h[-1])
-                old = self._latest.get(key)
-                if old is not None:
-                    self._prev[key] = old
-                self._latest[key] = len(h)
+            for n in self._NS:
+                if len(h) >= n:
+                    key = tuple(h[-n:])
+                    old = self._latest.get(key)
+                    if old is not None:
+                        self._prev[key] = old
+                    self._latest[key] = len(h)
 
     def draft(self) -> list[int]:
         """Always K tokens (verify needs a static shape); with no history
         signal the draft repeats the last token — frequently right in code
         and lists, harmless otherwise."""
         h = self.history
-        if len(h) >= 2:
-            q = self._prev.get((h[-2], h[-1]))
+        for n in self._NS:
+            if len(h) < n:
+                continue
+            q = self._prev.get(tuple(h[-n:]))
             if q is not None:
                 d = h[q:q + self.k]
                 if d:

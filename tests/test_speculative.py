@@ -54,6 +54,22 @@ def test_proposer_self_overlap():
     assert p.draft() == [8, 8, 8]
 
 
+def test_proposer_trigram_beats_bigram():
+    """Two continuations of the bigram (1,2) exist; the trailing TRIGRAM
+    (9,1,2) disambiguates to the second one."""
+    p = NgramProposer(2)
+    p.extend([0, 1, 2, 7, 7,    # (1,2) -> 7,7  (bigram candidate)
+              9, 1, 2, 5, 5,    # (9,1,2) -> 5,5 (trigram match)
+              9, 1, 2])
+    assert p.draft() == [5, 5]
+
+
+def test_proposer_bigram_fallback_when_trigram_unseen():
+    p = NgramProposer(2)
+    p.extend([4, 1, 2, 7, 7, 3, 1, 2])  # trailing trigram (3,1,2) unseen
+    assert p.draft() == [7, 7]
+
+
 # -- verify_step vs sequential greedy ---------------------------------------
 
 
