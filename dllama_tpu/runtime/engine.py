@@ -400,6 +400,13 @@ class InferenceEngine:
                     jnp.asarray(coins, dtype=jnp.float32), k)
         return np.asarray(toks)
 
+    @property
+    def spec_active(self) -> bool:
+        """Whether generation will use speculative verify dispatches — the
+        ONE eligibility rule (engine loop, API loop, CLI stats all key off
+        this)."""
+        return bool(self.spec_lookup) and self.sampler.temperature == 0.0
+
     def speculative_tokens(self, token: int, drafts: list[int]) -> list[int]:
         """One speculative verify dispatch (greedy only): returns the
         accepted run of 1..K+1 tokens — exactly what that many single greedy
@@ -476,7 +483,7 @@ class InferenceEngine:
                     and self.tokenizer.is_eos(tok))
 
         proposer = None
-        if self.spec_lookup and self.sampler.temperature == 0.0:
+        if self.spec_active:
             from .speculative import NgramProposer
 
             proposer = NgramProposer(self.spec_lookup)

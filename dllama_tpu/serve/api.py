@@ -180,7 +180,7 @@ class ApiState:
         tok.reset_decoder()
 
         proposer = None
-        if engine.spec_lookup and engine.sampler.temperature == 0.0:
+        if engine.spec_active:
             from ..runtime.speculative import NgramProposer
 
             proposer = NgramProposer(engine.spec_lookup)

@@ -200,6 +200,10 @@ def run_inference(args) -> int:
     print(f"    nTokens: {n_pred}")
     print(f"   tokens/s: {result.pred_tok_per_s:.2f} "
           f"({result.pred_ms / max(1, n_pred):.2f} ms/tok)")
+    if engine.spec_active:
+        n_disp = sum(1 for s in result.steps if s.kind == "pred")
+        print(f"  spec rate: {n_pred / max(1, n_disp):.2f} tokens/dispatch "
+              f"({n_disp} dispatches)")
     engine.close()
     return 0
 
