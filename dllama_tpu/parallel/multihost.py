@@ -259,6 +259,7 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         s32(engine.weight_mode),
         s32(engine.cfg.attn_impl),
         s32(engine.cfg.moe_impl),
+        s32(str(engine.kv_dtype)),
     ], dtype=np.int32)
     root_fp = np.asarray(multihost_utils.broadcast_one_to_all(
         fp, is_source=jax.process_index() == 0))
@@ -271,7 +272,7 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         raise ValueError(
             f"multihost config mismatch on process {jax.process_index()}: "
             f"local [n_batches, tp, sp, pp, seq_len, n_layers, dim, vocab, "
-            f"sync_q80, dtype, weight_mode, attn_impl, moe_impl] = "
+            f"sync_q80, dtype, weight_mode, attn_impl, moe_impl, kv_dtype] = "
             f"{fp.tolist()} vs root {root_fp.tolist()} — start every process "
             f"with identical model files and flags")
     if any_bad.sum() > 0:

@@ -96,7 +96,8 @@ class InferenceEngine:
                  n_batches: int = DEFAULT_N_BATCHES,
                  temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5,
                  multihost: bool = False, host_sampling: bool = False,
-                 decode_chunk: int = 1, spec_lookup: int = 0):
+                 decode_chunk: int = 1, spec_lookup: int = 0,
+                 kv_dtype: str = "auto"):
         self.model_file = ModelFile.open(model_path, max_seq_len=max_seq_len,
                                          sync_type=sync_type)
         self.cfg = ModelConfig.from_header(self.model_file.header,
@@ -111,6 +112,18 @@ class InferenceEngine:
         self.tokenizer = Tokenizer.load(tokenizer_path) if tokenizer_path else None
         self.sampler = Sampler(self.cfg.vocab_size, temperature, topp, seed)
         self.host_sampling = host_sampling
+        # KV cache dtype: "auto" rides the compute dtype; "f8" stores the
+        # cache as float8_e4m3 — half of bf16's footprint and read bandwidth
+        # with no scale bookkeeping (both attention paths already upcast
+        # reads to f32). Long-context decode is KV-bandwidth-bound, so this
+        # is the context-length analogue of Q40 weights. Beyond parity: the
+        # reference's cache is always f32 (nn-cpu-ops.cpp shiftForward).
+        _kv_dtypes = {"auto": self.cfg.compute_dtype, "f32": jnp.float32,
+                      "bf16": jnp.bfloat16, "f8": jnp.float8_e4m3fn}
+        if kv_dtype not in _kv_dtypes:
+            raise ValueError(f"kv_dtype must be one of {sorted(_kv_dtypes)}, "
+                             f"got {kv_dtype!r}")
+        self.kv_dtype = jnp.dtype(_kv_dtypes[kv_dtype])
         self.weight_mode = weight_mode
         # multi-step fused decode: K tokens per dispatch (lax.scan feeds the
         # picked token back on device; models.llama.greedy_steps). Output is
@@ -235,9 +248,9 @@ class InferenceEngine:
                                         donate_argnums=(4,))
 
     def _fresh_kv(self) -> KVCache:
-        # cache rides the compute dtype: f32 for parity, bf16 halves HBM
-        # footprint and bandwidth in serving mode
-        kv = KVCache.create(self.cfg, dtype=jnp.dtype(self.cfg.compute_dtype))
+        # dtype policy in __init__ (self.kv_dtype): compute dtype for parity,
+        # bf16/f8 for serving footprint+bandwidth
+        kv = KVCache.create(self.cfg, dtype=self.kv_dtype)
         if self.plan is not None:
             kv = jax.device_put(kv, kv_cache_sharding(self.plan, kv))
         return kv

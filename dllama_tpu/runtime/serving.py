@@ -97,8 +97,8 @@ class BatchedGenerator:
         self.eng = engine
         self.cfg = engine.cfg
         self.n_slots = n_slots
-        dtype = jnp.dtype(self.cfg.compute_dtype)
-        kv = KVCache.create(self.cfg, batch_size=n_slots, dtype=dtype)
+        kv = KVCache.create(self.cfg, batch_size=n_slots,
+                            dtype=engine.kv_dtype)
         if engine.plan is not None:
             from ..parallel.sharding import kv_cache_sharding
 

@@ -56,8 +56,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "streamed per layer during forward (70B/405B on "
                         "small-HBM chips)")
     p.add_argument("--compute-dtype", choices=["f32", "bf16"], default="f32",
-                   help="activation/KV-cache dtype: f32 for reference parity, "
+                   help="activation dtype: f32 for reference parity, "
                         "bf16 for TPU serving throughput")
+    p.add_argument("--kv-dtype", choices=["auto", "f32", "bf16", "f8"],
+                   default="auto",
+                   help="KV cache dtype (auto = compute dtype). f8 "
+                        "(float8_e4m3) halves bf16's cache footprint and "
+                        "read bandwidth — long-context decode is "
+                        "KV-bandwidth-bound")
     p.add_argument("--nbatches", type=int, default=DEFAULT_N_BATCHES)
     p.add_argument("--decode-chunk", type=int, default=1, metavar="K",
                    help="fuse K decode steps into one dispatch (tokens feed "
@@ -152,6 +158,7 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         multihost=multihost, host_sampling=args.host_sampling,
         decode_chunk=args.decode_chunk,
         spec_lookup=getattr(args, "spec_lookup", 0),
+        kv_dtype=getattr(args, "kv_dtype", "auto"),
     )
     h = engine.model_file.header
     print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "

@@ -188,14 +188,16 @@ def test_sp_attention_kernel_matches_oracle(mesh_axes, T, start_pos):
 
 @pytest.mark.slow
 @pytest.mark.parametrize("T,start_pos", [
-    (4, 1996),   # T % sp == 0 → RING path, 4 kernel blocks per hop
+    (4, 1996),   # T % sp == 0 → RING path
     (3, 1997),   # indivisible T → LSE-merge path over the long cache
 ])
 def test_sp_long_context_kernel_matches_oracle(T, start_pos):
-    """Long-context shape: S=2048 over sp=4 (512 per shard, 4 kernel blocks
-    per shard) at late positions — the capability sp exists for, at a length
-    where block/ring bookkeeping bugs can't hide in one block. Covers both
-    the ring (divisible T) and merge (indivisible T) paths."""
+    """Long-context shape: S=2048 over sp=2 — 1024 rows per shard, so
+    _pick_bs chooses 512 and each shard's kernel runs TWO blocks: the
+    intra-shard online-softmax m/l carry is exercised, not just the
+    cross-shard ring/merge combining (review finding: sp=4 would make each
+    shard a single block). Late positions; both the ring (divisible T) and
+    merge (indivisible T) paths."""
     B, H, n_kv, hd = 1, 8, 4, 16
     S = 2048
     rng = np.random.default_rng(2048 + T)
@@ -203,7 +205,7 @@ def test_sp_long_context_kernel_matches_oracle(T, start_pos):
         rng, B, T, H, n_kv, S, hd, start_pos)
     ref_out, ref_k, ref_v = _oracle(q, new_k, new_v, k_cache, v_cache,
                                     positions, start_pos, hd)
-    plan = make_mesh({"sp": 4})
+    plan = make_mesh({"sp": 2})
     out, got_k, got_v = jax.jit(
         lambda *a: sp_attention(plan, *a, head_dim=hd, attn_impl="flash"))(
         q, k_cache, v_cache, new_k, new_v, positions, jnp.int32(start_pos))

@@ -131,3 +131,27 @@ def test_sharded_quant_matmul_on_hw(tpu_backend):
     want = linear(x, w)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_f8_kv_flash_on_hw(tpu_backend):
+    """float8_e4m3 cache through the real Mosaic-lowered flash kernel: f8
+    loads + upcast must match the XLA oracle reading the same stored cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.ops.attention import attention
+    from dllama_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(41)
+    B, T, H, KV, D, S = 1, 4, 8, 2, 64, 256
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k8 = jnp.asarray(rng.standard_normal((B, KV, S, D)),
+                     jnp.float32).astype(jnp.float8_e4m3fn)
+    v8 = jnp.asarray(rng.standard_normal((B, KV, S, D)),
+                     jnp.float32).astype(jnp.float8_e4m3fn)
+    start = jnp.int32(17)
+    positions = start + jnp.arange(T, dtype=jnp.int32)[None, :]
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(flash_attention(q, k8, v8, start, D))
+        want = np.asarray(attention(q, k8, v8, positions, D))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
