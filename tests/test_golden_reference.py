@@ -79,6 +79,35 @@ def test_transcript_matches_reference(variant, tp, tmp_path):
         eng.close()
 
 
+def test_transcript_matches_reference_with_speculation(tmp_path):
+    """The reference-binary golden reproduced BY the speculative decode path:
+    cross-implementation parity through verify dispatches (greedy speculation
+    is exact, so the transcript must be identical token-for-token)."""
+    golden = golden_assets.load_golden("llama_q40")
+    if golden is None:
+        pytest.skip("no golden (run tools/golden_reference.py)")
+    if golden["temperature"] != 0.0:
+        pytest.skip("speculation is greedy-only")
+    m, t, m_sha, _ = golden_assets.build_assets("llama_q40", tmp_path)
+    if m_sha != golden["m_sha256"]:
+        pytest.skip("assets no longer match golden hashes")
+    eng = InferenceEngine(
+        str(m), str(t), sync_type=BUFFER_TYPES[golden["buffer_float_type"]],
+        compute_dtype="float32", temperature=0.0,
+        seed=golden["sampler_seed"], spec_lookup=4)
+    try:
+        ids = eng.tokenizer.encode(golden["prompt"], is_start=True)
+        drive = ids[:-1] + [golden["effective_seed_token"]]
+        res = eng.generate(drive, max_tokens=len(golden["pieces"]),
+                           stop_on_eos=False)
+        eng.tokenizer.reset_decoder()
+        got = [p if (p := eng.tokenizer.decode(tok)) is not None else "~"
+               for tok in res.tokens]
+        assert got == golden["pieces"]
+    finally:
+        eng.close()
+
+
 @pytest.mark.parametrize("variant", list(golden_assets.VARIANTS))
 def test_perplexity_matches_reference(variant, tmp_path):
     eng, golden = _engine_for(variant, tmp_path, tp=1)
