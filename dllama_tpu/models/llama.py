@@ -403,6 +403,30 @@ def verify_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return n_acc, preds, kv
 
 
+def ragged_verify_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                       pos_vec: jax.Array, kv: KVCache, temps: jax.Array,
+                       topps: jax.Array, coins: jax.Array
+                       ) -> tuple[jax.Array, jax.Array, KVCache]:
+    """Batched-serving twin of :func:`verify_step`: one verify dispatch over
+    ragged rows ``tokens [B, K+1]`` at per-row positions ``pos_vec [B]``.
+    Greedy rows (temp <= 0) accept the longest draft prefix exactly as the
+    single-sequence path does; sampled rows consume their one coin on the
+    position-0 logits and accept nothing — their token/coin streams are
+    bit-identical to the plain ragged step, so per-request determinism (the
+    serving invariant) survives speculation joining the batch."""
+    from ..ops.sampling import sampled_token
+
+    logits, kv = forward(params, cfg, tokens, pos_vec, kv)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+    ok = (tokens[:, 1:] == preds[:, :-1]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(ok, axis=-1), axis=-1)
+    greedy_row = jnp.asarray(temps) <= 0.0
+    n_acc = jnp.where(greedy_row, n_acc, 0)
+    first = sampled_token(logits[:, 0], temps, topps, coins)
+    preds = preds.at[:, 0].set(first)  # greedy rows: first == argmax already
+    return n_acc, preds, kv
+
+
 def scan_decode(step1, token: jax.Array, start_pos: jax.Array, kv: KVCache,
                 n_steps: int, coins: jax.Array | None = None):
     """The one multi-step decode scan shared by every chunked variant

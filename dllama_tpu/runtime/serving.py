@@ -112,6 +112,17 @@ class BatchedGenerator:
         # in via temperature 0); same jitted function family as the engine's
         self._step = jax.jit(sampled_step, static_argnums=1,
                              donate_argnums=(4,))
+        # speculative serving (engine --spec-lookup): per-slot prompt-lookup
+        # drafts verified in the ragged program. Greedy rows accept runs;
+        # sampled rows keep their exact one-token/one-coin behavior, so every
+        # request's output still matches its solo run.
+        self.spec = max(0, getattr(engine, "spec_lookup", 0))
+        self._proposers: list = [None] * n_slots
+        if self.spec:
+            from ..models.llama import ragged_verify_step
+
+            self._verify = jax.jit(ragged_verify_step, static_argnums=1,
+                                   donate_argnums=(4,))
         self._prefill_fwd = jax.jit(forward, static_argnums=1,
                                     donate_argnums=(4,))
         # slot-column gather/scatter for per-slot prefill
@@ -142,9 +153,13 @@ class BatchedGenerator:
         interleaves chunks with :meth:`step`)."""
         ids = req.prompt_ids
         assert ids, "empty prompt"
-        if len(ids) >= self.cfg.seq_len:
-            raise ValueError(f"prompt of {len(ids)} tokens exceeds seq_len "
-                             f"{self.cfg.seq_len}")
+        limit = self.cfg.seq_len - self.spec  # spec: the K+1-wide dispatch
+        # needs spec+1 free rows past the prompt or it could never run once
+        if len(ids) >= limit:
+            raise ValueError(
+                f"prompt of {len(ids)} tokens exceeds the usable context "
+                f"({limit} = seq_len {self.cfg.seq_len}"
+                + (f" - spec-lookup {self.spec}" if self.spec else "") + ")")
         return _Admission(req=req, slot=slot, col=self._take(self.kv, slot))
 
     def _plan_ctx(self):
@@ -179,6 +194,11 @@ class BatchedGenerator:
 
             req.decoder = copy.copy(self.eng.tokenizer)
             req.decoder._pending = bytearray()
+        if self.spec:
+            from .speculative import NgramProposer
+
+            self._proposers[adm.slot] = NgramProposer(self.spec)
+            self._proposers[adm.slot].extend(req.prompt_ids)
         self.slots[adm.slot] = req
         return True
 
@@ -191,6 +211,7 @@ class BatchedGenerator:
     def _retire(self, slot: int) -> None:
         req = self.slots[slot]
         self.slots[slot] = None
+        self._proposers[slot] = None
         req.done.set()
 
     # -- the batched step ---------------------------------------------------
@@ -203,6 +224,15 @@ class BatchedGenerator:
         for i, s in enumerate(self.slots):  # client-cancelled slots retire
             if s is not None and s.cancel.is_set():
                 self._retire(i)
+        if self.spec:
+            # the K+1-wide cache write would CLAMP (and corrupt earlier
+            # rows) past seq_len - spec - 1: retire slots that close to the
+            # cap before dispatching (non-spec mode retires at seq_len; spec
+            # trades the last few positions of capacity for run dispatches)
+            for i, s in enumerate(self.slots):
+                if s is not None and \
+                        self.pos[i] + self.spec + 1 > self.cfg.seq_len:
+                    self._retire(i)
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return 0
@@ -216,6 +246,8 @@ class BatchedGenerator:
             if req.temperature > 0.0:
                 coins[i], req.rng_state = xorshift_random_f32(req.rng_state)
 
+        if self.spec:
+            return self._spec_step(active, temps, topps, coins)
         with self._plan_ctx():
             nxt, self.kv = self._step(
                 self.eng.params, self.cfg,
@@ -225,21 +257,62 @@ class BatchedGenerator:
         nxt = np.asarray(nxt)
 
         emitted = 0
-        tok = self.eng.tokenizer
         for i in active:
-            req = self.slots[i]
-            t = int(nxt[i])
-            self.pos[i] += 1
-            self.next_token[i] = t
-            req.tokens.append(t)
-            emitted += 1
+            emitted += self._emit_run(i, [int(nxt[i])])
+        return emitted
+
+    def _emit_run(self, i: int, run: list[int]) -> int:
+        """Deliver a run of tokens to slot ``i``'s request: append, stream,
+        advance position, retire on EOS / limits. Returns tokens emitted.
+        The run is pre-truncated to the ACCEPTED prefix; EOS/max_tokens
+        truncation happens here so both step paths share the exact rules."""
+        req = self.slots[i]
+        tok = self.eng.tokenizer
+        n_keep = min(len(run), req.max_tokens - len(req.tokens))
+        if n_keep <= 0:  # belt: the scheduler retires at max_tokens
+            self._retire(i)
+            return 0
+        retire = n_keep < len(run)
+        for j in range(n_keep):
+            t = run[j]
+            eos = (req.stop_on_eos and tok is not None and tok.is_eos(t))
+            if eos:
+                n_keep, retire = j + 1, True
+                break
+        run = run[:n_keep]
+        self.pos[i] += len(run)
+        self.next_token[i] = run[-1]
+        req.tokens.extend(run)
+        if self._proposers[i] is not None:
+            self._proposers[i].extend(run)
+        for t in run:
             piece = req.decoder.decode(t) if req.decoder is not None else None
             if req.on_token is not None:
                 req.on_token(t, piece)
-            eos = (req.stop_on_eos and tok is not None and tok.is_eos(t))
-            if (eos or len(req.tokens) >= req.max_tokens
-                    or self.pos[i] >= self.cfg.seq_len):
-                self._retire(i)
+        if (retire or len(req.tokens) >= req.max_tokens
+                or self.pos[i] >= self.cfg.seq_len):
+            self._retire(i)
+        return len(run)
+
+    def _spec_step(self, active: list[int], temps, topps, coins) -> int:
+        """One ragged speculative verify dispatch (models.ragged_verify_step):
+        greedy rows emit their accepted run, sampled rows exactly one token."""
+        toks = np.zeros((self.n_slots, self.spec + 1), dtype=np.int32)
+        for i in active:
+            toks[i, 0] = self.next_token[i]
+            if self.slots[i].temperature <= 0.0:
+                toks[i, 1:] = self._proposers[i].draft()
+        with self._plan_ctx():
+            n_acc, preds, self.kv = self._verify(
+                self.eng.params, self.cfg, jnp.asarray(toks),
+                jnp.asarray(self.pos), self.kv,
+                jnp.asarray(temps), jnp.asarray(topps), jnp.asarray(coins))
+        n_acc = np.asarray(n_acc)
+        preds = np.asarray(preds)
+        emitted = 0
+        for i in active:
+            run = [int(t) for t in preds[i, : int(n_acc[i]) + 1]]
+            emitted += self._emit_run(i, run)
         return emitted
 
 

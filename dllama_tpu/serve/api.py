@@ -421,8 +421,8 @@ def run_api_server(args) -> int:
                                      make_handler(state))
         print(f"🕸️ continuous batching: {n_slots} slots")
         if engine.spec_lookup:
-            print("🚧 --spec-lookup is per-sequence and does not apply to "
-                  "the batched scheduler; ignoring it for this server")
+            print(f"🕸️ speculative serving: verify K={engine.spec_lookup} "
+                  f"per slot (greedy requests)")
     else:
         state = ApiState(engine, template_type=ttype)
         server = HTTPServer((args.host, args.port), make_handler(state))

@@ -25,7 +25,8 @@ import golden_assets
 BUFFER_TYPES = {"f32": F32, "q80": Q80}
 
 
-def _engine_for(variant: str, tmp_path, tp: int) -> tuple[InferenceEngine, dict]:
+def _engine_for(variant: str, tmp_path, tp: int,
+                spec_lookup: int = 0) -> tuple[InferenceEngine, dict]:
     golden = golden_assets.load_golden(variant)
     if golden is None:
         pytest.skip(f"no golden for {variant} (run tools/golden_reference.py)")
@@ -36,7 +37,7 @@ def _engine_for(variant: str, tmp_path, tp: int) -> tuple[InferenceEngine, dict]
     eng = InferenceEngine(
         str(m), str(t), tp=tp,
         sync_type=BUFFER_TYPES[golden["buffer_float_type"]],
-        compute_dtype="float32",
+        compute_dtype="float32", spec_lookup=spec_lookup,
         temperature=golden["temperature"], seed=golden["sampler_seed"])
     return eng, golden
 
@@ -83,18 +84,10 @@ def test_transcript_matches_reference_with_speculation(tmp_path):
     """The reference-binary golden reproduced BY the speculative decode path:
     cross-implementation parity through verify dispatches (greedy speculation
     is exact, so the transcript must be identical token-for-token)."""
-    golden = golden_assets.load_golden("llama_q40")
-    if golden is None:
-        pytest.skip("no golden (run tools/golden_reference.py)")
+    eng, golden = _engine_for("llama_q40", tmp_path, tp=1, spec_lookup=4)
     if golden["temperature"] != 0.0:
+        eng.close()
         pytest.skip("speculation is greedy-only")
-    m, t, m_sha, _ = golden_assets.build_assets("llama_q40", tmp_path)
-    if m_sha != golden["m_sha256"]:
-        pytest.skip("assets no longer match golden hashes")
-    eng = InferenceEngine(
-        str(m), str(t), sync_type=BUFFER_TYPES[golden["buffer_float_type"]],
-        compute_dtype="float32", temperature=0.0,
-        seed=golden["sampler_seed"], spec_lookup=4)
     try:
         ids = eng.tokenizer.encode(golden["prompt"], is_start=True)
         drive = ids[:-1] + [golden["effective_seed_token"]]

@@ -227,3 +227,100 @@ def test_batched_under_tp_matches_solo(tmp_path_factory):
         gen.step()
     assert r_a.tokens == want_a
     assert r_b.tokens == want_b
+
+
+def test_batched_speculative_matches_solo_mixed(tmp_path_factory):
+    """Speculative batched serving: greedy rows ride verify runs, sampled
+    rows keep their one-token/one-coin stream — every request must still be
+    byte-identical to its solo (non-spec) run, and the greedy repetitive
+    request must show multi-token acceptance (fewer steps than tokens)."""
+    d = tmp_path_factory.mktemp("spec_serving")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(41))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    eng_spec = InferenceEngine(str(mpath), str(tpath), tp=1, spec_lookup=3)
+
+    prompts = ["hello hello hello", "hello", " world hello world", "hell"]
+    specs = [dict(temperature=0.0, seed=1), dict(temperature=0.8, seed=2),
+             dict(temperature=0.0, seed=3), dict(temperature=1.2, seed=4)]
+    n = 12
+    want = []
+    for p, s in zip(prompts, specs):
+        e = InferenceEngine(str(mpath), str(tpath), tp=1,
+                            temperature=s["temperature"], seed=s["seed"])
+        want.append(e.generate(p, n, stop_on_eos=False).tokens)
+        e.close()
+
+    gen = BatchedGenerator(eng_spec, n_slots=4)
+    assert gen.spec == 3
+    reqs = []
+    for i, (p, s) in enumerate(zip(prompts, specs)):
+        ids = eng_spec.tokenizer.encode(p, is_start=True)
+        r = Request(rid=i, prompt_ids=ids, max_tokens=n, stop_on_eos=False,
+                    temperature=s["temperature"], topp=0.9, seed=s["seed"])
+        gen.admit(r, i)
+        reqs.append(r)
+    steps = steps_r0 = 0
+    while gen.n_active:
+        gen.step()
+        steps += 1
+        if not reqs[0].done.is_set():
+            steps_r0 = steps
+    for r, w in zip(reqs, want):
+        assert r.tokens == w, r.rid
+    # the greedy repetitive request (slot 0) finished in fewer dispatches
+    # than tokens — real multi-token acceptance (sampled rows stay 1/step)
+    assert steps_r0 + 1 < n, (
+        f"no acceptance on the greedy row: {steps_r0 + 1} steps for {n}")
+    eng_spec.close()
+
+
+def test_batched_speculative_near_cap_retires_early(tmp_path_factory):
+    """A slot within spec+1 positions of seq_len retires instead of letting
+    the K+1-wide cache write clamp and corrupt earlier rows — and every
+    dispatch observed the safe bound. The emitted tokens must be a prefix of
+    the non-spec run (speculation trades tail capacity, never content)."""
+    d = tmp_path_factory.mktemp("spec_cap")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=32),
+                     np.random.default_rng(41))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    prompt = "hello world hello"
+
+    eng0 = InferenceEngine(str(mpath), str(tpath), tp=1)
+    want = eng0.generate(prompt, 64, stop_on_eos=False).tokens
+    eng0.close()
+
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1, spec_lookup=4)
+    gen = BatchedGenerator(eng, n_slots=1)
+    ids = eng.tokenizer.encode(prompt, is_start=True)
+    r = Request(rid=0, prompt_ids=ids, max_tokens=64, stop_on_eos=False)
+    gen.admit(r, 0)
+    while gen.n_active:
+        before, n_before = int(gen.pos[0]), len(r.tokens)
+        gen.step()
+        if len(r.tokens) > n_before:
+            # a dispatch ran from `before`: its K+1-wide write must have fit
+            # under seq_len (the REAL clamp-safety invariant)
+            assert before + gen.spec + 1 <= eng.cfg.seq_len, before
+    assert r.done.is_set() and len(r.tokens) >= 1
+    assert r.tokens == want[: len(r.tokens)]
+    eng.close()
+
+
+def test_batched_spec_rejects_prompt_in_unsafe_zone(tmp_path_factory):
+    """Prompts that would leave no room for a single K+1-wide dispatch are
+    rejected at admission with a clear error (they would otherwise complete
+    silently with zero tokens — review finding)."""
+    d = tmp_path_factory.mktemp("spec_rej")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=32),
+                     np.random.default_rng(41))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1, spec_lookup=4)
+    gen = BatchedGenerator(eng, n_slots=1)
+    ids = list(range(1, 30))  # 29 tokens: >= seq_len(32) - spec(4)
+    with pytest.raises(ValueError, match="usable context"):
+        gen.begin_admit(Request(rid=0, prompt_ids=ids, max_tokens=8), 0)
+    eng.close()
