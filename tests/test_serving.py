@@ -276,6 +276,38 @@ def test_batched_speculative_matches_solo_mixed(tmp_path_factory):
     eng_spec.close()
 
 
+def test_batched_speculative_under_tp_matches_solo(tmp_path_factory):
+    """Speculative batched serving under tensor parallelism: the ragged
+    verify dispatch runs inside the tp plan; outputs equal solo tp runs."""
+    d = tmp_path_factory.mktemp("spec_tp")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(41))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+
+    s1 = InferenceEngine(str(mpath), str(tpath), tp=2)
+    want_a = s1.generate("hello hello hello", 10, stop_on_eos=False).tokens
+    s1.close()
+    s2 = InferenceEngine(str(mpath), str(tpath), tp=2, temperature=0.8, seed=6)
+    want_b = s2.generate("hello", 10, stop_on_eos=False).tokens
+    s2.close()
+
+    eng = InferenceEngine(str(mpath), str(tpath), tp=2, spec_lookup=3)
+    gen = BatchedGenerator(eng, n_slots=2)
+    enc = lambda p: eng.tokenizer.encode(p, is_start=True)
+    r_a = Request(rid=0, prompt_ids=enc("hello hello hello"), max_tokens=10,
+                  stop_on_eos=False)
+    r_b = Request(rid=1, prompt_ids=enc("hello"), max_tokens=10,
+                  stop_on_eos=False, temperature=0.8, seed=6)
+    gen.admit(r_a, 0)
+    gen.admit(r_b, 1)
+    while gen.n_active:
+        gen.step()
+    assert r_a.tokens == want_a
+    assert r_b.tokens == want_b
+    eng.close()
+
+
 def test_batched_speculative_near_cap_retires_early(tmp_path_factory):
     """A slot within spec+1 positions of seq_len retires instead of letting
     the K+1-wide cache write clamp and corrupt earlier rows — and every
