@@ -89,7 +89,7 @@ class InferenceEngine:
     """Owns config, params, KV cache, and the jitted step functions."""
 
     def __init__(self, model_path: str, tokenizer_path: str | None = None, *,
-                 tp: int | None = None, sp: int = 1, pp: int = 1,
+                 tp: int | None = None, sp: int = 1, pp: int = 1, dp: int = 1,
                  max_seq_len: int = 0,
                  weight_mode: str = "auto", sync_type: int = F32,
                  compute_dtype: str = "float32",
@@ -155,13 +155,20 @@ class InferenceEngine:
                 f"{self.n_batches} token slots (raise --nbatches)")
 
         n_dev = len(jax.devices())
+        for name, n in (("dp", dp), ("sp", sp), ("pp", pp)):
+            if n < 1:
+                raise ValueError(f"{name} must be >= 1, got {n}")
+        if dp * sp * pp * (tp or 1) > n_dev:
+            raise ValueError(
+                f"mesh dp={dp} sp={sp} pp={pp} tp={tp or 1} needs "
+                f"{dp * sp * pp * (tp or 1)} devices, found {n_dev}")
         if tp is None:
             # largest power-of-2 device count the model's shapes accept
             # (after reserving the sp and pp axes)
             tp = 1
-            while (pp * sp * tp * 2 <= n_dev and _tp_ok(self.cfg, tp * 2)):
+            while (dp * pp * sp * tp * 2 <= n_dev and _tp_ok(self.cfg, tp * 2)):
                 tp *= 2
-        self.tp, self.sp, self.pp = tp, sp, pp
+        self.tp, self.sp, self.pp, self.dp = tp, sp, pp, dp
         if sp > 1 and self.cfg.seq_len % sp != 0:
             # sp = sequence parallelism: KV cache seq-sharded, ring attention
             # (parallel/ring.py) — long-context capability with no reference
@@ -178,8 +185,14 @@ class InferenceEngine:
             if sp > 1:
                 raise ValueError("pp does not compose with sp yet "
                                  "(nested shard_maps)")
+        # dp = data parallelism over the BATCH axis: meaningful for batched
+        # serving (--batch-slots N with N % dp == 0 shards the slot pool);
+        # single-sequence paths run batch 1, which degrades to replicated
+        # under dp (sharding_for's divisibility fallback) — allowed but
+        # pointless, so nothing breaks when a dp engine serves one sequence.
         axes = {name: n
-                for name, n in (("pp", pp), ("sp", sp), ("tp", tp)) if n > 1}
+                for name, n in (("dp", dp), ("pp", pp), ("sp", sp),
+                                ("tp", tp)) if n > 1}
         self.plan: MeshPlan | None = make_mesh(axes) if axes else None
         if tp > 1:
             validate_tp(self.cfg, tp)

@@ -92,6 +92,10 @@ class BatchedGenerator:
         if engine.sp > 1 or engine.pp > 1:
             raise ValueError("batched serving composes with tp/dp only "
                              "(ragged positions over sp/pp is future work)")
+        if getattr(engine, "dp", 1) > 1 and n_slots % engine.dp != 0:
+            raise ValueError(
+                f"--batch-slots {n_slots} must divide over dp={engine.dp} "
+                f"(the slot pool is the dp-sharded batch axis)")
         if engine.multihost:
             raise ValueError("batched serving is single-host for now")
         self.eng = engine

@@ -411,6 +411,10 @@ def make_handler(state: ApiState):
 def run_api_server(args) -> int:
     from .cli import make_engine
 
+    if getattr(args, "dp", 1) > 1 and (getattr(args, "batch_slots", 0) or 0) <= 1:
+        raise SystemExit("--dp shards the --batch-slots pool; without "
+                         "batched serving it only replicates batch-1 work "
+                         "(set --batch-slots N with N % dp == 0, or drop --dp)")
     engine = make_engine(args)
     n_slots = getattr(args, "batch_slots", 0) or 0
     ttype = ChatTemplateType(getattr(args, "chat_template", None) or "unknown")

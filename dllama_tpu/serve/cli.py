@@ -84,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel device count (ring attention; "
                         "long-context — no reference equivalent)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel device count: shards the batch axis "
+                        "of --batch-slots serving over the mesh (requires "
+                        "batch-slots divisible by dp; no reference "
+                        "equivalent)")
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline-parallel stage count (layer stages; "
                         "pp-1 activation hand-offs + one activation "
@@ -149,7 +154,8 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
     seed = args.seed if args.seed is not None else int(time.time())
     engine = InferenceEngine(
         args.model, args.tokenizer,
-        tp=args.tp, sp=args.sp, pp=args.pp, max_seq_len=args.max_seq_len,
+        tp=args.tp, sp=args.sp, pp=args.pp, dp=getattr(args, "dp", 1),
+        max_seq_len=args.max_seq_len,
         weight_mode=args.weight_mode,
         compute_dtype="bfloat16" if args.compute_dtype == "bf16" else "float32",
         sync_type=Q80 if args.buffer_float_type == "q80" else F32,

@@ -308,6 +308,57 @@ def test_batched_speculative_under_tp_matches_solo(tmp_path_factory):
     eng.close()
 
 
+def test_batched_under_dp_tp_matches_solo(tmp_path_factory):
+    """Batched serving with the slot pool SHARDED over a dp axis (dp=2 ×
+    tp=2): every request equals its solo unsharded run — mesh invariance
+    extended to the serving batch axis."""
+    d = tmp_path_factory.mktemp("serving_dp")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(41))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+
+    want = []
+    for p, s in [("hello world", dict(temperature=0.0, seed=1)),
+                 ("hello", dict(temperature=0.8, seed=2)),
+                 (" world hello", dict(temperature=0.0, seed=3)),
+                 ("hell", dict(temperature=1.2, seed=4))]:
+        e = InferenceEngine(str(mpath), str(tpath), tp=1, **s)
+        want.append(e.generate(p, 8, stop_on_eos=False).tokens)
+        e.close()
+
+    eng = InferenceEngine(str(mpath), str(tpath), dp=2, tp=2)
+    gen = BatchedGenerator(eng, n_slots=4)
+    reqs = []
+    for i, (p, s) in enumerate([
+            ("hello world", dict(temperature=0.0, seed=1)),
+            ("hello", dict(temperature=0.8, seed=2)),
+            (" world hello", dict(temperature=0.0, seed=3)),
+            ("hell", dict(temperature=1.2, seed=4))]):
+        ids = eng.tokenizer.encode(p, is_start=True)
+        r = Request(rid=i, prompt_ids=ids, max_tokens=8, stop_on_eos=False,
+                    topp=0.9, **s)
+        gen.admit(r, i)
+        reqs.append(r)
+    while gen.n_active:
+        gen.step()
+    for r, w in zip(reqs, want):
+        assert r.tokens == w, r.rid
+    eng.close()
+
+
+def test_batched_dp_requires_divisible_slots(tmp_path_factory):
+    d = tmp_path_factory.mktemp("serving_dp_bad")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(41))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    eng = InferenceEngine(str(mpath), str(tpath), dp=2, tp=1)
+    with pytest.raises(ValueError, match="divide over dp"):
+        BatchedGenerator(eng, n_slots=3)
+    eng.close()
+
+
 def test_batched_speculative_near_cap_retires_early(tmp_path_factory):
     """A slot within spec+1 positions of seq_len retires instead of letting
     the K+1-wide cache write clamp and corrupt earlier rows — and every
