@@ -40,14 +40,16 @@ PRED_RE = re.compile(r"^🔶 Pred.*")
 
 
 def run_inference(bin_path: str, m: Path, t: Path, buffer_ft: str,
-                  steps: int) -> list[str]:
+                  steps: int, temperature: float = 0.0,
+                  topp: float = 0.9) -> list[str]:
     cmd = [
         bin_path, "inference",
         "--model", str(m), "--tokenizer", str(t),
         "--prompt", golden_assets.PROMPT,
         "--steps", str(steps),
         "--seed", str(golden_assets.SAMPLER_SEED),
-        "--temperature", "0.0",
+        "--temperature", str(temperature),
+        "--topp", str(topp),
         "--nthreads", "1",
         "--buffer-float-type", buffer_ft,
         "--max-seq-len", "0",
@@ -91,6 +93,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--bin", default="/tmp/ref-build/dllama")
     ap.add_argument("--out", default=str(golden_assets.GOLDEN_DIR))
+    ap.add_argument("--only", default=None,
+                    choices=list(golden_assets.VARIANTS),
+                    help="regenerate just this variant (leave others alone)")
     args = ap.parse_args()
 
     out_dir = Path(args.out)
@@ -99,17 +104,21 @@ def main() -> None:
     with tempfile.TemporaryDirectory() as td:
         tmp = Path(td)
         for variant, spec in golden_assets.VARIANTS.items():
+            if args.only and variant != args.only:
+                continue
             m, t, m_sha, t_sha = golden_assets.build_assets(variant, tmp)
             steps = golden_assets.variant_steps(variant)
             pieces = run_inference(args.bin, m, t, spec["buffer_float_type"],
-                                   steps)
+                                   steps, spec.get("temperature", 0.0),
+                                   spec.get("topp", 0.9))
             ppl = run_perplexity(args.bin, m, t, spec["buffer_float_type"])
             golden = {
                 "variant": variant,
                 "prompt": golden_assets.PROMPT,
                 "steps": steps,
                 "sampler_seed": golden_assets.SAMPLER_SEED,
-                "temperature": 0.0,
+                "temperature": spec.get("temperature", 0.0),
+                "topp": spec.get("topp", 0.9),
                 "buffer_float_type": spec["buffer_float_type"],
                 "effective_seed_token": 0,  # dllama.cpp:54 off-by-one, see module doc
                 "m_sha256": m_sha,
