@@ -111,6 +111,19 @@ class BatchedGenerator:
         self.pos = np.zeros(n_slots, dtype=np.int32)
         self.next_token = np.zeros(n_slots, dtype=np.int32)
         self.slots: list[Request | None] = [None] * n_slots
+        # per-slot PREFILL context: _ctx[s][p] is the prompt token whose KV
+        # row sits at position p of slot s, for the prefill-built region
+        # only. Survives retirement (the KV column is untouched until the
+        # slot is re-admitted), so a new request whose prompt shares a
+        # prefix with ANY slot's prompt — live or retired — skips
+        # prefilling that prefix (cross-slot KV reuse: the batched analogue
+        # of the API's single-sequence NaiveCache, amortizing shared system
+        # prompts). Exact: the reused rows were computed by the same
+        # prefill-shaped program a solo run would use; decode-built rows are
+        # deliberately NOT matched (a decode-shaped dispatch may differ in
+        # the last ulp from the prefill that solo-C would run — golden_assets
+        # documents ulp flips becoming token flips).
+        self._ctx: list[list[int] | None] = [None] * n_slots
 
         # one fused ragged step: forward + per-row sample (greedy rows mixed
         # in via temperature 0); same jitted function family as the engine's
@@ -164,7 +177,26 @@ class BatchedGenerator:
                 f"prompt of {len(ids)} tokens exceeds the usable context "
                 f"({limit} = seq_len {self.cfg.seq_len}"
                 + (f" - spec-lookup {self.spec}" if self.spec else "") + ")")
-        return _Admission(req=req, slot=slot, col=self._take(self.kv, slot))
+        src, k = self._best_prefix(ids[:-1])
+        adm = _Admission(req=req, slot=slot,
+                         col=self._take(self.kv, src if k else slot))
+        adm.pos = k  # prefill resumes after the reused prefix
+        return adm
+
+    def _best_prefix(self, rest: list[int]) -> tuple[int, int]:
+        """(source slot, longest shared context prefix) over all slots."""
+        best, best_k = 0, 0
+        for s, ctx in enumerate(self._ctx):
+            if not ctx:
+                continue
+            k = 0
+            for a, b in zip(rest, ctx):
+                if a != b:
+                    break
+                k += 1
+            if k > best_k:
+                best, best_k = s, k
+        return best, best_k
 
     def _plan_ctx(self):
         return (use_plan(self.eng.plan) if self.eng.plan is not None
@@ -189,6 +221,7 @@ class BatchedGenerator:
         self.kv = self._put(self.kv, adm.col, adm.slot)
         self.pos[adm.slot] = adm.pos
         self.next_token[adm.slot] = adm.req.prompt_ids[-1]
+        self._ctx[adm.slot] = list(adm.req.prompt_ids[:-1])
         req = adm.req
         if self.eng.tokenizer is not None:
             # per-request streaming decoder: a shallow copy shares the vocab

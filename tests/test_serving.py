@@ -407,3 +407,57 @@ def test_batched_spec_rejects_prompt_in_unsafe_zone(tmp_path_factory):
     with pytest.raises(ValueError, match="usable context"):
         gen.begin_admit(Request(rid=0, prompt_ids=ids, max_tokens=8), 0)
     eng.close()
+
+
+def test_cross_slot_prefix_reuse_exact_and_skips_prefill(engine):
+    """Batched prefix KV reuse: a request sharing a prompt prefix with a
+    previous (even retired) slot skips prefilling that prefix, and its
+    output is identical to a solo run — the batched analogue of NaiveCache.
+    Only the prefill-built region is matched (decode-built rows are
+    excluded; see BatchedGenerator._ctx)."""
+    sys_prompt = "hello world hello world "  # shared system prompt
+
+    e1 = solo()
+    want_b = e1.generate(sys_prompt + "abc", 8, stop_on_eos=False).tokens
+    e1.close()
+    e2 = solo(temperature=0.8, seed=5)
+    want_c = e2.generate(sys_prompt + "xyz", 8, stop_on_eos=False).tokens
+    e2.close()
+
+    gen = BatchedGenerator(engine, n_slots=2)
+    enc = lambda p: engine.tokenizer.encode(p, is_start=True)
+
+    r_a = Request(rid=0, prompt_ids=enc(sys_prompt + "abc"), max_tokens=8,
+                  stop_on_eos=False)
+    gen.admit(r_a, 0)
+    while gen.n_active:
+        gen.step()
+    assert r_a.tokens == want_b  # sanity: same request as want_b
+
+    # request B: same prompt — admission must skip the ENTIRE prefix
+    ids_b = enc(sys_prompt + "abc")
+    adm = gen.begin_admit(Request(rid=1, prompt_ids=ids_b, max_tokens=8,
+                                  stop_on_eos=False), 1)
+    assert adm.pos == len(ids_b) - 1, "full-prefix reuse expected"
+    while not gen.continue_admit(adm):
+        pass
+    while gen.n_active:
+        gen.step()
+    assert adm.req.tokens == want_b
+
+    # request C: shares only the system prompt, then diverges (and samples)
+    ids_c = enc(sys_prompt + "xyz")
+    adm_c = gen.begin_admit(Request(rid=2, prompt_ids=ids_c, max_tokens=8,
+                                    stop_on_eos=False, temperature=0.8,
+                                    seed=5), 0)
+    shared = 0
+    for a, b in zip(ids_c[:-1], ids_b[:-1]):
+        if a != b:
+            break
+        shared += 1
+    assert adm_c.pos == shared > 4, "partial-prefix reuse expected"
+    while not gen.continue_admit(adm_c):
+        pass
+    while gen.n_active:
+        gen.step()
+    assert adm_c.req.tokens == want_c
