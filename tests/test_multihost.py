@@ -452,11 +452,13 @@ def _spawn_root(script: str, coord: str, m: str, t: str) -> subprocess.Popen:
         env=_two_proc_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
 
 
-def _spawn_worker(coord: str, m: str, t: str, *extra: str) -> subprocess.Popen:
+def _spawn_worker(coord: str, m: str, t: str, *extra: str, nprocs: int = 2,
+                  procid: int = 1, tp: int = 2) -> subprocess.Popen:
     return subprocess.Popen(
         [sys.executable, "-m", "dllama_tpu", "worker",
-         "--coordinator", coord, "--nprocs", "2", "--procid", "1",
-         "--model", m, "--tokenizer", t, "--tp", "2", *extra],
+         "--coordinator", coord, "--nprocs", str(nprocs),
+         "--procid", str(procid),
+         "--model", m, "--tokenizer", t, "--tp", str(tp), *extra],
         env=_two_proc_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
 
 
@@ -543,3 +545,62 @@ def test_worker_reserves_new_root_after_root_death(tiny_files):
     assert "TOKENS=" in r2txt
     assert worker.returncode == 0, f"worker rc={worker.returncode}\n{wtxt[-3000:]}"
     assert "re-serving" in wtxt and "worker done" in wtxt, wtxt[-2000:]
+
+
+FOUR_PROC_ROOT = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, sys.argv[1])
+    from dllama_tpu.parallel.multihost import init_distributed
+    init_distributed(sys.argv[2], 4, 0, platform="cpu")
+    from dllama_tpu.formats.quants import Q80
+    from dllama_tpu.runtime.engine import InferenceEngine
+    eng = InferenceEngine(sys.argv[3], sys.argv[4], tp=4, temperature=0.0,
+                          sync_type=Q80, multihost=True)
+    res = eng.generate([1, 2, 3, 1, 2], max_tokens=6, stop_on_eos=False)
+    eng.close()
+    print("TOKENS4=" + ",".join(map(str, res.tokens)), flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_four_process_cluster_matches_solo(tiny_files):
+    """A 4-process cluster (tp=4, one device per process) produces the same
+    tokens as a solo single-device run — node-count invariance at real
+    multi-process scale (the reference's 4-node localhost cluster,
+    examples/n-workers.sh)."""
+    m, t = tiny_files
+    from dllama_tpu.formats.quants import Q80
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    solo = InferenceEngine(m, t, tp=1, temperature=0.0, sync_type=Q80)
+    want = solo.generate([1, 2, 3, 1, 2], max_tokens=6,
+                         stop_on_eos=False).tokens
+    solo.close()
+
+    coord = f"127.0.0.1:{PORT + 9}"
+    root = subprocess.Popen(
+        [sys.executable, "-c", FOUR_PROC_ROOT, str(REPO), coord, m, t],
+        env=_two_proc_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    workers = [_spawn_worker(coord, m, t, "--worker-timeout", "120",
+                             nprocs=4, procid=p, tp=4)
+               for p in (1, 2, 3)]
+    try:
+        out, _ = root.communicate(timeout=600)
+        txt = out.decode(errors="replace")
+        # assert on the root FIRST: if it crashed, the workers would block
+        # until their timeout and bury the root traceback (review finding)
+        assert root.returncode == 0, f"root failed:\n{txt[-3000:]}"
+        wouts = [w.communicate(timeout=180)[0] for w in workers]
+    finally:
+        for p in [root, *workers]:
+            if p.poll() is None:
+                p.kill()
+    tok4 = [ln for ln in txt.splitlines() if ln.startswith("TOKENS4=")]
+    assert tok4, txt[-2000:]
+    for i, w in enumerate(workers):
+        wtxt = wouts[i].decode(errors="replace")
+        assert w.returncode == 0, f"worker {i + 1} failed:\n{wtxt[-2000:]}"
+        assert "served" in wtxt and "served 0" not in wtxt, wtxt[-1000:]
+    got = [int(x) for x in tok4[0].split("=")[1].split(",")]
+    assert got == want, (got, want)
