@@ -87,3 +87,40 @@ def test_tfile_roundtrip(tmp_path):
     assert rd.eos_token_ids == data.eos_token_ids
     assert rd.chat_template == data.chat_template
     assert rd.regular_vocab_size == data.bos_id
+
+
+# -- malformed-file error paths (a user pointing at the wrong file must get
+# -- a clean diagnostic, not a crash, hang, or silent garbage) --------------
+
+
+def test_mfile_rejects_wrong_magic(tmp_path):
+    p = tmp_path / "bad.m"
+    p.write_bytes(b"\x00" * 256)
+    with pytest.raises(ValueError, match="magic"):
+        mfile.ModelFile.open(p)
+
+
+def test_mfile_rejects_truncated_body(tmp_path):
+    """A valid header whose tensor data is cut short: the tensor walk's size
+    check must fail loudly (reference: file-size assert, llm.cpp)."""
+    path = tmp_path / "tiny.m"
+    write_tiny_model(path, tiny_header_params(), np.random.default_rng(0))
+    data = path.read_bytes()
+    trunc = tmp_path / "trunc.m"
+    trunc.write_bytes(data[: len(data) - 64])
+    with pytest.raises(ValueError, match="size mismatch"):
+        mfile.ModelFile.open(trunc)
+
+
+def test_mfile_rejects_empty_file(tmp_path):
+    p = tmp_path / "empty.m"
+    p.write_bytes(b"")
+    with pytest.raises((ValueError, OSError)):
+        mfile.ModelFile.open(p)
+
+
+def test_tfile_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.t"
+    p.write_bytes(b"not a tokenizer file at all" * 4)
+    with pytest.raises((ValueError, AssertionError)):
+        tfile.read_tfile(p)
