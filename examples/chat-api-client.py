@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Minimal OpenAI-compatible chat client for the dllama-tpu API server
+(the reference ships examples/chat-api-client.js; same endpoint shape).
+
+Start a server first:
+    python -m dllama_tpu api --model m.m --tokenizer t.t --port 9990
+Then:
+    python examples/chat-api-client.py "Hello there" --stream
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prompt")
+    ap.add_argument("--url", default="http://127.0.0.1:9990")
+    ap.add_argument("--max-tokens", type=int, default=128)
+    ap.add_argument("--temperature", type=float, default=0.8)
+    ap.add_argument("--stop", action="append", default=None,
+                    help="custom stop string (repeatable)")
+    ap.add_argument("--stream", action="store_true")
+    args = ap.parse_args()
+
+    body = {
+        "messages": [{"role": "user", "content": args.prompt}],
+        "max_tokens": args.max_tokens,
+        "temperature": args.temperature,
+        "stream": args.stream,
+    }
+    if args.stop:
+        body["stop"] = args.stop
+    req = urllib.request.Request(
+        args.url + "/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        if not args.stream:
+            data = json.loads(r.read())
+            choice = data["choices"][0]
+            print(choice["message"]["content"])
+            print(f"\n[{choice['finish_reason']}] usage: {data['usage']}",
+                  file=sys.stderr)
+            return
+        for raw in r:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            delta = json.loads(payload)["choices"][0]["delta"]
+            sys.stdout.write(delta.get("content", ""))
+            sys.stdout.flush()
+        print()
+
+
+if __name__ == "__main__":
+    main()

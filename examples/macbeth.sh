@@ -1,0 +1,8 @@
+#!/bin/sh
+# Fixed-seed determinism check — the reference's examples/macbeth.sh without
+# needing a real checkpoint: the committed reference-binary goldens play the
+# same role (tests/goldens/llama_macbeth_f32.json is a 2049-token transcript
+# from the actual reference binary), replayed by:
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/test_golden_reference.py -q -k macbeth

@@ -1,0 +1,27 @@
+#!/bin/sh
+# Local multi-process cluster — the reference's examples/n-workers.sh for the
+# SPMD runtime: every process (root included) runs the same binary with the
+# same model files; workers join via the jax.distributed coordinator.
+#
+# Usage: MODEL=m.m TOKENIZER=t.t NPROCS=4 sh examples/n-workers.sh "prompt"
+set -e
+MODEL=${MODEL:?set MODEL=path/to.m}
+TOKENIZER=${TOKENIZER:?set TOKENIZER=path/to.t}
+NPROCS=${NPROCS:-2}
+COORD=${COORD:-127.0.0.1:19917}
+PROMPT=${1:-"Hello world"}
+
+i=1
+while [ "$i" -lt "$NPROCS" ]; do
+    python -m dllama_tpu worker \
+        --coordinator "$COORD" --nprocs "$NPROCS" --procid "$i" \
+        --model "$MODEL" --tokenizer "$TOKENIZER" --tp "$NPROCS" \
+        --worker-reserve --worker-timeout 300 &
+    i=$((i + 1))
+done
+
+python -m dllama_tpu inference \
+    --coordinator "$COORD" --nprocs "$NPROCS" --procid 0 \
+    --model "$MODEL" --tokenizer "$TOKENIZER" --tp "$NPROCS" \
+    --prompt "$PROMPT" --steps 128 --temperature 0
+wait
