@@ -155,3 +155,42 @@ def test_f8_kv_flash_on_hw(tpu_backend):
         got = np.asarray(flash_attention(q, k8, v8, start, D))
         want = np.asarray(attention(q, k8, v8, positions, D))
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_serving_programs_on_hw(tpu_backend):
+    """The batched-serving dispatches on real hardware: one ragged mixed
+    greedy/sampled step and one ragged speculative verify, per-row
+    positions, donated KV."""
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.formats.mfile import ArchType, RopeType
+    from dllama_tpu.models import ModelConfig, init_random_params
+    from dllama_tpu.models.llama import ragged_verify_step, sampled_step
+    from dllama_tpu.runtime import KVCache
+
+    cfg = ModelConfig(
+        arch=ArchType.LLAMA, dim=256, hidden_dim=512, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=64, vocab_size=2048, seq_len=256,
+        norm_epsilon=1e-5, rope_theta=10000.0, rope_type=RopeType.LLAMA,
+        compute_dtype="bfloat16")
+    params = init_random_params(cfg, seed=9, quantized=True)
+    n_slots = 4
+    kv = KVCache.create(cfg, batch_size=n_slots, dtype=jnp.bfloat16)
+    step = jax.jit(sampled_step, static_argnums=1, donate_argnums=(4,))
+    verify = jax.jit(ragged_verify_step, static_argnums=1, donate_argnums=(4,))
+
+    pos = jnp.asarray([3, 0, 9, 5], jnp.int32)
+    temps = jnp.asarray([0.0, 0.8, 0.0, 1.2], jnp.float32)
+    topps = jnp.full((n_slots,), 0.9, jnp.float32)
+    coins = jnp.full((n_slots,), 0.4, jnp.float32)
+    toks = jnp.ones((n_slots, 1), jnp.int32)
+    nxt, kv = step(params, cfg, toks, pos, kv, temps, topps, coins)
+    assert nxt.shape == (n_slots,)
+    draft = jnp.tile(nxt[:, None], (1, 5))
+    n_acc, preds, kv = verify(params, cfg, draft, pos + 1, kv,
+                              temps, topps, coins)
+    n_acc, preds = np.asarray(n_acc), np.asarray(preds)
+    assert preds.shape == (n_slots, 5)
+    sampled_rows = np.asarray(temps) > 0
+    assert (n_acc[sampled_rows] == 0).all()  # sampled rows accept nothing
