@@ -185,3 +185,44 @@ def test_spec_ignored_at_temperature(model_files):
     finally:
         eng2.close()
     assert a == b
+
+
+def test_ragged_verify_matches_per_row_oracles():
+    """ragged_verify_step row-by-row: greedy rows equal a solo verify_step
+    at that row's position; sampled rows equal sampled_token on the
+    position-0 logits with n_acc forced to 0."""
+    from dllama_tpu.models.llama import ragged_verify_step
+    from dllama_tpu.ops.sampling import sampled_token
+
+    cfg = _cfg()
+    params = init_random_params(cfg, seed=5)
+    rng = np.random.default_rng(5)
+    B, K = 3, 3
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, K + 1)), jnp.int32)
+    pos = jnp.asarray([4, 0, 9], jnp.int32)
+    temps = jnp.asarray([0.0, 0.8, 0.0], jnp.float32)
+    topps = jnp.full((B,), 0.9, jnp.float32)
+    coins = jnp.asarray([0.0, 0.37, 0.0], jnp.float32)
+
+    kv = KVCache.create(cfg, batch_size=B)
+    n_acc, preds, _ = jax.jit(ragged_verify_step, static_argnums=1)(
+        params, cfg, toks, pos, kv, temps, topps, coins)
+    n_acc, preds = np.asarray(n_acc), np.asarray(preds)
+
+    for b in (0, 2):  # greedy rows: equal a solo single-row verify
+        kv1 = KVCache.create(cfg)
+        na1, p1, _ = jax.jit(verify_step, static_argnums=1)(
+            params, cfg, toks[b:b + 1], pos[b], kv1)
+        assert int(na1[0]) == n_acc[b]
+        np.testing.assert_array_equal(np.asarray(p1)[0], preds[b])
+
+    # sampled row: n_acc 0 and first token from the row's own coin
+    assert n_acc[1] == 0
+    from dllama_tpu.models import forward
+
+    kv1 = KVCache.create(cfg)
+    logits, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg, toks[1:2], pos[1], kv1)
+    want = sampled_token(logits[:, 0], jnp.float32(0.8), jnp.float32(0.9),
+                         jnp.float32(0.37))
+    assert int(want[0]) == preds[1, 0]
