@@ -358,7 +358,9 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     cfg = model_cfg(preset)
     params = device_random_params(cfg)
     jax.block_until_ready(params)
-    kv = KVCache.create(cfg, batch_size=batch, dtype=jnp.bfloat16)
+    kv_dtype = {"bf16": jnp.bfloat16, "f8": jnp.float8_e4m3fn,
+                "f32": jnp.float32}[os.environ.get("DLLAMA_BENCH_KV", "bf16")]
+    kv = KVCache.create(cfg, batch_size=batch, dtype=kv_dtype)
 
     step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
     greedy = jax.jit(greedy_step, static_argnums=1, donate_argnums=(4,))
