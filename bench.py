@@ -358,9 +358,13 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     cfg = model_cfg(preset)
     params = device_random_params(cfg)
     jax.block_until_ready(params)
-    kv_dtype = {"bf16": jnp.bfloat16, "f8": jnp.float8_e4m3fn,
-                "f32": jnp.float32}[os.environ.get("DLLAMA_BENCH_KV", "bf16")]
-    kv = KVCache.create(cfg, batch_size=batch, dtype=kv_dtype)
+    _kv_map = {"bf16": jnp.bfloat16, "f8": jnp.float8_e4m3fn,
+               "f32": jnp.float32}  # mirrors --kv-dtype (runtime/engine.py)
+    kv_env = os.environ.get("DLLAMA_BENCH_KV", "bf16")
+    if kv_env not in _kv_map:
+        raise ValueError(
+            f"DLLAMA_BENCH_KV must be one of {sorted(_kv_map)}, got {kv_env!r}")
+    kv = KVCache.create(cfg, batch_size=batch, dtype=_kv_map[kv_env])
 
     step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
     greedy = jax.jit(greedy_step, static_argnums=1, donate_argnums=(4,))

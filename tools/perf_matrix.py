@@ -8,6 +8,7 @@ and prints a JSON line per combo plus a final summary. The knobs:
 
   DLLAMA_TPU_QUANT_KERNEL  pallas | xla   (ops/linear.py dispatch)
   DLLAMA_BENCH_ATTN        flash  | xla   (ModelConfig.attn_impl)
+  DLLAMA_BENCH_KV          bf16 | f8 | f32  (KV cache storage dtype)
 
 Usage:
   python tools/perf_matrix.py [preset] [per-stage-budget-s]
