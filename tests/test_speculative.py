@@ -226,3 +226,20 @@ def test_ragged_verify_matches_per_row_oracles():
     want = sampled_token(logits[:, 0], jnp.float32(0.8), jnp.float32(0.9),
                          jnp.float32(0.37))
     assert int(want[0]) == preds[1, 0]
+
+
+def test_speculative_on_moe_model(tmp_path):
+    """verify_step is forward-based, so speculation rides MoE models too:
+    identical to plain greedy."""
+    m, t = tmp_path / "m.m", tmp_path / "t.t"
+    write_tiny_model(m, tiny_header_params(vocab_size=268, seq_len=96,
+                                           n_experts=4, n_active_experts=2),
+                     np.random.default_rng(11))
+    tfile.write_tfile(t, byte_vocab_tokenizer())
+    plain = InferenceEngine(str(m), str(t), temperature=0.0)
+    want = plain.generate("hello hello", 20, stop_on_eos=False).tokens
+    plain.close()
+    spec = InferenceEngine(str(m), str(t), temperature=0.0, spec_lookup=3)
+    got = spec.generate("hello hello", 20, stop_on_eos=False).tokens
+    spec.close()
+    assert got == want
