@@ -95,6 +95,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "all-reduce per forward vs tp's 2 all-reduces per "
                         "layer — the low-bandwidth scale-out axis; no "
                         "reference equivalent)")
+    p.add_argument("--compile-cache", default="auto", metavar="DIR",
+                   help="persistent XLA compilation cache directory: repeat "
+                        "runs skip the multi-second jit compiles (first-token "
+                        "latency on restart). 'auto' = "
+                        "~/.cache/dllama_tpu/xla; 'off' disables; an "
+                        "explicit JAX_COMPILATION_CACHE_DIR env wins")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a JAX/XLA profiler trace to DIR (the TPU-side "
                         "Eval/Sync breakdown: per-op + collective time; view "
@@ -437,12 +443,46 @@ def run_worker(args) -> int:
     return 0
 
 
+def _setup_compile_cache(args) -> None:
+    """Persistent jit-compile cache (defaults on): dllama restarts reuse
+    every compiled program instead of re-paying 20-40s-per-program TPU
+    compiles. An explicit JAX_COMPILATION_CACHE_DIR always wins; --compile-
+    cache off disables. Applied via env BEFORE any jax import so worker
+    subprocesses inherit it too."""
+    flag = getattr(args, "compile_cache", "auto")
+    explicit = flag not in ("auto", "off")
+    if flag == "off":
+        return
+    # precedence: explicit --compile-cache DIR > JAX_COMPILATION_CACHE_DIR
+    # env > the auto default. The env value is applied via config.update too
+    # — jax snapshots env at import (already happened), so env alone is not
+    # enough for THIS process.
+    cache = flag if explicit else (
+        os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.join(os.path.expanduser("~"), ".cache", "dllama_tpu", "xla"))
+    try:
+        os.makedirs(cache, exist_ok=True)
+    except OSError as e:
+        if explicit:  # a named dir that can't be used deserves a message
+            print(f"🚧 --compile-cache {cache}: {e}; compilation cache "
+                  f"disabled", file=sys.stderr)
+        return  # auto default on an unwritable home: silently skip
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache  # children inherit
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", float(
+        os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     # raw argv for the worker supervisor's respawn command: honors explicit
     # programmatic argv (tests call cli.main([...])), not the host process's
     args._argv = list(argv) if argv is not None else sys.argv[1:]
     args._multihost = False
+    _setup_compile_cache(args)
     if args.mode != "worker":
         # Honor an explicit JAX_PLATFORMS (e.g. the virtual CPU mesh:
         # JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8)
