@@ -243,3 +243,17 @@ def test_speculative_on_moe_model(tmp_path):
     got = spec.generate("hello hello", 20, stop_on_eos=False).tokens
     spec.close()
     assert got == want
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_speculative_under_sp_matches_plain(model_files, tp):
+    """Speculation composes with sequence parallelism (verify rides the ring
+    attention path at T=K+1): identical to plain greedy under sp=2."""
+    m, t = model_files
+    plain = InferenceEngine(m, t, sp=2, tp=tp, temperature=0.0)
+    want = plain.generate("hello hello hello", 12, stop_on_eos=False).tokens
+    plain.close()
+    spec = InferenceEngine(m, t, sp=2, tp=tp, temperature=0.0, spec_lookup=2)
+    got = spec.generate("hello hello hello", 12, stop_on_eos=False).tokens
+    spec.close()
+    assert got == want
