@@ -249,7 +249,8 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         return zlib.crc32(text.encode()) & 0x7FFFFFFF
 
     fp = np.array([
-        engine.n_batches, engine.tp, engine.sp, engine.pp, engine.cfg.seq_len,
+        engine.n_batches, engine.tp, engine.sp, engine.pp,
+        getattr(engine, "dp", 1), engine.cfg.seq_len,
         engine.cfg.n_layers, engine.cfg.dim, engine.cfg.vocab_size,
         1 if engine.cfg.sync_q80 else 0,
         np.dtype(engine.cfg.compute_dtype).num,
@@ -271,7 +272,7 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
     if mismatch:
         raise ValueError(
             f"multihost config mismatch on process {jax.process_index()}: "
-            f"local [n_batches, tp, sp, pp, seq_len, n_layers, dim, vocab, "
+            f"local [n_batches, tp, sp, pp, dp, seq_len, n_layers, dim, vocab, "
             f"sync_q80, dtype, weight_mode, attn_impl, moe_impl, kv_dtype] = "
             f"{fp.tolist()} vs root {root_fp.tolist()} — start every process "
             f"with identical model files and flags")
