@@ -88,6 +88,26 @@ def _kernel_mode() -> str:
     return os.environ.get("DLLAMA_TPU_QUANT_KERNEL", "auto")  # auto|pallas|xla
 
 
+def _fast_mode(x: jax.Array) -> bool:
+    """Exact vs fast quant-matmul numerics (SURVEY §7.4's exact/fast split).
+
+    ``DLLAMA_TPU_QUANT_MODE``: ``exact`` = f32 dequant + HIGHEST-precision
+    dots (parity with the host oracle and the committed goldens); ``fast`` =
+    bf16 dequant, one default-precision MXU pass, f32 accumulation (serving
+    mode — the TPU analogue of the reference's int8-dot-plus-scale-epilogue
+    kernels, nn-cpu-ops.cpp:229-447). ``auto`` (default) keys off the
+    activation dtype: a bf16 compute graph (`--compute-dtype bf16`) already
+    accepted bf16 rounding at every op boundary, so it gets the fast kernel;
+    f32 graphs keep exact.
+    """
+    mode = os.environ.get("DLLAMA_TPU_QUANT_MODE", "auto")
+    if mode == "fast":
+        return True
+    if mode == "exact":
+        return False
+    return x.dtype == jnp.bfloat16
+
+
 def _pallas_wanted(x: jax.Array, w: QuantizedWeight) -> bool:
     mode = _kernel_mode()
     if mode == "xla":
@@ -107,7 +127,7 @@ def _pallas_wanted(x: jax.Array, w: QuantizedWeight) -> bool:
 
 
 def _pallas_sharded(x: jax.Array, w: QuantizedWeight, out_axis: str | None,
-                    in_axis: str | None):
+                    in_axis: str | None, fast: bool):
     """Try the shard_map-wrapped kernel under the active plan; None → caller
     falls back to XLA dequant+dot (auto-sharded via constraints)."""
     mode = _kernel_mode()
@@ -122,7 +142,7 @@ def _pallas_sharded(x: jax.Array, w: QuantizedWeight, out_axis: str | None,
 
     return quant_matmul_sharded(
         current_plan(), x, w, out_axis=out_axis, in_axis=in_axis,
-        interpret=mode == "pallas" and not _on_tpu())
+        interpret=mode == "pallas" and not _on_tpu(), fast=fast)
 
 
 def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
@@ -139,18 +159,25 @@ def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
     Override with DLLAMA_TPU_QUANT_KERNEL=auto|pallas|xla; unsupported shapes
     fall back to XLA dequant+dot with identical f32 dequant values.
     """
+    out_dtype = x.dtype
     if isinstance(w, QuantizedWeight):
         from ..parallel.api import current_plan
 
+        fast = _fast_mode(x)
         if current_plan() is not None and (out_axis or in_axis):
-            y = _pallas_sharded(x, w, out_axis, in_axis)
+            y = _pallas_sharded(x, w, out_axis, in_axis, fast)
             if y is not None:
                 return y.astype(x.dtype)
         elif _pallas_wanted(x, w):
             from .quant_matmul import quant_matmul
 
-            return quant_matmul(x, w)
-        wd = dequantize_weight(w, dtype=x.dtype)
+            return quant_matmul(x, w, fast=fast)
+        # XLA fallback: in fast mode the dense dequant lands in bf16 (half the
+        # HBM traffic of f32) and the dot takes one MXU pass; exact mode
+        # dequantizes at the activation dtype as before
+        wd = dequantize_weight(w, dtype=jnp.bfloat16 if fast else x.dtype)
+        if fast and x.dtype != jnp.bfloat16:
+            x = x.astype(jnp.bfloat16)
         contract = wd.ndim - 2  # K-major: contract the `in` axis
     else:
         wd = w.astype(x.dtype)
@@ -159,7 +186,7 @@ def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
         x, wd,
         dimension_numbers=(((x.ndim - 1,), (contract,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ).astype(x.dtype)
+    ).astype(out_dtype)
 
 
 def fake_quant_q80(x: jax.Array) -> jax.Array:

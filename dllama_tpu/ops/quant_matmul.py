@@ -46,21 +46,40 @@ from .linear import QuantizedWeight
 _HIGHEST = jax.lax.Precision.HIGHEST
 
 
-def _kernel(x_ref, codes_ref, scales_ref, expand_ref, out_ref):
-    """One (n, k) grid step: out[M, BN] += x[M, BK] @ dequant(W[BK, BN])."""
+def _kernel(x_ref, codes_ref, scales_ref, expand_ref, out_ref, *, fast: bool):
+    """One (n, k) grid step: out[M, BN] += x[M, BK] @ dequant(W[BK, BN]).
+
+    ``fast=False`` (exact/parity mode): f32 dequant, both dots at
+    ``Precision.HIGHEST`` (~6 bf16 MXU passes per dot) — matches the host
+    oracle to ~2e-5.  ``fast=True`` (serving mode): dequant lands in bf16 and
+    the main dot runs ONE default-precision MXU pass with f32 accumulation —
+    the TPU analogue of the reference's integer-dot philosophy (Q80×Q40
+    int8-dot with f32 per-block scale epilogue, nn-cpu-ops.cpp:229-447):
+    low-precision multiplies, full-precision accumulate, scales applied at
+    block granularity.
+    """
     k = pl.program_id(1)
 
-    # element-repeat each scale 32× along K (sublanes) as a 0/1 matmul
+    # element-repeat each scale 32× along K (sublanes) as a 0/1 matmul; each
+    # output is a single selected scale (no accumulation), so HIGHEST here
+    # costs little and keeps exact-mode scales bit-clean
     sexp = jax.lax.dot_general(
         expand_ref[:], scales_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32, precision=_HIGHEST)
-    wd = codes_ref[:].astype(jnp.float32) * sexp
 
-    partial = jax.lax.dot_general(
-        x_ref[:], wd,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=_HIGHEST)
+    if fast:
+        wd = codes_ref[:].astype(jnp.bfloat16) * sexp.astype(jnp.bfloat16)
+        partial = jax.lax.dot_general(
+            x_ref[:], wd,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        wd = codes_ref[:].astype(jnp.float32) * sexp
+        partial = jax.lax.dot_general(
+            x_ref[:], wd,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_HIGHEST)
 
     @pl.when(k == 0)
     def _():
@@ -92,12 +111,14 @@ def _expansion_matrix(bk: int) -> np.ndarray:
                    np.ones((Q40_BLOCK_SIZE, 1), np.float32))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def quant_matmul(x: jax.Array, w: QuantizedWeight, *, interpret: bool = False) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("interpret", "fast"))
+def quant_matmul(x: jax.Array, w: QuantizedWeight, *, interpret: bool = False,
+                 fast: bool = False) -> jax.Array:
     """``y[..., N] = x[..., K] @ dequant(w)`` via the Pallas kernel.
 
-    ``x`` is cast to f32 for the dequantized dot (parity with the XLA path);
-    leading dims flatten into M.
+    ``fast=False``: ``x`` is cast to f32 for the dequantized dot (parity with
+    the XLA exact path). ``fast=True``: bf16 operands, one MXU pass, f32
+    accumulation (see _kernel). Leading dims flatten into M.
     """
     *lead, K = x.shape
     N = w.out_features
@@ -110,11 +131,11 @@ def quant_matmul(x: jax.Array, w: QuantizedWeight, *, interpret: bool = False) -
     if bn is None or bk is None:
         raise ValueError(f"shapes N={N}, K={K} do not fit the tile grid")
 
-    xf = x.reshape(M, K).astype(jnp.float32)
+    xf = x.reshape(M, K).astype(jnp.bfloat16 if fast else jnp.float32)
     grid = (N // bn, K // bk)
 
     out = pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, fast=fast),
         grid=grid,
         in_specs=[
             pl.BlockSpec((M, bk), lambda n, k: (0, k), memory_space=pltpu.VMEM),
@@ -135,7 +156,8 @@ def quant_matmul(x: jax.Array, w: QuantizedWeight, *, interpret: bool = False) -
 def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
                          out_axis: str | None = None,
                          in_axis: str | None = None, *,
-                         interpret: bool = False) -> jax.Array | None:
+                         interpret: bool = False,
+                         fast: bool = False) -> jax.Array | None:
     """Tensor-parallel Pallas quant matmul: the kernel inside a shard_map.
 
     The auto-sharder cannot partition a ``pallas_call``, so under a mesh plan
@@ -193,9 +215,11 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
     if k_ax is not None:
         def local(xl, sc, cd):
             # f32 partials so the cross-device reduction doesn't round in bf16
+            # (fast mode keeps bf16 multiplies but its accumulator/output is
+            # already f32, so the psum is f32 either way)
             part = quant_matmul(xl.astype(jnp.float32),
                                 QuantizedWeight(scales=sc, codes=cd),
-                                interpret=interpret)
+                                interpret=interpret, fast=fast)
             return jax.lax.psum(part, k_ax)
 
         fn = jax.shard_map(
@@ -205,7 +229,7 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
     else:
         def local(xl, sc, cd):
             return quant_matmul(xl, QuantizedWeight(scales=sc, codes=cd),
-                                interpret=interpret)
+                                interpret=interpret, fast=fast)
 
         fn = jax.shard_map(
             local, mesh=plan.mesh,

@@ -58,6 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-dtype", choices=["f32", "bf16"], default="f32",
                    help="activation dtype: f32 for reference parity, "
                         "bf16 for TPU serving throughput")
+    p.add_argument("--quant-mode", choices=["auto", "exact", "fast"],
+                   default="auto",
+                   help="quantized-matmul numerics (ops/linear.py): exact = "
+                        "f32 dequant + HIGHEST-precision dots (golden "
+                        "parity); fast = bf16 dequant, one MXU pass, f32 "
+                        "accumulation; auto = fast iff --compute-dtype bf16")
     p.add_argument("--kv-dtype", choices=["auto", "f32", "bf16", "f8"],
                    default="auto",
                    help="KV cache dtype (auto = compute dtype). f8 "
@@ -158,6 +164,10 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
     if not args.model or not args.tokenizer:
         raise SystemExit("--model and --tokenizer are required")
     seed = args.seed if args.seed is not None else int(time.time())
+    if getattr(args, "quant_mode", "auto") != "auto":
+        os.environ["DLLAMA_TPU_QUANT_MODE"] = args.quant_mode
+    else:  # auto must mean auto, not whatever a prior engine left in the env
+        os.environ.pop("DLLAMA_TPU_QUANT_MODE", None)
     engine = InferenceEngine(
         args.model, args.tokenizer,
         tp=args.tp, sp=args.sp, pp=args.pp, dp=getattr(args, "dp", 1),

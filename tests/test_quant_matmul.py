@@ -2,6 +2,7 @@
 methodology of nn-vulkan-test.cpp: accelerated op vs reference semantics)."""
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -132,6 +133,109 @@ def test_sharded_with_dp_batch():
     got = quant_matmul_sharded(plan, x, w, out_axis="hidden", interpret=True)
     assert got is not None
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# fast mode (bf16 dequant, one MXU pass) vs exact mode — SURVEY §7.4's
+# exact/fast split; drift bound is the deliverable (VERDICT r3 next #2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("m,n,k", [(1, 256, 512), (8, 512, 1024)])
+def test_fast_mode_drift_bounded(m, n, k):
+    """Fast-mode output drifts from the exact kernel only by bf16 rounding of
+    the weights/activations: relative error stays under ~1%, typical ~0.3%.
+    The accumulator is f32, so error does NOT grow with K."""
+    w = _mk(n, k, seed=n + k + 1)
+    x = jnp.asarray(np.random.default_rng(m + 7).standard_normal((m, k)),
+                    jnp.float32)
+    exact = np.asarray(quant_matmul(x, w, interpret=True))
+    fast = np.asarray(quant_matmul(x, w, interpret=True, fast=True))
+    rel = np.abs(fast - exact) / np.maximum(np.abs(exact), 1e-3)
+    assert float(np.median(rel)) < 3e-3, float(np.median(rel))
+    # elementwise max-rel explodes where the exact output cancels to ~0, so
+    # the worst-case bound is error relative to the output's RMS magnitude
+    rms = float(np.sqrt(np.mean(exact ** 2)))
+    assert float(np.abs(fast - exact).max()) / rms < 2e-2, \
+        (float(np.abs(fast - exact).max()), rms)
+
+
+def test_fast_mode_env_knob_xla_path(monkeypatch):
+    """DLLAMA_TPU_QUANT_MODE=fast flips the XLA fallback to bf16 dequant; the
+    output dtype still matches the caller's activation dtype."""
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_KERNEL", "xla")
+    w = _mk(256, 512, seed=21)
+    x = jnp.asarray(np.random.default_rng(8).standard_normal((4, 512)),
+                    jnp.float32)
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "exact")
+    exact = linear(x, w)
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "fast")
+    fast = linear(x, w)
+    assert fast.dtype == x.dtype
+    denom = np.maximum(np.abs(np.asarray(exact)), 1e-3)
+    rel = np.abs(np.asarray(fast) - np.asarray(exact)) / denom
+    assert float(np.median(rel)) < 5e-3, float(np.median(rel))
+
+
+def test_fast_mode_auto_keys_off_bf16_activations(monkeypatch):
+    """Unit-tests the mode predicate: auto resolves to fast iff activations
+    are bf16; explicit exact/fast override the dtype. (The numerics each mode
+    produces are covered by the drift tests above.)"""
+    from dllama_tpu.ops.linear import _fast_mode
+
+    monkeypatch.delenv("DLLAMA_TPU_QUANT_MODE", raising=False)
+    assert _fast_mode(jnp.zeros((1, 4), jnp.bfloat16)) is True
+    assert _fast_mode(jnp.zeros((1, 4), jnp.float32)) is False
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "exact")
+    assert _fast_mode(jnp.zeros((1, 4), jnp.bfloat16)) is False
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "fast")
+    assert _fast_mode(jnp.zeros((1, 4), jnp.float32)) is True
+
+
+def test_fast_mode_sharded_matches_plain_fast():
+    """The shard_map-wrapped fast kernel reproduces the single-device fast
+    kernel (row and col splits)."""
+    plan = make_tp_mesh(2)
+    w = _mk(256, 512, seed=22)
+    x = _x3(1, 8, 512, seed=23)
+    want = np.asarray(quant_matmul(x.reshape(8, 512), w, interpret=True,
+                                   fast=True)).reshape(1, 8, 256)
+    for kw in ({"out_axis": "hidden"}, {"in_axis": "hidden"}):
+        got = quant_matmul_sharded(plan, x, w, interpret=True, fast=True, **kw)
+        assert got is not None
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-2, atol=1e-3)
+
+
+def test_fast_mode_model_logit_drift(monkeypatch):
+    """End-to-end logit drift of fast-mode numerics on a full (tiny) model
+    forward — the quantified exact-vs-fast deliverable at the level users see.
+    Drift is bf16-rounding-sized; argmax (greedy token) is stable here."""
+    from dllama_tpu.formats import mfile
+    from dllama_tpu.models import ModelConfig, forward, init_random_params
+    from dllama_tpu.runtime import KVCache
+
+    cfg = ModelConfig(
+        arch=mfile.ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2,
+        n_heads=8, n_kv_heads=4, head_dim=8, vocab_size=128, seq_len=32,
+        norm_epsilon=1e-5, rope_theta=10000.0,
+        rope_type=mfile.RopeType.LLAMA)
+    params = init_random_params(cfg, seed=31, quantized=True)
+    tokens = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], dtype=jnp.int32)
+
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_KERNEL", "xla")
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "exact")
+    exact, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg, tokens, jnp.int32(0), KVCache.create(cfg))
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "fast")
+    fast, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg, tokens, jnp.int32(0), KVCache.create(cfg))
+
+    e = np.asarray(exact, np.float32)
+    f = np.asarray(fast, np.float32)
+    rms = float(np.sqrt(np.mean(e ** 2)))
+    drift = float(np.abs(f - e).max()) / rms
+    assert drift < 5e-2, drift
+    np.testing.assert_array_equal(e.argmax(-1), f.argmax(-1))
 
 
 def test_linear_dispatches_sharded_kernel_under_plan(monkeypatch):

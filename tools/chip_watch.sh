@@ -1,0 +1,78 @@
+#!/bin/bash
+# Chip watcher: probe the axon TPU every PROBE_INTERVAL seconds; in the first
+# healthy window, automatically run the full perf capture sequence
+# (bench.py -> tpu-tier pytest -> perf_matrix 1b -> perf_matrix 8b) and save
+# everything under bench_results/.  Designed to survive a wedged chip: every
+# probe and every capture stage is a killable subprocess with a hard timeout.
+#
+# State files (all under bench_results/):
+#   probe_log.jsonl   one line per probe: {"ts", "healthy", "latency_s"}
+#   capture_done      marker: a full capture has been banked this session
+#   RERUN             touch this file to request a fresh capture on the next
+#                     healthy probe even if capture_done exists
+#   capture_<ts>/     per-capture artifacts (bench JSON, pytest log, matrices)
+set -u
+REPO=/root/repo
+OUT=$REPO/bench_results
+mkdir -p "$OUT"
+PROBE_INTERVAL=${PROBE_INTERVAL:-240}
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-30}
+
+probe() {
+    # healthy iff jax.devices() answers fast in a subprocess
+    local t0 t1 rc
+    t0=$(date +%s.%N)
+    timeout "$PROBE_TIMEOUT" python -c "
+import jax
+ds = jax.devices()
+assert ds, 'no devices'
+print(ds[0].platform, ds[0].device_kind)
+" >"$OUT/last_probe.out" 2>"$OUT/last_probe.err"
+    rc=$?
+    t1=$(date +%s.%N)
+    local dt
+    dt=$(python -c "print(f'{$t1-$t0:.2f}')")
+    local healthy=false
+    [ $rc -eq 0 ] && healthy=true
+    echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"healthy\": $healthy, \"rc\": $rc, \"latency_s\": $dt}" >> "$OUT/probe_log.jsonl"
+    [ $rc -eq 0 ]
+}
+
+capture() {
+    local ts cdir
+    ts=$(date -u +%Y%m%dT%H%M%SZ)
+    cdir=$OUT/capture_$ts
+    mkdir -p "$cdir"
+    echo "capture start $ts" >> "$OUT/probe_log.jsonl.notes"
+    cd "$REPO" || return 1
+
+    # 1. bench.py — wedge-proof by construction (parent never imports jax);
+    #    generous outer timeout as backstop only.
+    timeout 3600 python bench.py > "$cdir/BENCH_live.json" 2> "$cdir/bench.stderr"
+    echo "bench rc=$?" >> "$cdir/status"
+
+    # 2. TPU hardware test tier
+    timeout 1800 env DLLAMA_TESTS_TPU=1 python -m pytest tests -m tpu -q \
+        > "$cdir/pytest_tpu.log" 2>&1
+    echo "pytest_tpu rc=$?" >> "$cdir/status"
+
+    # 3+4. kernel-choice sweeps (1b first: always banks something)
+    timeout 3600 python tools/perf_matrix.py 1b 300 > "$cdir/matrix_1b.log" 2>&1
+    echo "matrix_1b rc=$?" >> "$cdir/status"
+    timeout 4800 python tools/perf_matrix.py 8b 420 > "$cdir/matrix_8b.log" 2>&1
+    echo "matrix_8b rc=$?" >> "$cdir/status"
+
+    touch "$OUT/capture_done"
+    rm -f "$OUT/RERUN"
+    echo "capture end $(date -u +%FT%TZ)" >> "$OUT/probe_log.jsonl.notes"
+}
+
+echo "watcher start $(date -u +%FT%TZ) interval=${PROBE_INTERVAL}s" >> "$OUT/probe_log.jsonl.notes"
+while true; do
+    if probe; then
+        if [ ! -f "$OUT/capture_done" ] || [ -f "$OUT/RERUN" ]; then
+            capture
+        fi
+    fi
+    sleep "$PROBE_INTERVAL"
+done

@@ -33,24 +33,30 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402 — the bench parent module is deliberately jax-free
 
 COMBOS = [
-    # (label, quant_kernel, attn_impl, kv_dtype)
-    ("pallas+flash", "pallas", "flash", None),
-    ("pallas+xla", "pallas", "xla", None),
-    ("xla+flash", "xla", "flash", None),
-    ("xla+xla", "xla", "xla", None),
-    ("auto", None, None, None),  # production dispatch (what the engine ships)
-    ("auto+f8kv", None, None, "f8"),  # fp8 KV cache storage
+    # (label, quant_kernel, attn_impl, kv_dtype, quant_mode)
+    ("pallas+flash", "pallas", "flash", None, None),
+    ("pallas+xla", "pallas", "xla", None, None),
+    ("xla+flash", "xla", "flash", None, None),
+    ("xla+xla", "xla", "xla", None, None),
+    ("auto", None, None, None, None),  # production dispatch (what the engine ships)
+    ("auto+f8kv", None, None, "f8", None),  # fp8 KV cache storage
+    # fast-mode quant numerics (bf16 dequant, one MXU pass — ops/linear.py
+    # _fast_mode) on both kernel choices; exact mode is the rows above
+    ("pallas+fast", "pallas", "flash", None, "fast"),
+    ("xla+fast", "xla", "flash", None, "fast"),
 ]
 
 
 def run_combo(preset: str, budget: float, quant: str | None,
-              attn: str | None, kv: str | None = None) -> dict:
+              attn: str | None, kv: str | None = None,
+              qmode: str | None = None) -> dict:
     """Set the combo's knobs in this process's env and delegate to
     bench.run_stage (subprocess isolation, live phase tracking, stderr tail,
     kill+reap — no second implementation to drift)."""
     for var, val in (("DLLAMA_TPU_QUANT_KERNEL", quant),
                      ("DLLAMA_BENCH_ATTN", attn),
-                     ("DLLAMA_BENCH_KV", kv)):
+                     ("DLLAMA_BENCH_KV", kv),
+                     ("DLLAMA_TPU_QUANT_MODE", qmode)):
         if val:
             os.environ[var] = val
         else:
@@ -65,9 +71,9 @@ def main() -> None:
     preset = sys.argv[1] if len(sys.argv) > 1 else "1b"
     budget = float(sys.argv[2]) if len(sys.argv) > 2 else 420.0
     rows: dict = {}
-    for label, quant, attn, kv in COMBOS:
+    for label, quant, attn, kv, qmode in COMBOS:
         t0 = time.monotonic()
-        res = run_combo(preset, budget, quant, attn, kv)
+        res = run_combo(preset, budget, quant, attn, kv, qmode)
         res["combo_s"] = round(time.monotonic() - t0, 1)
         rows[label] = res
         print(json.dumps({label: res}), flush=True)
