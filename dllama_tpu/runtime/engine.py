@@ -51,12 +51,20 @@ DEFAULT_N_BATCHES = 32  # reference default nBatches (app.cpp:28)
 @dataclass
 class StepMetrics:
     """Per-token timing, mirroring the reference's console metrics
-    (dllama.cpp:59-67, 88-97). On TPU the eval/sync split lives inside XLA, so
-    the engine reports whole-step wall time; collective time needs the profiler."""
+    (dllama.cpp:59-67, 88-97). ``ms`` is whole-step wall time. On TPU the
+    eval/sync seam lives inside one fused XLA program, so ``sync_ms`` (the
+    collective share) comes from a one-off profiler capture whose measured
+    sync fraction is applied to each step's wall time — populated when the
+    engine runs with ``profile_split=True`` (runtime.profiling)."""
 
     kind: str  # "eval" (prefill chunk) or "pred" (decode)
     ms: float
     n_tokens: int
+    sync_ms: float | None = None
+
+    @property
+    def eval_only_ms(self) -> float | None:
+        return None if self.sync_ms is None else self.ms - self.sync_ms
 
 
 @dataclass
@@ -97,7 +105,7 @@ class InferenceEngine:
                  temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5,
                  multihost: bool = False, host_sampling: bool = False,
                  decode_chunk: int = 1, spec_lookup: int = 0,
-                 kv_dtype: str = "auto"):
+                 kv_dtype: str = "auto", profile_split: bool = False):
         self.model_file = ModelFile.open(model_path, max_seq_len=max_seq_len,
                                          sync_type=sync_type)
         self.cfg = ModelConfig.from_header(self.model_file.header,
@@ -216,6 +224,11 @@ class InferenceEngine:
             self.model_file, self.cfg, weight_mode, plan=self.plan)
         self.kv: KVCache = self._fresh_kv()
         self.pos = 0
+        # Eval/Sync split (reference dllama.cpp:59-67): measured lazily on
+        # the first decode of a generation when enabled; see measure_split()
+        self.profile_split = profile_split
+        self.split = None       # runtime.profiling.EvalSyncSplit | None
+        self.traffic = None     # runtime.profiling.TrafficStats | None
         # donate the KV cache (arg 4) so decode updates it in place
         if multihost:
             from ..parallel.multihost import (
@@ -469,6 +482,57 @@ class InferenceEngine:
                 _, st = xorshift_random_f32(st)
             self.sampler.rng_state = st
 
+    # -- eval/sync split ----------------------------------------------------
+
+    def measure_split(self, n_steps: int = 3):
+        """One-off Eval/Sync measurement (reference per-token metrics,
+        dllama.cpp:59-67). Two artifacts, both cached on the engine:
+
+        * ``self.traffic`` — collective payload bytes per decode step, read
+          off the compiled HLO (exact shapes; runtime.profiling docstring).
+        * ``self.split`` — measured compute-vs-collective device time from a
+          short profiler capture of scratch greedy dispatches at the current
+          position. Scratch steps advance nothing: ``self.pos`` is untouched
+          and the KV column they write is rewritten by the next real step
+          (the same overwrite argument as decode_chunk_tokens). When the
+          compiled program contains no collectives (tp=sp=pp=dp=1 — the
+          single-chip case), sync is identically zero and no trace runs.
+
+        Uses the greedy single-step program: every decode-path program shares
+        the same forward body, and the sampling epilogue is microseconds.
+
+        Cost note: reading the compiled HLO goes through the AOT
+        ``.lower().compile()`` path, which does NOT share the jit wrapper's
+        C++ executable cache — on TPU that's a second multi-second XLA
+        compile unless the persistent compile cache (on by default in the
+        CLI, ``--compile-cache``) absorbs it. Opt-in diagnostics only.
+        """
+        from .profiling import (
+            EvalSyncSplit,
+            collective_traffic,
+            measure_eval_sync,
+        )
+
+        pos = min(self.pos, self.cfg.seq_len - 1)
+        tokens = np.asarray([[0]])
+        with (use_plan(self.plan) if self.plan is not None else nullcontext()):
+            txt = self._greedy_step.lower(
+                self.params, self.cfg, jnp.asarray(tokens, jnp.int32),
+                jnp.int32(pos), self.kv).compile().as_text()
+        self.traffic = collective_traffic(txt, len(jax.devices()))
+        if not self.traffic:
+            self.split = EvalSyncSplit(eval_ms=0.0, sync_ms=0.0,
+                                       n_steps=0, n_lanes=0)
+            return self.split
+
+        def _scratch():
+            jax.block_until_ready(
+                self._dispatch(self._greedy_step, tokens, pos))
+
+        _scratch()  # compile outside the capture window
+        self.split = measure_eval_sync(_scratch, n_steps)
+        return self.split
+
     # -- generation ---------------------------------------------------------
 
     def generate(self, prompt: str | list[int], max_tokens: int,
@@ -563,6 +627,26 @@ class InferenceEngine:
             for tok in chunk[:n_keep]:
                 stop = emit(tok)
             token = chunk[n_keep - 1]
+        if self.profile_split and out_tokens:
+            # measured once per engine; the decode program is identical every
+            # step, so its sync fraction back-fills all pred wall times.
+            # Prefill runs a different program (wide chunk) — its split is
+            # not this one, so eval steps keep sync_ms=None. Metrics must
+            # never destroy a finished generation: any profiler/proto failure
+            # downgrades to "no split" with a warning.
+            if self.split is None:
+                try:
+                    self.measure_split()
+                except Exception as exc:  # noqa: BLE001
+                    import warnings
+
+                    warnings.warn(f"eval/sync split unavailable: {exc}",
+                                  stacklevel=2)
+            if self.split is not None:
+                frac = self.split.sync_frac
+                for s in steps:
+                    if s.kind == "pred":
+                        s.sync_ms = s.ms * frac
         return GenerationResult(tokens=out_tokens, text="".join(pieces),
                                 prompt_tokens=len(ids), steps=steps)
 

@@ -1,0 +1,253 @@
+"""Per-token Eval/Sync split and collective-traffic accounting.
+
+Reference parity target: dllama.cpp prints, for every generated token,
+``Eval ms / Sync ms / Sent kB / Recv kB`` (src/dllama.cpp:59-67) from its
+executor timers and socket byte counters (src/nn/nn-network.cpp:493-508).
+On TPU the whole step is ONE fused XLA program — there is no host-visible
+seam between "eval" and "sync" to put a timer on — so the split comes from
+the two places it actually exists:
+
+* **time**: a one-off profiler capture of a few steady-state decode steps,
+  post-processed here by classifying device-lane events into collective vs
+  compute time (``measure_eval_sync``). The measured sync fraction is then
+  applied to every token's wall time (the program is identical every step,
+  so the fraction is stationary).
+* **bytes**: the compiled HLO, where every collective's payload shape is
+  static (``collective_traffic``) — per-token wire traffic on TPU is a
+  compile-time constant, which is *stronger* accounting than the reference's
+  runtime socket counters.
+
+Both are cheap after the first call and neither touches the decode hot path.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+import tempfile
+from dataclasses import dataclass
+
+# -- xplane trace parsing ----------------------------------------------------
+
+# Event names that are collective communication (or waiting on it).
+# Covers TPU HLO op names (all-reduce.1, all-gather-start.2, ...), the CPU
+# backend's jaxpr-derived thunk names (psum.7, ppermute.3), and the CPU
+# runtime's cross-device rendezvous machinery.
+_SYNC_RE = re.compile(
+    r"(all-reduce|all-gather|all-to-all|collective-permute|reduce-scatter"
+    r"|collective-broadcast|^psum\b|^psum[._]|^ppermute[._]?|^all_gather"
+    r"|^all_to_all|^reduce_scatter|rendezvous|^wait\b|^wait:)",
+    re.IGNORECASE)
+
+# Runtime bookkeeping events on device lanes that are neither compute nor
+# sync (executor scaffolding); excluded from both classes.
+_NOISE_RE = re.compile(
+    r"(ExecuteHelper|Handle inputs|CreateOutputs|Execute$|::)")
+
+
+def _union_ms(intervals: list[tuple[int, int]]) -> float:
+    """Total covered time (ms) of possibly-overlapping [start, end] ps spans —
+    nested profiler events (a rendezvous wait inside a psum span) must not
+    double-count."""
+    if not intervals:
+        return 0.0
+    intervals.sort()
+    total = 0
+    cur_s, cur_e = intervals[0]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    total += cur_e - cur_s
+    return total / 1e9
+
+
+def _device_lines(xspace):
+    """Yield (plane_name, line) pairs for lanes that carry per-op device
+    events: TPU/GPU ``/device:*`` planes ("XLA Ops" lines), or the CPU
+    backend's per-virtual-device ``tf_XLAPjRt*`` executor lanes."""
+    for plane in xspace.planes:
+        is_dev = "/device:" in plane.name
+        for line in plane.lines:
+            if is_dev and plane.lines and (
+                    "XLA Ops" in line.name or len(plane.lines) == 1):
+                yield plane.name, line
+            elif line.name.startswith("tf_XLAPjRt"):
+                yield plane.name, line
+
+
+def _load_xplane(path: str):
+    """Parse an .xplane.pb via TF's generated proto WITHOUT importing the
+    tensorflow package (its __init__ is tens of seconds and half a GB): the
+    generated module only needs google.protobuf, so we import it from inside
+    the installed tree directly."""
+    tf_dir = None
+    for p in sys.path:
+        cand = os.path.join(p, "tensorflow")
+        if os.path.isdir(os.path.join(cand, "tsl")):
+            tf_dir = cand
+            break
+    if tf_dir is None:
+        raise RuntimeError("tensorflow/tsl xplane proto not found")
+    if tf_dir not in sys.path:
+        sys.path.append(tf_dir)
+    from tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+@dataclass
+class EvalSyncSplit:
+    """Steady-state per-step device-time split, averaged over the profiled
+    steps and device lanes."""
+
+    eval_ms: float        # non-collective device time per step per device
+    sync_ms: float        # collective + rendezvous time per step per device
+    n_steps: int          # steps profiled
+    n_lanes: int          # device lanes seen in the trace
+
+    @property
+    def sync_frac(self) -> float:
+        tot = self.eval_ms + self.sync_ms
+        return self.sync_ms / tot if tot > 0 else 0.0
+
+
+def split_from_trace(trace_dir: str, n_steps: int) -> EvalSyncSplit:
+    """Post-process the newest xplane.pb under ``trace_dir``."""
+    pbs = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                    recursive=True)
+    if not pbs:
+        raise RuntimeError(f"no xplane.pb under {trace_dir}")
+    xs = _load_xplane(max(pbs, key=os.path.getmtime))
+
+    sync_ms = eval_ms = 0.0
+    n_lanes = 0
+    for plane, line in _device_lines(xs):
+        evmeta = getattr(
+            next(p for p in xs.planes if p.name == plane), "event_metadata")
+        sync_iv: list[tuple[int, int]] = []
+        eval_iv: list[tuple[int, int]] = []
+        for ev in line.events:
+            name = evmeta[ev.metadata_id].name
+            if _NOISE_RE.search(name):
+                continue
+            span = (ev.offset_ps, ev.offset_ps + ev.duration_ps)
+            (sync_iv if _SYNC_RE.search(name) else eval_iv).append(span)
+        if not sync_iv and not eval_iv:
+            continue
+        n_lanes += 1
+        s = _union_ms(sync_iv)
+        sync_ms += s
+        # compute time nested under / overlapping a sync span counts once,
+        # as sync (it is time the lane spent inside the collective)
+        eval_ms += max(0.0, _union_ms(eval_iv + sync_iv) - s)
+    lanes = max(1, n_lanes)
+    return EvalSyncSplit(eval_ms=eval_ms / lanes / max(1, n_steps),
+                         sync_ms=sync_ms / lanes / max(1, n_steps),
+                         n_steps=n_steps, n_lanes=n_lanes)
+
+
+def measure_eval_sync(step, n_steps: int = 3) -> EvalSyncSplit:
+    """Profile ``step()`` (already compiled; must block until ready) for
+    ``n_steps`` calls and return the classified device-time split."""
+    import jax
+
+    with tempfile.TemporaryDirectory(prefix="dllama-prof-") as d:
+        with jax.profiler.trace(d):
+            for _ in range(n_steps):
+                step()
+        return split_from_trace(d, n_steps)
+
+
+# -- static collective-traffic accounting ------------------------------------
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "f8e4m3": 1, "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+}
+
+# Matches only the DEFINING instruction: the opcode must come directly after
+# the `= <type>[shape]` result (possibly a (tuple,...) for async -start ops)
+# and be followed by its `(` operand list — consumer lines that merely
+# reference `%all-reduce.3` as an operand never match, and the -done half of
+# an async start/done pair is skipped so each collective counts once.
+_COLL_RE = re.compile(
+    r"=\s*\(?\s*([a-z0-9]+)\[([0-9,]*)\][^=(]*?\s"
+    r"((?:all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all"
+    r"|collective-broadcast)(?:-start|-done)?)\(")
+
+# group size from the instruction's replica_groups: `{{0,1},{2,3}}` (explicit
+# lists -> size of the first group) or iota v2 `[4,2]<=[8]` (groups x size)
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([0-9,]+)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[\d+,(\d+)\]<=")
+
+
+@dataclass
+class TrafficStats:
+    """Per-device, per-step collective wire traffic from the compiled HLO.
+
+    ``sent_kb``/``recv_kb`` use the standard ring-algorithm byte model over
+    each collective's OWN replica group (parsed from the instruction; the
+    global device count is only the fallback). With group size ``n`` and the
+    op's result bytes ``R``: all-reduce moves ``2(n-1)/n × R`` per device,
+    reduce-scatter ``(n-1) × R`` (its result is the 1/n shard), everything
+    else ``(n-1)/n × R``. The reference reports measured socket bytes
+    (nn-network.cpp:493-508); on TPU the program — and therefore the traffic
+    — is a compile-time constant, so this accounting is exact in shape and
+    model-based only in the ring factor."""
+
+    sent_kb: float
+    recv_kb: float
+    n_collectives: int
+    by_kind: dict
+
+    def __bool__(self) -> bool:
+        return self.n_collectives > 0
+
+
+def collective_traffic(hlo_text: str, n_devices: int) -> TrafficStats:
+    by_kind: dict[str, float] = {}
+    n = 0
+    total_kb = 0.0
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.search(line)
+        if m is None:
+            continue
+        dtype, dims, kind = m.group(1), m.group(2), m.group(3)
+        if kind.endswith("-done"):
+            continue  # the -start half already counted this collective
+        kind = kind.removesuffix("-start")
+        nbytes = _DTYPE_BYTES.get(dtype)
+        if nbytes is None:
+            continue
+        gm = _GROUPS_LIST_RE.search(line)
+        if gm is not None:
+            group = gm.group(1).count(",") + 1  # {{0}} -> 1 -> moves nothing
+        else:
+            gm = _GROUPS_IOTA_RE.search(line)
+            # iota form, or `replica_groups={}` = all participants
+            group = int(gm.group(1)) if gm else n_devices
+        numel = 1
+        for d in dims.split(","):
+            if d:
+                numel *= int(d)
+        payload_kb = numel * nbytes / 1024.0
+        if kind == "all-reduce":
+            moved = 2.0 * payload_kb * (group - 1) / group
+        elif kind == "reduce-scatter":
+            moved = payload_kb * (group - 1)  # result is the 1/group shard
+        else:
+            moved = payload_kb * (group - 1) / group
+        by_kind[kind] = by_kind.get(kind, 0.0) + moved
+        total_kb += moved
+        n += 1
+    return TrafficStats(sent_kb=total_kb, recv_kb=total_kb,
+                        n_collectives=n, by_kind=by_kind)

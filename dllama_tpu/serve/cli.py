@@ -112,6 +112,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "Eval/Sync breakdown: per-op + collective time; view "
                         "with TensorBoard or Perfetto). Replaces the "
                         "reference's per-step-type executor timers")
+    p.add_argument("--profile-split", action="store_true",
+                   help="measure and print the per-token Eval/Sync split and "
+                        "collective Sent/Recv traffic (the reference's "
+                        "per-token metrics, dllama.cpp:59-67): one short "
+                        "profiler capture classifies collective vs compute "
+                        "device time; traffic comes from the compiled HLO "
+                        "(costs one extra XLA compile, absorbed by the "
+                        "persistent compile cache)")
     p.add_argument("--port", type=int, default=9990, help="api mode port")
     p.add_argument("--host", default="127.0.0.1", help="api mode bind host")
     p.add_argument("--batch-slots", type=int, default=0, metavar="N",
@@ -158,16 +166,26 @@ def _maybe_init_distributed(args) -> bool:
     return True
 
 
+# whether THIS process's make_engine wrote DLLAMA_TPU_QUANT_MODE (vs the user)
+_cli_wrote_quant_mode = False
+
+
 def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
     if multihost is None:
         multihost = getattr(args, "_multihost", False)
     if not args.model or not args.tokenizer:
         raise SystemExit("--model and --tokenizer are required")
     seed = args.seed if args.seed is not None else int(time.time())
+    global _cli_wrote_quant_mode
     if getattr(args, "quant_mode", "auto") != "auto":
         os.environ["DLLAMA_TPU_QUANT_MODE"] = args.quant_mode
-    else:  # auto must mean auto, not whatever a prior engine left in the env
+        _cli_wrote_quant_mode = True
+    elif _cli_wrote_quant_mode:
+        # auto must mean auto, not whatever a PRIOR make_engine in this
+        # process wrote — but a user-exported DLLAMA_TPU_QUANT_MODE is
+        # theirs to keep (matching how DLLAMA_TPU_QUANT_KERNEL behaves)
         os.environ.pop("DLLAMA_TPU_QUANT_MODE", None)
+        _cli_wrote_quant_mode = False
     engine = InferenceEngine(
         args.model, args.tokenizer,
         tp=args.tp, sp=args.sp, pp=args.pp, dp=getattr(args, "dp", 1),
@@ -181,6 +199,7 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         decode_chunk=args.decode_chunk,
         spec_lookup=getattr(args, "spec_lookup", 0),
         kv_dtype=getattr(args, "kv_dtype", "auto"),
+        profile_split=getattr(args, "profile_split", False),
     )
     h = engine.model_file.header
     print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "
@@ -225,10 +244,30 @@ def run_inference(args) -> int:
     print(f"    nTokens: {n_eval}")
     print(f"   tokens/s: {result.eval_tok_per_s:.2f} "
           f"({result.eval_ms / max(1, n_eval):.2f} ms/tok)")
+    if getattr(args, "profile_split", False) and engine.split is not None:
+        # per-token lines in the reference's 🔶 style (dllama.cpp:59-67);
+        # printed after the stream so they don't garble the generated text
+        tr = engine.traffic
+        skb = f"{tr.sent_kb:7.1f}" if tr else "    0.0"
+        for s in result.steps:
+            if s.kind != "pred" or s.sync_ms is None:
+                continue
+            print(f"🔶 P {s.ms:8.2f} ms  E {s.eval_only_ms:8.2f} ms  "
+                  f"S {s.sync_ms:6.2f} ms  Sent {skb} kB  Recv {skb} kB"
+                  + (f"  ({s.n_tokens} tok)" if s.n_tokens > 1 else ""))
     print("Prediction")
     print(f"    nTokens: {n_pred}")
     print(f"   tokens/s: {result.pred_tok_per_s:.2f} "
           f"({result.pred_ms / max(1, n_pred):.2f} ms/tok)")
+    if getattr(args, "profile_split", False) and engine.split is not None:
+        sp = engine.split
+        tr = engine.traffic
+        print(f"  eval/sync: {sp.eval_ms:.2f}/{sp.sync_ms:.2f} ms device time "
+              f"per step (sync {100 * sp.sync_frac:.1f}%)")
+        if tr:
+            print(f"    traffic: {tr.sent_kb:.1f} kB/step/device over "
+                  f"{tr.n_collectives} collectives "
+                  + " ".join(f"{k}={v:.1f}kB" for k, v in tr.by_kind.items()))
     if engine.spec_active:
         n_disp = sum(1 for s in result.steps if s.kind == "pred")
         print(f"  spec rate: {n_pred / max(1, n_disp):.2f} tokens/dispatch "

@@ -1,0 +1,116 @@
+"""Eval/Sync split + collective-traffic accounting (runtime.profiling) —
+the reference's per-token `Eval ms / Sync ms / Sent kB / Recv kB` metrics
+(src/dllama.cpp:59-67, socket counters nn-network.cpp:493-508), re-derived
+the TPU way: measured collective device time from a profiler capture, and
+exact payload bytes from the compiled HLO."""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.runtime.profiling import TrafficStats, collective_traffic
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("prof")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(55)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=48), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    return str(mpath), str(tpath)
+
+
+def test_collective_traffic_parses_hlo():
+    hlo = """
+  %all-reduce.3 = f32[4,1024] all-reduce(f32[4,1024] %x), replica_groups={}
+  %ag = bf16[8,256] all-gather(bf16[1,256] %y), dimensions={0}
+  %noise = f32[4] add(f32[4] %a, f32[4] %b)
+"""
+    tr = collective_traffic(hlo, n_devices=8)
+    assert tr.n_collectives == 2
+    # all-reduce: 2 * payload * 7/8; all-gather: 1 * payload * 7/8
+    ar = 2 * (4 * 1024 * 4 / 1024) * 7 / 8
+    ag = 1 * (8 * 256 * 2 / 1024) * 7 / 8
+    assert tr.sent_kb == pytest.approx(ar + ag)
+    assert tr.recv_kb == tr.sent_kb
+    assert set(tr.by_kind) == {"all-reduce", "all-gather"}
+    assert bool(tr)
+    assert not TrafficStats(0.0, 0.0, 0, {})
+
+
+def test_collective_traffic_async_pairs_and_consumers_count_once():
+    """TPU HLO uses all-reduce-start/-done async pairs, and consumers name
+    the collective as an operand — exactly one count, from the -start."""
+    hlo = """
+  %all-reduce-start.1 = (f32[4,1024], f32[4,1024]) all-reduce-start(f32[4,1024] %x), replica_groups={}
+  %all-reduce-done.1 = f32[4,1024] all-reduce-done((f32[4,1024], f32[4,1024]) %all-reduce-start.1)
+  %copy.2 = f32[4,1024] copy(f32[4,1024] %all-reduce-done.1)
+"""
+    tr = collective_traffic(hlo, n_devices=8)
+    assert tr.n_collectives == 1
+    assert tr.sent_kb == pytest.approx(2 * (4 * 1024 * 4 / 1024) * 7 / 8)
+
+
+def test_collective_traffic_replica_groups_and_reduce_scatter():
+    """Ring model runs over each op's own replica group, not the global
+    device count; reduce-scatter moves (n-1) x its shard-sized result."""
+    hlo = """
+  %all-reduce.9 = f32[1024] all-reduce(f32[1024] %x), replica_groups={{0,1},{2,3},{4,5},{6,7}}
+  %rs.1 = f32[128] reduce-scatter(f32[1024] %y), replica_groups=[1,8]<=[8], dimensions={0}
+"""
+    tr = collective_traffic(hlo, n_devices=8)
+    assert tr.n_collectives == 2
+    ar = 2 * (1024 * 4 / 1024) * 1 / 2          # tp-pair group: 2(n-1)/n, n=2
+    rs = (128 * 4 / 1024) * 7                   # (n-1) x shard, n=8
+    assert tr.by_kind["all-reduce"] == pytest.approx(ar)
+    assert tr.by_kind["reduce-scatter"] == pytest.approx(rs)
+
+
+def test_single_device_engine_sync_is_zero(model_files):
+    """tp=1: the compiled decode program has no collectives, so the split is
+    (eval, 0) by construction and no profiler trace is taken."""
+    e = InferenceEngine(model_files[0], model_files[1], temperature=0.0,
+                        seed=7, tp=1, profile_split=True)
+    r = e.generate("hello world", 4, stop_on_eos=False)
+    assert e.split is not None
+    assert e.split.sync_ms == 0.0
+    assert e.traffic is not None and not e.traffic
+    pred = [s for s in r.steps if s.kind == "pred"]
+    assert pred and all(s.sync_ms == 0.0 for s in pred)
+    assert all(s.eval_only_ms == s.ms for s in pred)
+    # prefill steps run a different program: split not applied there
+    assert all(s.sync_ms is None for s in r.steps if s.kind == "eval")
+
+
+def test_tp_engine_measures_collective_split(model_files):
+    """tp=2 on the virtual CPU mesh: the compiled program carries psum
+    collectives — traffic accounting sees them, and the measured split
+    attributes a nonzero share of device time to sync."""
+    e = InferenceEngine(model_files[0], model_files[1], temperature=0.0,
+                        seed=7, tp=2, profile_split=True)
+    r = e.generate("hello world", 4, stop_on_eos=False)
+    assert e.traffic is not None and e.traffic.n_collectives > 0
+    assert e.traffic.sent_kb > 0
+    assert e.split is not None and e.split.n_lanes >= 1
+    assert e.split.sync_ms > 0.0
+    assert 0.0 < e.split.sync_frac < 1.0
+    pred = [s for s in r.steps if s.kind == "pred"]
+    assert pred
+    for s in pred:
+        assert s.sync_ms is not None and 0.0 < s.sync_ms < s.ms
+        assert s.eval_only_ms == pytest.approx(s.ms - s.sync_ms)
+
+
+def test_generation_unperturbed_by_split_measurement(model_files):
+    """The scratch profiling dispatches must not change the transcript."""
+    e1 = InferenceEngine(model_files[0], model_files[1], temperature=0.0,
+                         seed=7, tp=2, profile_split=True)
+    r1 = e1.generate("hello world", 6, stop_on_eos=False)
+    e2 = InferenceEngine(model_files[0], model_files[1], temperature=0.0,
+                         seed=7, tp=2)
+    r2 = e2.generate("hello world", 6, stop_on_eos=False)
+    assert r1.tokens == r2.tokens
