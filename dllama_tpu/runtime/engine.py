@@ -500,6 +500,9 @@ class InferenceEngine:
 
         Uses the greedy single-step program: every decode-path program shares
         the same forward body, and the sampling epilogue is microseconds.
+        Chunked/speculative dispatches repeat that body K times per step, so
+        the sync FRACTION transfers while byte counts scale with the step's
+        token count (the CLI multiplies by StepMetrics.n_tokens).
 
         Cost note: reading the compiled HLO goes through the AOT
         ``.lower().compile()`` path, which does NOT share the jit wrapper's
@@ -519,7 +522,10 @@ class InferenceEngine:
             txt = self._greedy_step.lower(
                 self.params, self.cfg, jnp.asarray(tokens, jnp.int32),
                 jnp.int32(pos), self.kv).compile().as_text()
-        self.traffic = collective_traffic(txt, len(jax.devices()))
+        # per-layer collectives sit inside the layer-scan's while body: once
+        # in the HLO text, n_layers executions per step
+        self.traffic = collective_traffic(txt, len(jax.devices()),
+                                          loop_multiplier=self.cfg.n_layers)
         if not self.traffic:
             self.split = EvalSyncSplit(eval_ms=0.0, sync_ms=0.0,
                                        n_steps=0, n_lanes=0)
@@ -642,6 +648,10 @@ class InferenceEngine:
 
                     warnings.warn(f"eval/sync split unavailable: {exc}",
                                   stacklevel=2)
+                    # don't re-pay the AOT compile + trace on every
+                    # generation once the environment has shown it can't
+                    # deliver a split
+                    self.profile_split = False
             if self.split is not None:
                 frac = self.split.sync_frac
                 for s in steps:

@@ -67,37 +67,47 @@ def _union_ms(intervals: list[tuple[int, int]]) -> float:
 
 
 def _device_lines(xspace):
-    """Yield (plane_name, line) pairs for lanes that carry per-op device
-    events: TPU/GPU ``/device:*`` planes ("XLA Ops" lines), or the CPU
-    backend's per-virtual-device ``tf_XLAPjRt*`` executor lanes."""
+    """Yield (plane, line) pairs for lanes that carry per-op device events:
+    TPU/GPU ``/device:*`` planes ("XLA Ops" lines), or the CPU backend's
+    per-virtual-device ``tf_XLAPjRt*`` executor lanes."""
     for plane in xspace.planes:
         is_dev = "/device:" in plane.name
         for line in plane.lines:
             if is_dev and plane.lines and (
                     "XLA Ops" in line.name or len(plane.lines) == 1):
-                yield plane.name, line
+                yield plane, line
             elif line.name.startswith("tf_XLAPjRt"):
-                yield plane.name, line
+                yield plane, line
+
+
+_xplane_pb2 = None
 
 
 def _load_xplane(path: str):
     """Parse an .xplane.pb via TF's generated proto WITHOUT importing the
     tensorflow package (its __init__ is tens of seconds and half a GB): the
-    generated module only needs google.protobuf, so we import it from inside
-    the installed tree directly."""
-    tf_dir = None
-    for p in sys.path:
-        cand = os.path.join(p, "tensorflow")
-        if os.path.isdir(os.path.join(cand, "tsl")):
-            tf_dir = cand
-            break
-    if tf_dir is None:
-        raise RuntimeError("tensorflow/tsl xplane proto not found")
-    if tf_dir not in sys.path:
-        sys.path.append(tf_dir)
-    from tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
+    generated module only needs google.protobuf, so it loads by file path —
+    no sys.path mutation, nothing else in the TF tree becomes importable."""
+    global _xplane_pb2
+    if _xplane_pb2 is None:
+        import importlib.util
 
-    xs = xplane_pb2.XSpace()
+        pb_py = None
+        for p in sys.path:
+            cand = os.path.join(p, "tensorflow", "tsl", "profiler",
+                                "protobuf", "xplane_pb2.py")
+            if os.path.isfile(cand):
+                pb_py = cand
+                break
+        if pb_py is None:
+            raise RuntimeError("tensorflow/tsl xplane proto not found")
+        spec = importlib.util.spec_from_file_location(
+            "dllama_tpu._xplane_pb2", pb_py)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _xplane_pb2 = mod
+
+    xs = _xplane_pb2.XSpace()
     with open(path, "rb") as f:
         xs.ParseFromString(f.read())
     return xs
@@ -130,8 +140,7 @@ def split_from_trace(trace_dir: str, n_steps: int) -> EvalSyncSplit:
     sync_ms = eval_ms = 0.0
     n_lanes = 0
     for plane, line in _device_lines(xs):
-        evmeta = getattr(
-            next(p for p in xs.planes if p.name == plane), "event_metadata")
+        evmeta = plane.event_metadata
         sync_iv: list[tuple[int, int]] = []
         eval_iv: list[tuple[int, int]] = []
         for ev in line.events:
@@ -156,14 +165,21 @@ def split_from_trace(trace_dir: str, n_steps: int) -> EvalSyncSplit:
 
 def measure_eval_sync(step, n_steps: int = 3) -> EvalSyncSplit:
     """Profile ``step()`` (already compiled; must block until ready) for
-    ``n_steps`` calls and return the classified device-time split."""
+    ``n_steps`` calls and return the classified device-time split.
+
+    The process's FIRST profiler session initializes tracing lazily and
+    misses most thunk-level device events (observed on the CPU backend:
+    an almost-empty first capture, a rich second one) — so a throwaway
+    warm-up session runs first."""
     import jax
 
     with tempfile.TemporaryDirectory(prefix="dllama-prof-") as d:
-        with jax.profiler.trace(d):
+        with jax.profiler.trace(os.path.join(d, "warmup")):
+            step()
+        with jax.profiler.trace(os.path.join(d, "capture")):
             for _ in range(n_steps):
                 step()
-        return split_from_trace(d, n_steps)
+        return split_from_trace(os.path.join(d, "capture"), n_steps)
 
 
 # -- static collective-traffic accounting ------------------------------------
@@ -199,7 +215,10 @@ class TrafficStats:
     global device count is only the fallback). With group size ``n`` and the
     op's result bytes ``R``: all-reduce moves ``2(n-1)/n × R`` per device,
     reduce-scatter ``(n-1) × R`` (its result is the 1/n shard), everything
-    else ``(n-1)/n × R``. The reference reports measured socket bytes
+    else ``(n-1)/n × R``. Collectives inside a while-loop body (the layer
+    ``lax.scan`` compiles to one) appear ONCE in the HLO but execute once per
+    iteration — the caller supplies ``loop_multiplier`` (= n_layers for a
+    decode step) to scale them. The reference reports measured socket bytes
     (nn-network.cpp:493-508); on TPU the program — and therefore the traffic
     — is a compile-time constant, so this accounting is exact in shape and
     model-based only in the ring factor."""
@@ -213,14 +232,26 @@ class TrafficStats:
         return self.n_collectives > 0
 
 
-def collective_traffic(hlo_text: str, n_devices: int) -> TrafficStats:
+_COMP_HEADER_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s+\(.*\{\s*$")
+_WHILE_BODY_RE = re.compile(r"body=%?([\w.\-]+)")
+
+
+def collective_traffic(hlo_text: str, n_devices: int,
+                       loop_multiplier: int = 1) -> TrafficStats:
+    body_names = set(_WHILE_BODY_RE.findall(hlo_text))
     by_kind: dict[str, float] = {}
     n = 0
     total_kb = 0.0
+    current_comp = None
     for line in hlo_text.splitlines():
+        hm = _COMP_HEADER_RE.match(line)
+        if hm is not None:
+            current_comp = hm.group(1)
+            continue
         m = _COLL_RE.search(line)
         if m is None:
             continue
+        mult = loop_multiplier if current_comp in body_names else 1
         dtype, dims, kind = m.group(1), m.group(2), m.group(3)
         if kind.endswith("-done"):
             continue  # the -start half already counted this collective
@@ -246,8 +277,9 @@ def collective_traffic(hlo_text: str, n_devices: int) -> TrafficStats:
             moved = payload_kb * (group - 1)  # result is the 1/group shard
         else:
             moved = payload_kb * (group - 1) / group
+        moved *= mult
         by_kind[kind] = by_kind.get(kind, 0.0) + moved
         total_kb += moved
-        n += 1
+        n += mult
     return TrafficStats(sent_kb=total_kb, recv_kb=total_kb,
                         n_collectives=n, by_kind=by_kind)

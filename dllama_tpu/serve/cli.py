@@ -248,10 +248,13 @@ def run_inference(args) -> int:
         # per-token lines in the reference's 🔶 style (dllama.cpp:59-67);
         # printed after the stream so they don't garble the generated text
         tr = engine.traffic
-        skb = f"{tr.sent_kb:7.1f}" if tr else "    0.0"
         for s in result.steps:
             if s.kind != "pred" or s.sync_ms is None:
                 continue
+            # traffic is measured on the single-token program; chunked /
+            # speculative dispatches repeat that program body per token, so
+            # a multi-token step's bytes scale by its token count
+            skb = f"{tr.sent_kb * s.n_tokens:7.1f}" if tr else "    0.0"
             print(f"🔶 P {s.ms:8.2f} ms  E {s.eval_only_ms:8.2f} ms  "
                   f"S {s.sync_ms:6.2f} ms  Sent {skb} kB  Recv {skb} kB"
                   + (f"  ({s.n_tokens} tok)" if s.n_tokens > 1 else ""))
@@ -265,7 +268,7 @@ def run_inference(args) -> int:
         print(f"  eval/sync: {sp.eval_ms:.2f}/{sp.sync_ms:.2f} ms device time "
               f"per step (sync {100 * sp.sync_frac:.1f}%)")
         if tr:
-            print(f"    traffic: {tr.sent_kb:.1f} kB/step/device over "
+            print(f"    traffic: {tr.sent_kb:.1f} kB/token/device over "
                   f"{tr.n_collectives} collectives "
                   + " ".join(f"{k}={v:.1f}kB" for k, v in tr.by_kind.items()))
     if engine.spec_active:

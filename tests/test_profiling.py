@@ -70,6 +70,28 @@ def test_collective_traffic_replica_groups_and_reduce_scatter():
     assert tr.by_kind["reduce-scatter"] == pytest.approx(rs)
 
 
+def test_collective_traffic_while_body_multiplier():
+    """Per-layer collectives live inside the layer-scan's while body: one HLO
+    instruction, n_layers executions. loop_multiplier scales them; top-level
+    collectives (the argmax epilogue) stay at 1."""
+    hlo = """
+%region_0.5 (arg: (s32[], f32[1,64])) -> (s32[], f32[1,64]) {
+  %all-reduce.10 = f32[1,64] all-reduce(%x), replica_groups={}
+}
+ENTRY %main.42 (p0: f32[1,64]) -> f32[1,64] {
+  %w = (s32[], f32[1,64]) while(%init), condition=%cond.2, body=%region_0.5
+  %all-gather.3 = f32[1,8] all-gather(%y), replica_groups={}
+}
+"""
+    tr1 = collective_traffic(hlo, n_devices=8, loop_multiplier=1)
+    tr32 = collective_traffic(hlo, n_devices=8, loop_multiplier=32)
+    ar = 2 * (64 * 4 / 1024) * 7 / 8
+    ag = (8 * 4 / 1024) * 7 / 8
+    assert tr1.sent_kb == pytest.approx(ar + ag)
+    assert tr32.sent_kb == pytest.approx(32 * ar + ag)
+    assert tr32.n_collectives == 33
+
+
 def test_single_device_engine_sync_is_zero(model_files):
     """tp=1: the compiled decode program has no collectives, so the split is
     (eval, 0) by construction and no profiler trace is taken."""
