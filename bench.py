@@ -354,16 +354,31 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     from dllama_tpu.runtime import KVCache
 
     out = {} if out is None else out
-    out["phase"] = "params"
+    out["phase"] = "budget_check"
     cfg = model_cfg(preset)
-    params = device_random_params(cfg)
-    jax.block_until_ready(params)
+    # pre-staging HBM guardrail (runtime.hbm): a preset that can't fit must
+    # refuse HERE with a clean stage error — an OOM mid-staging wedges the
+    # chip for hours (the round-1/2 outage; reference prints its own
+    # required-memory estimate up front, nn-core.cpp:162-176)
+    from dllama_tpu.runtime.hbm import check_budget, estimate_device_bytes
+
     _kv_map = {"bf16": jnp.bfloat16, "f8": jnp.float8_e4m3fn,
                "f32": jnp.float32}  # mirrors --kv-dtype (runtime/engine.py)
     kv_env = os.environ.get("DLLAMA_BENCH_KV", "bf16")
     if kv_env not in _kv_map:
         raise ValueError(
             f"DLLAMA_BENCH_KV must be one of {sorted(_kv_map)}, got {kv_env!r}")
+    est = estimate_device_bytes(cfg, weight_repr="q40",
+                                kv_dtype_bytes=jnp.dtype(_kv_map[kv_env]).itemsize,
+                                batch=batch)
+    out["hbm_need_gb"] = round(est["need_per_device"] / 1024 ** 3, 2)
+    limit = check_budget(est["need_per_device"], f"bench preset {preset}")
+    if limit is not None:
+        out["hbm_limit_gb"] = round(limit / 1024 ** 3, 2)
+
+    out["phase"] = "params"
+    params = device_random_params(cfg)
+    jax.block_until_ready(params)
     kv = KVCache.create(cfg, batch_size=batch, dtype=_kv_map[kv_env])
 
     step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))

@@ -47,6 +47,14 @@ from .kvcache import KVCache
 
 DEFAULT_N_BATCHES = 32  # reference default nBatches (app.cpp:28)
 
+# TPU-sized prefill chunking: the reference's 32-token default is a
+# Pi-cluster constant — on a TPU a 32-token dispatch leaves the MXU idle, so
+# when the user keeps the default the engine buckets prompt evaluation into
+# the largest of these chunk sizes that fits (largest-first; the tail pads
+# into the smallest bucket). One compiled program per bucket, absorbed by
+# the compile cache. An explicit --nbatches pins a single fixed chunk size.
+PREFILL_BUCKETS = (256, 128, 64, 32)
+
 
 @dataclass
 class StepMetrics:
@@ -101,7 +109,7 @@ class InferenceEngine:
                  max_seq_len: int = 0,
                  weight_mode: str = "auto", sync_type: int = F32,
                  compute_dtype: str = "float32",
-                 n_batches: int = DEFAULT_N_BATCHES,
+                 n_batches: int | None = None,
                  temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5,
                  multihost: bool = False, host_sampling: bool = False,
                  decode_chunk: int = 1, spec_lookup: int = 0,
@@ -116,7 +124,19 @@ class InferenceEngine:
             from dataclasses import replace as _replace
 
             self.cfg = _replace(self.cfg, offload=True)
-        self.n_batches = min(n_batches, self.cfg.seq_len)
+        # prefill chunk buckets (PREFILL_BUCKETS): adaptive when n_batches is
+        # None (the default), pinned when the caller passed any explicit
+        # value — including 32, so a reference-parity session can force the
+        # reference's fixed chunking. packet_slots sizes the multihost
+        # control packet to the largest dispatch any path emits.
+        self.n_batches = min(n_batches or DEFAULT_N_BATCHES, self.cfg.seq_len)
+        if n_batches is None:
+            self.prefill_buckets = tuple(
+                b for b in PREFILL_BUCKETS if b <= self.cfg.seq_len
+            ) or (self.n_batches,)
+        else:
+            self.prefill_buckets = (self.n_batches,)
+        self.packet_slots = max(self.n_batches, *self.prefill_buckets)
         self.tokenizer = Tokenizer.load(tokenizer_path) if tokenizer_path else None
         self.sampler = Sampler(self.cfg.vocab_size, temperature, topp, seed)
         self.host_sampling = host_sampling
@@ -140,10 +160,10 @@ class InferenceEngine:
         # chunk also amortizes the control channel: ONE packet per K tokens
         # (coins ride the packet), capped by the packet's coin capacity.
         self.decode_chunk = 1 if host_sampling else max(1, decode_chunk)
-        if multihost and self.decode_chunk > max(1, self.n_batches - 1):
+        if multihost and self.decode_chunk > max(1, self.packet_slots - 1):
             raise ValueError(
                 f"decode_chunk {self.decode_chunk} exceeds the control "
-                f"packet's capacity of {self.n_batches - 1} coins "
+                f"packet's capacity of {self.packet_slots - 1} coins "
                 f"(raise --nbatches or lower --decode-chunk)")
         # prompt-lookup speculative decode (greedy only): verify K drafted
         # tokens per dispatch (models.llama.verify_step), drafts from the
@@ -157,10 +177,10 @@ class InferenceEngine:
         if self.spec_lookup and self.decode_chunk > 1:
             raise ValueError("--spec-lookup and --decode-chunk are exclusive "
                              "(both multiply tokens per dispatch)")
-        if multihost and self.spec_lookup + 1 > self.n_batches:
+        if multihost and self.spec_lookup + 1 > self.packet_slots:
             raise ValueError(
                 f"spec_lookup {self.spec_lookup} exceeds the control packet's "
-                f"{self.n_batches} token slots (raise --nbatches)")
+                f"{self.packet_slots} token slots (raise --nbatches)")
 
         n_dev = len(jax.devices())
         for name, n in (("dp", dp), ("sp", sp), ("pp", pp)):
@@ -215,8 +235,41 @@ class InferenceEngine:
             from ..parallel.multihost import ControlCodec, validate_cluster_config
 
             self._is_root = jax.process_index() == 0
-            self._ctrl = ControlCodec(self.n_batches)
+            # packet sized for the largest dispatch (adaptive prefill buckets
+            # can exceed n_batches); both sides derive this from the same
+            # flags, and the cluster fingerprint still pins n_batches itself
+            self._ctrl = ControlCodec(self.packet_slots)
             validate_cluster_config(self)  # fail fast before the weight load
+
+        # pre-staging HBM budget check (runtime.hbm): the reference prints
+        # its required-memory estimate before loading (nn-core.cpp:162-176);
+        # here a misfit additionally risks wedging the TPU backend for hours,
+        # so a clean refusal beats an OOM
+        from ..formats.quants import Q40 as _Q40
+        from .hbm import check_budget, estimate_device_bytes
+
+        wt = self.model_file.header.weight_type
+        if weight_mode in ("f32", "bf16"):
+            _repr = weight_mode
+        elif weight_mode == "offload" or wt == _Q40:
+            _repr = "q40"
+        elif wt == Q80:
+            _repr = "q80"
+        else:
+            # dense disk types (F32/F16) load at the COMPUTE dtype
+            # (weights.py dense path), not their disk width
+            _repr = ("bf16" if self.cfg.compute_dtype == "bfloat16"
+                     else "f32")
+        self.hbm_weight_repr = _repr
+        # weights shard over tp and pp only — dp replicates them, and
+        # batch-1 KV degrades to replicated under dp too
+        est = estimate_device_bytes(
+            self.cfg, weight_repr=_repr, kv_dtype_bytes=self.kv_dtype.itemsize,
+            n_shards=self.tp * self.pp,
+            offload=(weight_mode == "offload"))
+        self.hbm_estimate = est
+        check_budget(est["need_per_device"],
+                     f"model {model_path} ({weight_mode})")
 
         # streaming loader: shard-direct reads from the mmap, host memory
         # bounded by one tensor shard (VERDICT round-1 missing #4)
@@ -332,9 +385,19 @@ class InferenceEngine:
         """Run one jitted step; returns logits [1, T, vocab] (device)."""
         return self._dispatch(self._step, tokens_2d, start_pos)
 
+    def _prefill_chunk_size(self, remaining: int) -> int:
+        """Largest prefill bucket that ``remaining`` fills, else the smallest
+        bucket (the tail rides one padded small-chunk program)."""
+        for b in self.prefill_buckets:  # descending
+            if remaining >= b:
+                return b
+        return self.prefill_buckets[-1]
+
     def prefill(self, token_ids: list[int]) -> tuple[np.ndarray, list[StepMetrics]]:
-        """Evaluate the prompt in n_batches-sized chunks; returns logits of the
-        final prompt token and per-chunk metrics. Advances ``self.pos``."""
+        """Evaluate the prompt in bucketed chunks (PREFILL_BUCKETS; a pinned
+        --nbatches gives the reference's fixed-chunk behavior, app.cpp:28);
+        returns logits of the final prompt token and per-chunk metrics.
+        Advances ``self.pos``."""
         if self.pos + len(token_ids) > self.cfg.seq_len:
             raise ValueError(
                 f"prompt of {len(token_ids)} tokens at position {self.pos} exceeds "
@@ -344,12 +407,13 @@ class InferenceEngine:
         i = 0
         n = len(token_ids)
         while i < n:
-            chunk = token_ids[i:i + self.n_batches]
+            size = self._prefill_chunk_size(n - i)
+            chunk = token_ids[i:i + size]
             valid = len(chunk)
             # Never let padding spill past seq_len: dynamic_update_slice would
             # clamp start_pos and overwrite genuine history. At the context
             # tail, pad only up to the remaining room (one extra compile max).
-            pad_to = min(self.n_batches, self.cfg.seq_len - self.pos)
+            pad_to = min(size, self.cfg.seq_len - self.pos)
             padded = chunk + [0] * (pad_to - valid)
             t0 = time.perf_counter()
             logits = self._forward(np.asarray([padded]), self.pos)
@@ -672,8 +736,9 @@ class InferenceEngine:
         count = 0
         i = 0
         while i < len(token_ids) - 1:
-            chunk = token_ids[i:i + self.n_batches]
-            pad_to = min(self.n_batches, self.cfg.seq_len - self.pos)
+            size = self._prefill_chunk_size(len(token_ids) - 1 - i)
+            chunk = token_ids[i:i + size]
+            pad_to = min(size, self.cfg.seq_len - self.pos)
             pad = [0] * (pad_to - len(chunk))
             logits = self._forward(np.asarray([chunk + pad]), self.pos)
             logits_np = np.asarray(logits[0, :len(chunk)], dtype=np.float64)

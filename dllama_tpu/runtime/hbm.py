@@ -1,0 +1,101 @@
+"""Pre-staging HBM budget check — refuse loudly instead of OOM-wedging.
+
+The reference prints its required-memory estimate before loading
+(nn-core.cpp:162-176, "required memory" at graph-build time) and a malloc
+failure is a clean abort. On this TPU stack the failure mode is much worse:
+an HBM OOM can wedge the backend server-side for HOURS (the round-1/2 bench
+outage), so the engine and the bench estimate device bytes up front and
+refuse with an actionable error when the budget doesn't fit.
+
+Estimates are deliberately simple shape algebra with a safety margin — the
+goal is catching the 2x-and-worse misfits (8B f32 on a 16 GB chip, 70B on
+anything single-chip), not byte-exact accounting.
+"""
+
+from __future__ import annotations
+
+import os
+
+# dense-equivalent bytes per weight for each on-device representation
+_WEIGHT_BYTES = {
+    "q40": 1.125,   # int8 codes (1 B) + f32 block scales (4/32 B)
+    "q80": 1.125,
+    "f16": 2.0,
+    "bf16": 2.0,
+    "f32": 4.0,
+}
+
+# headroom for XLA workspace, fusion temporaries, logits buffers, and the
+# dispatch double-buffering the estimate can't see
+_MARGIN = 1.15
+_FIXED_OVERHEAD = 512 * 1024 * 1024
+
+
+def device_memory_bytes() -> int | None:
+    """The per-device memory limit, or None when unknown (CPU backend,
+    plugin without memory_stats). ``DLLAMA_HBM_BYTES`` overrides (testing +
+    plugins that misreport)."""
+    env = os.environ.get("DLLAMA_HBM_BYTES")
+    if env:
+        return int(env)
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            return stats.get("bytes_limit")
+    except Exception:  # noqa: BLE001 — no stats is simply "unknown"
+        return None
+    return None
+
+
+def matmul_weight_count(cfg) -> int:
+    """Total matmul-plane weights (the quantized payload)."""
+    per_layer = (cfg.dim * cfg.q_dim + 2 * cfg.dim * cfg.kv_dim
+                 + cfg.q_dim * cfg.dim)
+    if cfg.is_moe:
+        per_layer += (3 * cfg.dim * cfg.hidden_dim * cfg.n_experts
+                      + cfg.dim * cfg.n_experts)
+    else:
+        per_layer += 3 * cfg.dim * cfg.hidden_dim
+    return cfg.n_layers * per_layer + cfg.dim * cfg.vocab_size  # + lm head
+
+
+def estimate_device_bytes(cfg, *, weight_repr: str, kv_dtype_bytes: int,
+                          batch: int = 1, n_shards: int = 1,
+                          offload: bool = False) -> dict:
+    """Per-device byte estimate. ``weight_repr`` names the on-device weight
+    representation (q40/q80/f16/bf16/f32); ``n_shards`` divides the
+    weight+KV payload (mesh sharding); ``offload`` keeps layer stacks in
+    host DRAM, leaving only embeddings + head + a working set on device."""
+    wbytes = _WEIGHT_BYTES[weight_repr]
+    emb_bytes = cfg.vocab_size * cfg.dim * 4  # compute-dtype upper bound
+    if offload:
+        # resident: embedding + head + ~2 layers of streamed working set
+        per_layer = matmul_weight_count(cfg) // max(1, cfg.n_layers)
+        weights = emb_bytes + int(2 * per_layer * wbytes)
+    else:
+        weights = emb_bytes + int(matmul_weight_count(cfg) * wbytes)
+    kv = 2 * cfg.n_layers * cfg.seq_len * cfg.kv_dim * batch * kv_dtype_bytes
+    need = int(((weights + kv) / max(1, n_shards)) * _MARGIN) + _FIXED_OVERHEAD
+    return {"weights_bytes": weights, "kv_bytes": kv,
+            "need_per_device": need}
+
+
+def check_budget(need_per_device: int, what: str) -> int | None:
+    """Raise a clean, actionable error when the estimate exceeds the device
+    limit. Returns the limit (None = unknown, check skipped). Bypass with
+    DLLAMA_SKIP_HBM_CHECK=1."""
+    if os.environ.get("DLLAMA_SKIP_HBM_CHECK"):
+        return None
+    limit = device_memory_bytes()
+    if limit is not None and need_per_device > limit:
+        gb = 1024 ** 3
+        raise RuntimeError(
+            f"{what} needs ~{need_per_device / gb:.1f} GB per device but the "
+            f"device reports {limit / gb:.1f} GB — refusing to stage (an HBM "
+            f"OOM can wedge the TPU backend for hours). Shard over more "
+            f"devices (--tp/--pp), quantize (Q40), shrink --max-seq-len, use "
+            f"--weight-mode offload, or set DLLAMA_SKIP_HBM_CHECK=1 to "
+            f"override.")
+    return limit

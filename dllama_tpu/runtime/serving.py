@@ -101,6 +101,18 @@ class BatchedGenerator:
         self.eng = engine
         self.cfg = engine.cfg
         self.n_slots = n_slots
+        # the engine's admission-time HBM check budgeted a batch-1 KV; the
+        # slot pool multiplies that by n_slots, so re-check before allocating
+        # (runtime.hbm — a staging OOM can wedge the TPU backend for hours)
+        from .hbm import check_budget, estimate_device_bytes
+
+        est = estimate_device_bytes(
+            self.cfg, weight_repr=getattr(engine, "hbm_weight_repr", "q40"),
+            kv_dtype_bytes=engine.kv_dtype.itemsize, batch=n_slots,
+            n_shards=engine.tp * engine.pp,
+            offload=(engine.weight_mode == "offload"))
+        check_budget(est["need_per_device"],
+                     f"batched serving ({n_slots} slots)")
         kv = KVCache.create(self.cfg, batch_size=n_slots,
                             dtype=engine.kv_dtype)
         if engine.plan is not None:
@@ -206,7 +218,9 @@ class BatchedGenerator:
         """Run one prefill chunk; True when the slot is armed for decode."""
         rest = adm.req.prompt_ids[:-1]
         if adm.pos < len(rest):
-            n_b = self.eng.n_batches
+            # same bucketed chunk sizing as engine.prefill (TPU-sized
+            # dispatches; pinned --nbatches pins it here too)
+            n_b = self.eng._prefill_chunk_size(len(rest) - adm.pos)
             chunk = rest[adm.pos:adm.pos + n_b]
             pad_to = min(n_b, self.cfg.seq_len - adm.pos)
             padded = chunk + [0] * (pad_to - len(chunk))

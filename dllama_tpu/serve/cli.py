@@ -24,7 +24,7 @@ import sys
 import time
 
 from ..formats.quants import F32, Q80
-from ..runtime.engine import DEFAULT_N_BATCHES, InferenceEngine
+from ..runtime.engine import InferenceEngine
 from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
                               ChatTemplateType)
 
@@ -70,7 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(float8_e4m3) halves bf16's cache footprint and "
                         "read bandwidth — long-context decode is "
                         "KV-bandwidth-bound")
-    p.add_argument("--nbatches", type=int, default=DEFAULT_N_BATCHES)
+    p.add_argument("--nbatches", type=int, default=None,
+                   help="pin a fixed prefill chunk size (reference default "
+                        "32, app.cpp:28); unset = TPU-sized adaptive "
+                        "buckets (engine.PREFILL_BUCKETS)")
     p.add_argument("--decode-chunk", type=int, default=1, metavar="K",
                    help="fuse K decode steps into one dispatch (tokens feed "
                         "back on device; output identical to K=1, EOS "
@@ -240,7 +243,8 @@ def run_inference(args) -> int:
     n_eval = sum(s.n_tokens for s in result.steps if s.kind == "eval")
     n_pred = sum(s.n_tokens for s in result.steps if s.kind == "pred")
     print("\nEvaluation")
-    print(f"   nBatches: {args.nbatches}")
+    buckets = engine.prefill_buckets
+    print(f"   nBatches: {buckets[0] if len(buckets) == 1 else list(buckets)}")
     print(f"    nTokens: {n_eval}")
     print(f"   tokens/s: {result.eval_tok_per_s:.2f} "
           f"({result.eval_ms / max(1, n_eval):.2f} ms/tok)")

@@ -137,3 +137,37 @@ def test_bf16_compute_mode(model_files):
     r2 = e2.generate("hello world", 6, stop_on_eos=False)
     assert r1.tokens == r2.tokens and len(r1.tokens) == 6
     assert all(0 <= t < e.cfg.vocab_size for t in r1.tokens)
+
+
+def test_prefill_bucket_selection(model_files):
+    """Default nbatches -> adaptive TPU-sized buckets; explicit -> pinned."""
+    e = make_engine(model_files)  # seq_len 48: only the 32 bucket fits
+    assert e.prefill_buckets == (32,)
+    assert e._prefill_chunk_size(100) == 32
+    e2 = make_engine(model_files, n_batches=16)
+    assert e2.prefill_buckets == (16,)
+
+
+def test_prefill_bucketed_matches_fixed(tmp_path):
+    """Adaptive bucketing (128+64+32 chunks) must generate exactly what a
+    fixed-chunk engine does — positions-as-batch semantics are chunk-size
+    invariant (same property the reference relies on, SURVEY.md §4)."""
+    from dllama_tpu.formats import tfile as _tfile
+    from helpers import byte_vocab_tokenizer as _bv, tiny_header_params as _hp
+    from helpers import write_tiny_model as _wm
+
+    mpath, tpath = tmp_path / "m.m", tmp_path / "t.t"
+    rng = np.random.default_rng(321)
+    _wm(mpath, _hp(vocab_size=268, seq_len=192), rng)
+    _tfile.write_tfile(tpath, _bv())
+
+    adaptive = InferenceEngine(str(mpath), str(tpath), temperature=0.0, seed=7)
+    assert adaptive.prefill_buckets == (128, 64, 32)
+    fixed = InferenceEngine(str(mpath), str(tpath), temperature=0.0, seed=7,
+                            n_batches=8)
+    prompt = [int(t) for t in rng.integers(4, 260, size=150)]
+    ra = adaptive.generate(prompt, 6, stop_on_eos=False)
+    rf = fixed.generate(prompt, 6, stop_on_eos=False)
+    assert ra.tokens == rf.tokens
+    # 149 prompt-eval tokens (last seeds decode): 128 + 21 = two dispatches
+    assert sum(1 for s in ra.steps if s.kind == "eval") == 2
