@@ -51,6 +51,24 @@ CTRL_SAMPLED_CHUNK = 6
 # speculative verify: tokens = [seed, draft_1..draft_K] in the ordinary
 # token slots; workers co-execute the same verify dispatch
 CTRL_SPEC_VERIFY = 7
+# batched-serving mirror protocol (runtime.serving under multihost): the
+# root's BatchedGenerator broadcasts every DEVICE-state-mutating operation —
+# slot-column gather (TAKE), per-slot prefill chunk (PREFILL), column
+# scatter (COMMIT), the ragged decode step (STEP), and the ragged verify
+# step (VERIFY) — and workers replay them on a mirror generator. Host-side
+# bookkeeping (retirement, EOS truncation, streaming) stays root-only: the
+# step/verify packets carry the full per-slot token/position/sampling
+# vectors, so workers need no slot state. These packets are RAW
+# (variable-length, encode_raw): the KV-store channel carries arbitrary
+# bytes, and the ragged payloads don't fit the fixed single-sequence width.
+# The reference's analogue is its API server driving the same worker mesh as
+# the CLI (dllama-api.cpp:599-613 wrapping runInferenceApp).
+CTRL_SRV_INIT = 8
+CTRL_SRV_TAKE = 9
+CTRL_SRV_PREFILL = 10
+CTRL_SRV_COMMIT = 11
+CTRL_SRV_STEP = 12
+CTRL_SRV_VERIFY = 13
 
 
 class RootLostError(RuntimeError):
@@ -143,6 +161,23 @@ class ControlCodec:
             buf[4:4 + n_steps] = np.asarray(coins, np.float32).view(np.int32)
         buf[-3:-1] = np.asarray([temp, topp], np.float32).view(np.int32)
         return buf
+
+    @staticmethod
+    def encode_raw(kind: int, aux: int, payload) -> np.ndarray:
+        """Variable-length packet: [kind, payload_len, aux, payload...].
+        Used by the batched-serving kinds whose ragged vectors don't fit the
+        fixed single-sequence width; f32 values travel as int32 bit
+        patterns (callers .view both ways)."""
+        pl = np.asarray(payload, dtype=np.int32).reshape(-1)
+        buf = np.empty(3 + pl.size, dtype=np.int32)
+        buf[0], buf[1], buf[2] = kind, pl.size, aux
+        buf[3:] = pl
+        return buf
+
+    @staticmethod
+    def decode_raw(buf: np.ndarray) -> tuple[int, np.ndarray]:
+        buf = np.ascontiguousarray(buf)
+        return int(buf[2]), buf[3:3 + int(buf[1])]
 
     @staticmethod
     def decode_chunk_packet(buf: np.ndarray):
@@ -261,6 +296,8 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         s32(engine.cfg.attn_impl),
         s32(engine.cfg.moe_impl),
         s32(str(engine.kv_dtype)),
+        # batched serving's ragged_verify_step program is shaped by K
+        engine.spec_lookup,
     ], dtype=np.int32)
     root_fp = np.asarray(multihost_utils.broadcast_one_to_all(
         fp, is_source=jax.process_index() == 0))
@@ -273,7 +310,8 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         raise ValueError(
             f"multihost config mismatch on process {jax.process_index()}: "
             f"local [n_batches, tp, sp, pp, dp, seq_len, n_layers, dim, vocab, "
-            f"sync_q80, dtype, weight_mode, attn_impl, moe_impl, kv_dtype] = "
+            f"sync_q80, dtype, weight_mode, attn_impl, moe_impl, kv_dtype, "
+            f"spec_lookup] = "
             f"{fp.tolist()} vs root {root_fp.tolist()} — start every process "
             f"with identical model files and flags")
     if any_bad.sum() > 0:
@@ -370,8 +408,39 @@ def worker_serve(engine: "InferenceEngine", *,
     assert engine.multihost and jax.process_index() != 0
     codec = engine._ctrl
     served = 0
+    gen = None              # mirror BatchedGenerator (CTRL_SRV_INIT)
+    adm_cols: dict = {}     # in-flight admission columns, keyed by slot
     while True:
         buf = codec.recv(timeout_s)
+        kind = int(buf[0])
+        if kind >= CTRL_SRV_INIT:
+            aux, payload = codec.decode_raw(buf)
+            if kind == CTRL_SRV_INIT:
+                from ..runtime.serving import BatchedGenerator
+
+                gen = BatchedGenerator(engine, n_slots=aux, _mirror=True)
+                adm_cols = {}
+            elif kind == CTRL_SRV_TAKE:
+                adm_cols[int(payload[0])] = gen._exec_take(aux)
+            elif kind == CTRL_SRV_PREFILL:
+                adm_cols[aux] = gen._exec_prefill(
+                    adm_cols[aux], payload[1:], int(payload[0]))
+            elif kind == CTRL_SRV_COMMIT:
+                gen._exec_commit(aux, adm_cols.pop(aux))
+            elif kind == CTRL_SRV_STEP:
+                B = gen.n_slots
+                f32 = payload[2 * B:].view(np.float32)
+                gen._exec_step(payload[:B], payload[B:2 * B],
+                               f32[:B], f32[B:2 * B], f32[2 * B:3 * B])
+            elif kind == CTRL_SRV_VERIFY:
+                B, w = gen.n_slots, aux + 1
+                toks = payload[:B * w].reshape(B, w)
+                pos = payload[B * w:B * w + B]
+                f32 = payload[B * w + B:].view(np.float32)
+                gen._exec_verify(toks, pos, f32[:B], f32[B:2 * B],
+                                 f32[2 * B:3 * B])
+            served += 1
+            continue
         kind, tokens, start_pos, scalars = codec.decode(buf)
         if kind == CTRL_STOP:
             return served

@@ -69,6 +69,11 @@ class StepMetrics:
     ms: float
     n_tokens: int
     sync_ms: float | None = None
+    # token WIDTH of the dispatch that produced this step (a speculative
+    # verify always runs K+1 columns even when only 1 draft is accepted; a
+    # fused chunk always scans its full k) — what per-step wire traffic
+    # scales with, unlike n_tokens (the kept count)
+    width: int = 1
 
     @property
     def eval_only_ms(self) -> float | None:
@@ -600,7 +605,15 @@ class InferenceEngine:
                 self._dispatch(self._greedy_step, tokens, pos))
 
         _scratch()  # compile outside the capture window
-        self.split = measure_eval_sync(_scratch, n_steps)
+        # the profiler intermittently delivers an (almost) empty capture —
+        # observed on the CPU backend even after measure_eval_sync's warm-up
+        # session. This branch only runs when the compiled program provably
+        # contains collectives, so a capture with zero sync time IS an empty
+        # capture: retry a few times (each costs ~n_steps dispatches).
+        for _ in range(4):
+            self.split = measure_eval_sync(_scratch, n_steps)
+            if self.split.sync_ms > 0.0:
+                break
         return self.split
 
     # -- generation ---------------------------------------------------------
@@ -667,7 +680,8 @@ class InferenceEngine:
                             break
                 self.commit_chunk(n_keep)  # greedy: positions only
                 steps.append(StepMetrics(
-                    "pred", (time.perf_counter() - t0) * 1000.0, n_keep))
+                    "pred", (time.perf_counter() - t0) * 1000.0, n_keep,
+                    width=self.spec_lookup + 1))
                 for tok in run[:n_keep]:
                     stop = emit(tok)
                 proposer.extend(run[:n_keep])
@@ -693,7 +707,8 @@ class InferenceEngine:
                         break
             self.commit_chunk(n_keep)
             steps.append(StepMetrics(
-                "pred", (time.perf_counter() - t0) * 1000.0, n_keep))
+                "pred", (time.perf_counter() - t0) * 1000.0, n_keep,
+                width=len(chunk)))
             for tok in chunk[:n_keep]:
                 stop = emit(tok)
             token = chunk[n_keep - 1]

@@ -46,6 +46,25 @@ if TYPE_CHECKING:
 _MASK64 = (1 << 64) - 1
 
 
+def _replicated_ragged_step(params, cfg, tokens, pos, kv, temps, topps, coins):
+    """Ragged sampled step with replicated picked tokens (multihost: every
+    process reads the same [B] vector on host)."""
+    from ..parallel.api import constrain
+
+    tok, kv = sampled_step(params, cfg, tokens, pos, kv, temps, topps, coins)
+    return constrain(tok, None), kv
+
+
+def _replicated_ragged_verify(params, cfg, tokens, pos, kv, temps, topps,
+                              coins):
+    from ..models.llama import ragged_verify_step
+    from ..parallel.api import constrain
+
+    n_acc, preds, kv = ragged_verify_step(params, cfg, tokens, pos, kv,
+                                          temps, topps, coins)
+    return constrain(n_acc, None), constrain(preds, None, None), kv
+
+
 @dataclass
 class Request:
     rid: int
@@ -88,7 +107,8 @@ class BatchedGenerator:
     """Slot pool + the ragged batched decode step. Not thread-safe by itself
     (the scheduler serializes access)."""
 
-    def __init__(self, engine: "InferenceEngine", n_slots: int = 4):
+    def __init__(self, engine: "InferenceEngine", n_slots: int = 4, *,
+                 _mirror: bool = False):
         if engine.sp > 1 or engine.pp > 1:
             raise ValueError("batched serving composes with tp/dp only "
                              "(ragged positions over sp/pp is future work)")
@@ -96,8 +116,25 @@ class BatchedGenerator:
             raise ValueError(
                 f"--batch-slots {n_slots} must divide over dp={engine.dp} "
                 f"(the slot pool is the dp-sharded batch axis)")
-        if engine.multihost:
-            raise ValueError("batched serving is single-host for now")
+        # multihost: the ROOT's generator broadcasts every device-mutating op
+        # over the control channel (parallel.multihost CTRL_SRV_*) and
+        # workers replay them on a mirror generator built by worker_serve —
+        # the reference's API-server-drives-the-worker-mesh shape
+        # (dllama-api.cpp:599-613). A worker must not construct one directly.
+        if engine.multihost and not engine._is_root and not _mirror:
+            raise ValueError("on worker processes batched serving runs via "
+                             "worker_serve's mirror, not directly")
+        self._root_bcast = engine.multihost and engine._is_root
+        if self._root_bcast:
+            # FIRST thing, before any device work: the slot-pool KV below is
+            # device_put onto a sharding that spans every process, which
+            # blocks until all processes participate — the worker must be
+            # building its mirror generator concurrently, not still waiting
+            # in its packet loop
+            from ..parallel.multihost import CTRL_SRV_INIT
+
+            engine._ctrl.send(engine._ctrl.encode_raw(CTRL_SRV_INIT,
+                                                      n_slots, ()))
         self.eng = engine
         self.cfg = engine.cfg
         self.n_slots = n_slots
@@ -106,10 +143,14 @@ class BatchedGenerator:
         # (runtime.hbm — a staging OOM can wedge the TPU backend for hours)
         from .hbm import check_budget, estimate_device_bytes
 
+        # KV per device: the slot pool is dp-sharded (enforced above), so a
+        # device holds n_slots/dp columns; weights shard over tp only (pp is
+        # rejected above, dp replicates weights)
         est = estimate_device_bytes(
             self.cfg, weight_repr=getattr(engine, "hbm_weight_repr", "q40"),
-            kv_dtype_bytes=engine.kv_dtype.itemsize, batch=n_slots,
-            n_shards=engine.tp * engine.pp,
+            kv_dtype_bytes=engine.kv_dtype.itemsize,
+            batch=n_slots // max(1, getattr(engine, "dp", 1)),
+            n_shards=engine.tp,
             offload=(engine.weight_mode == "offload"))
         check_budget(est["need_per_device"],
                      f"batched serving ({n_slots} slots)")
@@ -125,9 +166,13 @@ class BatchedGenerator:
         self.slots: list[Request | None] = [None] * n_slots
         # per-slot PREFILL context: _ctx[s][p] is the prompt token whose KV
         # row sits at position p of slot s, for the prefill-built region
-        # only. Survives retirement (the KV column is untouched until the
-        # slot is re-admitted), so a new request whose prompt shares a
-        # prefix with ANY slot's prompt — live or retired — skips
+        # only. Survives retirement: retired slots DO keep riding every
+        # dispatch as temp-0 rows writing at pos[i] (clamped for the
+        # K+1-wide spec write), but those writes land at/above pos[i],
+        # which never goes below the prefill-built region — the invariant
+        # pos[i] >= len(_ctx[i]) (debug-asserted in step()) is what keeps
+        # the reusable prefix rows intact. So a new request whose prompt
+        # shares a prefix with ANY slot's prompt — live or retired — skips
         # prefilling that prefix (cross-slot KV reuse: the batched analogue
         # of the API's single-sequence NaiveCache, amortizing shared system
         # prompts). Exact: the reused rows were computed by the same
@@ -138,9 +183,14 @@ class BatchedGenerator:
         self._ctx: list[list[int] | None] = [None] * n_slots
 
         # one fused ragged step: forward + per-row sample (greedy rows mixed
-        # in via temperature 0); same jitted function family as the engine's
-        self._step = jax.jit(sampled_step, static_argnums=1,
-                             donate_argnums=(4,))
+        # in via temperature 0); same jitted function family as the engine's.
+        # Under multihost the host-read outputs (picked tokens, verify
+        # accept counts) must be REPLICATED or np.asarray on a
+        # non-addressable global array throws — the ragged twin of
+        # parallel.multihost's replicated_* wrappers.
+        self._step = jax.jit(
+            _replicated_ragged_step if engine.multihost else sampled_step,
+            static_argnums=1, donate_argnums=(4,))
         # speculative serving (engine --spec-lookup): per-slot prompt-lookup
         # drafts verified in the ragged program. Greedy rows accept runs;
         # sampled rows keep their exact one-token/one-coin behavior, so every
@@ -150,8 +200,10 @@ class BatchedGenerator:
         if self.spec:
             from ..models.llama import ragged_verify_step
 
-            self._verify = jax.jit(ragged_verify_step, static_argnums=1,
-                                   donate_argnums=(4,))
+            self._verify = jax.jit(
+                _replicated_ragged_verify if engine.multihost
+                else ragged_verify_step,
+                static_argnums=1, donate_argnums=(4,))
         self._prefill_fwd = jax.jit(forward, static_argnums=1,
                                     donate_argnums=(4,))
         # slot-column gather/scatter for per-slot prefill
@@ -164,6 +216,56 @@ class BatchedGenerator:
                 k=jax.lax.dynamic_update_slice_in_dim(kv.k, col.k, b, axis=1),
                 v=jax.lax.dynamic_update_slice_in_dim(kv.v, col.v, b, axis=1)),
             donate_argnums=(0,))
+    # -- multihost mirror plumbing ------------------------------------------
+    #
+    # Every method below that touches device state is split root/worker
+    # style: the public caller broadcasts the op (root only), then both
+    # sides run the SAME _exec_* body — one code path, no drift.
+
+    def _bcast(self, kind: int, aux: int = 0, payload=()) -> None:
+        if self._root_bcast:
+            self.eng._ctrl.send(self.eng._ctrl.encode_raw(kind, aux, payload))
+
+    @staticmethod
+    def _f32bits(*vecs) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(v, np.float32) for v in vecs]).view(np.int32)
+
+    def _exec_take(self, src: int):
+        return self._take(self.kv, src)
+
+    def _exec_prefill(self, col, padded, pos: int):
+        with self._plan_ctx():
+            _, col = self._prefill_fwd(
+                self.eng.params, self.cfg,
+                jnp.asarray(np.asarray(padded).reshape(1, -1), jnp.int32),
+                jnp.int32(pos), col)
+        return col
+
+    def _exec_commit(self, slot: int, col) -> None:
+        self.kv = self._put(self.kv, col, slot)
+
+    def _exec_step(self, tokens, pos, temps, topps, coins):
+        with self._plan_ctx():
+            nxt, self.kv = self._step(
+                self.eng.params, self.cfg,
+                jnp.asarray(np.asarray(tokens, np.int32)[:, None]),
+                jnp.asarray(np.asarray(pos, np.int32)), self.kv,
+                jnp.asarray(np.asarray(temps, np.float32)),
+                jnp.asarray(np.asarray(topps, np.float32)),
+                jnp.asarray(np.asarray(coins, np.float32)))
+        return np.asarray(nxt)
+
+    def _exec_verify(self, toks_2d, pos, temps, topps, coins):
+        with self._plan_ctx():
+            n_acc, preds, self.kv = self._verify(
+                self.eng.params, self.cfg,
+                jnp.asarray(np.asarray(toks_2d, np.int32)),
+                jnp.asarray(np.asarray(pos, np.int32)), self.kv,
+                jnp.asarray(np.asarray(temps, np.float32)),
+                jnp.asarray(np.asarray(topps, np.float32)),
+                jnp.asarray(np.asarray(coins, np.float32)))
+        return np.asarray(n_acc), np.asarray(preds)
 
     # -- slot lifecycle -----------------------------------------------------
 
@@ -190,8 +292,11 @@ class BatchedGenerator:
                 f"({limit} = seq_len {self.cfg.seq_len}"
                 + (f" - spec-lookup {self.spec}" if self.spec else "") + ")")
         src, k = self._best_prefix(ids[:-1])
+        from ..parallel.multihost import CTRL_SRV_TAKE
+
+        self._bcast(CTRL_SRV_TAKE, src if k else slot, [slot])
         adm = _Admission(req=req, slot=slot,
-                         col=self._take(self.kv, src if k else slot))
+                         col=self._exec_take(src if k else slot))
         adm.pos = k  # prefill resumes after the reused prefix
         return adm
 
@@ -216,6 +321,8 @@ class BatchedGenerator:
 
     def continue_admit(self, adm: "_Admission") -> bool:
         """Run one prefill chunk; True when the slot is armed for decode."""
+        from ..parallel.multihost import CTRL_SRV_COMMIT, CTRL_SRV_PREFILL
+
         rest = adm.req.prompt_ids[:-1]
         if adm.pos < len(rest):
             # same bucketed chunk sizing as engine.prefill (TPU-sized
@@ -224,15 +331,13 @@ class BatchedGenerator:
             chunk = rest[adm.pos:adm.pos + n_b]
             pad_to = min(n_b, self.cfg.seq_len - adm.pos)
             padded = chunk + [0] * (pad_to - len(chunk))
-            with self._plan_ctx():
-                _, adm.col = self._prefill_fwd(
-                    self.eng.params, self.cfg,
-                    jnp.asarray([padded], dtype=jnp.int32),
-                    jnp.int32(adm.pos), adm.col)
+            self._bcast(CTRL_SRV_PREFILL, adm.slot, [adm.pos] + padded)
+            adm.col = self._exec_prefill(adm.col, padded, adm.pos)
             adm.pos += len(chunk)
             if adm.pos < len(rest):
                 return False
-        self.kv = self._put(self.kv, adm.col, adm.slot)
+        self._bcast(CTRL_SRV_COMMIT, adm.slot)
+        self._exec_commit(adm.slot, adm.col)
         self.pos[adm.slot] = adm.pos
         self.next_token[adm.slot] = adm.req.prompt_ids[-1]
         self._ctx[adm.slot] = list(adm.req.prompt_ids[:-1])
@@ -287,6 +392,13 @@ class BatchedGenerator:
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return 0
+        if __debug__:
+            # cross-slot prefix-reuse safety: every slot with a recorded
+            # prefill context must have its write cursor at/above that
+            # region, or a ride-along write could corrupt reusable rows
+            for i, ctx in enumerate(self._ctx):
+                assert ctx is None or self.pos[i] >= len(ctx), (
+                    i, int(self.pos[i]), len(ctx))
         temps = np.zeros(self.n_slots, dtype=np.float32)
         topps = np.zeros(self.n_slots, dtype=np.float32)
         coins = np.zeros(self.n_slots, dtype=np.float32)
@@ -299,13 +411,12 @@ class BatchedGenerator:
 
         if self.spec:
             return self._spec_step(active, temps, topps, coins)
-        with self._plan_ctx():
-            nxt, self.kv = self._step(
-                self.eng.params, self.cfg,
-                jnp.asarray(self.next_token[:, None]),
-                jnp.asarray(self.pos), self.kv,
-                jnp.asarray(temps), jnp.asarray(topps), jnp.asarray(coins))
-        nxt = np.asarray(nxt)
+        from ..parallel.multihost import CTRL_SRV_STEP
+
+        self._bcast(CTRL_SRV_STEP, 0, np.concatenate([
+            self.next_token.astype(np.int32), self.pos.astype(np.int32),
+            self._f32bits(temps, topps, coins)]))
+        nxt = self._exec_step(self.next_token, self.pos, temps, topps, coins)
 
         emitted = 0
         for i in active:
@@ -353,13 +464,12 @@ class BatchedGenerator:
             toks[i, 0] = self.next_token[i]
             if self.slots[i].temperature <= 0.0:
                 toks[i, 1:] = self._proposers[i].draft()
-        with self._plan_ctx():
-            n_acc, preds, self.kv = self._verify(
-                self.eng.params, self.cfg, jnp.asarray(toks),
-                jnp.asarray(self.pos), self.kv,
-                jnp.asarray(temps), jnp.asarray(topps), jnp.asarray(coins))
-        n_acc = np.asarray(n_acc)
-        preds = np.asarray(preds)
+        from ..parallel.multihost import CTRL_SRV_VERIFY
+
+        self._bcast(CTRL_SRV_VERIFY, self.spec, np.concatenate([
+            toks.reshape(-1), self.pos.astype(np.int32),
+            self._f32bits(temps, topps, coins)]))
+        n_acc, preds = self._exec_verify(toks, self.pos, temps, topps, coins)
         emitted = 0
         for i in active:
             run = [int(t) for t in preds[i, : int(n_acc[i]) + 1]]

@@ -6,8 +6,13 @@ first, bigram fallback) — so there is no draft model, no extra device
 memory, and no new failure mode: a bad draft
 costs nothing (the verify dispatch happens regardless and its HBM cost is one
 decode step), a good draft advances several positions at once. Greedy output
-is bit-identical to plain decode by construction (models.llama.verify_step
-accepts exactly the prefix the model itself would have generated).
+is exact by construction (models.llama.verify_step accepts exactly the prefix
+the model itself would have generated) — MODULO dispatch-shape numerics: a
+[B, K+1] verify and a [B, 1] decode dispatch may differ in the last ulp on
+TPU, and an ulp can flip an argmax (the hazard tests/golden_assets.py
+documents). Identity is asserted token-for-token on the CPU mesh
+(test_speculative.py) and on real hardware by the tpu-tier transcript test
+(test_tpu_hw.py::test_spec_transcript_identity_on_hw).
 
 The reference has no speculative path (one token per step, dllama.cpp:88-99);
 this is TPU-economics-driven: decode is HBM-bound, so tokens-per-weight-read

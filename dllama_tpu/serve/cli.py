@@ -256,9 +256,10 @@ def run_inference(args) -> int:
             if s.kind != "pred" or s.sync_ms is None:
                 continue
             # traffic is measured on the single-token program; chunked /
-            # speculative dispatches repeat that program body per token, so
-            # a multi-token step's bytes scale by its token count
-            skb = f"{tr.sent_kb * s.n_tokens:7.1f}" if tr else "    0.0"
+            # speculative dispatches scale by their DISPATCH width (a verify
+            # runs K+1 columns even when one draft is accepted), not the
+            # kept-token count
+            skb = f"{tr.sent_kb * s.width:7.1f}" if tr else "    0.0"
             print(f"🔶 P {s.ms:8.2f} ms  E {s.eval_only_ms:8.2f} ms  "
                   f"S {s.sync_ms:6.2f} ms  Sent {skb} kB  Recv {skb} kB"
                   + (f"  ({s.n_tokens} tok)" if s.n_tokens > 1 else ""))
@@ -410,12 +411,11 @@ def _worker_supervisor(args) -> int:
                 os.unlink(phase_file)
             signal.pthread_sigmask(signal.SIG_BLOCK, _SIGS)
             try:
-                # the blocked mask is inherited across exec — undo it in the
-                # child or terminate() forwarding could never be delivered
-                state["child"] = subprocess.Popen(
-                    cmd, env=child_env,
-                    preexec_fn=lambda: signal.pthread_sigmask(
-                        signal.SIG_UNBLOCK, _SIGS))
+                # the blocked mask is inherited across exec; the CHILD
+                # unblocks it at interpreter start (cli.main's
+                # DLLAMA_WORKER_CHILD branch) — not via preexec_fn, which is
+                # deadlock-prone in a threaded parent (jax is imported here)
+                state["child"] = subprocess.Popen(cmd, env=child_env)
             finally:
                 signal.pthread_sigmask(signal.SIG_UNBLOCK, _SIGS)
             rc = state["child"].wait()
@@ -533,6 +533,18 @@ def _setup_compile_cache(args) -> None:
 
 
 def main(argv=None) -> int:
+    if os.environ.get("DLLAMA_WORKER_CHILD"):
+        # the supervisor blocks SIGTERM/SIGINT around its spawn (so a kill
+        # can't slip between fork/exec and its child bookkeeping) and the
+        # blocked mask is inherited across exec — undo it HERE, in the
+        # child's own interpreter, rather than via Popen(preexec_fn=...):
+        # CPython documents preexec_fn as deadlock-prone once the parent has
+        # threads (the supervisor imported jax, which starts several) and it
+        # forces fork over the faster posix_spawn path.
+        import signal
+
+        signal.pthread_sigmask(signal.SIG_UNBLOCK,
+                               {signal.SIGTERM, signal.SIGINT})
     args = build_parser().parse_args(argv)
     # raw argv for the worker supervisor's respawn command: honors explicit
     # programmatic argv (tests call cli.main([...])), not the host process's

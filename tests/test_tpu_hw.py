@@ -194,3 +194,36 @@ def test_ragged_serving_programs_on_hw(tpu_backend):
     assert preds.shape == (n_slots, 5)
     sampled_rows = np.asarray(temps) > 0
     assert (n_acc[sampled_rows] == 0).all()  # sampled rows accept nothing
+
+
+def test_spec_transcript_identity_on_hw(tpu_backend):
+    """--spec-lookup vs plain greedy transcript identity ON HARDWARE
+    (ADVICE r3 #1): the claim 'exact by construction' rides on logits being
+    bit-equal between the [1, K+1] verify dispatch and the [1, 1] decode
+    dispatch — exactly the dispatch-shape ulp hazard golden_assets documents.
+    CPU asserts it in test_speculative.py; this asserts it where it can
+    actually break. A mismatch here would demote speculation from 'exact'
+    to 'approximate' and must fail loudly."""
+    import numpy as np
+
+    from dllama_tpu.formats import tfile
+    from dllama_tpu.runtime.engine import InferenceEngine
+    from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+    import tempfile, os
+    d = tempfile.mkdtemp(prefix="dllama-hw-spec-")
+    m, t = os.path.join(d, "m.m"), os.path.join(d, "t.t")
+    rng = np.random.default_rng(17)
+    write_tiny_model(m, tiny_header_params(vocab_size=268, seq_len=160), rng)
+    tfile.write_tfile(t, byte_vocab_tokenizer())
+
+    plain = InferenceEngine(m, t, temperature=0.0, seed=5,
+                            compute_dtype="bfloat16")
+    r_plain = plain.generate("hello world hello world", 24, stop_on_eos=False)
+    spec = InferenceEngine(m, t, temperature=0.0, seed=5,
+                           compute_dtype="bfloat16", spec_lookup=4)
+    r_spec = spec.generate("hello world hello world", 24, stop_on_eos=False)
+    assert r_spec.tokens == r_plain.tokens
+    # speculation actually engaged: fewer dispatches than tokens
+    n_disp = sum(1 for s in r_spec.steps if s.kind == "pred")
+    assert n_disp < len(r_spec.tokens)
