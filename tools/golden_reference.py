@@ -69,11 +69,13 @@ def run_inference(bin_path: str, m: Path, t: Path, buffer_ft: str,
     return pieces
 
 
-def run_perplexity(bin_path: str, m: Path, t: Path, buffer_ft: str) -> dict:
+def run_perplexity(bin_path: str, m: Path, t: Path, buffer_ft: str,
+                   prompt: str | None = None) -> dict:
+    prompt = prompt if prompt is not None else golden_assets.PROMPT * 4
     cmd = [
         bin_path, "perplexity",
         "--model", str(m), "--tokenizer", str(t),
-        "--prompt", golden_assets.PROMPT * 4,  # longer sequence
+        "--prompt", prompt,
         "--nthreads", "1",
         "--buffer-float-type", buffer_ft,
     ]
@@ -85,8 +87,7 @@ def run_perplexity(bin_path: str, m: Path, t: Path, buffer_ft: str) -> dict:
     text = out.stdout.decode(errors="replace")
     ppl = float(re.search(r"perplexity: ([0-9.]+)", text).group(1))
     avg = float(re.search(r"avgLogProb: (-?[0-9.]+)", text).group(1))
-    return {"prompt": golden_assets.PROMPT * 4, "perplexity": ppl,
-            "avg_log_prob": avg}
+    return {"prompt": prompt, "perplexity": ppl, "avg_log_prob": avg}
 
 
 def main() -> None:
@@ -108,10 +109,13 @@ def main() -> None:
                 continue
             m, t, m_sha, t_sha = golden_assets.build_assets(variant, tmp)
             steps = golden_assets.variant_steps(variant)
-            pieces = run_inference(args.bin, m, t, spec["buffer_float_type"],
-                                   steps, spec.get("temperature", 0.0),
-                                   spec.get("topp", 0.9))
-            ppl = run_perplexity(args.bin, m, t, spec["buffer_float_type"])
+            pieces = ([] if spec.get("ppl_only")
+                      else run_inference(args.bin, m, t,
+                                         spec["buffer_float_type"], steps,
+                                         spec.get("temperature", 0.0),
+                                         spec.get("topp", 0.9)))
+            ppl = run_perplexity(args.bin, m, t, spec["buffer_float_type"],
+                                 prompt=spec.get("ppl_prompt"))
             golden = {
                 "variant": variant,
                 "prompt": golden_assets.PROMPT,

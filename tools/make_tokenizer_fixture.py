@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Generate the committed production-shape BPE tokenizer fixture.
+
+The reference gates its tokenizer DEV_TESTS on a real downloaded Llama-3
+tokenizer (src/tokenizer-test.cpp:44-120). This environment has zero egress,
+so the fixture is the next-best thing: a byte-level BPE vocabulary TRAINED
+here (deterministically) on an embedded multilingual corpus — thousands of
+multi-byte pieces with genuine merge ranks learned from data, laid out
+exactly the way convert/tokenizers.py lays out real HF vocabs (256 byte
+-fallback entries + merges in rank order, scores = -id, specials after the
+regular vocab).
+
+Outputs (committed):
+  tests/goldens/fixture_bpe.t        the tokenizer file
+  tests/goldens/fixture_bpe.json     encode goldens for the sample strings
+
+Rerun ``python tools/make_tokenizer_fixture.py`` to regenerate; the output
+is byte-stable (pure-deterministic training, no RNG).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import Counter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_MERGES = 2400
+
+# An embedded multilingual corpus: English prose, European accents, Greek,
+# Cyrillic, CJK, emoji, code, numbers — enough pair statistics for real
+# multi-byte merges. (Public-domain snippets + filler, intentionally bland.)
+CORPUS = (
+    "The quick brown fox jumps over the lazy dog. "
+    "It was the best of times, it was the worst of times, it was the age of "
+    "wisdom, it was the age of foolishness, it was the epoch of belief. "
+    "To be, or not to be, that is the question: whether 'tis nobler in the "
+    "mind to suffer the slings and arrows of outrageous fortune. "
+    "All happy families are alike; each unhappy family is unhappy in its own "
+    "way. Call me Ishmael. Some years ago, never mind how long precisely. "
+    "We the People of the United States, in Order to form a more perfect "
+    "Union, establish Justice, insure domestic Tranquility. "
+    "def tokenize(text):\n    return [t for t in text.split() if t]\n"
+    "for i in range(100):\n    print(f\"token {i}: {vocab[i]}\")\n"
+    "The model processes 1024 tokens per batch at 3.14 tokens/second. "
+    "Résumé naïve café déjà vu — l'été à Zürich coûte 42 €. "
+    "Der schnelle braune Fuchs springt über den faulen Hund. "
+    "El rápido zorro marrón salta sobre el perro perezoso. "
+    "Ο γρήγορος καφές αλεπού πηδά πάνω από το τεμπέλικο σκυλί. "
+    "Быстрая коричневая лиса прыгает через ленивую собаку. "
+    "素早い茶色の狐はのろまな犬を飛び越える。日本語のテキストです。"
+    "敏捷的棕色狐狸跳过懒狗。中文文本示例。"
+    "빠른 갈색 여우가 게으른 개를 뛰어넘는다. "
+    "🦊🐕 emoji test 🎉🚀 done. "
+)
+
+# synthetic long tail: varied word/number/punctuation contexts so pair
+# statistics stay rich enough for thousands of merges (pure repetition
+# starves the pair counts after a few hundred)
+_WORDS = ("model tensor shard device batch token layer cache prefill decode "
+          "attention expert router pipeline mesh collective kernel scale "
+          "memory stream weight logits sample greedy verify draft accept "
+          "серверу обучение модель 模型 训练 データ 処理 변환 처리").split()
+_TAIL = []
+for i in range(700):
+    w1 = _WORDS[i % len(_WORDS)]
+    w2 = _WORDS[(i * 7 + 3) % len(_WORDS)]
+    _TAIL.append(f"The {w1} writes {i} {w2}s, then {w1}-{w2} #{i % 97}. ")
+CORPUS = (CORPUS + "".join(_TAIL)) * 2
+
+
+MAX_PIECE_LEN = 16  # production vocabs keep pieces short (Llama-3 ~max 128)
+
+
+def train_bpe(data: bytes, n_merges: int) -> list[bytes]:
+    """Classic BPE: repeatedly merge the most frequent adjacent pair.
+    Ties break on the lexicographically smaller pair — fully deterministic.
+    Pieces are capped at MAX_PIECE_LEN bytes (unbounded chaining on a small
+    corpus merges whole sentences into single tokens, which no production
+    vocab does). Returns learned pieces in merge (rank) order."""
+    seq: list[bytes] = [bytes([b]) for b in data]
+    merges: list[bytes] = []
+    for _ in range(n_merges):
+        counts: Counter = Counter(zip(seq, seq[1:]))
+        if not counts:
+            break
+        best, freq = None, 0
+        for pair, c in counts.items():
+            if len(pair[0]) + len(pair[1]) > MAX_PIECE_LEN:
+                continue
+            if c > freq or (c == freq and best is not None
+                            and pair < best):
+                best, freq = pair, c
+        if best is None or freq < 2:
+            break
+        merged = best[0] + best[1]
+        merges.append(merged)
+        out: list[bytes] = []
+        i = 0
+        while i < len(seq):
+            if (i + 1 < len(seq) and seq[i] == best[0]
+                    and seq[i + 1] == best[1]):
+                out.append(merged)
+                i += 2
+            else:
+                out.append(seq[i])
+                i += 1
+        seq = out
+    return merges
+
+
+SAMPLES = [
+    "hello world",
+    "The quick brown fox jumps over the lazy dog.",
+    "Résumé naïve café — déjà vu à Zürich",
+    "Быстрая лиса и 素早い狐 together",
+    "🦊 emoji 🎉 mix with ASCII",
+    "def tokenize(text):\n    return text.split()",
+    "a",
+    "    leading spaces and trailing   ",
+    "ΑΒΓαβγ mixed Ελληνικά",
+    "<|start_header_id|>user<|end_header_id|>\n\nhello<|eot_id|>",
+]
+
+
+def main() -> None:
+    from dllama_tpu.formats import tfile
+    from dllama_tpu.tokenizer.bpe import Tokenizer
+
+    corpus = CORPUS.encode("utf-8")
+    merges = train_bpe(corpus, N_MERGES)
+    multi_byte = sum(1 for m in merges if len(m) >= 2 and any(b >= 0x80 for b in m))
+    print(f"trained {len(merges)} merges ({multi_byte} contain non-ASCII bytes)")
+
+    # layout mirrors convert/tokenizers.py resolve_hf_vocab + llama3 specials:
+    # byte fallback first, merges in rank order, scores=-id, specials after
+    vocab: list[bytes] = [bytes([b]) for b in range(256)] + merges
+    scores = [-float(i) for i in range(len(vocab))]
+    bos_id = len(vocab)
+    specials = [b"<s>", b"</s>", b"<|start_header_id|>", b"<|end_header_id|>",
+                b"<|eot_id|>"]
+    vocab += specials
+    scores += [0.0] * len(specials)
+
+    data = tfile.TokenizerData(
+        vocab=vocab, scores=scores, bos_id=bos_id, add_bos=True,
+        eos_token_ids=[bos_id + 1, bos_id + 4],  # </s> and <|eot_id|>
+        chat_template=None,
+        max_token_length=max(len(t) for t in vocab),
+    )
+    out_dir = os.path.join(REPO, "tests", "goldens")
+    t_path = os.path.join(out_dir, "fixture_bpe.t")
+    tfile.write_tfile(t_path, data)
+
+    tok = Tokenizer.load(t_path)
+    goldens = []
+    for s in SAMPLES:
+        ids = tok.encode(s, is_start=False)
+        tok.reset_decoder()
+        rt = "".join(p for t in ids if (p := tok.decode(t)) is not None)
+        # EOS specials stream as None by design (the reference hides EOS
+        # text); everything else must round-trip exactly
+        expect = s
+        for e in tok.eos_token_ids:
+            expect = expect.replace(tok.vocab[e].decode(), "")
+        assert rt == expect, (s, rt)
+        goldens.append({"text": s, "ids": ids})
+    stats = {
+        "n_merges": len(merges), "vocab_size": len(vocab),
+        "multi_byte_merges": multi_byte,
+        "max_piece_len": max(len(m) for m in merges),
+    }
+    with open(os.path.join(out_dir, "fixture_bpe.json"), "w") as f:
+        json.dump({"stats": stats, "goldens": goldens}, f, indent=1,
+                  ensure_ascii=False)
+    print(f"wrote {t_path} ({os.path.getsize(t_path)} bytes) "
+          f"+ goldens; stats={stats}")
+
+
+if __name__ == "__main__":
+    main()
