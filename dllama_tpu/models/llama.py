@@ -484,7 +484,13 @@ def sampled_steps(params: Params, cfg: ModelConfig, token: jax.Array,
     """The temperature>0 twin of :func:`greedy_steps`: ``coins [n_steps]``
     are the host xorshift draws for the whole chunk (the host rewinds its
     RNG to the number of tokens actually kept after EOS truncation, so the
-    stream stays bit-identical to single-step decode)."""
+    stream stays bit-identical to single-step decode).
+
+    Also the RAGGED chunked step for batched serving (BatchedGenerator
+    .step_chunk): everything broadcasts over rows — ``token/start_pos [B]``,
+    vector ``temperature/topp [B]`` (temp<=0 rows take argmax), and ``coins
+    [n_steps, B]`` (scan consumes axis 0) — so K fused steps run over the
+    whole slot pool in one dispatch."""
     return scan_decode(
         lambda t, p, kv, c: sampled_step(params, cfg, t, p, kv,
                                          temperature, topp, c),

@@ -30,6 +30,7 @@ KV cache (new conversation / perplexity run).
 
 from __future__ import annotations
 
+import os
 from typing import TYPE_CHECKING
 
 import numpy as np
@@ -69,6 +70,7 @@ CTRL_SRV_PREFILL = 10
 CTRL_SRV_COMMIT = 11
 CTRL_SRV_STEP = 12
 CTRL_SRV_VERIFY = 13
+CTRL_SRV_STEP_CHUNK = 14  # K fused ragged steps (aux = K, coins [K, B])
 
 
 class RootLostError(RuntimeError):
@@ -298,6 +300,10 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         s32(str(engine.kv_dtype)),
         # batched serving's ragged_verify_step program is shaped by K
         engine.spec_lookup,
+        # exact vs fast quant-matmul numerics compile different programs
+        # (ops/linear.py _fast_mode); `auto` resolves identically on both
+        # sides because compute_dtype is fingerprinted above
+        s32(os.environ.get("DLLAMA_TPU_QUANT_MODE", "auto")),
     ], dtype=np.int32)
     root_fp = np.asarray(multihost_utils.broadcast_one_to_all(
         fp, is_source=jax.process_index() == 0))
@@ -311,7 +317,7 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
             f"multihost config mismatch on process {jax.process_index()}: "
             f"local [n_batches, tp, sp, pp, dp, seq_len, n_layers, dim, vocab, "
             f"sync_q80, dtype, weight_mode, attn_impl, moe_impl, kv_dtype, "
-            f"spec_lookup] = "
+            f"spec_lookup, quant_mode] = "
             f"{fp.tolist()} vs root {root_fp.tolist()} — start every process "
             f"with identical model files and flags")
     if any_bad.sum() > 0:
@@ -432,6 +438,12 @@ def worker_serve(engine: "InferenceEngine", *,
                 f32 = payload[2 * B:].view(np.float32)
                 gen._exec_step(payload[:B], payload[B:2 * B],
                                f32[:B], f32[B:2 * B], f32[2 * B:3 * B])
+            elif kind == CTRL_SRV_STEP_CHUNK:
+                B, k = gen.n_slots, aux
+                f32 = payload[2 * B:].view(np.float32)
+                gen._exec_step_chunk(
+                    payload[:B], payload[B:2 * B], f32[:B], f32[B:2 * B],
+                    f32[2 * B:].reshape(k, B), k)
             elif kind == CTRL_SRV_VERIFY:
                 B, w = gen.n_slots, aux + 1
                 toks = payload[:B * w].reshape(B, w)

