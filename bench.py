@@ -356,6 +356,10 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     out = {} if out is None else out
     out["phase"] = "budget_check"
     cfg = model_cfg(preset)
+    # record the quant numerics the stage ran so captures are attributable
+    from dllama_tpu.ops.linear import quant_mode_label
+
+    out["quant_mode"] = quant_mode_label(cfg.compute_dtype == "bfloat16")
     # pre-staging HBM guardrail (runtime.hbm): a preset that can't fit must
     # refuse HERE with a clean stage error — an OOM mid-staging wedges the
     # chip for hours (the round-1/2 outage; reference prints its own

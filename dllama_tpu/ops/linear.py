@@ -108,6 +108,18 @@ def _fast_mode(x: jax.Array) -> bool:
     return x.dtype == jnp.bfloat16
 
 
+def quant_mode_label(activations_bf16: bool) -> str:
+    """The resolved mode label for diagnostics (bench captures, logs) — the
+    ONE place the env knob + auto rule turn into a string, so reports can't
+    drift from what _fast_mode actually dispatches."""
+    mode = os.environ.get("DLLAMA_TPU_QUANT_MODE", "auto")
+    if mode not in ("exact", "fast"):
+        mode = "auto"
+    resolved = mode if mode != "auto" else (
+        "fast" if activations_bf16 else "exact")
+    return resolved if mode != "auto" else f"auto({resolved})"
+
+
 def _pallas_wanted(x: jax.Array, w: QuantizedWeight) -> bool:
     mode = _kernel_mode()
     if mode == "xla":
