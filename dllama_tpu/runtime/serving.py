@@ -37,6 +37,15 @@ import numpy as np
 
 from ..models.llama import forward, sampled_step
 from ..parallel.api import use_plan
+from ..parallel.multihost import (
+    CTRL_SRV_COMMIT,
+    CTRL_SRV_INIT,
+    CTRL_SRV_PREFILL,
+    CTRL_SRV_STEP,
+    CTRL_SRV_STEP_CHUNK,
+    CTRL_SRV_TAKE,
+    CTRL_SRV_VERIFY,
+)
 from ..tokenizer.sampler import xorshift_random_f32
 from .kvcache import KVCache
 
@@ -141,8 +150,6 @@ class BatchedGenerator:
             # blocks until all processes participate — the worker must be
             # building its mirror generator concurrently, not still waiting
             # in its packet loop
-            from ..parallel.multihost import CTRL_SRV_INIT
-
             engine._ctrl.send(engine._ctrl.encode_raw(CTRL_SRV_INIT,
                                                       n_slots, ()))
         self.eng = engine
@@ -326,8 +333,6 @@ class BatchedGenerator:
                 f"({limit} = seq_len {self.cfg.seq_len}"
                 + (f" - spec-lookup {self.spec}" if self.spec else "") + ")")
         src, k = self._best_prefix(ids[:-1])
-        from ..parallel.multihost import CTRL_SRV_TAKE
-
         self._bcast(CTRL_SRV_TAKE, src if k else slot, [slot])
         adm = _Admission(req=req, slot=slot,
                          col=self._exec_take(src if k else slot))
@@ -355,8 +360,6 @@ class BatchedGenerator:
 
     def continue_admit(self, adm: "_Admission") -> bool:
         """Run one prefill chunk; True when the slot is armed for decode."""
-        from ..parallel.multihost import CTRL_SRV_COMMIT, CTRL_SRV_PREFILL
-
         rest = adm.req.prompt_ids[:-1]
         if adm.pos < len(rest):
             # same bucketed chunk sizing as engine.prefill (TPU-sized
@@ -445,11 +448,10 @@ class BatchedGenerator:
 
         if self.spec:
             return self._spec_step(active, temps, topps, coins)
-        from ..parallel.multihost import CTRL_SRV_STEP
-
-        self._bcast(CTRL_SRV_STEP, 0, np.concatenate([
-            self.next_token.astype(np.int32), self.pos.astype(np.int32),
-            self._f32bits(temps, topps, coins)]))
+        if self._root_bcast:  # payload built only when it will be sent
+            self._bcast(CTRL_SRV_STEP, 0, np.concatenate([
+                self.next_token.astype(np.int32), self.pos.astype(np.int32),
+                self._f32bits(temps, topps, coins)]))
         nxt = self._exec_step(self.next_token, self.pos, temps, topps, coins)
 
         emitted = 0
@@ -493,11 +495,10 @@ class BatchedGenerator:
                 for j in range(k):
                     coins[j, i], st = xorshift_random_f32(st)
 
-        from ..parallel.multihost import CTRL_SRV_STEP_CHUNK
-
-        self._bcast(CTRL_SRV_STEP_CHUNK, k, np.concatenate([
-            self.next_token.astype(np.int32), self.pos.astype(np.int32),
-            self._f32bits(temps, topps, coins.reshape(-1))]))
+        if self._root_bcast:
+            self._bcast(CTRL_SRV_STEP_CHUNK, k, np.concatenate([
+                self.next_token.astype(np.int32), self.pos.astype(np.int32),
+                self._f32bits(temps, topps, coins.reshape(-1))]))
         toks = self._exec_step_chunk(self.next_token, self.pos, temps,
                                      topps, coins, k)
         emitted = 0
@@ -554,11 +555,10 @@ class BatchedGenerator:
             toks[i, 0] = self.next_token[i]
             if self.slots[i].temperature <= 0.0:
                 toks[i, 1:] = self._proposers[i].draft()
-        from ..parallel.multihost import CTRL_SRV_VERIFY
-
-        self._bcast(CTRL_SRV_VERIFY, self.spec, np.concatenate([
-            toks.reshape(-1), self.pos.astype(np.int32),
-            self._f32bits(temps, topps, coins)]))
+        if self._root_bcast:
+            self._bcast(CTRL_SRV_VERIFY, self.spec, np.concatenate([
+                toks.reshape(-1), self.pos.astype(np.int32),
+                self._f32bits(temps, topps, coins)]))
         n_acc, preds = self._exec_verify(toks, self.pos, temps, topps, coins)
         emitted = 0
         for i in active:

@@ -169,8 +169,10 @@ def _maybe_init_distributed(args) -> bool:
     return True
 
 
-# whether THIS process's make_engine wrote DLLAMA_TPU_QUANT_MODE (vs the user)
+# whether THIS process's make_engine wrote DLLAMA_TPU_QUANT_MODE (vs the
+# user), and the user's pre-existing value to restore when it did
 _cli_wrote_quant_mode = False
+_env_quant_before_cli: str | None = None
 
 
 def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
@@ -179,15 +181,20 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
     if not args.model or not args.tokenizer:
         raise SystemExit("--model and --tokenizer are required")
     seed = args.seed if args.seed is not None else int(time.time())
-    global _cli_wrote_quant_mode
+    global _cli_wrote_quant_mode, _env_quant_before_cli
     if getattr(args, "quant_mode", "auto") != "auto":
+        if not _cli_wrote_quant_mode:
+            _env_quant_before_cli = os.environ.get("DLLAMA_TPU_QUANT_MODE")
         os.environ["DLLAMA_TPU_QUANT_MODE"] = args.quant_mode
         _cli_wrote_quant_mode = True
     elif _cli_wrote_quant_mode:
         # auto must mean auto, not whatever a PRIOR make_engine in this
         # process wrote — but a user-exported DLLAMA_TPU_QUANT_MODE is
-        # theirs to keep (matching how DLLAMA_TPU_QUANT_KERNEL behaves)
-        os.environ.pop("DLLAMA_TPU_QUANT_MODE", None)
+        # theirs to keep (restored, not popped)
+        if _env_quant_before_cli is None:
+            os.environ.pop("DLLAMA_TPU_QUANT_MODE", None)
+        else:
+            os.environ["DLLAMA_TPU_QUANT_MODE"] = _env_quant_before_cli
         _cli_wrote_quant_mode = False
     engine = InferenceEngine(
         args.model, args.tokenizer,

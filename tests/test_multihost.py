@@ -749,3 +749,88 @@ def test_multihost_batched_serving_chunked(tmp_path):
     single = _run_batched_single(tmp_path, m, t, chunk=3)
     multi = _run_batched_cluster(tmp_path, m, t, chunk=3)
     assert multi == single
+
+
+@pytest.mark.slow
+def test_multihost_api_server_batched_end_to_end(tmp_path):
+    """The reference's exact deployment shape (dllama-api.cpp:599-613): the
+    HTTP API server runs on the ROOT and drives the whole worker mesh —
+    here with --batch-slots continuous batching riding the CTRL_SRV_*
+    mirror protocol. Two sequential requests with the same body must get
+    identical replies (determinism + the 2nd admission prefix-reuses)."""
+    import json as _json
+    import urllib.request
+
+    m, t = tmp_path / "m.m", tmp_path / "t.t"
+    rng = np.random.default_rng(91)
+    write_tiny_model(m, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    from dllama_tpu.formats import tfile
+    data = byte_vocab_tokenizer()
+    data.chat_template = (
+        "{% set content = '<|start_header_id|>' + message['role'] + "
+        "'<|end_header_id|>\n\n' + message['content'] | trim + "
+        "'<|eot_id|>' %}")  # autodetects as llama3 (test_cli's snippet)
+    tfile.write_tfile(t, data)
+
+    env = _two_proc_env()
+    coord = f"127.0.0.1:{PORT + 30}"
+    api_port = PORT + 31
+    root = subprocess.Popen(
+        [sys.executable, "-m", "dllama_tpu", "api",
+         "--coordinator", coord, "--nprocs", "2", "--procid", "0",
+         "--model", str(m), "--tokenizer", str(t), "--tp", "2",
+         "--buffer-float-type", "f32", "--batch-slots", "2",
+         "--port", str(api_port), "--host", "127.0.0.1"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "dllama_tpu", "worker",
+         "--coordinator", coord, "--nprocs", "2", "--procid", "1",
+         "--model", str(m), "--tokenizer", str(t), "--tp", "2",
+         "--buffer-float-type", "f32"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    body = _json.dumps({
+        "model": "m", "max_tokens": 6, "temperature": 0.0,
+        "messages": [{"role": "user", "content": "hello world"}],
+    }).encode()
+
+    def post():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{api_port}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return _json.loads(r.read())
+
+    try:
+        reply1 = reply2 = None
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            try:
+                reply1 = post()
+                break
+            except Exception:
+                if root.poll() is not None:
+                    break
+                time.sleep(3)
+        assert reply1 is not None, "api never came up"
+        reply2 = post()
+        c1 = reply1["choices"][0]["message"]["content"]
+        c2 = reply2["choices"][0]["message"]["content"]
+        assert c1 == c2 and isinstance(c1, str)
+    finally:
+        import signal as _signal
+
+        root.send_signal(_signal.SIGINT)
+        try:
+            root_out, _ = root.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            root.kill()
+            root_out, _ = root.communicate()
+        try:
+            worker_out, _ = worker.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            worker.kill()
+            worker_out, _ = worker.communicate()
+    worker_txt = worker_out.decode(errors="replace")
+    assert "served" in worker_txt, worker_txt[-1000:]
+    assert root.returncode in (0, -2, 130), root_out.decode(errors="replace")[-2000:]
