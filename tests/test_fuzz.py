@@ -1,0 +1,93 @@
+"""Seeded property sweeps — cheap randomized coverage of invariants the
+hand-picked cases can't span (deterministic seeds, so failures reproduce).
+
+The reference relies on exactly these invariance properties without testing
+them broadly: chunk-size-invariant prefill (positions-as-batch semantics,
+SURVEY §4) and byte-exact tokenizer round-trips (tokenizer-test.cpp)."""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.tokenizer.bpe import Tokenizer
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fuzz")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(99)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=192), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    return str(mpath), str(tpath)
+
+
+def test_prefill_bucketing_invariant_over_random_lengths(model_files):
+    """Adaptive 128/64/32 bucketing must produce the same tokens as pinned
+    tiny chunks for prompts of ARBITRARY length — the boundary cases (just
+    below/above a bucket edge, tail of 1) are where off-by-ones live."""
+    m, t = model_files
+    adaptive = InferenceEngine(m, t, temperature=0.0, seed=7)
+    pinned = InferenceEngine(m, t, temperature=0.0, seed=7, n_batches=5)
+    rng = np.random.default_rng(123)
+    lengths = [2, 31, 32, 33, 63, 64, 65, 127, 128, 129, 150]
+    for n in lengths:
+        prompt = [int(x) for x in rng.integers(4, 260, size=n)]
+        ra = adaptive.generate(prompt, 3, stop_on_eos=False)
+        rp = pinned.generate(prompt, 3, stop_on_eos=False)
+        assert ra.tokens == rp.tokens, n
+        adaptive.reset()
+        pinned.reset()
+
+
+def test_fixture_tokenizer_roundtrip_fuzz():
+    """Random multilingual strings through the production-shape BPE fixture:
+    encode→streaming-decode must reproduce the input byte-for-byte."""
+    import os
+
+    t_path = os.path.join(os.path.dirname(__file__), "goldens",
+                          "fixture_bpe.t")
+    tok = Tokenizer.load(t_path)
+    rng = np.random.default_rng(7)
+    pools = [
+        "abcdefghijklmnopqrstuvwxyz THE MODEL tokenize 0123456789.,!?-",
+        "éüßñçàøæœ€αβγδεζКНИГАшщъыь",
+        "素早い茶色の狐犬を飛び越える中文文本日本語",
+        "🦊🐕🎉🚀👩‍💻",
+    ]
+    for trial in range(60):
+        pool = pools[trial % len(pools)]
+        chars = [pool[i] for i in rng.integers(0, len(pool),
+                                               size=rng.integers(1, 80))]
+        s = "".join(chars)
+        ids = tok.encode(s, is_start=False)
+        tok.reset_decoder()
+        rt = "".join(p for t in ids if (p := tok.decode(t)) is not None)
+        assert rt == s, repr(s)
+
+
+def test_native_python_merge_fuzz_on_fixture():
+    """Random byte soup (valid UTF-8) through native vs Python mergers."""
+    import os
+
+    from dllama_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    t_path = os.path.join(os.path.dirname(__file__), "goldens",
+                          "fixture_bpe.t")
+    tok_nat = Tokenizer.load(t_path)
+    tok_py = Tokenizer.load(t_path)
+    tok_py._bpe_native = False
+    rng = np.random.default_rng(11)
+    corpus = ("the model writes tokens Résumé café Быстрая 素早い 🦊 "
+              "def f(x):\n  return x  # 42\n")
+    for _ in range(40):
+        i = int(rng.integers(0, len(corpus) - 1))
+        j = int(rng.integers(i + 1, len(corpus) + 1))
+        s = corpus[i:j] * int(rng.integers(1, 4))
+        assert tok_nat.encode(s, is_start=False) == \
+            tok_py.encode(s, is_start=False), repr(s)
