@@ -5,6 +5,8 @@ The reference relies on exactly these invariance properties without testing
 them broadly: chunk-size-invariant prefill (positions-as-batch semantics,
 SURVEY §4) and byte-exact tokenizer round-trips (tokenizer-test.cpp)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -13,6 +15,8 @@ from dllama_tpu.runtime.engine import InferenceEngine
 from dllama_tpu.tokenizer.bpe import Tokenizer
 
 from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+FIXTURE_T = os.path.join(os.path.dirname(__file__), "goldens", "fixture_bpe.t")
 
 
 @pytest.fixture(scope="module")
@@ -46,11 +50,7 @@ def test_prefill_bucketing_invariant_over_random_lengths(model_files):
 def test_fixture_tokenizer_roundtrip_fuzz():
     """Random multilingual strings through the production-shape BPE fixture:
     encode→streaming-decode must reproduce the input byte-for-byte."""
-    import os
-
-    t_path = os.path.join(os.path.dirname(__file__), "goldens",
-                          "fixture_bpe.t")
-    tok = Tokenizer.load(t_path)
+    tok = Tokenizer.load(FIXTURE_T)
     rng = np.random.default_rng(7)
     pools = [
         "abcdefghijklmnopqrstuvwxyz THE MODEL tokenize 0123456789.,!?-",
@@ -71,16 +71,12 @@ def test_fixture_tokenizer_roundtrip_fuzz():
 
 def test_native_python_merge_fuzz_on_fixture():
     """Random byte soup (valid UTF-8) through native vs Python mergers."""
-    import os
-
     from dllama_tpu import native
 
     if not native.available():
         pytest.skip("native library unavailable")
-    t_path = os.path.join(os.path.dirname(__file__), "goldens",
-                          "fixture_bpe.t")
-    tok_nat = Tokenizer.load(t_path)
-    tok_py = Tokenizer.load(t_path)
+    tok_nat = Tokenizer.load(FIXTURE_T)
+    tok_py = Tokenizer.load(FIXTURE_T)
     tok_py._bpe_native = False
     rng = np.random.default_rng(11)
     corpus = ("the model writes tokens Résumé café Быстрая 素早い 🦊 "
