@@ -1,0 +1,36 @@
+#!/bin/sh
+# OpenAI-compatible API server over a worker mesh — the reference's
+# dllama-api deployment shape (src/dllama-api.cpp:599-613: the HTTP server
+# runs on the root and drives the same worker mesh the CLI uses), with
+# continuous batching riding the CTRL_SRV_* mirror protocol
+# (runtime/serving.py + parallel/multihost.py).
+#
+# Usage: MODEL=m.m TOKENIZER=t.t NPROCS=2 sh examples/api-cluster.sh
+# Then:  curl http://127.0.0.1:9990/v1/chat/completions -d '{
+#          "model":"m","messages":[{"role":"user","content":"hi"}]}'
+set -e
+MODEL=${MODEL:?set MODEL=path/to.m}
+TOKENIZER=${TOKENIZER:?set TOKENIZER=path/to.t}
+NPROCS=${NPROCS:-2}
+COORD=${COORD:-127.0.0.1:19917}
+PORT=${PORT:-9990}
+SLOTS=${SLOTS:-4}
+
+i=1
+while [ "$i" -lt "$NPROCS" ]; do
+  # flags that select a jitted program (--compute-dtype here) must match the
+  # root's exactly — the cluster fingerprint rejects mismatches at init. No
+  # --worker-timeout: an idle API server sends no control packets, so any
+  # bounded wait would kill the mesh between requests; root death still
+  # surfaces as a coordination-service error and --worker-reserve re-serves.
+  python -m dllama_tpu worker \
+    --coordinator "$COORD" --nprocs "$NPROCS" --procid "$i" \
+    --model "$MODEL" --tokenizer "$TOKENIZER" --tp "$NPROCS" \
+    --compute-dtype bf16 --worker-reserve &
+  i=$((i + 1))
+done
+
+exec python -m dllama_tpu api \
+  --coordinator "$COORD" --nprocs "$NPROCS" --procid 0 \
+  --model "$MODEL" --tokenizer "$TOKENIZER" --tp "$NPROCS" \
+  --batch-slots "$SLOTS" --port "$PORT" --compute-dtype bf16

@@ -580,3 +580,62 @@ def test_scheduler_uses_chunked_steps(tmp_path_factory):
         assert got == want
     finally:
         sched.close()
+
+
+# ---------------------------------------------------------------------------
+# composition: batched serving × offload / f8 KV (round-4 matrix closure)
+# ---------------------------------------------------------------------------
+
+
+def test_batched_serving_with_offload_matches_solo(tmp_path_factory):
+    """--weight-mode offload (host-DRAM layer streaming) composes with the
+    slot pool: the ragged programs pull the same pinned-host stacks the solo
+    forward does, so transcripts must match solo offload runs."""
+    d = tmp_path_factory.mktemp("serving-off")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(61)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+
+    ref = InferenceEngine(str(mpath), str(tpath), tp=1,
+                          weight_mode="offload", temperature=0.0, seed=7)
+    ids = ref.tokenizer.encode("hello world", is_start=True)
+    want = ref.generate(ids, 6, stop_on_eos=False).tokens
+
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1,
+                          weight_mode="offload", temperature=0.0, seed=7)
+    gen = BatchedGenerator(eng, n_slots=2)
+    r = Request(rid=0, prompt_ids=ids, max_tokens=6, temperature=0.0,
+                stop_on_eos=False)
+    gen.admit(r, 0)
+    while gen.n_active:
+        gen.step()
+    assert r.tokens == want
+
+
+def test_batched_serving_with_f8_kv_runs_and_is_deterministic(
+        tmp_path_factory):
+    """--kv-dtype f8 composes with the slot pool (the serving cache is
+    created at engine.kv_dtype): same request twice -> same tokens."""
+    import jax.numpy as jnp
+
+    d = tmp_path_factory.mktemp("serving-f8")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(62)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1, kv_dtype="f8",
+                          compute_dtype="bfloat16", temperature=0.0, seed=7)
+    gen = BatchedGenerator(eng, n_slots=2)
+    assert gen.kv.k.dtype == jnp.float8_e4m3fn
+    ids = eng.tokenizer.encode("hello world", is_start=True)
+    outs = []
+    for slot in (0, 1):
+        r = Request(rid=slot, prompt_ids=ids, max_tokens=6,
+                    temperature=0.0, stop_on_eos=False)
+        gen.admit(r, slot)
+        while gen.slots[slot] is not None:
+            gen.step()
+        outs.append(r.tokens)
+    assert outs[0] == outs[1] and len(outs[0]) == 6
