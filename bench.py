@@ -388,15 +388,26 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
     greedy = jax.jit(greedy_step, static_argnums=1, donate_argnums=(4,))
 
-    # prefill (chunked the way engine.prefill batches positions)
+    # prefill (chunked the way engine.prefill batches positions — the
+    # production default's LARGEST bucket; the reference's fixed 32 would
+    # idle the MXU)
+    from dllama_tpu.runtime.engine import PREFILL_BUCKETS
+
     out["phase"] = "prefill_compile"
-    chunk = min(prefill_len, 128)
+    # seq_len/2 cap keeps room for at least one measured ADVANCING chunk
+    # after the compile chunk on small presets (tiny: 256-seq -> 128-chunk)
+    chunk = min(prefill_len, PREFILL_BUCKETS[0], cfg.seq_len // 2)
     prompt = jnp.ones((batch, chunk), dtype=jnp.int32)
     logits, kv = step(params, cfg, prompt, jnp.int32(0), kv)  # compile
     jax.block_until_ready(logits)
     if time.monotonic() > deadline:
         raise TimeoutError("deadline after prefill compile")
-    n_chunks = max(1, prefill_len // chunk - 1)
+    # measured dispatches advance positions like a real prefill (pos-0
+    # repeats would let the flash kernel's causal block-skip drop the
+    # attention over earlier chunks, inflating tok/s for multi-chunk
+    # prompts); chunks are capped to the rows seq_len actually has
+    n_chunks = max(1, min(prefill_len // chunk,
+                          cfg.seq_len // chunk - 1))
     out["phase"] = "prefill_measure"
     t0 = time.perf_counter()
     pos = chunk
