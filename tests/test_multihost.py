@@ -631,9 +631,11 @@ BATCHED_SCRIPT = textwrap.dedent("""
                                + sys.argv[8])
     sys.path.insert(0, sys.argv[1])
     multihost = sys.argv[2] != "-"
+    nprocs = int(sys.argv[10]) if len(sys.argv) > 10 else 2
+    dp = int(sys.argv[11]) if len(sys.argv) > 11 else 1
     if multihost:
         from dllama_tpu.parallel.multihost import init_distributed
-        init_distributed(sys.argv[2], 2, 0, platform="cpu")
+        init_distributed(sys.argv[2], nprocs, 0, platform="cpu")
     else:
         # single-host run: re-pin cpu past the axon sitecustomize override
         # (init_distributed does this on the multihost side)
@@ -643,7 +645,7 @@ BATCHED_SCRIPT = textwrap.dedent("""
     spec = int(sys.argv[7])
     from dllama_tpu.runtime.engine import InferenceEngine
     from dllama_tpu.runtime.serving import BatchedGenerator, Request
-    eng = InferenceEngine(m, t, tp=2, compute_dtype="float32",
+    eng = InferenceEngine(m, t, tp=2, dp=dp, compute_dtype="float32",
                           temperature=0.0, seed=3, multihost=multihost,
                           spec_lookup=spec)
     gen = BatchedGenerator(eng, n_slots=2)
@@ -853,3 +855,50 @@ def test_multihost_api_server_batched_end_to_end(tmp_path):
     worker_txt = worker_out.decode(errors="replace")
     assert "served" in worker_txt, worker_txt[-1000:]
     assert root.returncode in (0, -2, 130), root_out.decode(errors="replace")[-2000:]
+
+
+@pytest.mark.slow
+def test_four_process_dp_tp_batched_serving(tiny_files):
+    """The flagship serving topology at real multi-process scale: a dp=2 ×
+    tp=2 mesh over FOUR processes (one device each), slot pool dp-sharded,
+    with the CTRL_SRV_* mirror protocol driving all four. Must reproduce
+    the single-process dp×tp run of the same request set."""
+    m, t = tiny_files
+
+    env = _two_proc_env()
+    args = ["hello world", "the quick brown", "0"]  # p1, p2, spec
+    single = subprocess.run(
+        [sys.executable, "-c", BATCHED_SCRIPT, str(REPO), "-", m, t,
+         *args, "4", "0", "4", "2"], env=env, capture_output=True,
+        text=True, timeout=600)
+    assert single.returncode == 0, single.stdout[-3000:] + single.stderr[-2000:]
+    want = {ln.split("=")[0]: ln.split("=")[1]
+            for ln in single.stdout.splitlines() if ln.startswith("TOK")}
+    assert set(want) == {"TOK0", "TOK1"}, single.stdout[-2000:]
+
+    coord = f"127.0.0.1:{PORT + 40}"
+    root = subprocess.Popen(
+        [sys.executable, "-c", BATCHED_SCRIPT, str(REPO), coord, m, t,
+         *args, "1", "0", "4", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    workers = [_spawn_worker(coord, m, t, "--dp", "2",
+                             "--buffer-float-type", "f32",
+                             "--worker-timeout", "120",
+                             nprocs=4, procid=p, tp=2)
+               for p in (1, 2, 3)]
+    try:
+        out, _ = root.communicate(timeout=600)
+        txt = out.decode(errors="replace")
+        assert root.returncode == 0, f"root failed:\n{txt[-3000:]}"
+        wouts = [w.communicate(timeout=180)[0] for w in workers]
+    finally:
+        for p in [root, *workers]:
+            if p.poll() is None:
+                p.kill()
+    got = {ln.split("=")[0]: ln.split("=")[1]
+           for ln in txt.splitlines() if ln.startswith("TOK")}
+    assert got == want, (got, want)
+    for i, w in enumerate(workers):
+        wtxt = wouts[i].decode(errors="replace")
+        assert w.returncode == 0, f"worker {i + 1} failed:\n{wtxt[-2000:]}"
+        assert "served" in wtxt and "served 0" not in wtxt, wtxt[-1000:]
