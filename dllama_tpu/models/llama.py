@@ -273,10 +273,16 @@ def _moe_ffn_sparse(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Arra
     e_local = cfg.n_experts // (plan._axis_size(ep_ax) if ep_ax else 1)
     red_axes = tuple(a for a in (ep_ax, hid_ax) if a is not None)
 
+    from ..parallel.qcollectives import wire_psum
+
+    n_parts = 1
+    for a in red_axes:
+        n_parts *= plan._axis_size(a)
+
     def local(x_l, idx_l, w_l, we1, we2, we3):
         e_lo = (jax.lax.axis_index(ep_ax) * e_local) if ep_ax else jnp.int32(0)
         y = _moe_sparse_local(cfg, x_l, idx_l, w_l, we1, we2, we3, e_lo, e_local)
-        return jax.lax.psum(y, red_axes) if red_axes else y
+        return wire_psum(y, red_axes, n_parts) if red_axes else y
 
     fn = jax.shard_map(
         local, mesh=plan.mesh,

@@ -201,6 +201,24 @@ def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
     ).astype(out_dtype)
 
 
+def q80_quantize_planes(x: jax.Array):
+    """In-graph Q80 block quantization of the trailing axis: int8 codes
+    ``[..., n/32, 32]`` + f16 scales ``[..., n/32, 1]``. The ONE
+    implementation of the reference's activation-quantization math — both
+    :func:`fake_quant_q80` (numerics emulation at sync points) and the
+    quantized-wire collective (parallel.qcollectives) build on it, so their
+    bit-identity can't drift."""
+    *lead, n = x.shape
+    assert n % Q80_BLOCK_SIZE == 0, n
+    g = x.astype(jnp.float32).reshape(*lead, n // Q80_BLOCK_SIZE,
+                                      Q80_BLOCK_SIZE)
+    amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    d = amax / 127.0
+    inv = jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 0.0)
+    codes = jnp.round(g * inv).astype(jnp.int8)  # half-to-even, in [-127,127]
+    return codes, d.astype(jnp.float16)
+
+
 def fake_quant_q80(x: jax.Array) -> jax.Array:
     """In-graph Q80 quantize→dequantize of the trailing axis.
 
@@ -219,14 +237,6 @@ def fake_quant_q80(x: jax.Array) -> jax.Array:
     goldens were generated with, and it's IEEE/TPU-native (XLA lowers
     jnp.round to round_nearest_even directly).
     """
-    orig_shape = x.shape
-    orig_dtype = x.dtype
-    n = orig_shape[-1]
-    assert n % Q80_BLOCK_SIZE == 0, n
-    g = x.astype(jnp.float32).reshape(*orig_shape[:-1], n // Q80_BLOCK_SIZE, Q80_BLOCK_SIZE)
-    amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
-    d = amax / 127.0
-    inv = jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 0.0)
-    q = jnp.round(g * inv)  # half-to-even (see docstring)
-    d16 = d.astype(jnp.float16).astype(jnp.float32)
-    return (q * d16).reshape(orig_shape).astype(orig_dtype)
+    codes, d16 = q80_quantize_planes(x)
+    return (codes.astype(jnp.float32)
+            * d16.astype(jnp.float32)).reshape(x.shape).astype(x.dtype)

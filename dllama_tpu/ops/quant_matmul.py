@@ -213,14 +213,18 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
         return None
 
     if k_ax is not None:
+        from ..parallel.qcollectives import wire_psum
+
         def local(xl, sc, cd):
             # f32 partials so the cross-device reduction doesn't round in bf16
             # (fast mode keeps bf16 multiplies but its accumulator/output is
-            # already f32, so the psum is f32 either way)
+            # already f32, so the psum is f32 either way). wire_psum ships
+            # Q80-quantized partials when --wire q80 is on (the reference's
+            # quantized sync pipes; parallel/qcollectives.py).
             part = quant_matmul(xl.astype(jnp.float32),
                                 QuantizedWeight(scales=sc, codes=cd),
                                 interpret=interpret, fast=fast)
-            return jax.lax.psum(part, k_ax)
+            return wire_psum(part, k_ax, plan._axis_size(k_ax))
 
         fn = jax.shard_map(
             local, mesh=plan.mesh,

@@ -304,6 +304,8 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         # (ops/linear.py _fast_mode); `auto` resolves identically on both
         # sides because compute_dtype is fingerprinted above
         s32(os.environ.get("DLLAMA_TPU_QUANT_MODE", "auto")),
+        # wire format changes the collective program (qcollectives.py)
+        s32(os.environ.get("DLLAMA_TPU_WIRE", "f32")),
     ], dtype=np.int32)
     root_fp = np.asarray(multihost_utils.broadcast_one_to_all(
         fp, is_source=jax.process_index() == 0))
@@ -317,7 +319,7 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
             f"multihost config mismatch on process {jax.process_index()}: "
             f"local [n_batches, tp, sp, pp, dp, seq_len, n_layers, dim, vocab, "
             f"sync_q80, dtype, weight_mode, attn_impl, moe_impl, kv_dtype, "
-            f"spec_lookup, quant_mode] = "
+            f"spec_lookup, quant_mode, wire] = "
             f"{fp.tolist()} vs root {root_fp.tolist()} — start every process "
             f"with identical model files and flags")
     if any_bad.sum() > 0:

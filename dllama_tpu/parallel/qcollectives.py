@@ -1,0 +1,82 @@
+"""Quantized-wire collectives — the reference's Q80 sync as a real TPU
+collective, not just a numerics emulation.
+
+The reference's distributed backend ships Q80-quantized activations over
+its TCP mesh (pipes carry ``syncType`` floats, llm.cpp:167): each node
+quantizes its PARTIAL to int8 codes + f16 block scales, all-gathers, and
+merges with OP_MERGE_ADD after dequantization — wire volume ~1/4 of f32
+(report/report.pdf fig. 6: 6 MB/token for 8-node 7B). Here the same
+algorithm runs as XLA collectives: ``all_gather`` of the int8/f16 planes
+(1.0625 B per value instead of 4) + a local dequant-sum. On ICI the f32
+``psum`` is rarely bandwidth-bound, but over DCN — the reference's
+Ethernet-bound regime — the wire is the constraint, which is exactly where
+this applies. (Same direction as EQuARX's quantized AllReduce inside XLA;
+this is the reference-faithful all-gather formulation, so its numerics are
+identical to summing ``fake_quant_q80`` partials.)
+
+Byte math vs XLA's ring all-reduce (not the reference's all-gather+merge):
+a ring all-reduce moves ``2(n-1)/n × 4`` B/value per device; the quantized
+all-gather moves ``(n-1)/n × n × 1.0625`` B/value — a ``8/(1.0625·n)``×
+win: ~3.8× at n=2, ~1.9× at n=4, break-even near n=8. Past that a
+quantized ring reduce-scatter (requantize per hop, EQuARX-style) would be
+needed; this formulation is chosen because its numerics are exactly the
+reference's (one quantization per partial — goldens transfer).
+
+Opt-in via ``DLLAMA_TPU_WIRE=q80`` (CLI ``--wire q80``); selected at trace
+time like the quant-mode knob, and part of the multihost cluster
+fingerprint (a root/worker mismatch compiles different programs).
+Consumed by the explicit col-split collectives (the two per-layer wire
+syncs the reference has: wo and w2 partial merges) in
+ops/quant_matmul.quant_matmul_sharded; GSPMD-inserted psums (the XLA
+-fallback path) are not interceptable and keep full precision.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK = 32  # Q80 block size (reference NnBlockQ80)
+
+# past this many participants the quantized ALL-GATHER moves more bytes
+# than the f32 ring all-reduce (crossover math in the module docstring) —
+# wire_psum falls back to full precision there
+_MAX_WIRE_PARTS = 7
+
+
+def wire_q80() -> bool:
+    return os.environ.get("DLLAMA_TPU_WIRE", "f32") == "q80"
+
+
+def psum_q80_wire(x: jax.Array, axis_name) -> jax.Array:
+    """All-reduce whose WIRE traffic is Q80: quantize the local partial,
+    all-gather the planes, dequant-sum locally. Numerically identical to
+    ``sum_i fake_quant_q80(partial_i)`` — the reference's exact merge
+    (SYNC_NODE_SLICES + OP_MERGE_ADD over Q80 pipes).
+
+    ``axis_name`` may be a tuple of mesh axes (like ``jax.lax.psum``)."""
+    from ..ops.linear import q80_quantize_planes
+
+    codes, scales = q80_quantize_planes(x)
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    for ax in axes:
+        # each gather prepends one participant axis; the WIRE carries the
+        # int8/f16 planes, never the f32 values
+        codes = jax.lax.all_gather(codes, ax)
+        scales = jax.lax.all_gather(scales, ax)
+    deq = codes.astype(jnp.float32) * scales.astype(jnp.float32)
+    total = jnp.sum(deq, axis=tuple(range(len(axes))))
+    return total.reshape(x.shape).astype(x.dtype)
+
+
+def wire_psum(x: jax.Array, axis_name, n_parts: int | None = None) -> jax.Array:
+    """The dispatch point: q80 wire when enabled, the trailing axis is
+    block-divisible, and the participant count (``n_parts``, passed
+    statically by the caller from its mesh plan) is below the all-gather
+    crossover — else the ordinary full-precision psum."""
+    if (wire_q80() and x.shape[-1] % _BLOCK == 0
+            and (n_parts is None or n_parts <= _MAX_WIRE_PARTS)):
+        return psum_q80_wire(x, axis_name)
+    return jax.lax.psum(x, axis_name)

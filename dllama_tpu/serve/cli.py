@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-dtype", choices=["f32", "bf16"], default="f32",
                    help="activation dtype: f32 for reference parity, "
                         "bf16 for TPU serving throughput")
+    p.add_argument("--wire", choices=["f32", "q80"], default=None,
+                   help="collective wire format for the explicit col-split "
+                        "partial merges (parallel/qcollectives.py): q80 "
+                        "ships int8 codes + f16 block scales (~1/4 of f32 "
+                        "bytes) and dequant-sums locally — the reference's "
+                        "quantized sync pipes (llm.cpp:167, report fig. 6) "
+                        "as an XLA collective; for DCN-bound multihost")
     p.add_argument("--quant-mode", choices=["auto", "exact", "fast"],
                    default="auto",
                    help="quantized-matmul numerics (ops/linear.py): exact = "
@@ -173,6 +180,8 @@ def _maybe_init_distributed(args) -> bool:
 # user), and the user's pre-existing value to restore when it did
 _cli_wrote_quant_mode = False
 _env_quant_before_cli: str | None = None
+_cli_wrote_wire = False
+_env_wire_before_cli: str | None = None
 
 
 def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
@@ -196,6 +205,21 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         else:
             os.environ["DLLAMA_TPU_QUANT_MODE"] = _env_quant_before_cli
         _cli_wrote_quant_mode = False
+    # --wire mirrors the quant-mode discipline: an explicit flag value is
+    # set (and overrides a user export), the unset default restores
+    # whatever a PRIOR make_engine in this process overwrote
+    global _cli_wrote_wire, _env_wire_before_cli
+    if getattr(args, "wire", None) is not None:
+        if not _cli_wrote_wire:
+            _env_wire_before_cli = os.environ.get("DLLAMA_TPU_WIRE")
+        os.environ["DLLAMA_TPU_WIRE"] = args.wire
+        _cli_wrote_wire = True
+    elif _cli_wrote_wire:
+        if _env_wire_before_cli is None:
+            os.environ.pop("DLLAMA_TPU_WIRE", None)
+        else:
+            os.environ["DLLAMA_TPU_WIRE"] = _env_wire_before_cli
+        _cli_wrote_wire = False
     engine = InferenceEngine(
         args.model, args.tokenizer,
         tp=args.tp, sp=args.sp, pp=args.pp, dp=getattr(args, "dp", 1),

@@ -222,16 +222,22 @@ def test_fast_mode_model_logit_drift(monkeypatch):
     params = init_random_params(cfg, seed=31, quantized=True)
     tokens = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], dtype=jnp.int32)
 
+    # fresh lambdas per mode: jit wrappers around the SAME function object
+    # share the global pjit executable cache, which would reuse the exact
+    # program for the fast run and make this test vacuous
     monkeypatch.setenv("DLLAMA_TPU_QUANT_KERNEL", "xla")
     monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "exact")
-    exact, _ = jax.jit(forward, static_argnums=1)(
+    exact, _ = jax.jit(lambda p, c, t, s, k: forward(p, c, t, s, k),
+                       static_argnums=1)(
         params, cfg, tokens, jnp.int32(0), KVCache.create(cfg))
     monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "fast")
-    fast, _ = jax.jit(forward, static_argnums=1)(
+    fast, _ = jax.jit(lambda p, c, t, s, k: forward(p, c, t, s, k),
+                      static_argnums=1)(
         params, cfg, tokens, jnp.int32(0), KVCache.create(cfg))
 
     e = np.asarray(exact, np.float32)
     f = np.asarray(fast, np.float32)
+    assert not np.array_equal(e, f)  # the mode switch actually engaged
     rms = float(np.sqrt(np.mean(e ** 2)))
     drift = float(np.abs(f - e).max()) / rms
     assert drift < 5e-2, drift
