@@ -17,10 +17,12 @@ identical to summing ``fake_quant_q80`` partials.)
 Byte math vs XLA's ring all-reduce (not the reference's all-gather+merge):
 a ring all-reduce moves ``2(n-1)/n × 4`` B/value per device; the quantized
 all-gather moves ``(n-1)/n × n × 1.0625`` B/value — a ``8/(1.0625·n)``×
-win: ~3.8× at n=2, ~1.9× at n=4, break-even near n=8. Past that a
-quantized ring reduce-scatter (requantize per hop, EQuARX-style) would be
-needed; this formulation is chosen because its numerics are exactly the
-reference's (one quantization per partial — goldens transfer).
+win: ~3.8× at n=2, ~1.9× at n=4, break-even near n=8. Below the crossover
+this all-gather formulation is used because its numerics are exactly the
+reference's (one quantization per partial — goldens transfer); past it
+``psum_q80_ring`` takes over — a quantized ring reduce-scatter +
+all-gather (EQuARX shape) holding a constant ~3.76× wire win at any n, at
+the cost of per-hop requantization error in the reduce phase.
 
 Opt-in via ``DLLAMA_TPU_WIRE=q80`` (CLI ``--wire q80``); selected at trace
 time like the quant-mode knob, and part of the multihost cluster
@@ -42,8 +44,16 @@ _BLOCK = 32  # Q80 block size (reference NnBlockQ80)
 
 # past this many participants the quantized ALL-GATHER moves more bytes
 # than the f32 ring all-reduce (crossover math in the module docstring) —
-# wire_psum falls back to full precision there
+# wire_psum switches to the quantized ring there (f32 psum only when the
+# axis can't ring-split)
 _MAX_WIRE_PARTS = 7
+
+
+def q80_dequant(codes, scales, shape):
+    """The ONE dequant convention for wire'd planes (f32 multiply of the
+    int8 codes by the f16 scales) — pairs with linear.q80_quantize_planes."""
+    return (codes.astype(jnp.float32)
+            * scales.astype(jnp.float32)).reshape(shape)
 
 
 def wire_q80() -> bool:
@@ -66,17 +76,89 @@ def psum_q80_wire(x: jax.Array, axis_name) -> jax.Array:
         # int8/f16 planes, never the f32 values
         codes = jax.lax.all_gather(codes, ax)
         scales = jax.lax.all_gather(scales, ax)
-    deq = codes.astype(jnp.float32) * scales.astype(jnp.float32)
+    parts_shape = codes.shape[:len(axes)]
+    deq = q80_dequant(codes, scales, (*parts_shape, *x.shape))
     total = jnp.sum(deq, axis=tuple(range(len(axes))))
-    return total.reshape(x.shape).astype(x.dtype)
+    return total.astype(x.dtype)
+
+
+def psum_q80_ring(x: jax.Array, axis_name, n: int) -> jax.Array:
+    """Quantized RING all-reduce for past-crossover participant counts: a
+    reduce-scatter of quantized partials followed by a quantized all-gather
+    of the reduced chunks (the EQuARX shape). Wire per device is
+    ``2(n-1)/n × 1.0625`` B/value — a constant ~3.76× less than the f32
+    ring at ANY n, unlike the all-gather formulation.
+
+    Numerics differ from the reference's one-quantization-per-partial
+    merge: each reduce-scatter hop REQUANTIZES the running partial sum, so
+    error grows ~linearly in n (the price of staying quantized on every
+    hop). The all-gather phase quantizes each reduced chunk ONCE and ships
+    the planes unchanged, so the result is bit-identical on every device
+    (replica drift would desync downstream SPMD decisions). Single mesh
+    axis only; trailing axis must split into n block-divisible chunks."""
+    *lead, d = x.shape
+    assert d % (n * _BLOCK) == 0, (d, n)
+    from ..ops.linear import q80_quantize_planes
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    idx = jax.lax.axis_index(axis_name)
+    chunks = x.astype(jnp.float32).reshape(*lead, n, d // n)
+
+    def take(i):
+        # device-dependent chunk selection: a one-hot contraction instead
+        # of a dynamic slice (plays nicer with SPMD partitioning)
+        oh = (jnp.arange(n, dtype=jnp.int32) == (i % n)).astype(jnp.float32)
+        return jnp.tensordot(chunks, oh, axes=([len(lead)], [0]))
+
+    def q_hop(v):
+        codes, scales = q80_quantize_planes(v)
+        codes = jax.lax.ppermute(codes, axis_name, perm)
+        scales = jax.lax.ppermute(scales, axis_name, perm)
+        return q80_dequant(codes, scales, v.shape)
+
+    # reduce-scatter: at hop t device i forwards its running partial and
+    # folds in its local contribution for chunk (i-1-t); after n-1 hops it
+    # holds the FULL sum of chunk (i+1) mod n
+    acc = take(idx)
+    for t in range(n - 1):
+        acc = q_hop(acc) + take(idx - 1 - t)
+    # all-gather: each reduced chunk is quantized ONCE at its owner and the
+    # PLANES ride the ring unchanged — every device reconstructs chunk c
+    # from identical bytes, so the "replicated" result is bit-identical
+    # across devices (per-hop requantization here would let replicas drift
+    # in the low bits and desync downstream SPMD decisions)
+    codes, scales = q80_quantize_planes(acc)
+
+    out_chunks = [q80_dequant(codes, scales, acc.shape)]
+    for _ in range(n - 1):
+        codes = jax.lax.ppermute(codes, axis_name, perm)
+        scales = jax.lax.ppermute(scales, axis_name, perm)
+        out_chunks.append(q80_dequant(codes, scales, acc.shape))
+    # device i holds chunk (i+1)%n reduced; after k forward hops it holds
+    # chunk (i+1-k)%n — reassemble in chunk order via one-hot placement
+    stacked = jnp.stack(out_chunks, axis=len(lead))  # [..., n(hops), c]
+    hop = jnp.arange(n, dtype=jnp.int32)
+    owner = (idx + 1 - hop) % n  # chunk id held after `hop` hops
+    place = (owner[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :])
+    ordered = jnp.tensordot(stacked, place.astype(jnp.float32),
+                            axes=([len(lead)], [0]))
+    ordered = jnp.moveaxis(ordered, -1, len(lead))
+    return ordered.reshape(x.shape).astype(x.dtype)
 
 
 def wire_psum(x: jax.Array, axis_name, n_parts: int | None = None) -> jax.Array:
-    """The dispatch point: q80 wire when enabled, the trailing axis is
-    block-divisible, and the participant count (``n_parts``, passed
-    statically by the caller from its mesh plan) is below the all-gather
-    crossover — else the ordinary full-precision psum."""
-    if (wire_q80() and x.shape[-1] % _BLOCK == 0
-            and (n_parts is None or n_parts <= _MAX_WIRE_PARTS)):
-        return psum_q80_wire(x, axis_name)
+    """The dispatch point: q80 wire when enabled and the trailing axis is
+    block-divisible. Below the all-gather crossover (``n_parts``, passed
+    statically by the caller from its mesh plan) the reference-faithful
+    all-gather merge runs; past it the quantized ring keeps the wire win
+    at a constant factor; anything else falls back to full precision."""
+    if wire_q80() and x.shape[-1] % _BLOCK == 0:
+        if n_parts is None or n_parts <= _MAX_WIRE_PARTS:
+            return psum_q80_wire(x, axis_name)
+        # the ring handles one mesh axis; unwrap the 1-tuples callers pass
+        ax = (axis_name[0] if isinstance(axis_name, tuple)
+              and len(axis_name) == 1 else axis_name)
+        if (not isinstance(ax, tuple)
+                and x.shape[-1] % (n_parts * _BLOCK) == 0):
+            return psum_q80_ring(x, ax, n_parts)
     return jax.lax.psum(x, axis_name)

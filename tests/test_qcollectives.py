@@ -156,3 +156,53 @@ def test_wire_psum_crossover_guard(monkeypatch):
     got = np.asarray(fn(jnp.asarray(parts)))
     # exact f32 sum — no quantization happened
     np.testing.assert_allclose(got, parts.sum(axis=0), rtol=1e-6)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_psum_q80_ring_close_to_f32(n):
+    """The past-crossover ring variant: per-hop requantization error grows
+    ~linearly in n but stays rounding-scale; result within n quantization
+    steps of the exact sum, and every device sees the full vector."""
+    rng = np.random.default_rng(13)
+    parts = rng.standard_normal((n, 2, n * 64)).astype(np.float32)
+    exact = parts.sum(axis=0)
+    from dllama_tpu.parallel.qcollectives import psum_q80_ring
+
+    fn = jax.jit(jax.shard_map(
+        lambda x: psum_q80_ring(x[0], "tp", n)[None], mesh=_mesh(n),
+        in_specs=P("tp"), out_specs=P("tp", None, None), check_vma=False))
+    got = np.asarray(fn(jnp.asarray(parts)))  # [n, ...]: per-device results
+    for dev in range(n):
+        assert np.abs(got[dev] - exact).max() < \
+            (2 * n) * np.abs(parts).max() / 127 + 1e-6, dev
+    # all devices agree exactly (the all-gather hops are deterministic)
+    for dev in range(1, n):
+        np.testing.assert_array_equal(got[dev], got[0])
+
+
+def test_wire_psum_routes_ring_past_crossover(monkeypatch):
+    """n_parts > crossover with a ring-splittable axis routes to the ring
+    (quantized — differs from exact), not the f32 fallback."""
+    monkeypatch.setenv("DLLAMA_TPU_WIRE", "q80")
+    rng = np.random.default_rng(14)
+    parts = rng.standard_normal((8, 1, 8 * 32)).astype(np.float32)
+    fn = jax.jit(jax.shard_map(
+        lambda x: wire_psum(x[0], "tp", n_parts=8), mesh=_mesh(8),
+        in_specs=P("tp"), out_specs=P(), check_vma=False))
+    got = np.asarray(fn(jnp.asarray(parts)))
+    exact = parts.sum(axis=0)
+    assert not np.array_equal(got, exact)  # quantized path taken
+    assert np.abs(got - exact).max() < 16 * np.abs(parts).max() / 127 + 1e-6
+
+
+def test_wire_psum_unwraps_single_axis_tuple(monkeypatch):
+    """The MoE caller passes red_axes as a 1-tuple — past the crossover it
+    must still reach the quantized ring, not silently fall back to f32."""
+    monkeypatch.setenv("DLLAMA_TPU_WIRE", "q80")
+    rng = np.random.default_rng(15)
+    parts = rng.standard_normal((8, 1, 8 * 32)).astype(np.float32)
+    fn = jax.jit(jax.shard_map(
+        lambda x: wire_psum(x[0], ("tp",), n_parts=8), mesh=_mesh(8),
+        in_specs=P("tp"), out_specs=P(), check_vma=False))
+    got = np.asarray(fn(jnp.asarray(parts)))
+    assert not np.array_equal(got, parts.sum(axis=0))  # quantized ring ran
