@@ -275,14 +275,12 @@ def _moe_ffn_sparse(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Arra
 
     from ..parallel.qcollectives import wire_psum
 
-    n_parts = 1
-    for a in red_axes:
-        n_parts *= plan._axis_size(a)
+    ax_sizes = tuple(plan._axis_size(a) for a in red_axes)
 
     def local(x_l, idx_l, w_l, we1, we2, we3):
         e_lo = (jax.lax.axis_index(ep_ax) * e_local) if ep_ax else jnp.int32(0)
         y = _moe_sparse_local(cfg, x_l, idx_l, w_l, we1, we2, we3, e_lo, e_local)
-        return wire_psum(y, red_axes, n_parts) if red_axes else y
+        return wire_psum(y, red_axes, ax_sizes) if red_axes else y
 
     fn = jax.shard_map(
         local, mesh=plan.mesh,

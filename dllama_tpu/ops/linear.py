@@ -219,6 +219,15 @@ def q80_quantize_planes(x: jax.Array):
     return codes, d.astype(jnp.float16)
 
 
+def q80_dequant(codes: jax.Array, scales: jax.Array, shape) -> jax.Array:
+    """The ONE dequant convention pairing :func:`q80_quantize_planes` (f32
+    multiply of int8 codes by the f16 scales) — used by fake_quant_q80 and
+    the quantized-wire collectives alike, so their bit-identity can't
+    drift."""
+    return (codes.astype(jnp.float32)
+            * scales.astype(jnp.float32)).reshape(shape)
+
+
 def fake_quant_q80(x: jax.Array) -> jax.Array:
     """In-graph Q80 quantize→dequantize of the trailing axis.
 
@@ -238,5 +247,4 @@ def fake_quant_q80(x: jax.Array) -> jax.Array:
     jnp.round to round_nearest_even directly).
     """
     codes, d16 = q80_quantize_planes(x)
-    return (codes.astype(jnp.float32)
-            * d16.astype(jnp.float32)).reshape(x.shape).astype(x.dtype)
+    return q80_dequant(codes, d16, x.shape).astype(x.dtype)

@@ -49,13 +49,6 @@ _BLOCK = 32  # Q80 block size (reference NnBlockQ80)
 _MAX_WIRE_PARTS = 7
 
 
-def q80_dequant(codes, scales, shape):
-    """The ONE dequant convention for wire'd planes (f32 multiply of the
-    int8 codes by the f16 scales) — pairs with linear.q80_quantize_planes."""
-    return (codes.astype(jnp.float32)
-            * scales.astype(jnp.float32)).reshape(shape)
-
-
 def wire_q80() -> bool:
     return os.environ.get("DLLAMA_TPU_WIRE", "f32") == "q80"
 
@@ -67,7 +60,7 @@ def psum_q80_wire(x: jax.Array, axis_name) -> jax.Array:
     (SYNC_NODE_SLICES + OP_MERGE_ADD over Q80 pipes).
 
     ``axis_name`` may be a tuple of mesh axes (like ``jax.lax.psum``)."""
-    from ..ops.linear import q80_quantize_planes
+    from ..ops.linear import q80_dequant, q80_quantize_planes
 
     codes, scales = q80_quantize_planes(x)
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
@@ -98,7 +91,7 @@ def psum_q80_ring(x: jax.Array, axis_name, n: int) -> jax.Array:
     axis only; trailing axis must split into n block-divisible chunks."""
     *lead, d = x.shape
     assert d % (n * _BLOCK) == 0, (d, n)
-    from ..ops.linear import q80_quantize_planes
+    from ..ops.linear import q80_dequant, q80_quantize_planes
 
     perm = [(i, (i + 1) % n) for i in range(n)]
     idx = jax.lax.axis_index(axis_name)
@@ -146,19 +139,38 @@ def psum_q80_ring(x: jax.Array, axis_name, n: int) -> jax.Array:
     return ordered.reshape(x.shape).astype(x.dtype)
 
 
-def wire_psum(x: jax.Array, axis_name, n_parts: int | None = None) -> jax.Array:
+def wire_psum(x: jax.Array, axis_name,
+              n_parts: int | tuple[int, ...] | None = None) -> jax.Array:
     """The dispatch point: q80 wire when enabled and the trailing axis is
-    block-divisible. Below the all-gather crossover (``n_parts``, passed
-    statically by the caller from its mesh plan) the reference-faithful
-    all-gather merge runs; past it the quantized ring keeps the wire win
-    at a constant factor; anything else falls back to full precision."""
-    if wire_q80() and x.shape[-1] % _BLOCK == 0:
-        if n_parts is None or n_parts <= _MAX_WIRE_PARTS:
-            return psum_q80_wire(x, axis_name)
-        # the ring handles one mesh axis; unwrap the 1-tuples callers pass
-        ax = (axis_name[0] if isinstance(axis_name, tuple)
-              and len(axis_name) == 1 else axis_name)
-        if (not isinstance(ax, tuple)
-                and x.shape[-1] % (n_parts * _BLOCK) == 0):
-            return psum_q80_ring(x, ax, n_parts)
+    block-divisible. Below the all-gather crossover (``n_parts``: the
+    participant count, or per-axis sizes when ``axis_name`` is a tuple —
+    static, from the caller's mesh plan) the reference-faithful all-gather
+    merge runs; past it the quantized ring keeps the wire win at a
+    constant factor. A multi-axis reduction past the crossover decomposes
+    into sequential per-axis quantized reductions (requantizing between
+    stages) rather than silently paying f32 wire — the large-mesh MoE
+    regime is exactly where the wire matters."""
+    if not (wire_q80() and x.shape[-1] % _BLOCK == 0):
+        return jax.lax.psum(x, axis_name)
+    sizes = n_parts if isinstance(n_parts, tuple) else None
+    total = 1
+    for v in (sizes if sizes is not None
+              else ((n_parts,) if n_parts else ())):
+        total *= v
+    if n_parts is None or total <= _MAX_WIRE_PARTS:
+        return psum_q80_wire(x, axis_name)
+    if isinstance(axis_name, tuple):
+        if len(axis_name) == 1:
+            axis_name = axis_name[0]
+            sizes = None
+        elif sizes is not None and len(sizes) == len(axis_name):
+            # sequential per-axis reduction: each stage picks its own
+            # formulation; total wire ~ sum of per-axis costs
+            for ax, n_ax in zip(axis_name, sizes):
+                x = wire_psum(x, ax, n_ax)
+            return x
+        else:
+            return jax.lax.psum(x, axis_name)
+    if x.shape[-1] % (total * _BLOCK) == 0:
+        return psum_q80_ring(x, axis_name, total)
     return jax.lax.psum(x, axis_name)

@@ -206,3 +206,25 @@ def test_wire_psum_unwraps_single_axis_tuple(monkeypatch):
         in_specs=P("tp"), out_specs=P(), check_vma=False))
     got = np.asarray(fn(jnp.asarray(parts)))
     assert not np.array_equal(got, parts.sum(axis=0))  # quantized ring ran
+
+
+def test_wire_psum_multi_axis_past_crossover_decomposes(monkeypatch):
+    """A 2-axis reduction whose PRODUCT exceeds the crossover (4x2=8) must
+    decompose into sequential per-axis quantized reductions, not silently
+    pay f32 wire (the large-mesh MoE ep x hidden regime)."""
+    from jax.sharding import Mesh as _Mesh
+
+    monkeypatch.setenv("DLLAMA_TPU_WIRE", "q80")
+    mesh = _Mesh(np.array(jax.devices()).reshape(4, 2), ("a", "b"))
+    rng = np.random.default_rng(16)
+    parts = rng.standard_normal((4, 2, 1, 64)).astype(np.float32)
+
+    fn = jax.jit(jax.shard_map(
+        lambda x: wire_psum(x[0, 0], ("a", "b"), (4, 2)), mesh=mesh,
+        in_specs=P("a", "b"), out_specs=P(), check_vma=False))
+    got = np.asarray(fn(jnp.asarray(parts)))
+    exact = parts.sum(axis=(0, 1))
+    assert not np.array_equal(got, exact)  # quantized stages ran
+    # two-stage quantization error: bounded by a few rounding steps of the
+    # partial magnitudes
+    assert np.abs(got - exact).max() < 12 * np.abs(parts).max() / 127 + 1e-6

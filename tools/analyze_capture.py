@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Summarize a chip_watch capture (bench_results/capture_*/) into the
+comparison table the round changelog needs: measured decode/prefill vs the
+bench's own roofline and the BASELINE north star, per preset and per
+perf-matrix combo.
+
+Usage: python tools/analyze_capture.py [capture_dir]
+       (default: newest bench_results/capture_*)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NORTH_STAR = 1000.0  # tok/s, 8B Q40 — BASELINE.json (v5e-8 aggregate)
+
+
+def _load_bench(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            for line in f.read().splitlines()[::-1]:
+                if line.startswith("{"):
+                    try:
+                        return json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # mid-write/truncated line: keep scanning
+    except OSError:
+        return None
+    return None
+
+
+def _matrix_rows(path: str) -> dict:
+    rows: dict = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                if not line.startswith("{"):
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "matrix" in obj:
+                    return obj["matrix"]
+                for k, v in obj.items():
+                    if isinstance(v, dict):
+                        rows[k] = v
+    except OSError:
+        pass
+    return rows
+
+
+def main() -> None:
+    if len(sys.argv) > 1:
+        cdir = sys.argv[1]
+    else:
+        caps = sorted(glob.glob(os.path.join(REPO, "bench_results",
+                                             "capture_*")))
+        if not caps:
+            print("no capture yet (bench_results/capture_*) — chip never "
+                  "answered; see bench_results/probe_log.jsonl")
+            return
+        cdir = caps[-1]
+    print(f"capture: {cdir}\n")
+
+    bench = _load_bench(os.path.join(cdir, "BENCH_live.json"))
+    if bench:
+        print(f"headline: {bench.get('metric')} = {bench.get('value')} "
+              f"{bench.get('unit')}  (vs north star {NORTH_STAR:.0f}: "
+              f"{100 * float(bench.get('value') or 0) / NORTH_STAR:.1f}%)")
+        roof = bench.get("roofline_decode_tok_per_s")
+        if roof:
+            print(f"roofline (1-chip HBM): {roof} tok/s -> measured/roofline "
+                  f"= {100 * float(bench.get('value') or 0) / roof:.1f}%")
+        print(f"prefill MFU: {bench.get('prefill_mfu')}  "
+              f"HBM util (decode): {bench.get('hbm_util_decode')}")
+        for name, st in (bench.get("stages") or {}).items():
+            keys = ("quant_mode", "decode_tok_per_s", "prefill_tok_per_s",
+                    "sampled_decode_tok_per_s", "chunked_decode_tok_per_s",
+                    "verify_k4_over_decode", "hbm_need_gb", "phase", "error")
+            cells = "  ".join(f"{k}={st[k]}" for k in keys if k in st)
+            print(f"  stage {name}: {cells}")
+    else:
+        print("no BENCH_live.json in capture")
+
+    for preset in ("1b", "8b"):
+        rows = _matrix_rows(os.path.join(cdir, f"matrix_{preset}.log"))
+        if not rows:
+            continue
+        print(f"\nperf matrix ({preset}):")
+        print(f"  {'combo':14s} {'decode':>10s} {'prefill':>10s}")
+        for label, res in rows.items():
+            print(f"  {label:14s} {str(res.get('decode_tok_per_s', '-')):>10s}"
+                  f" {str(res.get('prefill_tok_per_s', '-')):>10s}"
+                  + (f"   ({res['error'][:40]})" if res.get("error") else ""))
+
+    tpu_log = os.path.join(cdir, "pytest_tpu.log")
+    if os.path.exists(tpu_log):
+        with open(tpu_log) as f:
+            tail = f.read().splitlines()[-3:]
+        print("\ntpu tier: " + " / ".join(tail))
+
+
+if __name__ == "__main__":
+    main()
