@@ -64,7 +64,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "ships int8 codes + f16 block scales (~1/4 of f32 "
                         "bytes) and dequant-sums locally — the reference's "
                         "quantized sync pipes (llm.cpp:167, report fig. 6) "
-                        "as an XLA collective; for DCN-bound multihost")
+                        "as an XLA collective; for DCN-bound multihost. "
+                        "Don't combine with --buffer-float-type q80: the "
+                        "cast-site emulation plus the wire would quantize "
+                        "the same partials twice (the reference does it "
+                        "once)")
     p.add_argument("--quant-mode", choices=["auto", "exact", "fast"],
                    default="auto",
                    help="quantized-matmul numerics (ops/linear.py): exact = "
