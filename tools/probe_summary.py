@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Summarize the chip watcher's probe log (bench_results/probe_log.jsonl)
+into the one-paragraph evidence the round changelog needs when the chip
+never answered: probe cadence, window covered, healthy count."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "bench_results", "probe_log.jsonl")
+    probes = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    probes.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        print(f"no probe log at {path}")
+        return
+    if not probes:
+        print("probe log empty")
+        return
+    healthy = [p for p in probes if p.get("healthy")]
+    print(f"probes: {len(probes)} from {probes[0]['ts']} to "
+          f"{probes[-1]['ts']}")
+    print(f"healthy: {len(healthy)}"
+          + (f" (first {healthy[0]['ts']})" if healthy else
+             " — chip wedged for the entire window (every probe's "
+             "jax.devices() timed out at 30 s)"))
+    if healthy:
+        for p in healthy[:5]:
+            print(f"  {p['ts']}  latency {p.get('latency_s')}s")
+
+
+if __name__ == "__main__":
+    main()
