@@ -50,10 +50,13 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, *rest,
     # query row r sits at absolute position q_pos0 + r // kv_mul; cache slot c
     # of this call covers absolute position kv_pos0 + c (kv_pos0 != 0 when the
     # caller holds a mid-sequence block, e.g. a ring-attention KV shard).
-    # pos_ref is blocked per batch row, so ragged batches (each sequence at
-    # its own depth — batched serving) read their own q_pos0.
-    q_pos0 = pos_ref[0, 0]
-    kv_pos0 = pos_ref[0, 1]
+    # The whole [B, 2] table rides in SMEM (Mosaic rejects a (1, 2) block of a
+    # (B, 2) array for B not in {1, 8k}); each instance reads its batch row by
+    # program id, so ragged batches (each sequence at its own depth — batched
+    # serving) still get their own q_pos0.
+    b_idx = pl.program_id(0)
+    q_pos0 = pos_ref[b_idx, 0]
+    kv_pos0 = pos_ref[b_idx, 1]
 
     @pl.when(s_idx == 0)
     def _():
@@ -150,7 +153,7 @@ def _call(q_g: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         kernel,
         grid=(B, n_kv, S // bs),
         in_specs=[
-            pl.BlockSpec((1, 2), lambda b, h, s: (b, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((B, 2), lambda b, h, s: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, TQ, D), lambda b, h, s: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, bs, D), lambda b, h, s: (b, h, s, 0),
