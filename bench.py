@@ -220,15 +220,20 @@ def device_random_params(cfg):
     import jax.numpy as jnp
 
     from dllama_tpu.models.llama import LayerParams, Params
-    from dllama_tpu.ops.linear import QuantizedWeight
+    from dllama_tpu.ops.linear import QuantizedWeight, fast_numerics_resolved
+    from dllama_tpu.runtime.weights import dense_logits_wanted
 
     key = iter(jax.random.split(jax.random.PRNGKey(0), 32))
     _codes = _codes_kernel()
+    # mirror the production load config (runtime.weights._StreamingLoader):
+    # fast numerics store bf16 scales and a resident dense-bf16 logits head
+    fast = fast_numerics_resolved(cfg.compute_dtype)
+    scale_dtype = jnp.bfloat16 if fast else jnp.float32
 
     def qw(out, in_, stacked=True):
         shape_s = (cfg.n_layers, in_ // 32, out) if stacked else (in_ // 32, out)
         shape_c = (cfg.n_layers, in_, out) if stacked else (in_, out)
-        scales = jax.random.uniform(next(key), shape_s, jnp.float32,
+        scales = jax.random.uniform(next(key), shape_s, scale_dtype,
                                     minval=0.001, maxval=0.011)
         codes = jax.block_until_ready(_codes(next(key), shape_c))
         return QuantizedWeight(scales=scales, codes=codes)
@@ -244,8 +249,14 @@ def device_random_params(cfg):
     )
     emb = (jax.random.uniform(next(key), (cfg.vocab_size, cfg.dim),
                               jnp.bfloat16, minval=-0.02, maxval=0.02))
+    if dense_logits_wanted(fast):
+        # dense head in the reference's [out, in] orientation (ops.linear)
+        logits = jax.random.uniform(next(key), (cfg.vocab_size, cfg.dim),
+                                    jnp.bfloat16, minval=-0.02, maxval=0.02)
+    else:
+        logits = qw(cfg.vocab_size, cfg.dim, stacked=False)
     return Params(embedding=emb, layers=layers, final_norm=ones(cfg.dim),
-                  logits=qw(cfg.vocab_size, cfg.dim, stacked=False))
+                  logits=logits)
 
 
 # ---------------------------------------------------------------------------
@@ -381,6 +392,14 @@ def _net(dt: float, rtt: float) -> float | None:
     return n if n > rtt else None
 
 
+# KV rows the post-prefill stages write (throwaways + decode + sampled +
+# chunked + verify); prefill's position cycling stays below seq_len minus
+# this so no stage writes past the cache. Stages that would still overrun
+# (short-seq presets) are skipped with a row-budget check instead of
+# silently clamping their writes onto stale tail rows.
+_DECODE_REGION = 352
+
+
 def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
                  prefill_len: int = 256, batch: int = 1,
                  out: dict | None = None) -> dict:
@@ -440,29 +459,36 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     from dllama_tpu.runtime.engine import PREFILL_BUCKETS
 
     out["phase"] = "prefill_compile"
-    # seq_len/2 cap keeps room for at least one measured ADVANCING chunk
-    # after the compile chunk on small presets (tiny: 256-seq -> 128-chunk)
-    chunk = min(prefill_len, PREFILL_BUCKETS[0], cfg.seq_len // 2)
+    # seq_len/4 cap keeps room for advancing measured chunks AND a decode
+    # region after them on small presets (tiny: 256-seq -> 64-chunk)
+    chunk = min(prefill_len, PREFILL_BUCKETS[0], cfg.seq_len // 4)
     prompt = jnp.ones((batch, chunk), dtype=jnp.int32)
     logits, kv = step(params, cfg, prompt, jnp.int32(0), kv)  # compile
     sync(logits)  # also warms the sync path for this shape
     if time.monotonic() > deadline:
         raise TimeoutError("deadline after prefill compile")
-    # measured dispatches advance positions like a real prefill (pos-0
+    # Measured dispatches advance positions like a real prefill (pos-0
     # repeats would let the flash kernel's causal block-skip drop the
     # attention over earlier chunks, inflating tok/s for multi-chunk
-    # prompts); chunks are capped to the rows seq_len actually has
-    n_chunks = max(1, min(prefill_len // chunk,
-                          cfg.seq_len // chunk - 1))
+    # prompts). Enough dispatches ride one fetch to clear the RTT floor,
+    # cycling through the positions the cache has; rows past
+    # chunk*(cyc+1) stay free for the decode stages below.
+    avail = cfg.seq_len // chunk - 1
+    cyc = max(1, min(avail - 1, (cfg.seq_len - _DECODE_REGION) // chunk - 1))
+    n_meas = 32
     out["phase"] = "prefill_measure"
+    # one throwaway dispatch: the first dispatch after a compile absorbs
+    # ~2 s of backlog on the tunnel even after a forced fetch (hw_probe)
+    logits, kv = step(params, cfg, prompt, jnp.int32(chunk), kv)
+    sync(logits)
     t0 = time.perf_counter()
-    pos = chunk
-    for i in range(n_chunks):
-        logits, kv = step(params, cfg, prompt, jnp.int32(pos), kv)
-        pos += chunk
+    for i in range(n_meas):
+        logits, kv = step(params, cfg, prompt,
+                          jnp.int32(chunk * (1 + i % cyc)), kv)
     sync(logits)
     dt = _net(time.perf_counter() - t0, rtt)
-    out["prefill_tok_per_s"] = round(batch * n_chunks * chunk / dt, 2) if dt else None
+    out["prefill_tok_per_s"] = round(batch * n_meas * chunk / dt, 2) if dt else None
+    pos = chunk * (cyc + 1)
 
     # decode (fused greedy step; token never leaves the device)
     out["phase"] = "decode_compile"
@@ -472,6 +498,9 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     if time.monotonic() > deadline:
         raise TimeoutError("deadline after decode compile")
     out["phase"] = "decode_measure"
+    pos += 1
+    token, kv = greedy(params, cfg, token[:, None], jnp.int32(pos), kv)
+    sync(token)  # throwaway: first-dispatch backlog (see prefill note)
     pos += 1
     t0 = time.perf_counter()
     for i in range(decode_steps):
@@ -483,7 +512,8 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
 
     # fused sampled decode (temperature/top-p on device, ops.sampling): the
     # serving path at temperature>0 — same dispatch budget as greedy
-    if batch == 1 and time.monotonic() < deadline:
+    if (batch == 1 and time.monotonic() < deadline
+            and pos + 2 + max(8, decode_steps // 2) <= cfg.seq_len):
         from dllama_tpu.models.llama import sampled_step
 
         out["phase"] = "sampled_decode"
@@ -495,6 +525,10 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
         sync(token)
         if time.monotonic() > deadline:
             return out  # keep the measured prefill/decode numbers
+        pos += 1
+        token, kv = sampled(params, cfg, token[:, None], jnp.int32(pos), kv,
+                            jnp.float32(0.8), jnp.float32(0.9), jnp.float32(0.5))
+        sync(token)  # throwaway
         pos += 1
         t0 = time.perf_counter()
         for i in range(n):
@@ -508,7 +542,8 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
 
     # multi-step fused decode (decode_chunk): K tokens per dispatch — the
     # dispatch-overhead-free decode rate (engine --decode-chunk)
-    if batch == 1 and time.monotonic() < deadline:
+    if (batch == 1 and time.monotonic() < deadline
+            and pos + 32 * (2 + max(1, decode_steps // 32)) <= cfg.seq_len):
         from dllama_tpu.models.llama import greedy_steps
 
         out["phase"] = "chunked_decode"
@@ -519,6 +554,9 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
         sync(toks)
         if time.monotonic() > deadline:
             return out
+        pos += K
+        toks, kv = gsteps(params, cfg, toks[:, -1], jnp.int32(pos), kv, K)
+        sync(toks)  # throwaway
         pos += K
         rounds = max(1, decode_steps // K)
         t0 = time.perf_counter()
@@ -532,7 +570,8 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     # speculative verify cost: ms for a K=4 verify dispatch vs a plain decode
     # step. On an HBM-bound chip the ratio should approach 1.0 — that ratio
     # times the workload's acceptance rate is the --spec-lookup speedup.
-    if batch == 1 and time.monotonic() < deadline:
+    if (batch == 1 and time.monotonic() < deadline
+            and pos + 5 * 19 <= cfg.seq_len):
         from dllama_tpu.models.llama import verify_step
 
         out["phase"] = "spec_verify"
@@ -540,6 +579,9 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
         vt = jnp.ones((1, 5), jnp.int32)
         _, preds0, kv = ver(params, cfg, vt, jnp.int32(pos), kv)  # compile
         sync(preds0)
+        _, preds0, kv = ver(params, cfg, vt, jnp.int32(pos + 5), kv)
+        sync(preds0)  # throwaway
+        pos += 5
         if time.monotonic() < deadline:
             n = 16
             t0 = time.perf_counter()

@@ -100,12 +100,18 @@ def _fast_mode(x: jax.Array) -> bool:
     accepted bf16 rounding at every op boundary, so it gets the fast kernel;
     f32 graphs keep exact.
     """
+    return fast_numerics_resolved(
+        "bfloat16" if x.dtype == jnp.bfloat16 else "float32")
+
+
+def fast_numerics_resolved(compute_dtype: str) -> bool:
+    """The load-time fast/exact resolution (same rule as _fast_mode, keyed
+    on the config's compute dtype instead of a live activation): decides
+    stored scale dtype and the dense-logits default in runtime.weights."""
     mode = os.environ.get("DLLAMA_TPU_QUANT_MODE", "auto")
-    if mode == "fast":
-        return True
-    if mode == "exact":
-        return False
-    return x.dtype == jnp.bfloat16
+    if mode in ("fast", "exact"):
+        return mode == "fast"
+    return compute_dtype == "bfloat16"
 
 
 def quant_mode_label(activations_bf16: bool) -> str:
@@ -120,7 +126,7 @@ def quant_mode_label(activations_bf16: bool) -> str:
     return resolved if mode != "auto" else f"auto({resolved})"
 
 
-def _pallas_wanted(x: jax.Array, w: QuantizedWeight) -> bool:
+def _pallas_wanted(x: jax.Array, w: QuantizedWeight, fast: bool) -> bool:
     mode = _kernel_mode()
     if mode == "xla":
         return False
@@ -129,13 +135,18 @@ def _pallas_wanted(x: jax.Array, w: QuantizedWeight) -> bool:
     ok = supports(tuple(x.shape), w)
     if mode == "pallas":
         return ok
-    # auto: TPU only (the kernel uses pltpu memory spaces; CPU interpret is
-    # slow and GPU can't lower it). Under a mesh plan the sharded entry in
-    # linear() handles dispatch; this plain path must stay out of
-    # GSPMD-partitioned graphs (the auto-sharder can't split a pallas_call).
+    # auto: Pallas only for EXACT mode on TPU (its HIGHEST-precision dots
+    # match the host oracle; CPU interpret is slow and GPU can't lower it).
+    # Fast mode always takes the XLA fused-dequant path: on the real chip it
+    # streams codes at 450-750 GB/s vs the kernel's ~130 GB/s
+    # (tools/gemv_sweep.py, 2026-07-31 capture) — XLA fuses convert+scale
+    # into the matmul's HBM loads, which a custom-call operand cannot.
+    # Under a mesh plan the sharded entry in linear() handles dispatch; this
+    # plain path must stay out of GSPMD-partitioned graphs (the auto-sharder
+    # can't split a pallas_call).
     from ..parallel.api import current_plan
 
-    return ok and _on_tpu() and current_plan() is None
+    return ok and not fast and _on_tpu() and current_plan() is None
 
 
 def _pallas_sharded(x: jax.Array, w: QuantizedWeight, out_axis: str | None,
@@ -145,8 +156,8 @@ def _pallas_sharded(x: jax.Array, w: QuantizedWeight, out_axis: str | None,
     mode = _kernel_mode()
     if mode == "xla":
         return None
-    if mode != "pallas" and not _on_tpu():
-        return None
+    if mode != "pallas" and (fast or not _on_tpu()):
+        return None  # fast mode: XLA fused dequant wins (see _pallas_wanted)
     if x.ndim != 3 or w.codes.ndim != 2:
         return None  # stacked (scan-external) or 2-D activations: XLA path
     from ..parallel.api import current_plan
@@ -180,7 +191,7 @@ def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
             y = _pallas_sharded(x, w, out_axis, in_axis, fast)
             if y is not None:
                 return y.astype(x.dtype)
-        elif _pallas_wanted(x, w):
+        elif _pallas_wanted(x, w, fast):
             from .quant_matmul import quant_matmul
 
             return quant_matmul(x, w, fast=fast)

@@ -90,6 +90,11 @@ def _kernel(x_ref, codes_ref, scales_ref, expand_ref, out_ref, *, fast: bool):
         out_ref[:] += partial
 
 
+# default tile candidates, largest first (gemv_sweep picks these)
+BN_CANDIDATES = (512, 256, 128)
+BK_CANDIDATES = (512, 256, 128)
+
+
 def _pick_block(dim: int, candidates: tuple[int, ...], min_align: int) -> int | None:
     """A 128-aligned block dividing ``dim``, or the whole dim (Mosaic allows a
     block equal to the array extent) when it at least meets ``min_align``."""
@@ -111,14 +116,16 @@ def _expansion_matrix(bk: int) -> np.ndarray:
                    np.ones((Q40_BLOCK_SIZE, 1), np.float32))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "fast"))
+@functools.partial(jax.jit, static_argnames=("interpret", "fast", "bn", "bk"))
 def quant_matmul(x: jax.Array, w: QuantizedWeight, *, interpret: bool = False,
-                 fast: bool = False) -> jax.Array:
+                 fast: bool = False, bn: int | None = None,
+                 bk: int | None = None) -> jax.Array:
     """``y[..., N] = x[..., K] @ dequant(w)`` via the Pallas kernel.
 
     ``fast=False``: ``x`` is cast to f32 for the dequantized dot (parity with
     the XLA exact path). ``fast=True``: bf16 operands, one MXU pass, f32
-    accumulation (see _kernel). Leading dims flatten into M.
+    accumulation (see _kernel). Leading dims flatten into M.  ``bn``/``bk``
+    override the tile picks (tools/gemv_sweep.py measures the candidates).
     """
     *lead, K = x.shape
     N = w.out_features
@@ -126,10 +133,14 @@ def quant_matmul(x: jax.Array, w: QuantizedWeight, *, interpret: bool = False,
     for d in lead:
         M *= d
 
-    bn = _pick_block(N, (512, 256, 128), min_align=8)
-    bk = _pick_block(K, (512, 256, 128), min_align=Q40_BLOCK_SIZE)
+    bn = bn or _pick_block(N, BN_CANDIDATES, min_align=8)
+    bk = bk or _pick_block(K, BK_CANDIDATES, min_align=Q40_BLOCK_SIZE)
     if bn is None or bk is None:
         raise ValueError(f"shapes N={N}, K={K} do not fit the tile grid")
+    if N % bn or K % bk or bk % Q40_BLOCK_SIZE:
+        # overrides included: a non-dividing block would truncate the grid
+        # and return uninitialized output columns
+        raise ValueError(f"blocks bn={bn}, bk={bk} do not tile N={N}, K={K}")
 
     xf = x.reshape(M, K).astype(jnp.bfloat16 if fast else jnp.float32)
     grid = (N // bn, K // bk)
@@ -256,5 +267,5 @@ def supports(x_shape: tuple[int, ...], w: QuantizedWeight) -> bool:
     return (w.codes.ndim == 2
             and w.in_features == K
             and M <= MAX_M
-            and _pick_block(w.out_features, (512, 256, 128), min_align=8) is not None
-            and _pick_block(K, (512, 256, 128), min_align=Q40_BLOCK_SIZE) is not None)
+            and _pick_block(w.out_features, BN_CANDIDATES, min_align=8) is not None
+            and _pick_block(K, BK_CANDIDATES, min_align=Q40_BLOCK_SIZE) is not None)

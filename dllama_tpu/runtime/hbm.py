@@ -17,6 +17,8 @@ from __future__ import annotations
 import os
 
 # dense-equivalent bytes per weight for each on-device representation
+# (quantized planes carry f32 block scales in exact configs, bf16 in fast
+# ones — the f32 value is kept as the conservative estimate either way)
 _WEIGHT_BYTES = {
     "q40": 1.125,   # int8 codes (1 B) + f32 block scales (4/32 B)
     "q80": 1.125,
@@ -70,6 +72,16 @@ def estimate_device_bytes(cfg, *, weight_repr: str, kv_dtype_bytes: int,
     host DRAM, leaving only embeddings + head + a working set on device."""
     wbytes = _WEIGHT_BYTES[weight_repr]
     emb_bytes = cfg.vocab_size * cfg.dim * 4  # compute-dtype upper bound
+    if wbytes < 2.0:
+        # fast configs load the logits head as resident dense bf16
+        # (runtime.weights.dense_logits_wanted); charge the delta so the
+        # budget check sees the real footprint
+        from ..ops.linear import fast_numerics_resolved
+        from .weights import dense_logits_wanted
+
+        if dense_logits_wanted(
+                fast_numerics_resolved(getattr(cfg, "compute_dtype", ""))):
+            emb_bytes += int(cfg.vocab_size * cfg.dim * (2.0 - wbytes))
     if offload:
         # resident: embedding + head + ~2 layers of streamed working set
         per_layer = matmul_weight_count(cfg) // max(1, cfg.n_layers)

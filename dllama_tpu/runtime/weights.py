@@ -30,6 +30,7 @@ of these same slice readers — see PARITY.md.
 
 from __future__ import annotations
 
+import os
 from typing import TYPE_CHECKING, Callable
 
 import jax
@@ -55,6 +56,23 @@ def _bounds(sl: slice, dim: int) -> tuple[int, int]:
 def _layer_range(sl: slice, n_layers: int) -> range:
     lo, hi = _bounds(sl, n_layers)
     return range(lo, hi)
+
+
+def dense_logits_wanted(fast_numerics: bool) -> bool:
+    """Whether the logits head loads as a resident dense-bf16 array.
+
+    ``DLLAMA_TPU_DENSE_LOGITS``: ``on`` / ``off`` force it; ``auto``
+    (default) follows the fast/exact numerics split — fast configs trade
+    ~(vocab*dim) extra HBM bytes for a ~2.5x faster logits GEMV (XLA
+    materializes the dequantized head every step otherwise; see
+    tools/gemv_sweep.py 2026-07-31). Exact mode keeps the quantized head —
+    its goldens are bit-tied to the f32 dequant."""
+    knob = os.environ.get("DLLAMA_TPU_DENSE_LOGITS", "auto")
+    if knob == "on":
+        return True
+    if knob == "off":
+        return False
+    return fast_numerics
 
 
 def _make(shape: tuple[int, ...], dtype, sharding, cb: Callable) -> jax.Array:
@@ -90,6 +108,17 @@ class _StreamingLoader:
         self.dense_dtype = jnp.bfloat16 if weight_mode == "bf16" else jnp.float32
         self.weight_mode = weight_mode
         self._host_scope = False
+        # fast-mode numerics already round dequant to bf16, so storing the
+        # scales in bf16 halves their HBM footprint AND removes a per-step
+        # f32->bf16 conversion pass over every scale plane (the round-4
+        # decode profile showed ~1.2 ms/step of f32 scale slicing+convert on
+        # the 1b preset). Exact mode keeps f32 scales — the host-oracle bit
+        # goldens depend on them. Resolved ONCE here: flipping
+        # DLLAMA_TPU_QUANT_MODE after load leaves the stored dtype behind.
+        from ..ops.linear import fast_numerics_resolved
+
+        self.fast_numerics = fast_numerics_resolved(cfg.compute_dtype)
+        self.scale_dtype = jnp.bfloat16 if self.fast_numerics else jnp.float32
 
     def _sharding(self, shape, *axes):
         """Build the target sharding; inside a host-placed scope (the layer
@@ -102,12 +131,19 @@ class _StreamingLoader:
     # -- matmul weights -----------------------------------------------------
 
     def matmul(self, name: str, out_dim: int, in_dim: int, *, stacked: bool,
-               out_axis: str | None, in_axis: str | None):
-        """One (possibly layer-stacked) matmul weight, quantized or dense."""
+               out_axis: str | None, in_axis: str | None,
+               force_dense: object = None):
+        """One (possibly layer-stacked) matmul weight, quantized or dense.
+
+        ``force_dense`` (a dtype) loads a quantized disk tensor as a resident
+        dense array instead — used for the logits head in fast configs, where
+        XLA materializes the huge [dim, vocab] dequant every step anyway
+        (166 GB/s effective) while a resident bf16 head streams at
+        ~750 GB/s (tools/gemv_sweep.py)."""
         L = self.h.n_layers
         key = (lambda l: f"{name}.{l}") if stacked else (lambda _l: name)
 
-        if self.quantized:
+        if self.quantized and force_dense is None:
             lead = ("layers",) if stacked else ()  # pipeline axis when present
             cshape = ((L, in_dim, out_dim) if stacked else (in_dim, out_dim))
             sshape = ((L, in_dim // QUANT_BLOCK_SIZE, out_dim) if stacked
@@ -150,7 +186,7 @@ class _StreamingLoader:
                 return out
 
             return QuantizedWeight(
-                scales=_make(sshape, jnp.float32, s_sh,
+                scales=_make(sshape, self.scale_dtype, s_sh,
                              lambda idx: read(idx, True)),
                 codes=_make(cshape, jnp.int8, c_sh,
                             lambda idx: read(idx, False)),
@@ -174,7 +210,7 @@ class _StreamingLoader:
                      for l in layers]
             return np.stack(parts) if stacked else parts[0]
 
-        return _make(shape, self.dense_dtype, sh, read_dense)
+        return _make(shape, force_dense or self.dense_dtype, sh, read_dense)
 
     # -- small / dense tensors ---------------------------------------------
 
@@ -283,6 +319,9 @@ def load_params(mf: ModelFile, cfg: "ModelConfig", weight_mode: str = "auto",
         embedding=ld.f32("embedding", h.vocab_size, h.dim),
         layers=layers,
         final_norm=ld.f32("final_norm", h.dim),
-        logits=ld.matmul("final_matmul_logits", h.vocab_size, h.dim,
-                         stacked=False, out_axis="vocab", in_axis=None),
+        logits=ld.matmul(
+            "final_matmul_logits", h.vocab_size, h.dim, stacked=False,
+            out_axis="vocab", in_axis=None,
+            force_dense=(jnp.bfloat16
+                         if dense_logits_wanted(ld.fast_numerics) else None)),
     )

@@ -1,0 +1,138 @@
+"""On-chip sweep of decode-GEMV kernel variants (honest slope timing).
+
+The decode profile (tools/profile_decode.py) shows the Q40 quant matmul
+streaming codes at ~114-130 GB/s effective against an 819 GB/s chip — the
+dominant term in the 8.4x roofline gap.  This sweep times, for the hot
+decode shapes, the production Pallas kernel at several (bn, bk) block
+choices against the XLA dequant+dot fallback, a dense bf16 matmul (the
+no-quantization reference point) and a dense s8->f32 dot (streaming-rate
+ceiling for int8 codes).
+
+Timing methodology: the host->device round trip on the axon tunnel is
+~67 ms and per-dispatch host enqueue is ~1 ms, so sub-millisecond kernels
+cannot be timed by host-side rep loops at all.  Each variant instead runs
+inside ONE dispatch as a ``lax.fori_loop`` whose carry perturbs the
+activation every iteration (the weights — the bytes being measured — stay
+loop-invariant, exactly like real decode; the carry dependency stops XLA
+from hoisting the matmul).  Wall time is taken at two iteration counts and
+the per-op cost is the SLOPE, which cancels the RTT and any fixed
+dispatch/loop overhead.
+
+Usage:  python tools/gemv_sweep.py [n_lo] [n_hi]
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    n_lo = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    n_hi = int(sys.argv[2]) if len(sys.argv) > 2 else 448
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.ops import quant_matmul as qm
+    from dllama_tpu.ops.linear import QuantizedWeight, dequantize_weight
+
+    def fetch(x):
+        jax.device_get(jnp.ravel(x)[0])
+
+    key = jax.random.PRNGKey(0)
+
+    def make_w(K, N):
+        kc, ks = jax.random.split(jax.random.fold_in(key, N))
+        codes = (jax.random.bits(kc, (K, N), jnp.uint8) & jnp.uint8(0x0F)
+                 ).astype(jnp.int8) - 8
+        scales = jax.random.uniform(ks, (K // 32, N), jnp.float32,
+                                    minval=0.001, maxval=0.011)
+        return QuantizedWeight(scales=scales, codes=codes)
+
+    def bench(label, op, x, *wargs, bytes_moved: int):
+        """op(x, *wargs) -> y [1, N]; loop it on device, slope-time it."""
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def looped(n, x, *wargs):
+            def body(i, carry):
+                x, acc = carry
+                y = op(x, *wargs)
+                acc = acc + jnp.sum(y, dtype=jnp.float32)
+                # perturb the activation so no iteration is hoistable; the
+                # scale keeps values finite over hundreds of iterations
+                x = x * (1.0 + 1e-12 * acc).astype(x.dtype)
+                return x, acc
+
+            x, acc = jax.lax.fori_loop(0, n, body, (x, jnp.float32(0.0)))
+            return acc
+
+        try:
+            times = {}
+            for n in (n_lo, n_hi):
+                fetch(looped(n, x, *wargs))  # compile + warm
+                t0 = time.perf_counter()
+                fetch(looped(n, x, *wargs))
+                times[n] = time.perf_counter() - t0
+            per_op = (times[n_hi] - times[n_lo]) / (n_hi - n_lo)
+            if per_op <= 0:
+                print(f"  {label:<28} not resolvable (slope <= 0)")
+                return None
+            gbps = bytes_moved / per_op / 1e9
+            print(f"  {label:<28} {1e6 * per_op:9.1f} us  {gbps:7.1f} GB/s")
+            return per_op
+        except Exception as e:  # noqa: BLE001
+            print(f"  {label:<28} {type(e).__name__}: {str(e)[:70]}")
+            return None
+
+    for K, N in ((2048, 8192), (4096, 14336), (2048, 128256)):
+        w = make_w(K, N)
+        x = jax.random.normal(jax.random.fold_in(key, K), (1, K), jnp.bfloat16)
+        nbytes = K * N + (K // 32) * N * 4  # codes + f32 scales
+        print(f"\nGEMV [1,{K}] x [{K},{N}]  ({nbytes / 1e6:.0f} MB quant)",
+              flush=True)
+
+        for bn, bk in ((512, 512), (1024, 512), (2048, 512), (512, 1024),
+                       (1024, 1024), (2048, 1024), (1024, 2048)):
+            if N % bn or K % bk:
+                continue
+            bench(f"pallas bn={bn} bk={bk}",
+                  functools.partial(qm.quant_matmul, fast=True, bn=bn, bk=bk),
+                  x, w, bytes_moved=nbytes)
+        bench("pallas default picks",
+              functools.partial(qm.quant_matmul, fast=True), x, w,
+              bytes_moved=nbytes)
+
+        bench("xla dequant+dot (fast)",
+              lambda x, w: x @ dequantize_weight(w, dtype=jnp.bfloat16),
+              x, w, bytes_moved=nbytes)
+
+        bench("xla dequant bf16-scales",
+              lambda x, w: x @ (w.codes.astype(jnp.bfloat16)
+                                * jnp.repeat(w.scales.astype(jnp.bfloat16),
+                                             32, axis=0)),
+              x, w, bytes_moved=K * N + (K // 32) * N * 2)
+
+        c4 = w.codes.astype(jnp.int4)  # packed: 0.5 B/weight in HBM
+        s16 = w.scales.astype(jnp.bfloat16)
+        bench("xla dequant s4 codes",
+              lambda x, c, s: x @ (c.astype(jnp.bfloat16)
+                                   * jnp.repeat(s, 32, axis=0)),
+              x, c4, s16, bytes_moved=K * N // 2 + (K // 32) * N * 2)
+
+        wd = w.codes.astype(jnp.bfloat16)
+        bench("dense bf16 (2B/weight)", lambda x, w: x @ w, x, wd,
+              bytes_moved=2 * K * N)
+        bench("dense s8 dot -> f32",
+              lambda x, c: jax.lax.dot_general(
+                  x.astype(jnp.float32), c.astype(jnp.float32),
+                  dimension_numbers=(((1,), (0,)), ((), ())),
+                  preferred_element_type=jnp.float32), x, w.codes,
+              bytes_moved=K * N)
+
+
+if __name__ == "__main__":
+    main()

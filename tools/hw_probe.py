@@ -38,6 +38,7 @@ bench.py uses the same fetch-based timing for the same reason.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -122,7 +123,8 @@ def main() -> None:
         emit("mxu", tflops=round(reps * 2 * (2 * m) * m * m / dt / 1e12, 1))
 
     if "decode" in stages or "chunked" in stages:
-        sys.path.insert(0, "/root/repo")
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
         import bench as benchmod
 
         cfg = benchmod.model_cfg("1b")

@@ -1,0 +1,91 @@
+"""Profile N decode steps on the real chip and print top device ops.
+
+Answers "where do the milliseconds go" for the single-step decode program —
+the gap between measured decode (14.3 ms/step on the 1b preset, hw_probe)
+and its HBM roofline (~1.7 ms).  Usage:
+
+    python tools/profile_decode.py [1b|8b] [n_steps]
+
+Aggregates per-op device time from the xplane capture via the same
+no-tensorflow-import proto loader the Eval/Sync split uses
+(runtime/profiling._load_xplane).
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    preset = sys.argv[1] if len(sys.argv) > 1 else "1b"
+    n_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench as benchmod
+    from dllama_tpu.models.llama import greedy_step
+    from dllama_tpu.runtime import KVCache
+    from dllama_tpu.runtime.profiling import _device_lines, _load_xplane
+
+    cfg = benchmod.model_cfg(preset)
+    params = benchmod.device_random_params(cfg)
+    kv = KVCache.create(cfg, batch_size=1, dtype=jnp.bfloat16)
+    greedy = jax.jit(greedy_step, static_argnums=1, donate_argnums=(4,))
+    token = jnp.ones((1,), jnp.int32)
+    token, kv = greedy(params, cfg, token[:, None], jnp.int32(0), kv)
+    jax.device_get(token)  # compile + force execution (block_until_ready lies)
+    pos = 1
+    for i in range(4):  # warm steady state
+        token, kv = greedy(params, cfg, token[:, None], jnp.int32(pos + i), kv)
+    jax.device_get(token)
+    pos += 4
+
+    d = tempfile.mkdtemp(prefix="dllama-prof-")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(d):
+        for i in range(n_steps):
+            token, kv = greedy(params, cfg, token[:, None],
+                               jnp.int32(pos + i), kv)
+        jax.device_get(token)
+    wall = time.perf_counter() - t0
+    print(f"wall for {n_steps} traced steps: {1e3 * wall:.1f} ms "
+          f"({1e3 * wall / n_steps:.2f} ms/step incl. one fetch)")
+
+    paths = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        print("no xplane capture produced")
+        return
+    xs = _load_xplane(max(paths, key=os.path.getmtime))
+
+    per_op = collections.Counter()
+    per_op_n = collections.Counter()
+    total_ns = 0
+    lanes = 0
+    for plane, line in _device_lines(xs):
+        lanes += 1
+        names = {e.id: e.name for e in plane.event_metadata.values()} \
+            if hasattr(plane.event_metadata, "values") else {}
+        for ev in line.events:
+            name = names.get(ev.metadata_id, str(ev.metadata_id))
+            per_op[name] += ev.duration_ps // 1000  # -> ns
+            per_op_n[name] += 1
+            total_ns += ev.duration_ps // 1000
+    print(f"device lanes: {lanes}; total device time "
+          f"{total_ns / 1e6:.1f} ms over {n_steps} steps "
+          f"({total_ns / 1e6 / n_steps:.2f} ms/step)")
+    width = max((len(n) for n, _ in per_op.most_common(25)), default=10)
+    for name, ns in per_op.most_common(25):
+        print(f"{name:<{width}}  {ns / 1e6:9.3f} ms  x{per_op_n[name]:<5} "
+              f"({100.0 * ns / max(total_ns, 1):5.1f}%)")
+
+
+if __name__ == "__main__":
+    main()
