@@ -509,6 +509,7 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     dt = _net(time.perf_counter() - t0, rtt)
     out["decode_tok_per_s"] = round(batch * decode_steps / dt, 2) if dt else None
     out["decode_ms_per_step"] = round(1000.0 * dt / decode_steps, 3) if dt else None
+    pos += decode_steps  # rows the loop above wrote
 
     # fused sampled decode (temperature/top-p on device, ops.sampling): the
     # serving path at temperature>0 — same dispatch budget as greedy
@@ -519,7 +520,6 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
         out["phase"] = "sampled_decode"
         sampled = jax.jit(sampled_step, static_argnums=1, donate_argnums=(4,))
         n = max(8, decode_steps // 2)
-        pos += decode_steps
         token, kv = sampled(params, cfg, token[:, None], jnp.int32(pos), kv,
                             jnp.float32(0.8), jnp.float32(0.9), jnp.float32(0.5))
         sync(token)

@@ -29,9 +29,12 @@ from ..formats.quants import Q40_BLOCK_SIZE, Q80_BLOCK_SIZE
 class QuantizedWeight(NamedTuple):
     """Q40 weight as TPU-friendly planes, K-major.
 
-    ``scales``: float32 ``[in // 32, out]`` block scales (f16 on disk; stored
-    f32 on device because narrow f16 blocks don't lower on the TPU Mosaic
-    toolchain — costs 0.125 B/weight next to the 1 B/weight codes).
+    ``scales``: ``[in // 32, out]`` block scales (f16 on disk; never f16 on
+    device — narrow f16 blocks don't lower on the TPU Mosaic toolchain).
+    Exact configs store f32 (0.125 B/weight; the host-oracle bit goldens
+    are tied to the f32 dequant); fast configs store bf16 (0.0625 B/weight
+    — halves scale HBM traffic; runtime.weights picks at load via
+    ops.linear.fast_numerics_resolved).
     ``codes``: int8 ``[in, out]`` centered 4-bit codes in [-8, 7].
 
     Logical value: ``w[o, i] = codes[i, o] * scales[i // 32, o]``

@@ -38,11 +38,22 @@ def topp_sample(probs: jax.Array, topp: jax.Array, coin: jax.Array) -> jax.Array
     masked = jnp.where(probs >= cutoff, probs, 0.0)
     order = jnp.argsort(-masked, stable=True)
     ps = masked[order]
+    return _nucleus_pick(ps, topp, coin, jnp.count_nonzero(ps), order)
+
+
+def _nucleus_pick(ps: jax.Array, topp: jax.Array, coin: jax.Array,
+                  n_kept, order: jax.Array) -> jax.Array:
+    """The reference's truncate+renormalize+CDF walk over probabilities
+    already sorted descending (``ps``); ``order`` maps positions back to
+    token ids and ``n_kept`` is the count of nonzero survivors of the
+    cutoff pre-filter (which may exceed ``ps``'s length in the windowed
+    fast path — only ever used via min with the window bound)."""
+    n = ps.shape[0]
     csum = jnp.cumsum(ps)
-    n_kept = jnp.count_nonzero(ps).astype(jnp.int32)
     over = csum > topp
     last = jnp.where(jnp.any(over), jnp.argmax(over),
-                     jnp.maximum(n_kept - 1, 0)).astype(jnp.int32)
+                     jnp.minimum(jnp.maximum(n_kept - 1, 0), n - 1)
+                     ).astype(jnp.int32)
     cumulative = csum[last]
     r = coin * cumulative
     inner = jnp.cumsum(
@@ -59,6 +70,18 @@ def mult_sample(probs: jax.Array, coin: jax.Array) -> jax.Array:
     return jnp.where(jnp.any(hit), jnp.argmax(hit), n - 1).astype(jnp.int32)
 
 
+# top-p fast-path window: the nucleus of a typical top-p<=0.95 draw is a few
+# dozen tokens; a 256-wide lax.top_k window replaces the full-vocab stable
+# sort (the dominant cost of a fused sampled step: ~6 ms/step of a 128k-vocab
+# argsort on the 1b preset, round-4 capture). The windowed math is the exact
+# reference algorithm on the same descending prefix (lax.top_k breaks ties by
+# lower index, like the stable argsort), so any draw whose nucleus fits the
+# window is bit-identical; a batch with any row whose nucleus could overflow
+# falls back to the full sort via a batch-level cond (a per-row cond would
+# lower to select under vmap and run the full sort anyway).
+TOPP_WINDOW = 256
+
+
 def sampled_token(logits: jax.Array, temperature: jax.Array, topp: jax.Array,
                   coin: jax.Array) -> jax.Array:
     """Sample one token per row of ``logits [B, V]``.
@@ -70,19 +93,37 @@ def sampled_token(logits: jax.Array, temperature: jax.Array, topp: jax.Array,
     ``topp`` outside (0, 1) selects plain multinomial, matching the host
     oracle."""
     logits = logits.astype(jnp.float32)
-    B = logits.shape[0]
+    B, V = logits.shape
     temp = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(temperature)), (B,))
     topp_v = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(topp)), (B,))
     coin_v = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(coin)), (B,))
     safe_t = jnp.where(temp > 0.0, temp, 1.0)
     probs = jax.nn.softmax(logits / safe_t[:, None], axis=-1)
+    topp_row = (topp_v > 0.0) & (topp_v < 1.0)
 
-    def pick(row, tp, cn):
-        return jax.lax.cond(
-            (tp > 0.0) & (tp < 1.0),
-            lambda: topp_sample(row, tp, cn),
-            lambda: mult_sample(row, cn))
+    if V > TOPP_WINDOW:
+        K = TOPP_WINDOW
+        cutoff = ((1.0 - topp_v) / (V - 1))[:, None]
+        masked = jnp.where(probs >= cutoff, probs, 0.0)
+        n_kept = jnp.count_nonzero(masked, axis=-1).astype(jnp.int32)
+        vals, idxs = jax.lax.top_k(masked, K)
+        # the window covers the nucleus iff it either exhausts the kept set
+        # or its cumulative mass already crosses topp
+        window_ok = (jnp.cumsum(vals, axis=-1)[:, -1] > topp_v) | (n_kept <= K)
+        all_safe = jnp.all(window_ok | ~topp_row)
 
-    sampled = jax.vmap(pick)(probs, topp_v, coin_v)
+        def windowed():
+            return jax.vmap(_nucleus_pick)(vals, topp_v, coin_v,
+                                           jnp.minimum(n_kept, K), idxs)
+
+        def full():
+            return jax.vmap(topp_sample)(probs, topp_v, coin_v)
+
+        nucleus = jax.lax.cond(all_safe, windowed, full)
+    else:
+        nucleus = jax.vmap(topp_sample)(probs, topp_v, coin_v)
+
+    multi = jax.vmap(mult_sample)(probs, coin_v)
+    sampled = jnp.where(topp_row, nucleus, multi)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jnp.where(temp > 0.0, sampled, greedy)
