@@ -71,7 +71,9 @@ def estimate_device_bytes(cfg, *, weight_repr: str, kv_dtype_bytes: int,
     weight+KV payload (mesh sharding); ``offload`` keeps layer stacks in
     host DRAM, leaving only embeddings + head + a working set on device."""
     wbytes = _WEIGHT_BYTES[weight_repr]
-    emb_bytes = cfg.vocab_size * cfg.dim * 4  # compute-dtype upper bound
+    # embedding is stored at compute dtype (runtime.weights.load_params)
+    emb_elem = 2 if getattr(cfg, "compute_dtype", "") == "bfloat16" else 4
+    emb_bytes = cfg.vocab_size * cfg.dim * emb_elem
     if wbytes < 2.0:
         # fast configs load the logits head as resident dense bf16
         # (runtime.weights.dense_logits_wanted); charge the delta so the

@@ -226,9 +226,9 @@ class _StreamingLoader:
 
         return _make(shape, jnp.float32, sh, read)
 
-    def f32(self, name: str, *shape: int) -> jax.Array:
+    def f32(self, name: str, *shape: int, dtype=jnp.float32) -> jax.Array:
         sh = self.plan.sharding_for(tuple(shape), *([None] * len(shape)))
-        return _make(tuple(shape), jnp.float32, sh,
+        return _make(tuple(shape), dtype, sh,
                      lambda idx: self.mf.tensor_f32(name)[idx])
 
     def expert_stack(self, name: str, out_dim: int, in_dim: int,
@@ -316,7 +316,13 @@ def load_params(mf: ModelFile, cfg: "ModelConfig", weight_mode: str = "auto",
     )
     ld._host_scope = False
     return Params(
-        embedding=ld.f32("embedding", h.vocab_size, h.dim),
+        # the embedding is only ever read as
+        # ``embedding[tokens].astype(compute_dtype)`` (models.llama.forward),
+        # so storing it AT compute dtype is bit-identical (same rounding of
+        # the same values) and, for bf16 configs, halves its HBM footprint
+        # (~1 GB on the 8B shape)
+        embedding=ld.f32("embedding", h.vocab_size, h.dim,
+                         dtype=jnp.dtype(cfg.compute_dtype)),
         layers=layers,
         final_norm=ld.f32("final_norm", h.dim),
         logits=ld.matmul(

@@ -2,13 +2,15 @@
 """Kernel-choice perf sweep: one command turns a live-chip window into a
 comparison table instead of a single point.
 
-Runs ``bench.py --stage <preset>`` once per (quant kernel, attention impl)
-combo — each in its own subprocess (wedge-isolated, same as the bench) —
-and prints a JSON line per combo plus a final summary. The knobs:
+Runs ``bench.py --stage <preset>`` once per knob combo — each in its own
+subprocess (wedge-isolated, same as the bench) — and prints a JSON line
+per combo plus a final summary. The knobs:
 
   DLLAMA_TPU_QUANT_KERNEL  pallas | xla   (ops/linear.py dispatch)
   DLLAMA_BENCH_ATTN        flash  | xla   (ModelConfig.attn_impl)
   DLLAMA_BENCH_KV          bf16 | f8 | f32  (KV cache storage dtype)
+  DLLAMA_TPU_QUANT_MODE    fast | exact  (dequant numerics, ops/linear.py)
+  DLLAMA_TPU_DENSE_LOGITS  on | off      (resident bf16 head vs Q40)
 
 Usage:
   python tools/perf_matrix.py [preset] [per-stage-budget-s]
@@ -32,31 +34,34 @@ sys.path.insert(0, REPO)
 
 import bench  # noqa: E402 — the bench parent module is deliberately jax-free
 
+# the bench presets run bf16 compute, so un-pinned rows resolve to FAST
+# numerics (auto): the production config. Each other row isolates one knob
+# against it. (Round-4 finding: fast quant dispatch is always the XLA fused
+# dequant — the gemv sweep measured it 3-5x over the Pallas kernel — so the
+# old pallas-vs-xla fast rows collapsed into one "pallas" comparison row.)
 COMBOS = [
-    # (label, quant_kernel, attn_impl, kv_dtype, quant_mode)
-    ("pallas+flash", "pallas", "flash", None, None),
-    ("pallas+xla", "pallas", "xla", None, None),
-    ("xla+flash", "xla", "flash", None, None),
-    ("xla+xla", "xla", "xla", None, None),
-    ("auto", None, None, None, None),  # production dispatch (what the engine ships)
-    ("auto+f8kv", None, None, "f8", None),  # fp8 KV cache storage
-    # fast-mode quant numerics (bf16 dequant, one MXU pass — ops/linear.py
-    # _fast_mode) on both kernel choices; exact mode is the rows above
-    ("pallas+fast", "pallas", "flash", None, "fast"),
-    ("xla+fast", "xla", "flash", None, "fast"),
+    # (label, quant_kernel, attn_impl, kv_dtype, quant_mode, dense_logits)
+    ("auto", None, None, None, None, None),          # production dispatch
+    ("pallas", "pallas", "flash", None, None, None), # Pallas kernel instead
+    ("xla-attn", None, "xla", None, None, None),     # XLA oracle attention
+    ("exact", None, None, None, "exact", None),      # parity numerics cost
+    ("auto+f8kv", None, None, "f8", None, None),     # fp8 KV cache storage
+    ("q40-logits", None, None, None, None, "off"),   # quantized head instead
 ]
 
 
 def run_combo(preset: str, budget: float, quant: str | None,
               attn: str | None, kv: str | None = None,
-              qmode: str | None = None) -> dict:
+              qmode: str | None = None,
+              dense_logits: str | None = None) -> dict:
     """Set the combo's knobs in this process's env and delegate to
     bench.run_stage (subprocess isolation, live phase tracking, stderr tail,
     kill+reap — no second implementation to drift)."""
     for var, val in (("DLLAMA_TPU_QUANT_KERNEL", quant),
                      ("DLLAMA_BENCH_ATTN", attn),
                      ("DLLAMA_BENCH_KV", kv),
-                     ("DLLAMA_TPU_QUANT_MODE", qmode)):
+                     ("DLLAMA_TPU_QUANT_MODE", qmode),
+                     ("DLLAMA_TPU_DENSE_LOGITS", dense_logits)):
         if val:
             os.environ[var] = val
         else:
@@ -71,9 +76,9 @@ def main() -> None:
     preset = sys.argv[1] if len(sys.argv) > 1 else "1b"
     budget = float(sys.argv[2]) if len(sys.argv) > 2 else 420.0
     rows: dict = {}
-    for label, quant, attn, kv, qmode in COMBOS:
+    for label, quant, attn, kv, qmode, dense in COMBOS:
         t0 = time.monotonic()
-        res = run_combo(preset, budget, quant, attn, kv, qmode)
+        res = run_combo(preset, budget, quant, attn, kv, qmode, dense)
         res["combo_s"] = round(time.monotonic() - t0, 1)
         rows[label] = res
         print(json.dumps({label: res}), flush=True)
