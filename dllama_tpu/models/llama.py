@@ -25,6 +25,8 @@ from __future__ import annotations
 
 from typing import Any, NamedTuple
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -560,8 +562,14 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
                                   start_pos, positions)
         return x, (k_l, v_l)
 
-    # scan over the stacked layer axis; caches ride along as per-layer xs/ys
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params.layers, kv.k, kv.v))
+    # scan over the stacked layer axis; caches ride along as per-layer xs/ys.
+    # DLLAMA_TPU_SCAN_UNROLL (default 1) trades program size for fusion
+    # across layer boundaries — the round-4 decode profile showed ~0.9 ms of
+    # per-step loop overhead beyond the matmuls on the 1b shape. Part of the
+    # multihost cluster fingerprint (different unroll = different program).
+    unroll = int(os.environ.get("DLLAMA_TPU_SCAN_UNROLL", "1"))
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params.layers, kv.k, kv.v),
+                                     unroll=max(1, unroll))
 
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
     if cfg.sync_q80:  # final cast before the logits matmul (llm.cpp:445-486)

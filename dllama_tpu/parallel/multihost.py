@@ -268,6 +268,18 @@ class ControlCodec:
         return np.frombuffer(data, dtype=np.int32).copy()
 
 
+def _dense_logits_resolved(engine: "InferenceEngine") -> bool:
+    """The effective dense-vs-quantized logits head decision (same rule the
+    loader applied: runtime.weights.dense_logits_wanted over the resolved
+    numerics mode) — fingerprinted because the two heads compile different
+    programs."""
+    from ..ops.linear import fast_numerics_resolved
+    from ..runtime.weights import dense_logits_wanted
+
+    return dense_logits_wanted(
+        fast_numerics_resolved(str(engine.cfg.compute_dtype)))
+
+
 def validate_cluster_config(engine: "InferenceEngine") -> None:
     """Fail fast on root/worker flag mismatches.
 
@@ -306,6 +318,13 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         s32(os.environ.get("DLLAMA_TPU_QUANT_MODE", "auto")),
         # wire format changes the collective program (qcollectives.py)
         s32(os.environ.get("DLLAMA_TPU_WIRE", "f32")),
+        # layer-scan unroll factor shapes the forward program (models.llama);
+        # fingerprint the EFFECTIVE value (same max(1,..) clamp as llama.py)
+        # so e.g. unset-vs-0 doesn't reject an identical cluster
+        max(1, int(os.environ.get("DLLAMA_TPU_SCAN_UNROLL", "1"))),
+        # dense-bf16 vs quantized logits head compile different programs;
+        # fingerprint the resolved decision (knob + numerics mode)
+        1 if _dense_logits_resolved(engine) else 0,
     ], dtype=np.int32)
     root_fp = np.asarray(multihost_utils.broadcast_one_to_all(
         fp, is_source=jax.process_index() == 0))

@@ -70,9 +70,12 @@ def estimate_device_bytes(cfg, *, weight_repr: str, kv_dtype_bytes: int,
     representation (q40/q80/f16/bf16/f32); ``n_shards`` divides the
     weight+KV payload (mesh sharding); ``offload`` keeps layer stacks in
     host DRAM, leaving only embeddings + head + a working set on device."""
+    import numpy as np
+
     wbytes = _WEIGHT_BYTES[weight_repr]
     # embedding is stored at compute dtype (runtime.weights.load_params)
-    emb_elem = 2 if getattr(cfg, "compute_dtype", "") == "bfloat16" else 4
+    emb_elem = np.dtype(getattr(cfg, "compute_dtype", "float32") or
+                        "float32").itemsize
     emb_bytes = cfg.vocab_size * cfg.dim * emb_elem
     if wbytes < 2.0:
         # fast configs load the logits head as resident dense bf16

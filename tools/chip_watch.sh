@@ -62,6 +62,12 @@ capture() {
     timeout 4800 python tools/perf_matrix.py 8b 420 > "$cdir/matrix_8b.log" 2>&1
     echo "matrix_8b rc=$?" >> "$cdir/status"
 
+    # 5+6. where the milliseconds go: per-op decode profiles (both presets)
+    timeout 1200 python tools/profile_decode.py 8b 4 > "$cdir/profile_8b.log" 2>&1
+    echo "profile_8b rc=$?" >> "$cdir/status"
+    timeout 900 python tools/profile_decode.py 1b 4 > "$cdir/profile_1b.log" 2>&1
+    echo "profile_1b rc=$?" >> "$cdir/status"
+
     touch "$OUT/capture_done"
     rm -f "$OUT/RERUN"
     echo "capture end $(date -u +%FT%TZ)" >> "$OUT/probe_log.jsonl.notes"

@@ -40,20 +40,23 @@ import bench  # noqa: E402 — the bench parent module is deliberately jax-free
 # dequant — the gemv sweep measured it 3-5x over the Pallas kernel — so the
 # old pallas-vs-xla fast rows collapsed into one "pallas" comparison row.)
 COMBOS = [
-    # (label, quant_kernel, attn_impl, kv_dtype, quant_mode, dense_logits)
-    ("auto", None, None, None, None, None),          # production dispatch
-    ("pallas", "pallas", "flash", None, None, None), # Pallas kernel instead
-    ("xla-attn", None, "xla", None, None, None),     # XLA oracle attention
-    ("exact", None, None, None, "exact", None),      # parity numerics cost
-    ("auto+f8kv", None, None, "f8", None, None),     # fp8 KV cache storage
-    ("q40-logits", None, None, None, None, "off"),   # quantized head instead
+    # (label, quant_kernel, attn_impl, kv_dtype, quant_mode, dense_logits,
+    #  scan_unroll)
+    ("auto", None, None, None, None, None, None),          # production
+    ("pallas", "pallas", "flash", None, None, None, None), # Pallas kernel
+    ("xla-attn", None, "xla", None, None, None, None),     # oracle attention
+    ("exact", None, None, None, "exact", None, None),      # parity numerics
+    ("auto+f8kv", None, None, "f8", None, None, None),     # fp8 KV storage
+    ("q40-logits", None, None, None, None, "off", None),   # quantized head
+    ("unroll4", None, None, None, None, None, "4"),        # layer-scan unroll
 ]
 
 
 def run_combo(preset: str, budget: float, quant: str | None,
               attn: str | None, kv: str | None = None,
               qmode: str | None = None,
-              dense_logits: str | None = None) -> dict:
+              dense_logits: str | None = None,
+              scan_unroll: str | None = None) -> dict:
     """Set the combo's knobs in this process's env and delegate to
     bench.run_stage (subprocess isolation, live phase tracking, stderr tail,
     kill+reap — no second implementation to drift)."""
@@ -61,7 +64,8 @@ def run_combo(preset: str, budget: float, quant: str | None,
                      ("DLLAMA_BENCH_ATTN", attn),
                      ("DLLAMA_BENCH_KV", kv),
                      ("DLLAMA_TPU_QUANT_MODE", qmode),
-                     ("DLLAMA_TPU_DENSE_LOGITS", dense_logits)):
+                     ("DLLAMA_TPU_DENSE_LOGITS", dense_logits),
+                     ("DLLAMA_TPU_SCAN_UNROLL", scan_unroll)):
         if val:
             os.environ[var] = val
         else:
@@ -76,9 +80,9 @@ def main() -> None:
     preset = sys.argv[1] if len(sys.argv) > 1 else "1b"
     budget = float(sys.argv[2]) if len(sys.argv) > 2 else 420.0
     rows: dict = {}
-    for label, quant, attn, kv, qmode, dense in COMBOS:
+    for label, quant, attn, kv, qmode, dense, unroll in COMBOS:
         t0 = time.monotonic()
-        res = run_combo(preset, budget, quant, attn, kv, qmode, dense)
+        res = run_combo(preset, budget, quant, attn, kv, qmode, dense, unroll)
         res["combo_s"] = round(time.monotonic() - t0, 1)
         rows[label] = res
         print(json.dumps({label: res}), flush=True)
