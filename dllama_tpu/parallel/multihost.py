@@ -268,18 +268,6 @@ class ControlCodec:
         return np.frombuffer(data, dtype=np.int32).copy()
 
 
-def _dense_logits_resolved(engine: "InferenceEngine") -> bool:
-    """The effective dense-vs-quantized logits head decision (same rule the
-    loader applied: runtime.weights.dense_logits_wanted over the resolved
-    numerics mode) — fingerprinted because the two heads compile different
-    programs."""
-    from ..ops.linear import fast_numerics_resolved
-    from ..runtime.weights import dense_logits_wanted
-
-    return dense_logits_wanted(
-        fast_numerics_resolved(str(engine.cfg.compute_dtype)))
-
-
 def validate_cluster_config(engine: "InferenceEngine") -> None:
     """Fail fast on root/worker flag mismatches.
 
@@ -293,6 +281,8 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
 
     import jax
     from jax.experimental import multihost_utils
+
+    from ..runtime.weights import dense_logits_resolved as _dense_logits
 
     def s32(text: str) -> int:  # stable string → i32 slot
         return zlib.crc32(text.encode()) & 0x7FFFFFFF
@@ -324,7 +314,7 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         max(1, int(os.environ.get("DLLAMA_TPU_SCAN_UNROLL", "1"))),
         # dense-bf16 vs quantized logits head compile different programs;
         # fingerprint the resolved decision (knob + numerics mode)
-        1 if _dense_logits_resolved(engine) else 0,
+        1 if _dense_logits(engine.cfg.compute_dtype) else 0,
     ], dtype=np.int32)
     root_fp = np.asarray(multihost_utils.broadcast_one_to_all(
         fp, is_source=jax.process_index() == 0))
@@ -338,7 +328,7 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
             f"multihost config mismatch on process {jax.process_index()}: "
             f"local [n_batches, tp, sp, pp, dp, seq_len, n_layers, dim, vocab, "
             f"sync_q80, dtype, weight_mode, attn_impl, moe_impl, kv_dtype, "
-            f"spec_lookup, quant_mode, wire] = "
+            f"spec_lookup, quant_mode, wire, scan_unroll, dense_logits] = "
             f"{fp.tolist()} vs root {root_fp.tolist()} — start every process "
             f"with identical model files and flags")
     if any_bad.sum() > 0:

@@ -81,11 +81,9 @@ def estimate_device_bytes(cfg, *, weight_repr: str, kv_dtype_bytes: int,
         # fast configs load the logits head as resident dense bf16
         # (runtime.weights.dense_logits_wanted); charge the delta so the
         # budget check sees the real footprint
-        from ..ops.linear import fast_numerics_resolved
-        from .weights import dense_logits_wanted
+        from .weights import dense_logits_resolved
 
-        if dense_logits_wanted(
-                fast_numerics_resolved(getattr(cfg, "compute_dtype", ""))):
+        if dense_logits_resolved(getattr(cfg, "compute_dtype", "")):
             emb_bytes += int(cfg.vocab_size * cfg.dim * (2.0 - wbytes))
     if offload:
         # resident: embedding + head + ~2 layers of streamed working set

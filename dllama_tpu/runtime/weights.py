@@ -58,6 +58,15 @@ def _layer_range(sl: slice, n_layers: int) -> range:
     return range(lo, hi)
 
 
+def dense_logits_resolved(compute_dtype: str) -> bool:
+    """The effective dense-vs-quantized logits head decision for a config —
+    the ONE composition of the knob + numerics rule, shared by the loader,
+    the HBM estimator, and the multihost fingerprint so they can't drift."""
+    from ..ops.linear import fast_numerics_resolved
+
+    return dense_logits_wanted(fast_numerics_resolved(str(compute_dtype)))
+
+
 def dense_logits_wanted(fast_numerics: bool) -> bool:
     """Whether the logits head loads as a resident dense-bf16 array.
 

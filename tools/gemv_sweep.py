@@ -4,9 +4,11 @@ The decode profile (tools/profile_decode.py) shows the Q40 quant matmul
 streaming codes at ~114-130 GB/s effective against an 819 GB/s chip — the
 dominant term in the 8.4x roofline gap.  This sweep times, for the hot
 decode shapes, the production Pallas kernel at several (bn, bk) block
-choices against the XLA dequant+dot fallback, a dense bf16 matmul (the
-no-quantization reference point) and a dense s8->f32 dot (streaming-rate
-ceiling for int8 codes).
+choices against: the XLA dequant+dot fallback (f32- and bf16-stored
+scales), a dense bf16 matmul (the no-quantization reference point), a raw
+s8xs8 MXU dot -> s32 (rate bound for a w8a8 "turbo" mode), manually packed
+4-bit codes unpacked on the VPU (halved code HBM vs shift/mask cost), and
+multi-row activations (M=8 verify / M=256 prefill-chunk shapes).
 
 Timing methodology: the host->device round trip on the axon tunnel is
 ~67 ms and per-dispatch host enqueue is ~1 ms, so sub-millisecond kernels
@@ -126,12 +128,39 @@ def main() -> None:
         wd = w.codes.astype(jnp.bfloat16)
         bench("dense bf16 (2B/weight)", lambda x, w: x @ w, x, wd,
               bytes_moved=2 * K * N)
-        bench("dense s8 dot -> f32",
-              lambda x, c: jax.lax.dot_general(
-                  x.astype(jnp.float32), c.astype(jnp.float32),
-                  dimension_numbers=(((1,), (0,)), ((), ())),
-                  preferred_element_type=jnp.float32), x, w.codes,
+        # s8 x s8 -> s32 directly on the MXU (no converts the compiler could
+        # hoist): the per-op rate bounds a w8a8 "turbo" quant mode
+        xq = jnp.clip(jnp.round(x.astype(jnp.float32) * 16.0),
+                      -127, 127).astype(jnp.int8)
+        bench("s8xs8 MXU dot -> s32",
+              lambda xq, c: jax.lax.dot_general(
+                  xq, c, dimension_numbers=(((1,), (0,)), ((), ())),
+                  preferred_element_type=jnp.int32), xq, w.codes,
               bytes_moved=K * N)
+        # manually packed 4-bit codes (two per byte along K), unpacked on the
+        # VPU in-graph: halves code HBM at the price of shift/mask VPU work
+        packed = ((w.codes[0::2] + 8).astype(jnp.uint8)
+                  | ((w.codes[1::2] + 8).astype(jnp.uint8) << 4))
+
+        def unpack_mv(x, p, s):
+            lo = (p & jnp.uint8(0x0F)).astype(jnp.int8) - 8
+            hi = (p >> 4).astype(jnp.int8) - 8
+            c = jnp.stack([lo, hi], axis=1).reshape(K, N)
+            wd = c.astype(jnp.bfloat16) * jnp.repeat(s, 32, axis=0)
+            return x @ wd
+
+        bench("packed-u4 dequant+dot", unpack_mv, x, packed,
+              w.scales.astype(jnp.bfloat16),
+              bytes_moved=K * N // 2 + (K // 32) * N * 2)
+
+        # multi-row activations: the verify (M=8) and prefill-chunk (M=256)
+        # shapes — how the fused dequant amortizes over rows
+        for M in (8, 256):
+            xm = jax.random.normal(jax.random.fold_in(key, 7 * M),
+                                   (M, K), jnp.bfloat16)
+            bench(f"xla dequant M={M}",
+                  lambda x, w: x @ dequantize_weight(w, dtype=jnp.bfloat16),
+                  xm, w, bytes_moved=K * N + (K // 32) * N * 4)
 
 
 if __name__ == "__main__":

@@ -11,6 +11,7 @@ per combo plus a final summary. The knobs:
   DLLAMA_BENCH_KV          bf16 | f8 | f32  (KV cache storage dtype)
   DLLAMA_TPU_QUANT_MODE    fast | exact  (dequant numerics, ops/linear.py)
   DLLAMA_TPU_DENSE_LOGITS  on | off      (resident bf16 head vs Q40)
+  DLLAMA_TPU_SCAN_UNROLL   N             (layer-scan unroll, models/llama.py)
 
 Usage:
   python tools/perf_matrix.py [preset] [per-stage-budget-s]
