@@ -221,7 +221,7 @@ def device_random_params(cfg):
 
     from dllama_tpu.models.llama import LayerParams, Params
     from dllama_tpu.ops.linear import QuantizedWeight, fast_numerics_resolved
-    from dllama_tpu.runtime.weights import dense_logits_wanted
+    from dllama_tpu.runtime.weights import dense_logits_resolved
 
     key = iter(jax.random.split(jax.random.PRNGKey(0), 32))
     _codes = _codes_kernel()
@@ -249,7 +249,7 @@ def device_random_params(cfg):
     )
     emb = (jax.random.uniform(next(key), (cfg.vocab_size, cfg.dim),
                               jnp.bfloat16, minval=-0.02, maxval=0.02))
-    if dense_logits_wanted(fast):
+    if dense_logits_resolved(cfg.compute_dtype):
         # dense head in the reference's [out, in] orientation (ops.linear)
         logits = jax.random.uniform(next(key), (cfg.vocab_size, cfg.dim),
                                     jnp.bfloat16, minval=-0.02, maxval=0.02)

@@ -257,3 +257,78 @@ def test_fast_mode_quant_matmul_drift_on_hw(tpu_backend):
     assert decisive.any()
     np.testing.assert_array_equal(exact.argmax(-1)[decisive],
                                   fast.argmax(-1)[decisive])
+
+
+def test_decode_rate_physically_sane_on_hw(tpu_backend):
+    """Fetch-forced decode rate sits inside its physical window.
+
+    Two regression classes this guards (both happened in round 4):
+    * timing that doesn't force execution (block_until_ready on the axon
+      tunnel) reports ENQUEUE rates far ABOVE the HBM roofline;
+    * a quant-matmul dispatch regression (e.g. back to the ~130 GB/s
+      custom-call path) drops the rate far BELOW the fused-dequant band.
+    Bounds are generous (roofline/6 .. roofline*1.3) so chip generations
+    and tunnel jitter can't flake them; the production path measures
+    ~roofline/3 (CHANGELOG round 4).
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.formats.mfile import ArchType, RopeType
+    from dllama_tpu.models import ModelConfig, init_random_params
+    from dllama_tpu.models.llama import greedy_step
+    from dllama_tpu.ops.linear import QuantizedWeight
+    from dllama_tpu.runtime import KVCache
+
+    cfg = ModelConfig(
+        arch=ArchType.LLAMA, dim=2048, hidden_dim=8192, n_layers=8,
+        n_heads=16, n_kv_heads=8, head_dim=128, vocab_size=32000,
+        seq_len=512, norm_epsilon=1e-5, rope_theta=500000.0,
+        rope_type=RopeType.LLAMA, compute_dtype="bfloat16")
+    params = init_random_params(cfg, seed=5, quantized=True)
+    kv = KVCache.create(cfg, dtype=jnp.bfloat16)
+    greedy = jax.jit(greedy_step, static_argnums=1, donate_argnums=(4,))
+
+    def fetch(x):
+        jax.device_get(jnp.ravel(x)[0])
+
+    token = jnp.zeros((1,), jnp.int32)
+    token, kv = greedy(params, cfg, token[:, None], jnp.int32(0), kv)
+    fetch(token)
+    token, kv = greedy(params, cfg, token[:, None], jnp.int32(1), kv)
+    fetch(token)  # throwaway: first post-compile dispatch absorbs backlog
+    probe = jax.jit(lambda x: x + 1)(jnp.zeros((8,), jnp.int32))
+    fetch(probe)
+    t0 = time.perf_counter()
+    fetch(probe)
+    rtt = time.perf_counter() - t0
+
+    steps = 24
+    t0 = time.perf_counter()
+    for i in range(steps):
+        token, kv = greedy(params, cfg, token[:, None], jnp.int32(2 + i), kv)
+    fetch(token)
+    ms = 1e3 * max(1e-9, time.perf_counter() - t0 - rtt) / steps
+
+    # bytes a decode step must stream: the layer stacks + the head
+    # (embedding excluded: one gathered row per step)
+    nbytes = 0
+    for leaf in jax.tree_util.tree_leaves(
+            (params.layers, params.logits),
+            is_leaf=lambda x: isinstance(x, QuantizedWeight)):
+        if isinstance(leaf, QuantizedWeight):
+            nbytes += leaf.codes.nbytes + leaf.scales.nbytes
+        elif hasattr(leaf, "nbytes"):
+            nbytes += leaf.nbytes  # dense head / norms
+    from bench import detect_specs
+
+    _, gbps = detect_specs(jax.devices()[0].device_kind)
+    roofline_ms = 1e3 * nbytes / (gbps * 1e9)
+    assert ms < 6 * roofline_ms, (
+        f"decode {ms:.2f} ms/step is >6x the {roofline_ms:.2f} ms HBM "
+        f"roofline — quant-matmul dispatch regression?")
+    assert ms > 0.77 * roofline_ms, (
+        f"decode {ms:.2f} ms/step is above the physical roofline "
+        f"({roofline_ms:.2f} ms) — timing is not forcing execution")

@@ -141,4 +141,7 @@ if __name__ == "__main__":
     try:
         main()
     except BrokenPipeError:  # e.g. `analyze_capture.py | head`
+        # point stdout at devnull so interpreter-shutdown flush of the
+        # broken pipe can't re-raise and dirty the exit status
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
         sys.exit(0)
