@@ -448,6 +448,16 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     out["fetch_rtt_ms"] = round(1e3 * rtt, 1)
     params = device_random_params(cfg)
     jax.block_until_ready(params)  # staging is forced by the compile sync below
+    from dllama_tpu.ops.linear import turbo_mode
+
+    if turbo_mode() is not None:
+        # measure what the engine would serve: integer-dot planes (source
+        # buffers freed leaf-by-leaf, same as the engine)
+        from dllama_tpu.ops.turbo import turbo_params
+
+        out["phase"] = "turbo_derive"
+        params = turbo_params(params, a8=turbo_mode() == "a8")
+        sync(params.layers.wq.w8)
     kv = KVCache.create(cfg, batch_size=batch, dtype=_kv_map[kv_env])
 
     step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))

@@ -107,13 +107,25 @@ def _fast_mode(x: jax.Array) -> bool:
         "bfloat16" if x.dtype == jnp.bfloat16 else "float32")
 
 
+def turbo_mode() -> str | None:
+    """``"a8"`` / ``"a16"`` when DLLAMA_TPU_QUANT_MODE selects turbo
+    numerics (ops.turbo: per-column int8 weights, scales in the epilogue),
+    else None. Opt-in only — never resolved from ``auto``."""
+    mode = os.environ.get("DLLAMA_TPU_QUANT_MODE", "auto")
+    return {"turbo": "a8", "turbo16": "a16"}.get(mode)
+
+
 def fast_numerics_resolved(compute_dtype: str) -> bool:
     """The load-time fast/exact resolution (same rule as _fast_mode, keyed
     on the config's compute dtype instead of a live activation): decides
-    stored scale dtype and the dense-logits default in runtime.weights."""
+    stored scale dtype and the dense-logits default in runtime.weights.
+    Turbo modes load like fast (bf16 scales feed the derivation, dense
+    head) before the planes requantize."""
     mode = os.environ.get("DLLAMA_TPU_QUANT_MODE", "auto")
-    if mode in ("fast", "exact"):
-        return mode == "fast"
+    if mode == "exact":
+        return False
+    if mode in ("fast", "turbo", "turbo16"):
+        return True
     return compute_dtype == "bfloat16"
 
 
@@ -122,7 +134,7 @@ def quant_mode_label(activations_bf16: bool) -> str:
     ONE place the env knob + auto rule turn into a string, so reports can't
     drift from what _fast_mode actually dispatches."""
     mode = os.environ.get("DLLAMA_TPU_QUANT_MODE", "auto")
-    if mode not in ("exact", "fast"):
+    if mode not in ("exact", "fast", "turbo", "turbo16"):
         mode = "auto"
     resolved = mode if mode != "auto" else (
         "fast" if activations_bf16 else "exact")
@@ -186,6 +198,12 @@ def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
     fall back to XLA dequant+dot with identical f32 dequant values.
     """
     out_dtype = x.dtype
+    from .turbo import TurboWeight, turbo_matmul  # lazy: turbo imports us
+
+    if isinstance(w, TurboWeight):
+        # a8/a16 rides on the weight (fixed at derivation) — the ambient env
+        # cannot silently flip serving numerics after load
+        return turbo_matmul(x, w).astype(out_dtype)
     if isinstance(w, QuantizedWeight):
         from ..parallel.api import current_plan
 

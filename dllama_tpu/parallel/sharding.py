@@ -43,6 +43,14 @@ def _weight_sharding(plan: MeshPlan, w, out_axis: str | None, in_axis: str | Non
             scales=plan.sharding_for(tuple(w.scales.shape), *lead, in_axis, out_axis),
             codes=plan.sharding_for(tuple(w.codes.shape), *lead, in_axis, out_axis),
         )
+    from ..ops.turbo import TurboWeight
+
+    if isinstance(w, TurboWeight):
+        return TurboWeight(
+            plan.sharding_for(tuple(w.w8.shape), *lead, in_axis, out_axis),
+            plan.sharding_for(tuple(w.scale.shape), *lead, out_axis),
+            w.a8,
+        )
     return plan.sharding_for(tuple(w.shape), *lead, out_axis, in_axis)
 
 

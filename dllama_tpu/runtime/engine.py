@@ -280,6 +280,24 @@ class InferenceEngine:
         # bounded by one tensor shard (VERDICT round-1 missing #4)
         self.params: Params = load_params_from_mfile(
             self.model_file, self.cfg, weight_mode, plan=self.plan)
+        from ..ops.linear import turbo_mode
+
+        if turbo_mode() is not None and weight_mode == "auto":
+            # opt-in integer-dot numerics (ops.turbo): requantize every Q40
+            # plane to per-column int8 on device, layer-at-a-time (same
+            # 1 B/weight HBM footprint; scales move to the matmul epilogue).
+            # Source buffers free as each leaf derives, so the transient is
+            # one extra leaf, not a second model (runtime.hbm charges it).
+            from ..ops.turbo import turbo_params
+
+            self.params = turbo_params(self.params,
+                                       a8=turbo_mode() == "a8")
+        elif turbo_mode() is not None and weight_mode == "offload":
+            raise ValueError(
+                "DLLAMA_TPU_QUANT_MODE=turbo/turbo16 does not compose with "
+                "--weight-mode offload: derivation would pull the host-DRAM "
+                "layer stacks into device HBM, defeating offload. Use fast "
+                "mode (the default for bf16 compute) with offload.")
         self.kv: KVCache = self._fresh_kv()
         self.pos = 0
         # Eval/Sync split (reference dllama.cpp:59-67): measured lazily on

@@ -91,6 +91,12 @@ def estimate_device_bytes(cfg, *, weight_repr: str, kv_dtype_bytes: int,
         weights = emb_bytes + int(2 * per_layer * wbytes)
     else:
         weights = emb_bytes + int(matmul_weight_count(cfg) * wbytes)
+        from ..ops.linear import turbo_mode
+
+        if turbo_mode() is not None and wbytes < 2.0:
+            # turbo derivation (ops.turbo) transiently holds one extra leaf
+            # (source planes free leaf-by-leaf); charge the largest stack
+            weights += cfg.n_layers * cfg.dim * cfg.hidden_dim
     kv = 2 * cfg.n_layers * cfg.seq_len * cfg.kv_dim * batch * kv_dtype_bytes
     need = int(((weights + kv) / max(1, n_shards)) * _MARGIN) + _FIXED_OVERHEAD
     return {"weights_bytes": weights, "kv_bytes": kv,

@@ -9,7 +9,7 @@ per combo plus a final summary. The knobs:
   DLLAMA_TPU_QUANT_KERNEL  pallas | xla   (ops/linear.py dispatch)
   DLLAMA_BENCH_ATTN        flash  | xla   (ModelConfig.attn_impl)
   DLLAMA_BENCH_KV          bf16 | f8 | f32  (KV cache storage dtype)
-  DLLAMA_TPU_QUANT_MODE    fast | exact  (dequant numerics, ops/linear.py)
+  DLLAMA_TPU_QUANT_MODE    fast | exact | turbo | turbo16  (ops/linear.py)
   DLLAMA_TPU_DENSE_LOGITS  on | off      (resident bf16 head vs Q40)
   DLLAMA_TPU_SCAN_UNROLL   N             (layer-scan unroll, models/llama.py)
 
@@ -50,6 +50,10 @@ COMBOS = [
     ("auto+f8kv", None, None, "f8", None, None, None),     # fp8 KV storage
     ("q40-logits", None, None, None, None, "off", None),   # quantized head
     ("unroll4", None, None, None, None, None, "4"),        # layer-scan unroll
+    # integer-dot turbo modes (ops/turbo.py): per-column int8 planes,
+    # scales in the epilogue; a8 = s8xs8 MXU dots, a16 = bf16 activations
+    ("turbo", None, None, None, "turbo", None, None),
+    ("turbo16", None, None, None, "turbo16", None, None),
 ]
 
 

@@ -71,13 +71,11 @@ def main() -> None:
     eng = InferenceEngine(m, t, temperature=0.8, topp=0.9, seed=11)
     gen = BatchedGenerator(eng, n_slots=n_slots)
 
-    reqs = []
     for i in range(n_slots):
         r = Request(rid=i, prompt_ids=list(range(2, 2 + PROMPT_LEN)),
                     max_tokens=10 ** 6, temperature=0.8, topp=0.9,
                     seed=100 + i)
         gen.admit(r, i)
-        reqs.append(r)
 
     gen.step()  # compile + first ragged dispatch
     t0 = time.perf_counter()
