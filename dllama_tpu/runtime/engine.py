@@ -119,6 +119,17 @@ class InferenceEngine:
                  multihost: bool = False, host_sampling: bool = False,
                  decode_chunk: int = 1, spec_lookup: int = 0,
                  kv_dtype: str = "auto", profile_split: bool = False):
+        from ..ops.linear import turbo_mode
+
+        if turbo_mode() is not None and weight_mode != "auto":
+            # fail BEFORE the multi-GB load: turbo requires quantized planes
+            # resident on device. offload would pull host-DRAM stacks into
+            # HBM; f32/bf16 modes have no Q40 planes to requantize (silently
+            # serving dense weights while reports say "turbo" would be the
+            # report-vs-dispatch drift quant_mode_label exists to prevent).
+            raise ValueError(
+                f"--quant-mode turbo/turbo16 requires --weight-mode auto "
+                f"with a quantized model (got --weight-mode {weight_mode})")
         self.model_file = ModelFile.open(model_path, max_seq_len=max_seq_len,
                                          sync_type=sync_type)
         self.cfg = ModelConfig.from_header(self.model_file.header,
@@ -280,24 +291,22 @@ class InferenceEngine:
         # bounded by one tensor shard (VERDICT round-1 missing #4)
         self.params: Params = load_params_from_mfile(
             self.model_file, self.cfg, weight_mode, plan=self.plan)
-        from ..ops.linear import turbo_mode
-
-        if turbo_mode() is not None and weight_mode == "auto":
+        if turbo_mode() is not None:
             # opt-in integer-dot numerics (ops.turbo): requantize every Q40
             # plane to per-column int8 on device, layer-at-a-time (same
             # 1 B/weight HBM footprint; scales move to the matmul epilogue).
             # Source buffers free as each leaf derives, so the transient is
             # one extra leaf, not a second model (runtime.hbm charges it).
-            from ..ops.turbo import turbo_params
+            from ..ops.turbo import TurboWeight, turbo_params
 
             self.params = turbo_params(self.params,
                                        a8=turbo_mode() == "a8")
-        elif turbo_mode() is not None and weight_mode == "offload":
-            raise ValueError(
-                "DLLAMA_TPU_QUANT_MODE=turbo/turbo16 does not compose with "
-                "--weight-mode offload: derivation would pull the host-DRAM "
-                "layer stacks into device HBM, defeating offload. Use fast "
-                "mode (the default for bf16 compute) with offload.")
+            if not isinstance(self.params.layers.wq, TurboWeight):
+                raise ValueError(
+                    "--quant-mode turbo/turbo16 requires a quantized (Q40/"
+                    "Q80) model file — this one loaded dense weights, so "
+                    "there is nothing to requantize and reports would "
+                    "mislabel plain dense numerics as turbo")
         self.kv: KVCache = self._fresh_kv()
         self.pos = 0
         # Eval/Sync split (reference dllama.cpp:59-67): measured lazily on

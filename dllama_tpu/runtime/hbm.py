@@ -94,9 +94,18 @@ def estimate_device_bytes(cfg, *, weight_repr: str, kv_dtype_bytes: int,
         from ..ops.linear import turbo_mode
 
         if turbo_mode() is not None and wbytes < 2.0:
-            # turbo derivation (ops.turbo) transiently holds one extra leaf
-            # (source planes free leaf-by-leaf); charge the largest stack
-            weights += cfg.n_layers * cfg.dim * cfg.hidden_dim
+            # turbo derivation (ops.turbo) transiently holds one extra
+            # derived int8 leaf (source planes free leaf-by-leaf) PLUS the
+            # dense f32 intermediate of the plane being derived: one layer's
+            # [dim, hidden] for stacked leaves, or the whole [dim, vocab]
+            # when the logits head stays quantized (2-D branch)
+            from .weights import dense_logits_resolved
+
+            dense_cols = cfg.hidden_dim
+            if not dense_logits_resolved(getattr(cfg, "compute_dtype", "")):
+                dense_cols = max(dense_cols, cfg.vocab_size)
+            weights += (cfg.n_layers * cfg.dim * cfg.hidden_dim
+                        + 4 * cfg.dim * dense_cols)
     kv = 2 * cfg.n_layers * cfg.seq_len * cfg.kv_dim * batch * kv_dtype_bytes
     need = int(((weights + kv) / max(1, n_shards)) * _MARGIN) + _FIXED_OVERHEAD
     return {"weights_bytes": weights, "kv_bytes": kv,

@@ -69,12 +69,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "cast-site emulation plus the wire would quantize "
                         "the same partials twice (the reference does it "
                         "once)")
-    p.add_argument("--quant-mode", choices=["auto", "exact", "fast"],
+    p.add_argument("--quant-mode",
+                   choices=["auto", "exact", "fast", "turbo", "turbo16"],
                    default="auto",
                    help="quantized-matmul numerics (ops/linear.py): exact = "
                         "f32 dequant + HIGHEST-precision dots (golden "
                         "parity); fast = bf16 dequant, one MXU pass, f32 "
-                        "accumulation; auto = fast iff --compute-dtype bf16")
+                        "accumulation; turbo/turbo16 = per-column int8 "
+                        "planes with integer dots and scales in the "
+                        "epilogue (ops/turbo.py — the reference's Q80xQ40 "
+                        "integer-dot shape; turbo also row-quantizes "
+                        "activations to int8); auto = fast iff "
+                        "--compute-dtype bf16")
     p.add_argument("--kv-dtype", choices=["auto", "f32", "bf16", "f8"],
                    default="auto",
                    help="KV cache dtype (auto = compute dtype). f8 "

@@ -152,9 +152,11 @@ def test_engine_end_to_end_turbo(tmp_path, monkeypatch):
     assert len(r1.tokens) > 0
 
 
-def test_turbo_tp_matches_unsharded(monkeypatch):
+@pytest.mark.parametrize("a8", [True, False])
+def test_turbo_tp_matches_unsharded(monkeypatch, a8):
     """Turbo planes under a tp mesh (param_shardings TurboWeight branch +
-    auto-sharded integer dots) reproduce the single-device turbo logits."""
+    auto-sharded integer dots — including the a8 row-amax + s8xs8->s32
+    epilogue under GSPMD) reproduce the single-device turbo logits."""
     import jax
     import jax.numpy as jnp
 
@@ -167,14 +169,15 @@ def test_turbo_tp_matches_unsharded(monkeypatch):
     from dllama_tpu.parallel.sharding import kv_cache_sharding, shard_params
     from dllama_tpu.runtime import KVCache
 
-    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "turbo16")
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE",
+                       "turbo" if a8 else "turbo16")
     cfg = ModelConfig(
         arch=mfile.ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
         n_heads=4, n_kv_heads=4, head_dim=16, vocab_size=96, seq_len=32,
         norm_epsilon=1e-5, rope_theta=10000.0, rope_type=mfile.RopeType.LLAMA,
         compute_dtype="bfloat16")
     params = turbo_params(init_random_params(cfg, seed=17, quantized=True),
-                          a8=False)
+                          a8=a8)
     assert isinstance(params.layers.wq, TurboWeight)
     tokens = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
 
