@@ -257,3 +257,17 @@ def test_speculative_under_sp_matches_plain(model_files, tp):
     got = spec.generate("hello hello hello", 12, stop_on_eos=False).tokens
     spec.close()
     assert got == want
+
+
+def test_speculative_identical_under_turbo(model_files, monkeypatch):
+    """Speculation composes with turbo numerics: a8 quantizes activations
+    per ROW, so each token position quantizes identically in a [B, K+1]
+    verify and a [B, 1] decode dispatch — greedy identity holds modulo the
+    same dispatch-shape ulp hazard the fast path documents (asserted
+    exactly here on CPU, like the fast-mode identity tests)."""
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "turbo")
+    plain = _gen(model_files, "the quick brown fox", 32,
+                 compute_dtype="bfloat16")
+    spec = _gen(model_files, "the quick brown fox", 32, spec_lookup=4,
+                compute_dtype="bfloat16")
+    assert spec.tokens == plain.tokens

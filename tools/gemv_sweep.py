@@ -125,6 +125,21 @@ def main() -> None:
                                    * jnp.repeat(s, 32, axis=0)),
               x, c4, s16, bytes_moved=K * N // 2 + (K // 32) * N * 2)
 
+        # grouped dot: batched [G,32]x[G,32,N] dots then one scale multiply
+        # per (group, col) — 32x less VPU scale work than per-element
+        # dequant, exact same math (sum regrouped by quant block)
+        def grouped_mv(x, w):
+            G = K // 32
+            xg = x.reshape(G, 32).astype(jnp.bfloat16)  # [G, 32]
+            cg = w.codes.reshape(G, 32, N).astype(jnp.bfloat16)
+            part = jax.lax.dot_general(  # [G, N]
+                xg, cg, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            return jnp.sum(part * w.scales.astype(jnp.float32),
+                           axis=0)[None, :]
+
+        bench("grouped-dot + scale", grouped_mv, x, w, bytes_moved=nbytes)
+
         wd = w.codes.astype(jnp.bfloat16)
         bench("dense bf16 (2B/weight)", lambda x, w: x @ w, x, wd,
               bytes_moved=2 * K * N)
