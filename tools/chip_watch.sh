@@ -71,6 +71,18 @@ capture() {
     touch "$OUT/capture_done"
     rm -f "$OUT/RERUN"
     echo "capture end $(date -u +%FT%TZ)" >> "$OUT/probe_log.jsonl.notes"
+
+    # bench_results/ is gitignored; mirror the capture into a TRACKED dir so
+    # the driver's end-of-round auto-commit preserves it even when the
+    # healthy window lands after the session's last manual commit
+    adir=$REPO/capture_artifacts/$ts
+    mkdir -p "$adir"
+    for f in BENCH_live.json status pytest_tpu.log matrix_1b.log \
+             matrix_8b.log profile_8b.log profile_1b.log bench.stderr; do
+        [ -f "$cdir/$f" ] && cp "$cdir/$f" "$adir/" 2>/dev/null
+    done
+    python "$REPO/tools/analyze_capture.py" "$cdir" \
+        > "$adir/ANALYSIS.txt" 2>&1 || true
 }
 
 echo "watcher start $(date -u +%FT%TZ) interval=${PROBE_INTERVAL}s" >> "$OUT/probe_log.jsonl.notes"
