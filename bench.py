@@ -281,7 +281,9 @@ def stage_child(spec: str) -> None:
     a chip wedge (the round-1/2 failure) kills this child, not the bench —
     the parent kills us at its per-stage budget and moves on.
 
-    spec: preset name, optionally ``@b16`` for the batched-serving variant."""
+    spec: preset name, optionally ``@b16`` (batched-serving variant) or
+    ``@s8k`` (8192-token context: long-context decode is KV-bandwidth-bound,
+    which is what ``--kv-dtype f8`` halves)."""
     force = os.environ.get("DLLAMA_BENCH_PLATFORM")
     if force:
         import jax
@@ -291,7 +293,8 @@ def stage_child(spec: str) -> None:
     budget = float(os.environ.get("DLLAMA_BENCH_CHILD_BUDGET", STAGE_DEADLINE_S))
     deadline = time.monotonic() + budget
     kwargs = (dict(decode_steps=32, prefill_len=128, batch=16)
-              if mod == "b16" else {})
+              if mod == "b16" else
+              dict(seq_len=8192) if mod == "s8k" else {})
     st = _PhaseDict()
     try:
         bench_preset(preset, deadline, out=st, **kwargs)
@@ -402,6 +405,7 @@ _DECODE_REGION = 352
 
 def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
                  prefill_len: int = 256, batch: int = 1,
+                 seq_len: int | None = None,
                  out: dict | None = None) -> dict:
     """Measure decode tok/s (+ prefill tok/s for batch=1) for one preset.
 
@@ -419,6 +423,10 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     out = {} if out is None else out
     out["phase"] = "budget_check"
     cfg = model_cfg(preset)
+    if seq_len:
+        from dataclasses import replace as _replace
+
+        cfg = _replace(cfg, seq_len=seq_len)
     # record the quant numerics the stage ran so captures are attributable
     from dllama_tpu.ops.linear import quant_mode_label
 
@@ -492,13 +500,24 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     logits, kv = step(params, cfg, prompt, jnp.int32(chunk), kv)
     sync(logits)
     t0 = time.perf_counter()
+    done = 0
     for i in range(n_meas):
         logits, kv = step(params, cfg, prompt,
                           jnp.int32(chunk * (1 + i % cyc)), kv)
+        done += 1
+        # enqueueing is cheap on TPU but each dispatch EXECUTES on the CPU
+        # backend (bench self-test): respect the deadline mid-loop
+        if done % 8 == 0 and time.monotonic() > deadline:
+            break
     sync(logits)
     dt = _net(time.perf_counter() - t0, rtt)
-    out["prefill_tok_per_s"] = round(batch * n_meas * chunk / dt, 2) if dt else None
+    out["prefill_tok_per_s"] = round(batch * done * chunk / dt, 2) if dt else None
     pos = chunk * (cyc + 1)
+    if done < n_meas:
+        # deadline fired mid-prefill: stop HERE so the banked prefill number
+        # reaches the parent (falling through to decode compile could eat
+        # the child's kill headroom and lose the whole stage result)
+        raise TimeoutError("deadline inside prefill measure")
 
     # decode (fused greedy step; token never leaves the device)
     out["phase"] = "decode_compile"
@@ -675,18 +694,22 @@ def main() -> None:
 
     # 1b FIRST: the cheap preset banks a real number before the 8B shape —
     # which once OOM-wedged the chip for the rest of the window — ever runs.
-    specs = ["1b", "8b", "8b@b16"] if on_tpu else ["tiny"]
+    specs = ["1b", "8b", "8b@b16", "1b@s8k"] if on_tpu else ["tiny"]
     if os.environ.get("DLLAMA_BENCH_PRESET"):
         specs = os.environ["DLLAMA_BENCH_PRESET"].split(",")
     bad = [s for s in specs
            if s.partition("@")[0] not in PRESETS
-           or s.partition("@")[2] not in ("", "b16")]
+           or s.partition("@")[2] not in ("", "b16", "s8k")]
     if bad:
         result["error"] = f"unknown preset(s) {bad}"
         emit(result)
         return
 
-    deadline = t_start + STAGE_DEADLINE_S + PROBE_TIMEOUT_S
+    # the window scales with the stage list: one STAGE_DEADLINE_S covers the
+    # first stage (probe + compiles dominate it) and each further stage adds
+    # headroom, so a slow early stage can't silently starve the later ones
+    deadline = (t_start + PROBE_TIMEOUT_S + STAGE_DEADLINE_S
+                + 300.0 * max(0, len(specs) - 1))
 
     # Watchdog: the per-stage deadline checks can't fire while blocked INSIDE
     # a jax call (backend init / compile hang — the exact round-1 failure).
