@@ -62,7 +62,13 @@ capture() {
     timeout 4800 python tools/perf_matrix.py 8b 420 > "$cdir/matrix_8b.log" 2>&1
     echo "matrix_8b rc=$?" >> "$cdir/status"
 
-    # 5+6. where the milliseconds go: per-op decode profiles (both presets)
+    # 5. the f8-KV long-context comparison: the bench's default stages
+    #    already measure 1b@s8k with a bf16 cache; this is the f8 twin
+    timeout 1200 env DLLAMA_BENCH_PRESET=1b@s8k DLLAMA_BENCH_KV=f8 \
+        python bench.py > "$cdir/s8k_f8.json" 2> "$cdir/s8k_f8.stderr"
+    echo "s8k_f8 rc=$?" >> "$cdir/status"
+
+    # 6+7. where the milliseconds go: per-op decode profiles (both presets)
     timeout 1200 python tools/profile_decode.py 8b 4 > "$cdir/profile_8b.log" 2>&1
     echo "profile_8b rc=$?" >> "$cdir/status"
     timeout 900 python tools/profile_decode.py 1b 4 > "$cdir/profile_1b.log" 2>&1
@@ -78,7 +84,8 @@ capture() {
     adir=$REPO/capture_artifacts/$ts
     mkdir -p "$adir"
     for f in BENCH_live.json status pytest_tpu.log matrix_1b.log \
-             matrix_8b.log profile_8b.log profile_1b.log bench.stderr; do
+             matrix_8b.log profile_8b.log profile_1b.log bench.stderr \
+             s8k_f8.json; do
         [ -f "$cdir/$f" ] && cp "$cdir/$f" "$adir/" 2>/dev/null
     done
     python "$REPO/tools/analyze_capture.py" "$cdir" \
