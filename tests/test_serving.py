@@ -639,3 +639,44 @@ def test_batched_serving_with_f8_kv_runs_and_is_deterministic(
             gen.step()
         outs.append(r.tokens)
     assert outs[0] == outs[1] and len(outs[0]) == 6
+
+
+def test_batched_under_turbo_matches_solo(tmp_path_factory, monkeypatch):
+    """Serving composes with turbo numerics: batched transcripts equal
+    turbo solo runs (the solo-identity invariant holds within the mode —
+    turbo vs fast numerics differ, turbo-batched vs turbo-solo must not)."""
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "turbo")
+    d = tmp_path_factory.mktemp("serving_turbo")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(43)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+
+    from dllama_tpu.ops.turbo import TurboWeight
+
+    prompts = ["hello world", "hello", " world"]
+    specs = [dict(temperature=0.0, seed=1), dict(temperature=0.8, seed=2),
+             dict(temperature=0.0, seed=3)]
+    n = 8
+    want = []
+    for p, s in zip(prompts, specs):
+        e = InferenceEngine(str(mpath), str(tpath), tp=1,
+                            compute_dtype="bfloat16", **s)
+        want.append(e.generate(p, n, stop_on_eos=False).tokens)
+
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1,
+                          compute_dtype="bfloat16")
+    assert isinstance(eng.params.layers.wq, TurboWeight)
+    gen = BatchedGenerator(eng, n_slots=3)
+    reqs = []
+    for i, (p, s) in enumerate(zip(prompts, specs)):
+        ids = eng.tokenizer.encode(p, is_start=True)
+        r = Request(rid=i, prompt_ids=ids, max_tokens=n, stop_on_eos=False,
+                    temperature=s["temperature"], topp=0.9, seed=s["seed"])
+        gen.admit(r, i)
+        reqs.append(r)
+    while gen.n_active:
+        gen.step()
+    for r, w in zip(reqs, want):
+        assert r.tokens == w, r.rid
