@@ -332,3 +332,30 @@ def test_decode_rate_physically_sane_on_hw(tpu_backend):
     assert ms > 0.77 * roofline_ms, (
         f"decode {ms:.2f} ms/step is above the physical roofline "
         f"({roofline_ms:.2f} ms) — timing is not forcing execution")
+
+
+def test_turbo_matmul_on_hw(tpu_backend):
+    """Turbo integer-dot planes on real hardware: the s8 x s8 -> s32 MXU
+    lowering (a8) and the s8->bf16 epilogue path (a16) both execute and
+    stay within the CPU-validated drift bounds vs the exact dequant oracle
+    (tests/test_turbo.py) — neither path has hardware coverage anywhere
+    else, and a Mosaic/XLA-TPU rejection should fail HERE with a clean
+    signal, not mid-capture in a perf-matrix row."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.ops.linear import dequantize_weight, quantize_weight_q40
+    from dllama_tpu.ops.turbo import derive_turbo, turbo_matmul
+
+    rng = np.random.default_rng(29)
+    qw = quantize_weight_q40(
+        (rng.standard_normal((512, 1024)) * 0.1).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((8, 1024)), jnp.bfloat16)
+    want = np.asarray(x.astype(jnp.float32)
+                      @ dequantize_weight(qw, dtype=jnp.float32))
+    rms = float(np.sqrt(np.mean(want ** 2)))
+
+    for a8, bound in ((True, 8e-2), (False, 5e-2)):
+        tw = derive_turbo(qw, a8=a8)
+        got = np.asarray(turbo_matmul(x, tw), np.float32)
+        drift = float(np.abs(got - want).max()) / max(rms, 1e-9)
+        assert drift < bound, (a8, drift)

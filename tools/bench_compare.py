@@ -1,0 +1,79 @@
+"""Diff two bench JSON results (or two captures) stage by stage.
+
+Round-5 helper: quantify what a change bought —
+
+    python tools/bench_compare.py BENCH_r04_manual.json \\
+        capture_artifacts/<ts>/BENCH_live.json
+
+Accepts bench JSON files (the one-line emit) or capture directories
+(reads BENCH_live.json inside). Prints per-stage deltas for every rate
+field present in both, most-improved first.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_RATES = ("decode_tok_per_s", "prefill_tok_per_s", "sampled_decode_tok_per_s",
+          "chunked_decode_tok_per_s")
+
+
+def _load(path: str) -> dict:
+    if os.path.isdir(path):
+        path = os.path.join(path, "BENCH_live.json")
+    with open(path) as f:
+        text = f.read()
+    try:
+        whole = json.loads(text)
+        if "stages" in whole or "value" in whole:
+            return whole
+        # the driver's BENCH_rN.json wrapper: {n, cmd, rc, tail, parsed}
+        if isinstance(whole.get("parsed"), dict):
+            return whole["parsed"]
+        if "tail" in whole:  # tail holds the emitted line (may be truncated)
+            for line in str(whole["tail"]).splitlines()[::-1]:
+                if line.startswith("{"):
+                    try:
+                        return json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+    except json.JSONDecodeError:
+        pass
+    for line in text.splitlines()[::-1]:
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    raise SystemExit(f"no bench JSON in {path}")
+
+
+def main() -> None:
+    if len(sys.argv) != 3:
+        raise SystemExit(__doc__)
+    a, b = _load(sys.argv[1]), _load(sys.argv[2])
+    print(f"A: {sys.argv[1]}  (git {a.get('git')}, {a.get('device_kind')})")
+    print(f"B: {sys.argv[2]}  (git {b.get('git')}, {b.get('device_kind')})")
+    hv_a, hv_b = a.get("value") or 0, b.get("value") or 0
+    if hv_a and hv_b:
+        print(f"headline {a.get('metric')}: {hv_a} -> {hv_b} "
+              f"({100 * (hv_b - hv_a) / hv_a:+.1f}%)\n")
+
+    rows = []
+    sa, sb = a.get("stages") or {}, b.get("stages") or {}
+    for stage in sorted(set(sa) & set(sb)):
+        for k in _RATES:
+            va, vb = sa[stage].get(k), sb[stage].get(k)
+            if va and vb:
+                rows.append((100 * (vb - va) / va, stage, k, va, vb))
+    if not rows:
+        print("no overlapping measured rates")
+        return
+    for pct, stage, k, va, vb in sorted(rows, reverse=True):
+        print(f"  {stage:10s} {k:28s} {va:>10} -> {vb:>10}  ({pct:+.1f}%)")
+
+
+if __name__ == "__main__":
+    main()
