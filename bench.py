@@ -627,6 +627,59 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     return out
 
 
+def _find_fallback_capture():
+    """Newest VALID banked capture, for emitting when the live chip is down.
+
+    The round-4 failure this guards against: the chip wedged hours before the
+    driver's end-of-round bench run, so BENCH_r04.json recorded only dead
+    probes even though a clean fetch-forced capture existed on disk.  Search
+    order: watcher captures (bench_results/capture_*/ and their tracked
+    mirrors under capture_artifacts/), newest first, then committed
+    BENCH_r*_manual.json snapshots.  A capture is valid iff
+
+    * its directory has no ``INVALID`` marker (rounds 1-3 enqueue-rate
+      captures are marked),
+    * it is not itself a fallback emission (no recursive staleness), and
+    * at least one stage carries BOTH ``fetch_rtt_ms`` (proof the
+      fetch-forced methodology produced it) and a measured decode number, and
+    * its top-level headline ``value`` is nonzero (a capture whose headline
+      stage failed is passed over for an older one that measured).
+
+    Returns ``(data, path)`` or ``None``."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    cands = []
+    for pat in ("bench_results/capture_*/BENCH_live.json",
+                "capture_artifacts/*/BENCH_live.json"):
+        for p in glob.glob(os.path.join(here, pat)):
+            if os.path.exists(os.path.join(os.path.dirname(p), "INVALID")):
+                continue
+            cands.append(p)
+    # capture dirs are named capture_<utc-ts> (bench_results) or bare
+    # <utc-ts> (tracked mirrors): strip the prefix so the sort compares
+    # timestamps, not the 'capture_' literal
+    cands.sort(key=lambda p: os.path.basename(os.path.dirname(p))
+               .removeprefix("capture_"), reverse=True)
+    cands += sorted(glob.glob(os.path.join(here, "BENCH_r*_manual.json")),
+                    reverse=True)
+    for p in cands:
+        try:
+            with open(p) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(data, dict) or "fallback" in data:
+            continue
+        stages = data.get("stages") or {}
+        if not any(isinstance(s, dict) and s.get("fetch_rtt_ms")
+                   and s.get("decode_tok_per_s") for s in stages.values()):
+            continue
+        if data.get("value"):
+            return data, p
+    return None
+
+
 def main() -> None:
     t_start = time.monotonic()
     result: dict = {
@@ -660,6 +713,21 @@ def main() -> None:
         if info is not None:
             ok, detail = True, info
     if not ok:
+        fb = _find_fallback_capture()
+        if fb is not None:
+            data, path = fb
+            here = os.path.dirname(os.path.abspath(__file__))
+            data["fallback"] = {
+                "source": os.path.relpath(path, here),
+                "live_probe_error": detail,
+                "probe_attempts": attempts,
+                "note": ("backend unavailable at bench time; emitting the "
+                         "newest valid fetch-forced capture banked by "
+                         "tools/chip_watch.sh (VERDICT r4 next #4)"),
+            }
+            data["elapsed_s"] = round(time.monotonic() - t_start, 1)
+            emit(data)
+            return
         result["error"] = f"backend unavailable: {detail}"
         result["probe_attempts"] = attempts
         result["env"] = {

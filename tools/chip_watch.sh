@@ -85,7 +85,7 @@ capture() {
     mkdir -p "$adir"
     for f in BENCH_live.json status pytest_tpu.log matrix_1b.log \
              matrix_8b.log profile_8b.log profile_1b.log bench.stderr \
-             s8k_f8.json; do
+             s8k_f8.json INVALID; do
         [ -f "$cdir/$f" ] && cp "$cdir/$f" "$adir/" 2>/dev/null
     done
     python "$REPO/tools/analyze_capture.py" "$cdir" \
