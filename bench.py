@@ -653,7 +653,15 @@ def _find_fallback_capture():
     for pat in ("bench_results/capture_*/BENCH_live.json",
                 "capture_artifacts/*/BENCH_live.json"):
         for p in glob.glob(os.path.join(here, pat)):
-            if os.path.exists(os.path.join(os.path.dirname(p), "INVALID")):
+            d = os.path.dirname(p)
+            if os.path.exists(os.path.join(d, "INVALID")):
+                continue
+            # a tracked mirror (capture_artifacts/<ts>) is copied at capture
+            # time, BEFORE any post-hoc invalidation can land in it — consult
+            # its bench_results sibling's marker too
+            sib = os.path.join(here, "bench_results",
+                               f"capture_{os.path.basename(d)}")
+            if os.path.exists(os.path.join(sib, "INVALID")):
                 continue
             cands.append(p)
     # capture dirs are named capture_<utc-ts> (bench_results) or bare

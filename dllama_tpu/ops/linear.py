@@ -207,7 +207,10 @@ def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
     if isinstance(w, QuantizedWeight):
         from ..parallel.api import current_plan
 
-        fast = _fast_mode(x)
+        # the stored scale dtype wins over the ambient env: bf16 scales were
+        # written by a fast-mode load, and an "exact" f32 dequant over them
+        # would be fake exactness (ADVICE r4 drift finding)
+        fast = _fast_mode(x) or w.scales.dtype == jnp.bfloat16
         if current_plan() is not None and (out_axis or in_axis):
             y = _pallas_sharded(x, w, out_axis, in_axis, fast)
             if y is not None:

@@ -99,7 +99,10 @@ def sampled_token(logits: jax.Array, temperature: jax.Array, topp: jax.Array,
     coin_v = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(coin)), (B,))
     safe_t = jnp.where(temp > 0.0, temp, 1.0)
     probs = jax.nn.softmax(logits / safe_t[:, None], axis=-1)
-    topp_row = (topp_v > 0.0) & (topp_v < 1.0)
+    # greedy rows (temp <= 0) never use their nucleus draw, so they must not
+    # be able to force the full-vocab sort fallback for the whole batch: a
+    # serving batch of mostly-greedy rows keeps the windowed fast path
+    topp_row = (topp_v > 0.0) & (topp_v < 1.0) & (temp > 0.0)
 
     if V > TOPP_WINDOW:
         K = TOPP_WINDOW

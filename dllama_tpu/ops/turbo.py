@@ -100,10 +100,19 @@ def derive_turbo(qw: QuantizedWeight, a8: bool = True,
 
         w8, scale = jax.jit(
             lambda s, c: jax.lax.map(one, (s, c)))(qw.scales, qw.codes)
-    jax.block_until_ready(w8)
     if free_source:
+        # fetch-forced sync, NOT block_until_ready: on the axon tunnel
+        # block_until_ready returns without waiting for device execution
+        # (bench.py round-4 finding), which would let tree_map enqueue the
+        # next leaf's derivation while this one's dense f32 intermediate is
+        # still in flight — breaking the one-extra-leaf transient HBM bound
+        # runtime.hbm charges. device_get of a value that data-depends on
+        # w8 cannot return until the derivation actually ran.
+        jax.device_get(w8[(0,) * w8.ndim])
         qw.codes.delete()
         qw.scales.delete()
+    else:
+        jax.block_until_ready(w8)
     return TurboWeight(w8, scale, a8)
 
 

@@ -307,6 +307,17 @@ class InferenceEngine:
                     "Q80) model file — this one loaded dense weights, so "
                     "there is nothing to requantize and reports would "
                     "mislabel plain dense numerics as turbo")
+        # pin the load-time quant-mode resolution: stored scale dtype, the
+        # dense-vs-Q40 logits head, and turbo derivation were all decided by
+        # DLLAMA_TPU_QUANT_MODE as it read HERE. _dispatch re-checks this
+        # label so an env flip after load fails loudly instead of silently
+        # running one mode's math over the other mode's stored weights
+        # (ADVICE r4: report-vs-dispatch drift).
+        from ..ops.linear import quant_mode_label
+
+        self._load_quant_label = quant_mode_label(
+            self.cfg.compute_dtype == "bfloat16")
+        self._load_quant_resolution = self._quant_resolution()
         self.kv: KVCache = self._fresh_kv()
         self.pos = 0
         # Eval/Sync split (reference dllama.cpp:59-67): measured lazily on
@@ -358,6 +369,15 @@ class InferenceEngine:
             self._verify_step = jax.jit(verify_step, static_argnums=1,
                                         donate_argnums=(4,))
 
+    def _quant_resolution(self) -> tuple:
+        """The env's quant-mode RESOLUTION (not the display label): what the
+        loader bakes into the weights. Label spellings that resolve the same
+        way (``auto`` on a bf16 config vs explicit ``fast``) are equal here,
+        so only a genuine numerics change trips the _dispatch guard."""
+        from ..ops.linear import fast_numerics_resolved, turbo_mode
+
+        return (fast_numerics_resolved(self.cfg.compute_dtype), turbo_mode())
+
     def _fresh_kv(self) -> KVCache:
         # dtype policy in __init__ (self.kv_dtype): compute dtype for parity,
         # bf16/f8 for serving footprint+bandwidth
@@ -391,6 +411,13 @@ class InferenceEngine:
         """Run one jitted step under the active mesh plan; returns
         (primary output, updated kv stored on self). ``extras`` are trailing
         traced f32 scalars (the sampled step's temperature/topp/coin)."""
+        live = self._quant_resolution()
+        if live != self._load_quant_resolution:
+            raise RuntimeError(
+                f"DLLAMA_TPU_QUANT_MODE changed after load: weights were "
+                f"loaded for {self._load_quant_resolution!r} (scale dtype, "
+                f"logits head, turbo planes are baked in) but the env now "
+                f"resolves {live!r} — restart with the desired mode instead")
         if self.multihost and self._is_root:
             # the reference's LlmControlPacket broadcast (app.cpp:193-204):
             # ship (program, tokens, position[, sampling scalars]) so workers

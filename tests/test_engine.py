@@ -171,3 +171,19 @@ def test_prefill_bucketed_matches_fixed(tmp_path):
     assert ra.tokens == rf.tokens
     # 149 prompt-eval tokens (last seeds decode): 128 + 21 = two dispatches
     assert sum(1 for s in ra.steps if s.kind == "eval") == 2
+
+
+def test_quant_mode_flip_after_load_fails_loudly(model_files, monkeypatch):
+    """Flipping DLLAMA_TPU_QUANT_MODE after load must raise, not silently run
+    one mode's math over the other mode's stored weights (bf16 scales, logits
+    head and turbo planes are baked in at load — ADVICE r4 drift finding)."""
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "fast")
+    e = make_engine(model_files, compute_dtype="bfloat16")
+    e.generate("ab", 2, stop_on_eos=False)  # sanity: matching env serves
+    # same RESOLUTION under a different spelling (auto on bf16 == fast):
+    # must NOT trip the guard — only genuine numerics changes do
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "auto")
+    e.generate("ab", 2, stop_on_eos=False)
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "exact")
+    with pytest.raises(RuntimeError, match="changed after load"):
+        e.generate("ab", 2, stop_on_eos=False)
