@@ -659,10 +659,11 @@ def _find_fallback_capture():
             # a tracked mirror (capture_artifacts/<ts>) is copied at capture
             # time, BEFORE any post-hoc invalidation can land in it — consult
             # its bench_results sibling's marker too
-            sib = os.path.join(here, "bench_results",
-                               f"capture_{os.path.basename(d)}")
-            if os.path.exists(os.path.join(sib, "INVALID")):
-                continue
+            if pat.startswith("capture_artifacts"):
+                sib = os.path.join(here, "bench_results",
+                                   f"capture_{os.path.basename(d)}")
+                if os.path.exists(os.path.join(sib, "INVALID")):
+                    continue
             cands.append(p)
     # capture dirs are named capture_<utc-ts> (bench_results) or bare
     # <utc-ts> (tracked mirrors): strip the prefix so the sort compares

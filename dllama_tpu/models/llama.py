@@ -65,15 +65,16 @@ class LayerParams(NamedTuple):
     norm_ffn: jax.Array  # [L, dim]
     norm_q: jax.Array | None  # [L, head_dim] (qwen3) or None
     norm_k: jax.Array | None
-    # MoE (None for dense models). Expert weights are kept dense (compute
-    # dtype): the quantized Pallas matmul path doesn't cover the stacked
-    # expert axis yet. Layout is IN-major ("[.., in, out]") so
-    # ``lax.ragged_dot``'s grouped matmul consumes them with no per-step
-    # transpose (its rhs contracts axis 1).
+    # MoE (None for dense models). Expert weights carry any Weight repr:
+    # dense (compute dtype), stacked QuantizedWeight (Q40/Q80 planes — 1
+    # B/weight resident, dequant fused into the consuming dot), or
+    # TurboWeight after turbo derivation. Layout is IN-major
+    # ("[.., in, out]") so ``lax.ragged_dot``'s grouped matmul consumes the
+    # dense planes with no per-step transpose (its rhs contracts axis 1).
     moe_gate: jax.Array | None = None  # [L, E, dim] router
-    we1: jax.Array | None = None       # [L, E, dim, hidden_dim] (gate)
-    we2: jax.Array | None = None       # [L, E, hidden_dim, dim] (down)
-    we3: jax.Array | None = None       # [L, E, dim, hidden_dim] (up)
+    we1: Weight | None = None          # [L, E, dim, hidden_dim] (gate)
+    we2: Weight | None = None          # [L, E, hidden_dim, dim] (down)
+    we3: Weight | None = None          # [L, E, dim, hidden_dim] (up)
 
 
 class Params(NamedTuple):
@@ -162,6 +163,57 @@ def _moe_router(cfg: ModelConfig, h: jax.Array, gate: jax.Array):
     return top, idx
 
 
+def _experts_dense(we, x: jax.Array, rows: jax.Array | None = None) -> jax.Array:
+    """Dense ``[..., in, out]`` planes of an expert-stack weight (inside the
+    layer scan: ``[E, in, out]``), optionally gathered at ``rows`` along the
+    leading expert axis first (gathering the QUANTIZED planes keeps the HBM
+    read at 1 B/weight — the dequant expansion happens on the k gathered
+    slices only, and XLA fuses it into the consuming dot, the same fused-
+    dequant fast path ops.linear uses)."""
+    from ..ops.linear import QuantizedWeight, _fast_mode, dequantize_weight
+    from ..ops.turbo import TurboWeight
+
+    if isinstance(we, QuantizedWeight):
+        if rows is not None:
+            we = QuantizedWeight(scales=we.scales[rows], codes=we.codes[rows])
+        fast = _fast_mode(x) or we.scales.dtype == jnp.bfloat16
+        return dequantize_weight(we, dtype=jnp.bfloat16 if fast else x.dtype)
+    if isinstance(we, TurboWeight):
+        w8 = we.w8 if rows is None else we.w8[rows]
+        scale = we.scale if rows is None else we.scale[rows]
+        # per-column scales: ONE multiply per element (half the fast path's
+        # per-element convert+scale); the ragged/dense consumers need a
+        # dense rhs, so the s8 dot itself is not used on this path
+        return w8.astype(jnp.bfloat16) * scale[..., None, :].astype(jnp.bfloat16)
+    return we if rows is None else we[rows]
+
+
+def _expert_gather_dot(x: jax.Array, we, rows: jax.Array) -> jax.Array:
+    """``y[n] = x[n] @ plane(rows[n])`` — the decode-regime per-row expert
+    dot. ``x [N, D]``, result f32 ``[N, out]``. TurboWeight runs its real
+    integer-dot contraction (scales in the epilogue, ops.turbo semantics);
+    other reprs gather-then-dequant via :func:`_experts_dense`."""
+    from ..ops.turbo import TurboWeight
+
+    if isinstance(we, TurboWeight):
+        w8 = we.w8[rows]                       # [N, D, out] int8
+        scale = we.scale[rows]                 # [N, out] f32
+        if we.a8:
+            from ..ops.turbo import quantize_activations_a8
+
+            xq, sx = quantize_activations_a8(x)
+            acc = jnp.einsum("nd,ndh->nh", xq, w8,
+                             preferred_element_type=jnp.int32)
+            return acc.astype(jnp.float32) * sx * scale
+        acc = jnp.einsum("nd,ndh->nh", x.astype(jnp.bfloat16),
+                         w8.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        return acc * scale
+    w = _experts_dense(we, x, rows)
+    return jnp.einsum("nd,ndh->nh", x.astype(w.dtype), w,
+                      preferred_element_type=jnp.float32)
+
+
 def _moe_ffn_dense(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Array:
     """All-experts einsum, gate-weighted — O(E) FLOPs but exact and simple;
     the oracle the sparse path is tested against, and the fallback when the
@@ -173,11 +225,14 @@ def _moe_ffn_dense(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Array
     gates = constrain(gates, "batch", None, "experts")
 
     ht = h.astype(cfg.compute_dtype)
-    h1 = jnp.einsum("btd,edh->bteh", ht, lp.we1)
-    h3 = jnp.einsum("btd,edh->bteh", ht, lp.we3)
+    we1 = _experts_dense(lp.we1, ht)
+    we2 = _experts_dense(lp.we2, ht)
+    we3 = _experts_dense(lp.we3, ht)
+    h1 = jnp.einsum("btd,edh->bteh", ht, we1)
+    h3 = jnp.einsum("btd,edh->bteh", ht, we3)
     a = _hidden_act(cfg, h1) * h3
     a = constrain(a, "batch", None, "experts", "hidden")
-    y = jnp.einsum("bteh,ehd,bte->btd", a, lp.we2,
+    y = jnp.einsum("bteh,ehd,bte->btd", a, we2,
                    gates.astype(cfg.compute_dtype))
     return y.astype(h.dtype)
 
@@ -210,25 +265,29 @@ def _moe_sparse_local(cfg: ModelConfig, x: jax.Array, idx: jax.Array,
     x_rep = x[jnp.arange(N * k, dtype=jnp.int32) // k]  # row per (token, k)
 
     if N * k <= _MOE_GATHER_MAX_ROWS:
-        h1 = jnp.einsum("nd,ndh->nh", x_rep, we1[flat_e],
-                        preferred_element_type=jnp.float32)
-        h3 = jnp.einsum("nd,ndh->nh", x_rep, we3[flat_e],
-                        preferred_element_type=jnp.float32)
+        h1 = _expert_gather_dot(x_rep, we1, flat_e)
+        h3 = _expert_gather_dot(x_rep, we3, flat_e)
         a = (_hidden_act(cfg, h1) * h3).astype(x.dtype)
-        y = jnp.einsum("nh,nhd->nd", a, we2[flat_e],
-                       preferred_element_type=jnp.float32)
+        y = _expert_gather_dot(a, we2, flat_e)
         y = y * flat_w[:, None]
     else:
         order = jnp.argsort(flat_e)                    # group rows by expert
         xs = x_rep[order]
         group_sizes = jnp.bincount(flat_e, length=e_local).astype(jnp.int32)
+        # ragged_dot needs a dense rhs: quantized/turbo planes expand to a
+        # bf16 transient of this device's local expert slice here (prefill
+        # regime — MXU-bound, so the extra HBM of the expansion is paid
+        # where it is cheapest; decode takes the gather regime above)
+        d1 = _experts_dense(we1, xs)
+        d2 = _experts_dense(we2, xs)
+        d3 = _experts_dense(we3, xs)
 
-        h1 = jax.lax.ragged_dot(xs, we1, group_sizes,
+        h1 = jax.lax.ragged_dot(xs.astype(d1.dtype), d1, group_sizes,
                                 preferred_element_type=jnp.float32)
-        h3 = jax.lax.ragged_dot(xs, we3, group_sizes,
+        h3 = jax.lax.ragged_dot(xs.astype(d3.dtype), d3, group_sizes,
                                 preferred_element_type=jnp.float32)
-        a = (_hidden_act(cfg, h1) * h3).astype(x.dtype)
-        y = jax.lax.ragged_dot(a, we2, group_sizes,
+        a = (_hidden_act(cfg, h1) * h3).astype(d2.dtype)
+        y = jax.lax.ragged_dot(a, d2, group_sizes,
                                preferred_element_type=jnp.float32)
         y = y[jnp.argsort(order)] * flat_w[:, None]    # unsort to [N*k]
     return jnp.sum(y.reshape(N, k, -1), axis=1).astype(x.dtype)
@@ -268,9 +327,19 @@ def _moe_ffn_sparse(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Arra
     # semantics (reference sliceColMatmul, nn-core.cpp:219-230) composed with
     # expert parallelism; previously a hidden-sharded mesh silently paid the
     # dense all-experts O(E) fallback (VERDICT r3 weak #3).
+    from ..ops.linear import QuantizedWeight
+
     hid_ax = plan.resolve("hidden")
     if hid_ax is not None and (plan._axis_size(hid_ax) == 1
                                or cfg.hidden_dim % plan._axis_size(hid_ax) != 0):
+        hid_ax = None
+    from ..formats.quants import QUANT_BLOCK_SIZE
+
+    if (hid_ax is not None and isinstance(lp.we2, QuantizedWeight)
+            and (cfg.hidden_dim // QUANT_BLOCK_SIZE)
+            % plan._axis_size(hid_ax) != 0):
+        # we2's scale plane is [E, H/32, D]: an H-shard must also divide the
+        # 32-element block axis or the scales can't split with the codes
         hid_ax = None
     e_local = cfg.n_experts // (plan._axis_size(ep_ax) if ep_ax else 1)
     red_axes = tuple(a for a in (ep_ax, hid_ax) if a is not None)
@@ -284,11 +353,28 @@ def _moe_ffn_sparse(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Arra
         y = _moe_sparse_local(cfg, x_l, idx_l, w_l, we1, we2, we3, e_lo, e_local)
         return wire_psum(y, red_axes, ax_sizes) if red_axes else y
 
+    def we_spec(we, *, hid_on_out: bool):
+        """Per-leaf PartitionSpecs for one expert-stack weight: the plane
+        axes are [E, in, out]; quantized scale planes shard like their codes
+        (the K/32 block axis follows K), turbo scales are [E, out]."""
+        from ..ops.turbo import TurboWeight
+
+        plane = (P(ep_ax, None, hid_ax) if hid_on_out
+                 else P(ep_ax, hid_ax, None))
+        if isinstance(we, QuantizedWeight):
+            return QuantizedWeight(scales=plane, codes=plane)
+        if isinstance(we, TurboWeight):
+            return TurboWeight(plane,
+                               P(ep_ax, hid_ax) if hid_on_out
+                               else P(ep_ax, None), we.a8)
+        return plane
+
     fn = jax.shard_map(
         local, mesh=plan.mesh,
         in_specs=(P(), P(), P(),
-                  P(ep_ax, None, hid_ax), P(ep_ax, hid_ax, None),
-                  P(ep_ax, None, hid_ax)),
+                  we_spec(lp.we1, hid_on_out=True),
+                  we_spec(lp.we2, hid_on_out=False),
+                  we_spec(lp.we3, hid_on_out=True)),
         out_specs=P(),
         check_vma=False)
     y = fn(x, idx2, w2, lp.we1, lp.we2, lp.we3)

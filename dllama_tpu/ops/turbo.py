@@ -84,22 +84,31 @@ def _derive_one(qw: QuantizedWeight):
 
 def derive_turbo(qw: QuantizedWeight, a8: bool = True,
                  free_source: bool = False) -> TurboWeight:
-    """Requantize a (possibly layer-stacked) Q40 weight to TurboWeight.
+    """Requantize a (possibly layer/expert-stacked) Q40 weight to TurboWeight.
 
-    Stacked planes convert one layer at a time (``lax.map``) so the dense
-    f32 intermediate is bounded by ONE layer's plane, not the whole stack
-    (an 8B stack would need ~30 GB dense).  ``free_source`` deletes the
-    source plane buffers right after the derived arrays materialize, so a
-    whole-tree conversion transiently holds at most one extra leaf, not a
-    second copy of the model (runtime.hbm charges that bound)."""
+    Stacked planes convert one (layer[, expert]) plane at a time
+    (``lax.map`` over the flattened leading axes) so the dense f32
+    intermediate is bounded by ONE plane, not the whole stack (an 8B stack
+    would need ~30 GB dense).  ``free_source`` deletes the source plane
+    buffers right after the derived arrays materialize, so a whole-tree
+    conversion transiently holds at most one extra leaf, not a second copy
+    of the model (runtime.hbm charges that bound)."""
     if qw.codes.ndim == 2:
         w8, scale = jax.jit(_derive_one)(qw)
     else:
+        lead = qw.codes.shape[:-2]  # [L] or [L, E] (MoE expert stacks)
+
         def one(args):
             return _derive_one(QuantizedWeight(scales=args[0], codes=args[1]))
 
-        w8, scale = jax.jit(
-            lambda s, c: jax.lax.map(one, (s, c)))(qw.scales, qw.codes)
+        def mapped(s, c):
+            s = s.reshape((-1,) + s.shape[len(lead):])
+            c = c.reshape((-1,) + c.shape[len(lead):])
+            w8_f, scale_f = jax.lax.map(one, (s, c))
+            return (w8_f.reshape(lead + w8_f.shape[1:]),
+                    scale_f.reshape(lead + scale_f.shape[1:]))
+
+        w8, scale = jax.jit(mapped)(qw.scales, qw.codes)
     if free_source:
         # fetch-forced sync, NOT block_until_ready: on the axon tunnel
         # block_until_ready returns without waiting for device execution
@@ -128,6 +137,18 @@ def turbo_params(params, a8: bool = True, free_source: bool = True):
         params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
 
 
+def quantize_activations_a8(x: jax.Array):
+    """Per-row int8 activation quantization (the Q80 idea at row
+    granularity): returns ``(xq int8, sx f32[..., 1])`` with
+    ``x ~= xq * sx``. The ONE implementation of the a8 prologue — both the
+    dense turbo matmul and the MoE gather-regime dot share it."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    sx = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+    xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+    return xq, sx
+
+
 def turbo_matmul(x: jax.Array, w: TurboWeight) -> jax.Array:
     """``y[..., N] = x[..., K] @ (w8 * scale)`` without per-element dequant.
 
@@ -137,10 +158,7 @@ def turbo_matmul(x: jax.Array, w: TurboWeight) -> jax.Array:
     the f32 epilogue."""
     out_dtype = x.dtype
     if w.a8:
-        xf = x.astype(jnp.float32)
-        amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)  # [..., 1]
-        sx = jnp.where(amax > 0.0, amax / 127.0, 1.0)
-        xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+        xq, sx = quantize_activations_a8(x)
         acc = jax.lax.dot_general(
             xq, w.w8,
             dimension_numbers=(((xq.ndim - 1,), (w.w8.ndim - 2,)), ((), ())),

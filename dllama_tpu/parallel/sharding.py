@@ -54,6 +54,29 @@ def _weight_sharding(plan: MeshPlan, w, out_axis: str | None, in_axis: str | Non
     return plan.sharding_for(tuple(w.shape), *lead, out_axis, in_axis)
 
 
+def _expert_sharding(plan: MeshPlan, we, in_axis, out_axis):
+    """Shardings for one [L, E, in, out] expert-stack weight, any repr.
+    Quantized scale planes shard like their codes (the K/32 block axis
+    follows the in axis); turbo scales are [L, E, out]."""
+    lead = ("layers", "experts")
+    if isinstance(we, QuantizedWeight):
+        return QuantizedWeight(
+            scales=plan.sharding_for(tuple(we.scales.shape), *lead,
+                                     in_axis, out_axis),
+            codes=plan.sharding_for(tuple(we.codes.shape), *lead,
+                                    in_axis, out_axis),
+        )
+    from ..ops.turbo import TurboWeight
+
+    if isinstance(we, TurboWeight):
+        return TurboWeight(
+            plan.sharding_for(tuple(we.w8.shape), *lead, in_axis, out_axis),
+            plan.sharding_for(tuple(we.scale.shape), *lead, out_axis),
+            we.a8,
+        )
+    return plan.sharding_for(tuple(we.shape), *lead, in_axis, out_axis)
+
+
 def param_shardings(plan: MeshPlan, params: "Params") -> "Params":
     """A Params-shaped tree of NamedShardings."""
     from ..models.llama import LayerParams, Params
@@ -76,15 +99,15 @@ def param_shardings(plan: MeshPlan, params: "Params") -> "Params":
         # MoE: experts over ep, expert-hidden over tp (new capability; the
         # reference has no runtime MoE, SURVEY.md §2.2). Expert weights are
         # in-major (ragged_dot layout, see LayerParams): we1/we3 [L,E,D,H],
-        # we2 [L,E,H,D].
+        # we2 [L,E,H,D] — any Weight repr (dense / quantized / turbo).
         moe_gate=None if lp.moe_gate is None else plan.sharding_for(
             tuple(lp.moe_gate.shape), "layers", "experts", None),
-        we1=None if lp.we1 is None else plan.sharding_for(
-            tuple(lp.we1.shape), "layers", "experts", None, "hidden"),
-        we2=None if lp.we2 is None else plan.sharding_for(
-            tuple(lp.we2.shape), "layers", "experts", "hidden", None),
-        we3=None if lp.we3 is None else plan.sharding_for(
-            tuple(lp.we3.shape), "layers", "experts", None, "hidden"),
+        we1=None if lp.we1 is None else _expert_sharding(
+            plan, lp.we1, None, "hidden"),
+        we2=None if lp.we2 is None else _expert_sharding(
+            plan, lp.we2, "hidden", None),
+        we3=None if lp.we3 is None else _expert_sharding(
+            plan, lp.we3, None, "hidden"),
     )
     return Params(
         embedding=plan.sharding(None, None),

@@ -310,13 +310,9 @@ class InferenceEngine:
         # pin the load-time quant-mode resolution: stored scale dtype, the
         # dense-vs-Q40 logits head, and turbo derivation were all decided by
         # DLLAMA_TPU_QUANT_MODE as it read HERE. _dispatch re-checks this
-        # label so an env flip after load fails loudly instead of silently
-        # running one mode's math over the other mode's stored weights
-        # (ADVICE r4: report-vs-dispatch drift).
-        from ..ops.linear import quant_mode_label
-
-        self._load_quant_label = quant_mode_label(
-            self.cfg.compute_dtype == "bfloat16")
+        # resolution so an env flip after load fails loudly instead of
+        # silently running one mode's math over the other mode's stored
+        # weights (ADVICE r4: report-vs-dispatch drift).
         self._load_quant_resolution = self._quant_resolution()
         self.kv: KVCache = self._fresh_kv()
         self.pos = 0

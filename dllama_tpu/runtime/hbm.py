@@ -104,8 +104,13 @@ def estimate_device_bytes(cfg, *, weight_repr: str, kv_dtype_bytes: int,
             dense_cols = cfg.hidden_dim
             if not dense_logits_resolved(getattr(cfg, "compute_dtype", "")):
                 dense_cols = max(dense_cols, cfg.vocab_size)
-            weights += (cfg.n_layers * cfg.dim * cfg.hidden_dim
-                        + 4 * cfg.dim * dense_cols)
+            # largest int8 leaf held twice during its derivation: for MoE
+            # that is an expert stack [L, E, dim, hidden] (experts quantize
+            # too); the dense f32 intermediate stays ONE plane (lax.map
+            # flattens the leading axes)
+            largest_leaf = cfg.n_layers * cfg.dim * cfg.hidden_dim * (
+                cfg.n_experts if cfg.is_moe else 1)
+            weights += largest_leaf + 4 * cfg.dim * dense_cols
     kv = 2 * cfg.n_layers * cfg.seq_len * cfg.kv_dim * batch * kv_dtype_bytes
     need = int(((weights + kv) / max(1, n_shards)) * _MARGIN) + _FIXED_OVERHEAD
     return {"weights_bytes": weights, "kv_bytes": kv,
