@@ -88,20 +88,26 @@ def _use_flash(cfg: ModelConfig, q_shape, kv_shape) -> bool:
     """Trace-time choice of the single-device attention kernel. Under a mesh
     plan the auto-sharder cannot partition a pallas_call — the TP path wraps
     the kernel in shard_map (flash_attention_sharded) and the SP path has its
-    own kernels (parallel/ring.py)."""
+    own kernels (parallel/ring.py). Exception: a PURE-pp mesh — inside the
+    manual pp shard_map with no other mesh axes every stage's arrays are
+    fully local, so the plain kernel applies per stage."""
     from ..parallel.api import current_plan
 
     if cfg.attn_impl not in ("auto", "xla", "flash"):
         raise ValueError(f"attn_impl must be auto|xla|flash, got {cfg.attn_impl!r}")
     if cfg.attn_impl == "xla":
         return False
+    plan = current_plan()
+    plan_ok = plan is None or (
+        plan.axis_size("pp") > 1
+        and all(plan.axis_size(a) == 1 for a in ("tp", "sp", "dp", "ep")))
     n_kv, s = kv_shape[1], kv_shape[2]
     ok = _fa.supports(q_shape, n_kv, s)
     if cfg.attn_impl == "flash":
         if not ok:
             raise ValueError(f"flash attention unsupported for q={q_shape}, S={s}")
-        return current_plan() is None
-    return ok and _fa.default_enabled() and current_plan() is None
+        return plan_ok
+    return ok and _fa.default_enabled() and plan_ok
 
 
 def _sharded_flash(cfg: ModelConfig, plan, q, k_cache, v_cache, start_pos):
@@ -119,11 +125,19 @@ def _sharded_flash(cfg: ModelConfig, plan, q, k_cache, v_cache, start_pos):
         # partition; per-stage attention uses the XLA oracle (validate_pp
         # rejects forced 'flash' up front)
         return None
-    if plan.axis_size("sp") > 1 and jnp.asarray(start_pos).ndim > 0:
-        # ragged decode under an sp mesh: the ring path owns sp attention
-        # but assumes affine positions, so per-row depths use the oracle —
-        # even when 'flash' is forced (this is the pre-ragged behavior, not
-        # a silently-missing kernel)
+    if plan.axis_size("sp") > 1:
+        # sp attention is owned by the ring path (parallel/ring.py); landing
+        # here means sp_attention declined the geometry (S % sp != 0, an
+        # irregular head split, or B % dp != 0) and the oracle serves the
+        # fallback — which a forced 'flash' must surface, not paper over
+        if cfg.attn_impl == "flash":
+            raise ValueError(
+                f"attn_impl='flash' forced but the sp ring path declined "
+                f"this geometry (plan axes "
+                f"{dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))}, "
+                f"q={q.shape}, kv={k_cache.shape}; needs S % sp == 0, a "
+                f"regular head split, and B % dp == 0) — drop attn_impl or "
+                f"use 'auto'")
         return None
     force = cfg.attn_impl == "flash"
     if not force and not _fa.default_enabled():
@@ -135,7 +149,8 @@ def _sharded_flash(cfg: ModelConfig, plan, q, k_cache, v_cache, start_pos):
         raise ValueError(
             f"attn_impl='flash' forced but the sharded kernel does not apply "
             f"(plan axes {dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))}, "
-            f"q={q.shape}, kv={k_cache.shape}; kv-replication groups and "
+            f"q={q.shape}, kv={k_cache.shape}; irregular q-head/kv-group "
+            f"splits (tp % n_kv != 0 with n_kv % tp != 0) and "
             f"non-128-multiple cache lengths use the XLA oracle — drop "
             f"attn_impl or use 'auto')")
     return res
@@ -354,20 +369,17 @@ def _moe_ffn_sparse(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Arra
         return wire_psum(y, red_axes, ax_sizes) if red_axes else y
 
     def we_spec(we, *, hid_on_out: bool):
-        """Per-leaf PartitionSpecs for one expert-stack weight: the plane
-        axes are [E, in, out]; quantized scale planes shard like their codes
-        (the K/32 block axis follows K), turbo scales are [E, out]."""
-        from ..ops.turbo import TurboWeight
+        """Per-leaf PartitionSpecs for one expert-stack weight [E, in, out]:
+        the per-repr plane layout comes from the ONE place that defines it
+        (parallel.sharding.map_expert_weight), with the logical "hidden"
+        axis resolved to this mesh's hid_ax."""
+        from ..parallel.sharding import map_expert_weight
 
-        plane = (P(ep_ax, None, hid_ax) if hid_on_out
-                 else P(ep_ax, hid_ax, None))
-        if isinstance(we, QuantizedWeight):
-            return QuantizedWeight(scales=plane, codes=plane)
-        if isinstance(we, TurboWeight):
-            return TurboWeight(plane,
-                               P(ep_ax, hid_ax) if hid_on_out
-                               else P(ep_ax, None), we.a8)
-        return plane
+        in_ax, out_ax = (None, "hidden") if hid_on_out else ("hidden", None)
+        return map_expert_weight(
+            we, in_ax, out_ax,
+            lambda _leaf, axes: P(ep_ax, *(hid_ax if a == "hidden" else None
+                                           for a in axes)))
 
     fn = jax.shard_map(
         local, mesh=plan.mesh,
@@ -438,10 +450,13 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     sp_res = None
     plan = _current_plan()
     if plan is not None and plan.axis_size("sp") > 1 \
-            and plan.axis_size("pp") == 1 \
-            and not ragged:  # sp×pp nesting / sp×ragged unsupported
+            and plan.axis_size("pp") == 1:  # sp×pp nesting unsupported
         from ..parallel.ring import sp_attention
 
+        # ragged rides the same ring/merge paths: positions are affine
+        # WITHIN each batch row, which is all the per-row kernel pos table
+        # and the [B, T] masks assume; the per-slot append depths shard
+        # with the batch rows
         sp_res = sp_attention(plan, q, k_cache, v_cache, k, v, positions,
                               start_pos, cfg.head_dim, attn_impl=cfg.attn_impl)
     if sp_res is not None:
@@ -454,8 +469,12 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
                if plan is not None else None)
         if att is None:
             if _use_flash(cfg, q.shape, k_cache.shape):
-                att = flash_attention(q, k_cache, v_cache, start_pos,
-                                      cfg.head_dim)
+                # forced 'flash' off-TPU runs the kernel in interpret mode
+                # (the test path, same rule _sharded_flash applies)
+                att = flash_attention(
+                    q, k_cache, v_cache, start_pos, cfg.head_dim,
+                    interpret=(cfg.attn_impl == "flash"
+                               and not _fa.default_enabled()))
             else:
                 att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
     att = constrain(att, "batch", None, "heads", None)

@@ -256,15 +256,39 @@ def flash_attention_sharded(plan, q: jax.Array, k_cache: jax.Array,
     tp = plan.axis_size("tp")
     if plan.axis_size("sp") > 1 or tp <= 1:
         return None
-    if H % tp != 0 or n_kv % tp != 0:
-        return None  # kv replication groups: oracle path handles those
-    if not supports((B, T, H // tp, D), n_kv // tp, S):
+    if H % tp != 0:
+        return None
+    # kv replication groups (tp > n_kv_heads — the v5e-16 70B shape): the
+    # cache stays replicated across tp (kv_cache_sharding's divisibility
+    # fallback) and each device slices out the ONE kv head its q-head shard
+    # maps to. Requires tp % n_kv == 0 so every device's q heads land in a
+    # single group; an irregular split keeps the oracle.
+    repl = n_kv % tp != 0
+    if repl and tp % n_kv != 0:
+        return None
+    n_kv_l = 1 if repl else n_kv // tp
+    if not supports((B, T, H // tp, D), n_kv_l, S):
         return None
     dp_ax = plan.resolve("batch") if B % plan.axis_size("dp") == 0 else None
 
-    def local(q_l, k_l, v_l, sp0):
-        return flash_attention(q_l, k_l, v_l, sp0, head_dim,
-                               interpret=interpret)
+    if repl:
+        grp = H // n_kv   # q heads per kv head
+        h_loc = H // tp
+
+        def local(q_l, k_l, v_l, sp0):
+            g = (jax.lax.axis_index("tp") * h_loc) // grp
+            k_s = jax.lax.dynamic_slice_in_dim(k_l, g, 1, axis=1)
+            v_s = jax.lax.dynamic_slice_in_dim(v_l, g, 1, axis=1)
+            return flash_attention(q_l, k_s, v_s, sp0, head_dim,
+                                   interpret=interpret)
+
+        kv_spec = P(dp_ax, None, None, None)
+    else:
+        def local(q_l, k_l, v_l, sp0):
+            return flash_attention(q_l, k_l, v_l, sp0, head_dim,
+                                   interpret=interpret)
+
+        kv_spec = P(dp_ax, "tp", None, None)
 
     start_pos = jnp.asarray(start_pos, dtype=jnp.int32)
     # scalar start_pos replicates; a [B] vector (ragged batched serving)
@@ -272,8 +296,7 @@ def flash_attention_sharded(plan, q: jax.Array, k_cache: jax.Array,
     pos_spec = P(dp_ax) if start_pos.ndim else P()
     fn = jax.shard_map(
         local, mesh=plan.mesh,
-        in_specs=(P(dp_ax, None, "tp", None), P(dp_ax, "tp", None, None),
-                  P(dp_ax, "tp", None, None), pos_spec),
+        in_specs=(P(dp_ax, None, "tp", None), kv_spec, kv_spec, pos_spec),
         out_specs=P(dp_ax, None, "tp", None),
         check_vma=False,
     )

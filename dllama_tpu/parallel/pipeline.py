@@ -191,14 +191,19 @@ def pp_forward(plan: "MeshPlan", cfg: "ModelConfig", params, tokens, start_pos,
     return logits, KVCache(k=new_k, v=new_v)
 
 
-def validate_pp(cfg: "ModelConfig", pp: int) -> None:
+def validate_pp(cfg: "ModelConfig", pp: int, tp: int = 1, dp: int = 1) -> None:
     """Pipeline divisibility and composition rules."""
     if cfg.n_layers % pp != 0:
         raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp={pp}")
     if cfg.offload:
         raise ValueError("pp does not compose with --weight-mode offload yet "
                          "(per-stage host streaming is future work)")
-    if cfg.attn_impl == "flash":
+    if cfg.attn_impl == "flash" and (tp > 1 or dp > 1):
+        # pure pp is fine: inside the manual pp shard_map every stage's
+        # arrays are fully local, so the plain kernel runs per stage
+        # (models.llama._use_flash); with tp/dp auto axes inside the manual
+        # region a pallas_call can't partition
         raise ValueError(
-            "attn_impl='flash' under pp is unsupported (the Pallas kernel "
-            "can't nest inside the manual pp shard_map); use 'auto' or 'xla'")
+            "attn_impl='flash' under pp×(tp|dp) is unsupported (the Pallas "
+            "kernel can't nest inside the manual pp shard_map with auto "
+            "axes); use 'auto' or 'xla', or pure pp")

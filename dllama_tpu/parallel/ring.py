@@ -94,14 +94,15 @@ def _finish(acc, l, dtype):
 
 
 def _scatter_rows(cache: jax.Array, rows: jax.Array, local_idx: jax.Array) -> jax.Array:
-    """Write ``rows: [B, n_kv, T, hd]`` into ``cache: [B, n_kv, Sl, hd]`` at
-    per-row indices ``local_idx: [T]``; out-of-range rows are dropped (they
-    belong to another shard)."""
-    s_local = cache.shape[2]
+    """Write ``rows: [..., n_kv, T, hd]`` into ``cache: [..., n_kv, Sl, hd]``
+    at per-row indices ``local_idx: [T]``; out-of-range rows are dropped
+    (they belong to another shard). Rank-agnostic on the leading axes so the
+    ragged path can vmap it over the batch."""
+    s_local = cache.shape[-2]
     in_range = (local_idx >= 0) & (local_idx < s_local)
     # map out-of-range to an OOB index so mode="drop" discards them
     safe_idx = jnp.where(in_range, local_idx, s_local)
-    return cache.at[:, :, safe_idx, :].set(rows.astype(cache.dtype), mode="drop")
+    return cache.at[..., safe_idx, :].set(rows.astype(cache.dtype), mode="drop")
 
 
 def _append_kv(k_shard, v_shard, new_k, new_v, start_pos, t_global,
@@ -109,16 +110,22 @@ def _append_kv(k_shard, v_shard, new_k, new_v, start_pos, t_global,
     """Inside shard_map: append the step's K/V rows into the seq-sharded cache.
 
     ``new_k/new_v: [B, T_local, n_kv_local, hd]`` time-major (T_local =
-    T_global/n_sp when queries are sharded, else T_global replicated)."""
+    T_global/n_sp when queries are sharded, else T_global replicated).
+    ``start_pos`` is a scalar, or a ``[B]`` vector for ragged batched
+    serving (each slot appends at its own depth)."""
     idx = lax.axis_index(AXIS)
     s_local = k_shard.shape[2]
     if q_sharded and n_sp > 1:
         new_k = lax.all_gather(new_k, AXIS, axis=1, tiled=True)
         new_v = lax.all_gather(new_v, AXIS, axis=1, tiled=True)
-    row_pos = start_pos + jnp.arange(t_global, dtype=jnp.int32)   # [T_global]
-    local_idx = row_pos - idx * s_local
     k_rows = jnp.swapaxes(new_k, 1, 2)   # [B, n_kv, T, hd]
     v_rows = jnp.swapaxes(new_v, 1, 2)
+    steps = jnp.arange(t_global, dtype=jnp.int32)
+    if jnp.asarray(start_pos).ndim:      # ragged: per-batch-row depths
+        local_idx = (start_pos[:, None] + steps[None, :]) - idx * s_local
+        scat = jax.vmap(_scatter_rows, in_axes=(0, 0, 0))
+        return scat(k_shard, k_rows, local_idx), scat(v_shard, v_rows, local_idx)
+    local_idx = (start_pos + steps) - idx * s_local   # [T_global]
     return (_scatter_rows(k_shard, k_rows, local_idx),
             _scatter_rows(v_shard, v_rows, local_idx))
 
@@ -171,10 +178,12 @@ def _ring_attention_local(qg, k_shard, v_shard, q_positions, head_dim: int,
         # after r forward rotations this block originated on rank (idx - r)
         src = jnp.mod(idx - r, n_sp)
         if use_kernel:
-            # model positions are affine (start_pos + arange), so row 0's
-            # position fully determines the causal mask inside the kernel
+            # positions are affine WITHIN each batch row (start + t), so the
+            # per-row first position fully determines the causal mask inside
+            # the kernel (its pos table is per batch row — ragged serving's
+            # per-slot depths ride the same table)
             bacc, bm, bl = _kernel_block_stats(
-                qg, k, v, q_positions[0, 0], src * s_local, head_dim, interpret)
+                qg, k, v, q_positions[:, 0], src * s_local, head_dim, interpret)
         else:
             kv_pos = src * s_local + jnp.arange(s_local, dtype=jnp.int32)
             mask = kv_pos[None, None, :] <= q_positions[:, :, None]
@@ -205,7 +214,7 @@ def _merge_attention_local(qg, k_shard, v_shard, q_positions, head_dim: int,
     idx = lax.axis_index(AXIS)
     if use_kernel:
         acc, m, l = _kernel_block_stats(qg, k_shard, v_shard,
-                                        q_positions[0, 0], idx * s_local,
+                                        q_positions[:, 0], idx * s_local,
                                         head_dim, interpret)
     else:
         kv_pos = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
@@ -326,12 +335,15 @@ def sp_attention(plan: "MeshPlan", q: jax.Array, k_cache: jax.Array,
         out = _finish(acc, l, q_l.dtype).reshape(Bl, Tl, Hl, hd)
         return out, k_l, v_l
 
+    start_pos = jnp.asarray(start_pos, dtype=jnp.int32)
+    # scalar start_pos replicates; a [B] vector (ragged batched serving:
+    # per-slot depths) shards with the batch rows
+    sp0_spec = P(dp_ax) if start_pos.ndim else P()
     fn = jax.shard_map(
         local_fn, mesh=mesh,
         in_specs=(q_spec, cache_spec, cache_spec, new_spec, new_spec,
-                  pos_spec, P()),
+                  pos_spec, sp0_spec),
         out_specs=(q_spec, cache_spec, cache_spec),
         check_vma=False,
     )
-    return fn(q, k_cache, v_cache, new_k, new_v, positions,
-              start_pos.astype(jnp.int32))
+    return fn(q, k_cache, v_cache, new_k, new_v, positions, start_pos)

@@ -54,27 +54,33 @@ def _weight_sharding(plan: MeshPlan, w, out_axis: str | None, in_axis: str | Non
     return plan.sharding_for(tuple(w.shape), *lead, out_axis, in_axis)
 
 
-def _expert_sharding(plan: MeshPlan, we, in_axis, out_axis):
-    """Shardings for one [L, E, in, out] expert-stack weight, any repr.
-    Quantized scale planes shard like their codes (the K/32 block axis
-    follows the in axis); turbo scales are [L, E, out]."""
-    lead = ("layers", "experts")
+def map_expert_weight(we, in_axis, out_axis, f):
+    """Rebuild an expert-stack weight by applying ``f(leaf, plane_axes)`` to
+    each leaf, where ``plane_axes`` are the logical axis names of the leaf's
+    PLANE dims (the leading ``[L?, E]`` axes are the caller's concern).
+
+    THE single statement of per-repr expert plane layout — quantized scale
+    planes shard like their codes (the K/32 block axis follows the in axis),
+    turbo scales are ``[..., out]`` — consumed by both the NamedSharding
+    builder below and the shard_map in_specs in models.llama, so the two
+    can't drift apart."""
     if isinstance(we, QuantizedWeight):
-        return QuantizedWeight(
-            scales=plan.sharding_for(tuple(we.scales.shape), *lead,
-                                     in_axis, out_axis),
-            codes=plan.sharding_for(tuple(we.codes.shape), *lead,
-                                    in_axis, out_axis),
-        )
+        return QuantizedWeight(scales=f(we.scales, (in_axis, out_axis)),
+                               codes=f(we.codes, (in_axis, out_axis)))
     from ..ops.turbo import TurboWeight
 
     if isinstance(we, TurboWeight):
-        return TurboWeight(
-            plan.sharding_for(tuple(we.w8.shape), *lead, in_axis, out_axis),
-            plan.sharding_for(tuple(we.scale.shape), *lead, out_axis),
-            we.a8,
-        )
-    return plan.sharding_for(tuple(we.shape), *lead, in_axis, out_axis)
+        return TurboWeight(f(we.w8, (in_axis, out_axis)),
+                           f(we.scale, (out_axis,)), we.a8)
+    return f(we, (in_axis, out_axis))
+
+
+def _expert_sharding(plan: MeshPlan, we, in_axis, out_axis):
+    """Shardings for one [L, E, in, out] expert-stack weight, any repr."""
+    return map_expert_weight(
+        we, in_axis, out_axis,
+        lambda leaf, axes: plan.sharding_for(
+            tuple(leaf.shape), "layers", "experts", *axes))
 
 
 def param_shardings(plan: MeshPlan, params: "Params") -> "Params":

@@ -207,11 +207,18 @@ class InferenceEngine:
                 f"mesh dp={dp} sp={sp} pp={pp} tp={tp or 1} needs "
                 f"{dp * sp * pp * (tp or 1)} devices, found {n_dev}")
         if tp is None:
-            # largest power-of-2 device count the model's shapes accept
-            # (after reserving the sp and pp axes)
-            tp = 1
-            while (dp * pp * sp * tp * 2 <= n_dev and _tp_ok(self.cfg, tp * 2)):
-                tp *= 2
+            if pp > 1 and dp == 1 and self.cfg.attn_impl == "flash":
+                # pure pp is the ONE pp layout that composes with a forced
+                # flash kernel (validate_pp); auto-widening tp here would
+                # turn the user's request into an error
+                tp = 1
+            else:
+                # largest power-of-2 device count the model's shapes accept
+                # (after reserving the sp and pp axes)
+                tp = 1
+                while (dp * pp * sp * tp * 2 <= n_dev
+                       and _tp_ok(self.cfg, tp * 2)):
+                    tp *= 2
         self.tp, self.sp, self.pp, self.dp = tp, sp, pp, dp
         if sp > 1 and self.cfg.seq_len % sp != 0:
             # sp = sequence parallelism: KV cache seq-sharded, ring attention
@@ -225,7 +232,7 @@ class InferenceEngine:
             # another new capability (SURVEY.md §2.2: reference has none)
             from ..parallel.pipeline import validate_pp
 
-            validate_pp(self.cfg, pp)
+            validate_pp(self.cfg, pp, tp=tp, dp=dp)
             if sp > 1:
                 raise ValueError("pp does not compose with sp yet "
                                  "(nested shard_maps)")

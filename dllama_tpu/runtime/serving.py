@@ -128,16 +128,14 @@ class BatchedGenerator:
 
     def __init__(self, engine: "InferenceEngine", n_slots: int = 4, *,
                  _mirror: bool = False):
-        if engine.sp > 1 or engine.pp > 1:
+        if engine.pp > 1:
             raise ValueError(
-                "batched serving composes with tp/dp only. sp's ring "
-                "attention assumes batch-affine positions (parallel/ring.py "
-                "uses row 0's position as the block-mask base and appends KV "
-                "at one scalar start), which ragged per-slot positions "
-                "break — and sp targets long-context single streams while "
-                "serving scales with dp, so shard the slot pool with --dp "
-                "instead. pp's microbatch schedule likewise assumes one "
-                "position per stage step.")
+                "batched serving composes with tp/dp/sp, not pp: pp's "
+                "microbatch schedule assumes one position per stage step, "
+                "which ragged per-slot positions break — shard the slot "
+                "pool with --dp instead. (sp composes: the ring/merge paths "
+                "carry per-row depths in their per-batch-row position "
+                "tables and append KV at per-slot starts, parallel/ring.py.)")
         if getattr(engine, "dp", 1) > 1 and n_slots % engine.dp != 0:
             raise ValueError(
                 f"--batch-slots {n_slots} must divide over dp={engine.dp} "

@@ -46,12 +46,40 @@ def test_sharded_flash_matches_oracle(mesh_axes, B, T):
 
 def test_sharded_flash_declines_unsupported():
     plan = make_mesh({"tp": 8})
-    q = jnp.zeros((1, 1, 8, 16))
-    kv = jnp.zeros((1, 4, 128, 16))  # n_kv=4 not divisible by tp=8
+    # irregular q-head/kv-group split: n_kv=3 with tp=8 (neither divides)
+    q = jnp.zeros((1, 1, 24, 16))
+    kv = jnp.zeros((1, 3, 128, 16))
     assert flash_attention_sharded(plan, q, kv, kv, jnp.int32(0), 16) is None
     plan2 = make_mesh({"sp": 2, "tp": 2})  # sp path owns attention
+    q2 = jnp.zeros((1, 1, 8, 16))
     kv2 = jnp.zeros((1, 4, 128, 16))
-    assert flash_attention_sharded(plan2, q, kv2, kv2, jnp.int32(0), 16) is None
+    assert flash_attention_sharded(plan2, q2, kv2, kv2, jnp.int32(0), 16) is None
+
+
+@pytest.mark.parametrize("B,T,tp,n_kv", [
+    (1, 1, 8, 4),   # decode, 2 devices per kv group
+    (1, 4, 4, 2),   # prefill chunk, replication groups
+    (2, 1, 8, 2),   # 4 devices per group
+])
+def test_sharded_flash_kv_replication_groups(B, T, tp, n_kv):
+    """tp > n_kv_heads (the v5e-16 70B shape): the cache stays replicated
+    and each device slices its q-head shard's single kv head — parity with
+    the oracle (VERDICT r4 next #6)."""
+    H, S, hd = 16, 128, 16
+    start_pos = 16
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)), dtype=jnp.float32)
+    k_cache = jnp.asarray(rng.standard_normal((B, n_kv, S, hd)), dtype=jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((B, n_kv, S, hd)), dtype=jnp.float32)
+    positions = start_pos + jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    want = attention(q, k_cache, v_cache, positions, hd)
+    plan = make_mesh({"tp": tp})
+    got = flash_attention_sharded(plan, q, k_cache, v_cache,
+                                  jnp.int32(start_pos), hd, interpret=True)
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_forward_tp_with_forced_flash_matches_unsharded():
@@ -82,17 +110,18 @@ def test_forward_tp_with_forced_flash_matches_unsharded():
 
 
 def test_forced_flash_under_unsupported_plan_raises():
-    """attn_impl='flash' under a plan the sharded kernel can't take (kv
-    heads not divisible by tp → replication groups) must fail loudly, not
+    """attn_impl='flash' under a plan the sharded kernel can't take (an
+    IRREGULAR q-head/kv-group split: neither n_kv % tp nor tp % n_kv is 0,
+    so a device's q heads straddle kv groups) must fail loudly, not
     silently run the oracle (advisor round-1 finding)."""
     cfg = ModelConfig(
         arch=mfile.ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2,
-        n_heads=8, n_kv_heads=2, head_dim=8, vocab_size=128, seq_len=128,
+        n_heads=24, n_kv_heads=3, head_dim=8, vocab_size=128, seq_len=128,
         norm_epsilon=1e-5, rope_theta=10000.0, rope_type=mfile.RopeType.LLAMA,
         attn_impl="flash")
     params = init_random_params(cfg, seed=1)
     tokens = jnp.asarray([[3, 1]], dtype=jnp.int32)
-    plan = make_tp_mesh(8)  # n_kv=2 % 8 != 0: kernel declines
+    plan = make_tp_mesh(8)  # n_kv=3, tp=8: neither divides — kernel declines
     sharded = shard_params(plan, params)
     kv0 = KVCache.create(cfg)
     kv = jax.device_put(kv0, kv_cache_sharding(plan, kv0))

@@ -104,8 +104,13 @@ def test_validate_pp_rules():
 
     with pytest.raises(ValueError, match="offload"):
         validate_pp(replace(_cfg(), offload=True), 2)
+    # pure pp composes with flash (per-stage plain kernel); pp×tp / pp×dp
+    # cannot nest the pallas_call inside the manual shard_map
+    validate_pp(replace(_cfg(), attn_impl="flash"), 2)
     with pytest.raises(ValueError, match="flash"):
-        validate_pp(replace(_cfg(), attn_impl="flash"), 2)
+        validate_pp(replace(_cfg(), attn_impl="flash"), 2, tp=2)
+    with pytest.raises(ValueError, match="flash"):
+        validate_pp(replace(_cfg(), attn_impl="flash"), 2, dp=2)
 
 
 @pytest.fixture(scope="module")
@@ -167,3 +172,29 @@ def test_pp_microbatch_schedule_matches_unsharded(pp, B):
             sharded, cfg, nxt2, jnp.int32(6), kv)
     np.testing.assert_allclose(np.asarray(logits2), np.asarray(ref_logits2),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_pp_forward_with_forced_flash_matches_oracle():
+    """Pure pp composes with the flash kernel: inside the manual pp
+    shard_map each stage's arrays are fully local, so the plain kernel runs
+    per stage (VERDICT r4 next #6). Forced + interpret off-TPU."""
+    cfg = _cfg(seq_len=128, attn_impl="flash")
+    params = init_random_params(cfg, seed=6)
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 8)), dtype=jnp.int32)
+
+    from dataclasses import replace
+
+    cfg_oracle = replace(cfg, attn_impl="xla")
+    ref, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg_oracle, prompt, jnp.int32(0), KVCache.create(cfg_oracle))
+
+    plan = make_mesh({"pp": 2})
+    sharded = shard_params(plan, params)
+    kv0 = KVCache.create(cfg)
+    kv = jax.device_put(kv0, kv_cache_sharding(plan, kv0))
+    with use_plan(plan):
+        got, _ = jax.jit(forward, static_argnums=1)(
+            sharded, cfg, prompt, jnp.int32(0), kv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

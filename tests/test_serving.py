@@ -680,3 +680,39 @@ def test_batched_under_turbo_matches_solo(tmp_path_factory, monkeypatch):
         gen.step()
     for r, w in zip(reqs, want):
         assert r.tokens == w, r.rid
+
+
+def test_batched_under_sp_matches_solo(tmp_path_factory):
+    """Batched serving under an sp mesh (ragged per-slot depths through the
+    ring/merge attention paths, parallel/ring.py): every request equals its
+    solo unsharded run (VERDICT r4 next #6 — sp×ragged was an oracle-only
+    hole)."""
+    d = tmp_path_factory.mktemp("serving_sp")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(43))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+
+    cases = [("hello world", dict(temperature=0.0, seed=1)),
+             ("hello", dict(temperature=0.8, seed=2)),
+             (" world", dict(temperature=0.0, seed=3))]
+    want = []
+    for p, s in cases:
+        e = InferenceEngine(str(mpath), str(tpath), tp=1, **s)
+        want.append(e.generate(p, 8, stop_on_eos=False).tokens)
+        e.close()
+
+    eng = InferenceEngine(str(mpath), str(tpath), sp=2, tp=2)
+    gen = BatchedGenerator(eng, n_slots=3)
+    reqs = []
+    for i, (p, s) in enumerate(cases):
+        ids = eng.tokenizer.encode(p, is_start=True)
+        r = Request(rid=i, prompt_ids=ids, max_tokens=8, stop_on_eos=False,
+                    topp=0.9, **s)
+        gen.admit(r, i)
+        reqs.append(r)
+    while gen.n_active:
+        gen.step()
+    for r, w in zip(reqs, want):
+        assert r.tokens == w, r.rid
+    eng.close()
