@@ -446,7 +446,6 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     q = apply_rope(q, cos, sin, positions, cfg.rope_type)
     k = apply_rope(k, cos, sin, positions, cfg.rope_type)
 
-    ragged = start_pos.ndim > 0  # per-row positions (batched serving)
     sp_res = None
     plan = _current_plan()
     if plan is not None and plan.axis_size("sp") > 1 \
@@ -638,11 +637,10 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     ragged = start_pos.ndim > 0
     plan = _current_plan()
     if plan is not None and plan.axis_size("pp") > 1:
-        if ragged:
-            raise ValueError("per-row positions (batched serving) do not "
-                             "compose with pp yet")
         # pipeline parallelism: layer stack sharded over pp, stages hand the
-        # activation along the ring (parallel/pipeline.py — new capability)
+        # activation along the ring (parallel/pipeline.py — new capability).
+        # Ragged [B] start_pos (batched serving) rides along: each stage's
+        # _layer_step gets the per-row depths.
         from ..parallel.pipeline import pp_forward
 
         return pp_forward(plan, cfg, params, tokens, start_pos, kv)

@@ -79,24 +79,28 @@ def pp_forward(plan: "MeshPlan", cfg: "ModelConfig", params, tokens, start_pos,
     x0 = constrain(x0, "batch", None, None)
 
     cos, sin = build_rope_cache(cfg)
-    positions = start_pos + jnp.arange(T, dtype=jnp.int32)[None, :]
+    start_pos = jnp.asarray(start_pos, dtype=jnp.int32)
+    ragged = start_pos.ndim > 0   # [B] per-slot depths (batched serving)
+    positions = ((start_pos[:, None] if ragged else start_pos)
+                 + jnp.arange(T, dtype=jnp.int32)[None, :])
     positions = jnp.broadcast_to(positions, (B, T))
     perm = [(i, (i + 1) % n_pp) for i in range(n_pp)]
 
-    # GPipe microbatching: with B divisible by n_pp (and per-row positions
-    # not in play), the batch splits into n_pp microbatches that flow through
-    # the stages concurrently — stage d works on microbatch j-d at tick j, so
-    # utilization is M/(M+n_pp-1) instead of the sequential schedule's 1/n_pp.
+    # GPipe microbatching: with B divisible by n_pp the batch splits into
+    # n_pp microbatches that flow through the stages concurrently — stage d
+    # works on microbatch j-d at tick j, so utilization is M/(M+n_pp-1)
+    # instead of the sequential schedule's 1/n_pp. Ragged per-row depths
+    # ride along: each microbatch carries its own position/start rows.
     microbatched = n_pp > 1 and B % n_pp == 0
 
     def local(x, layers_l, k_l, v_l, cos, sin, sp0, pos):
         stage = lax.axis_index(AXIS)
 
-        def run_layers(x, k, v, pos_rows):
+        def run_layers(x, k, v, pos_rows, sp0_rows):
             def body(xc, xs):
                 lp, k1, v1 = xs
                 xo, k1, v1 = _layer_step(cfg, xc, lp, k1, v1, cos, sin,
-                                         sp0, pos_rows)
+                                         sp0_rows, pos_rows)
                 return xo, (k1, v1)
 
             x, (k, v) = lax.scan(body, x, (layers_l, k, v))
@@ -120,10 +124,12 @@ def pp_forward(plan: "MeshPlan", cfg: "ModelConfig", params, tokens, start_pos,
                 k_mb = lax.dynamic_slice_in_dim(k_l, row0, mbs, axis=1)
                 v_mb = lax.dynamic_slice_in_dim(v_l, row0, mbs, axis=1)
                 pos_mb = lax.dynamic_slice_in_dim(pos, row0, mbs, axis=0)
+                sp0_mb = (lax.dynamic_slice_in_dim(sp0, row0, mbs, axis=0)
+                          if ragged else sp0)
 
                 def run(c):
                     x_use, k_mb, v_mb = c
-                    return run_layers(x_use, k_mb, v_mb, pos_mb)
+                    return run_layers(x_use, k_mb, v_mb, pos_mb, sp0_mb)
 
                 x_new, k_new, v_new = lax.cond(
                     active, run, lambda c: c, (x_use, k_mb, v_mb))
@@ -152,7 +158,7 @@ def pp_forward(plan: "MeshPlan", cfg: "ModelConfig", params, tokens, start_pos,
 
         def run(carry):
             x, k_l, v_l = carry
-            return run_layers(x, k_l, v_l, pos)
+            return run_layers(x, k_l, v_l, pos, sp0)
 
         def tick(s, carry):
             x, k_l, v_l = carry
@@ -176,12 +182,13 @@ def pp_forward(plan: "MeshPlan", cfg: "ModelConfig", params, tokens, start_pos,
         in_specs=(_repl_specs(x0), _lead_pp_specs(params.layers),
                   P(AXIS, None, None, None, None),
                   P(AXIS, None, None, None, None),
-                  _repl_specs(cos), _repl_specs(sin), P(), _repl_specs(positions)),
+                  _repl_specs(cos), _repl_specs(sin),
+                  P(None) if ragged else P(), _repl_specs(positions)),
         out_specs=(_repl_specs(x0), P(AXIS, None, None, None, None),
                    P(AXIS, None, None, None, None)),
         axis_names={AXIS}, check_vma=False)
     x, new_k, new_v = fn(x0, params.layers, kv.k, kv.v, cos, sin,
-                         jnp.int32(start_pos), positions)
+                         start_pos, positions)
 
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
     if cfg.sync_q80:

@@ -128,14 +128,6 @@ class BatchedGenerator:
 
     def __init__(self, engine: "InferenceEngine", n_slots: int = 4, *,
                  _mirror: bool = False):
-        if engine.pp > 1:
-            raise ValueError(
-                "batched serving composes with tp/dp/sp, not pp: pp's "
-                "microbatch schedule assumes one position per stage step, "
-                "which ragged per-slot positions break — shard the slot "
-                "pool with --dp instead. (sp composes: the ring/merge paths "
-                "carry per-row depths in their per-batch-row position "
-                "tables and append KV at per-slot starts, parallel/ring.py.)")
         if getattr(engine, "dp", 1) > 1 and n_slots % engine.dp != 0:
             raise ValueError(
                 f"--batch-slots {n_slots} must divide over dp={engine.dp} "
