@@ -1,0 +1,41 @@
+# The machine-readable "this is how you run it" surface (the reference
+# encodes the same contract in .github/workflows/main.yml:28-63: build the
+# test binaries, run each on every platform).
+#
+#   make test       CPU tier: the full suite (incl. @slow macbeth-scale
+#                   transcripts) on the 8-device virtual CPU mesh
+#                   (tests/conftest.py forces the platform) — every
+#                   sharding/collective path, no hardware needed.
+#   make test-tpu   Hardware tier: @tpu-marked kernel/numerics tests on the
+#                   real chip (compiles actual Pallas kernels).
+#   make test-all   Both CPU tiers, then the TPU tier if a chip answers.
+#   make native     Build the C++ host-runtime library (quant codecs, BPE).
+#   make bench      The driver's benchmark: ONE JSON line on stdout.
+#   make graft      Compile-check the jittable entry + the 8-device
+#                   multi-chip dry run (tp/pp/dp/sp/ep shardings).
+
+PY ?= python
+
+.PHONY: test test-tpu test-all native bench graft clean
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+test-tpu:
+	DLLAMA_TESTS_TPU=1 $(PY) -m pytest tests/ -m tpu -q
+
+test-all: test test-tpu
+
+native:
+	$(PY) -c 'from dllama_tpu import native; print(native.get_lib() or "native build unavailable (g++ missing?)")'
+
+bench:
+	$(PY) bench.py
+
+graft:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) __graft_entry__.py
+
+clean:
+	$(MAKE) -C dllama_tpu/native clean
+	rm -rf build dist *.egg-info

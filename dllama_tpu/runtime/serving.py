@@ -160,13 +160,14 @@ class BatchedGenerator:
         # KV per device: the slot pool is dp-sharded (enforced above), so a
         # device holds n_slots/dp columns — plus ONE more for the engine's
         # still-resident batch-1 cache (engine.kv stays allocated alongside
-        # the pool); weights shard over tp only (pp is rejected above, dp
+        # the pool); weights and the layer-stacked KV shard over tp×pp
+        # (same n_shards the engine's own load-time check uses; dp
         # replicates weights)
         est = estimate_device_bytes(
             self.cfg, weight_repr=getattr(engine, "hbm_weight_repr", "q40"),
             kv_dtype_bytes=engine.kv_dtype.itemsize,
             batch=n_slots // max(1, getattr(engine, "dp", 1)) + 1,
-            n_shards=engine.tp,
+            n_shards=engine.tp * engine.pp,
             offload=(engine.weight_mode == "offload"))
         check_budget(est["need_per_device"],
                      f"batched serving ({n_slots} slots)")

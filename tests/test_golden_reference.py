@@ -65,17 +65,8 @@ def test_transcript_matches_reference(variant, tp, tmp_path):
         data = golden_assets.word_vocab_tokenizer()
         assert ids == [data.bos_id] + list(range(1, 9))
 
-        # reproduce the reference driver: prefill ids[:-1], then seed decode
-        # with the buggy token (dllama.cpp:54) instead of ids[-1]
-        drive = ids[:-1] + [golden["effective_seed_token"]]
-        n_gen = len(golden["pieces"])
-        res = eng.generate(drive, max_tokens=n_gen, stop_on_eos=False)
-        assert len(res.tokens) == n_gen
-
-        # decode statefully the way the reference CLI prints pieces
-        eng.tokenizer.reset_decoder()
-        got = [p if (p := eng.tokenizer.decode(t)) is not None else "~"
-               for t in res.tokens]
+        got, res = golden_assets.replay_reference_driver(eng, golden)
+        assert len(res.tokens) == len(golden["pieces"])
         assert got == golden["pieces"], (
             f"token divergence at index "
             f"{next(i for i, (a, b) in enumerate(zip(got, golden['pieces'])) if a != b)}")

@@ -359,3 +359,45 @@ def test_turbo_matmul_on_hw(tpu_backend):
         got = np.asarray(turbo_matmul(x, tw), np.float32)
         drift = float(np.abs(got - want).max()) / max(rms, 1e-9)
         assert drift < bound, (a8, drift)
+
+
+def test_macbeth_transcript_on_hw(tpu_backend):
+    """The macbeth-scale determinism chain ON CHIP (VERDICT r4 next #8): the
+    reference's strongest test drives 2048+ greedy steps and diffs the
+    transcript (examples/macbeth.sh:5,192); here the committed
+    reference-binary golden (2049-step transcript from the rebuilt C++
+    dllama) replays through the real-TPU engine in exact numerics. This is
+    the longest cross-implementation chain in the suite — accumulation-order
+    or dispatch-shape drift anywhere in 2k steps breaks it.
+
+    Uses --decode-chunk to keep the tunnel's per-fetch RTT off the critical
+    path (chunked decode is bit-identical by construction,
+    tests/test_decode_chunk.py)."""
+    from pathlib import Path
+    import tempfile
+
+    import golden_assets
+    from dllama_tpu.formats.quants import F32
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    variant = "llama_macbeth_f32"
+    golden = golden_assets.load_golden(variant)
+    if golden is None:
+        pytest.skip("no macbeth golden (run tools/golden_reference.py)")
+    tmp = Path(tempfile.mkdtemp(prefix="dllama-hw-macbeth-"))
+    m, t, m_sha, t_sha = golden_assets.build_assets(variant, tmp)
+    if m_sha != golden["m_sha256"] or t_sha != golden["t_sha256"]:
+        pytest.skip("synthetic assets no longer match the golden's hashes")
+
+    eng = InferenceEngine(
+        str(m), str(t), sync_type=F32, compute_dtype="float32",
+        temperature=golden["temperature"], seed=golden["sampler_seed"],
+        decode_chunk=32)
+    try:
+        got, r = golden_assets.replay_reference_driver(eng, golden)
+        want = golden["pieces"]
+        assert len(r.tokens) == len(want) >= 2000
+        mismatches = [i for i in range(len(want)) if got[i] != want[i]]
+        assert not mismatches, (mismatches[:5], len(want))
+    finally:
+        eng.close()
