@@ -766,6 +766,27 @@ def main() -> None:
                           "/tmp/dllama-xla-cache-bench")
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
+    # promoted serving config (tools/promote_config.py, written when an
+    # on-chip A/B showed a combo beating `auto` by >=10%): apply its env
+    # knobs to the measurement children, with full provenance in the line.
+    # Explicitly-set env vars win — a sweep/debug run isn't overridden.
+    promo_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench_promoted.json")
+    if os.path.exists(promo_path):
+        try:
+            with open(promo_path) as f:
+                promo = json.load(f)
+            applied = {}
+            for var, val in (promo.get("env") or {}).items():
+                if var not in os.environ:
+                    os.environ[var] = str(val)
+                    applied[var] = str(val)
+            result["promoted_config"] = {
+                "combo": promo.get("combo"), "applied_env": applied,
+                "evidence": promo.get("evidence")}
+        except (OSError, ValueError) as e:
+            result["promoted_config"] = {"error": f"{type(e).__name__}: {e}"}
+
     on_tpu = "tpu" in str(info.get("kind", "")).lower() or info.get("platform") in ("tpu", "axon")
     tflops, gbps = detect_specs(str(info.get("kind", "")))
 

@@ -46,29 +46,61 @@ capture() {
     echo "capture start $ts" >> "$OUT/probe_log.jsonl.notes"
     cd "$REPO" || return 1
 
-    # 1. bench.py — wedge-proof by construction (parent never imports jax);
-    #    generous outer timeout as backstop only.
+    # Round-5 priority order: the most decision-relevant artifacts bank
+    # FIRST in case the chip wedges mid-window (the round-4 failure mode).
+
+    # 1. bench.py, production config — wedge-proof by construction (parent
+    #    never imports jax). Round-5 hardening means this now carries an
+    #    honest prefill number and clean chunked/verify numbers.
     timeout 3600 python bench.py > "$cdir/BENCH_live.json" 2> "$cdir/bench.stderr"
     echo "bench rc=$?" >> "$cdir/status"
 
-    # 2. TPU hardware test tier
-    timeout 1800 env DLLAMA_TESTS_TPU=1 python -m pytest tests -m tpu -q \
-        > "$cdir/pytest_tpu.log" 2>&1
-    echo "pytest_tpu rc=$?" >> "$cdir/status"
-
-    # 3+4. kernel-choice sweeps (1b first: always banks something)
+    # 2+3. kernel-choice sweeps — the turbo/scan-unroll A/B the round's
+    #    perf verdict rides on (1b first: always banks something)
     timeout 3600 python tools/perf_matrix.py 1b 300 > "$cdir/matrix_1b.log" 2>&1
     echo "matrix_1b rc=$?" >> "$cdir/status"
     timeout 4800 python tools/perf_matrix.py 8b 420 > "$cdir/matrix_8b.log" 2>&1
     echo "matrix_8b rc=$?" >> "$cdir/status"
 
-    # 5. the f8-KV long-context comparison: the bench's default stages
+    # 4. promote the winning combo (>=10% over auto writes
+    #    bench_promoted.json, which bench.py applies with provenance) and
+    #    re-measure under it; the promoted line replaces BENCH_live.json
+    #    so the round headline reflects the promoted serving config
+    timeout 120 python tools/promote_config.py \
+        "$cdir/matrix_8b.log" "$cdir/matrix_1b.log" \
+        > "$cdir/promotion.json" 2> "$cdir/promotion.stderr"
+    echo "promote rc=$?" >> "$cdir/status"
+    if [ -f "$REPO/bench_promoted.json" ]; then
+        timeout 2400 python bench.py > "$cdir/BENCH_promoted.json" \
+            2> "$cdir/bench_promoted.stderr"
+        echo "bench_promoted rc=$?" >> "$cdir/status"
+        # only a LIVE measurement taken under the promoted config may
+        # replace the headline — a fallback emission (chip wedged between
+        # the matrices and this re-bench) would re-bank the auto capture
+        # under a promoted label
+        if python -c "
+import json,sys
+d=json.load(open('$cdir/BENCH_promoted.json'))
+ok = d.get('value') and not d.get('fallback') and d.get('promoted_config')
+sys.exit(0 if ok else 1)" 2>/dev/null; then
+            cp "$cdir/BENCH_live.json" "$cdir/BENCH_auto.json"
+            cp "$cdir/BENCH_promoted.json" "$cdir/BENCH_live.json"
+        fi
+    fi
+
+    # 5. TPU hardware test tier (incl. the 2049-step macbeth chain on chip)
+    timeout 1800 env DLLAMA_TESTS_TPU=1 python -m pytest tests -m tpu -q \
+        > "$cdir/pytest_tpu.log" 2>&1
+    echo "pytest_tpu rc=$?" >> "$cdir/status"
+
+    # 6. the f8-KV long-context comparison: the bench's default stages
     #    already measure 1b@s8k with a bf16 cache; this is the f8 twin
     timeout 1200 env DLLAMA_BENCH_PRESET=1b@s8k DLLAMA_BENCH_KV=f8 \
         python bench.py > "$cdir/s8k_f8.json" 2> "$cdir/s8k_f8.stderr"
     echo "s8k_f8 rc=$?" >> "$cdir/status"
 
-    # 6+7. where the milliseconds go: per-op decode profiles (both presets)
+    # 7+8. where the milliseconds go: per-op decode profiles (both presets;
+    #    profile_decode prints the per-op-sum vs chain-time reconciliation)
     timeout 1200 python tools/profile_decode.py 8b 4 > "$cdir/profile_8b.log" 2>&1
     echo "profile_8b rc=$?" >> "$cdir/status"
     timeout 900 python tools/profile_decode.py 1b 4 > "$cdir/profile_1b.log" 2>&1
@@ -83,7 +115,8 @@ capture() {
     # healthy window lands after the session's last manual commit
     adir=$REPO/capture_artifacts/$ts
     mkdir -p "$adir"
-    for f in BENCH_live.json status pytest_tpu.log matrix_1b.log \
+    for f in BENCH_live.json BENCH_auto.json BENCH_promoted.json \
+             promotion.json status pytest_tpu.log matrix_1b.log \
              matrix_8b.log profile_8b.log profile_1b.log bench.stderr \
              s8k_f8.json INVALID; do
         [ -f "$cdir/$f" ] && cp "$cdir/$f" "$adir/" 2>/dev/null
