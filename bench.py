@@ -79,6 +79,19 @@ def _tail(b) -> str:
     return b[-600:]
 
 
+def force_platform_from_env() -> str | None:
+    """Apply the DLLAMA_BENCH_PLATFORM override in-process (sitecustomize
+    rewrites the bare JAX_PLATFORMS env var on every interpreter start, so
+    only jax.config.update sticks). The ONE implementation of the pin —
+    stage children, main, and the profiling tools all use it."""
+    force = os.environ.get("DLLAMA_BENCH_PLATFORM")
+    if force:
+        import jax
+
+        jax.config.update("jax_platforms", force)
+    return force
+
+
 def probe_once(platform: str | None, attempts: list) -> str | None:
     """One backend-probe subprocess; returns the device-info JSON line on
     success, None on failure. Every attempt's forensics (rc, duration,
@@ -284,11 +297,7 @@ def stage_child(spec: str) -> None:
     spec: preset name, optionally ``@b16`` (batched-serving variant) or
     ``@s8k`` (8192-token context: long-context decode is KV-bandwidth-bound,
     which is what ``--kv-dtype f8`` halves)."""
-    force = os.environ.get("DLLAMA_BENCH_PLATFORM")
-    if force:
-        import jax
-
-        jax.config.update("jax_platforms", force)  # sitecustomize-proof
+    force_platform_from_env()
     preset, _, mod = spec.partition("@")
     budget = float(os.environ.get("DLLAMA_BENCH_CHILD_BUDGET", STAGE_DEADLINE_S))
     deadline = time.monotonic() + budget
@@ -772,7 +781,9 @@ def main() -> None:
     # Explicitly-set env vars win — a sweep/debug run isn't overridden.
     promo_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "bench_promoted.json")
-    if os.path.exists(promo_path):
+    if os.environ.get("DLLAMA_BENCH_NO_PROMO"):
+        promo_path = ""  # isolation runs (e.g. the f8-KV twin) opt out
+    if promo_path and os.path.exists(promo_path):
         try:
             with open(promo_path) as f:
                 promo = json.load(f)

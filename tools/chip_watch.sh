@@ -49,6 +49,11 @@ capture() {
     # Round-5 priority order: the most decision-relevant artifacts bank
     # FIRST in case the chip wedges mid-window (the round-4 failure mode).
 
+    # each capture derives its own promotion from its own matrices — a
+    # stale winner from a previous capture must not leak into step 1's
+    # production-config measurement (or get compared against itself)
+    rm -f "$REPO/bench_promoted.json"
+
     # 1. bench.py, production config — wedge-proof by construction (parent
     #    never imports jax). Round-5 hardening means this now carries an
     #    honest prefill number and clean chunked/verify numbers.
@@ -81,7 +86,9 @@ capture() {
         if python -c "
 import json,sys
 d=json.load(open('$cdir/BENCH_promoted.json'))
-ok = d.get('value') and not d.get('fallback') and d.get('promoted_config')
+pc = d.get('promoted_config') or {}
+ok = (d.get('value') and not d.get('fallback')
+      and pc.get('applied_env') and not pc.get('error'))
 sys.exit(0 if ok else 1)" 2>/dev/null; then
             cp "$cdir/BENCH_live.json" "$cdir/BENCH_auto.json"
             cp "$cdir/BENCH_promoted.json" "$cdir/BENCH_live.json"
@@ -95,7 +102,9 @@ sys.exit(0 if ok else 1)" 2>/dev/null; then
 
     # 6. the f8-KV long-context comparison: the bench's default stages
     #    already measure 1b@s8k with a bf16 cache; this is the f8 twin
+    #    (NO_PROMO: the knob isolation must not inherit a promoted mode)
     timeout 1200 env DLLAMA_BENCH_PRESET=1b@s8k DLLAMA_BENCH_KV=f8 \
+        DLLAMA_BENCH_NO_PROMO=1 \
         python bench.py > "$cdir/s8k_f8.json" 2> "$cdir/s8k_f8.stderr"
     echo "s8k_f8 rc=$?" >> "$cdir/status"
 
