@@ -16,7 +16,7 @@
 
 PY ?= python
 
-.PHONY: test test-tpu test-all native bench graft clean
+.PHONY: test test-tpu test-all native tsan bench graft clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -28,6 +28,10 @@ test-all: test test-tpu
 
 native:
 	$(PY) -c 'from dllama_tpu import native; print(native.get_lib() or "native build unavailable (g++ missing?)")'
+
+tsan:
+	$(MAKE) -C dllama_tpu/native tsan
+	TSAN_OPTIONS="halt_on_error=1 exitcode=66" ./dllama_tpu/native/tsan_stress
 
 bench:
 	$(PY) bench.py

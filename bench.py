@@ -82,8 +82,10 @@ def _tail(b) -> str:
 def force_platform_from_env() -> str | None:
     """Apply the DLLAMA_BENCH_PLATFORM override in-process (sitecustomize
     rewrites the bare JAX_PLATFORMS env var on every interpreter start, so
-    only jax.config.update sticks). The ONE implementation of the pin —
-    stage children, main, and the profiling tools all use it."""
+    only jax.config.update sticks). For jax-importing processes ONLY —
+    stage children and the profiling tools; the bench PARENT stays jax-free
+    by design (a wedged PJRT import must not stall its emit path) and keeps
+    its env-var write."""
     force = os.environ.get("DLLAMA_BENCH_PLATFORM")
     if force:
         import jax

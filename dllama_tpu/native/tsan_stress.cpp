@@ -1,0 +1,103 @@
+// ThreadSanitizer stress binary for the native host-runtime library — the
+// race-detection tier SURVEY.md §5 lists as "partial" (the reference ships
+// no sanitizer tier at all; its threaded kernels rely on review).
+//
+// Built by `make -C dllama_tpu/native tsan` (tests/test_native.py builds and
+// runs it): links quants.cpp + tokenizer.cpp with -fsanitize=thread and
+// drives every threaded entry point the way the loader / tokenizer do —
+// internal block-range pools at nthreads=4 PLUS concurrent outer callers on
+// disjoint buffers (the library's documented concurrency contract: calls
+// share no state except the read-only inputs; BPE handles are per-caller).
+// Any data race TSAN finds fails the run (TSAN_OPTIONS=halt_on_error=1).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void q40_quantize(const float* x, int64_t n, uint8_t* out, int nthreads);
+void q40_dequantize(const uint8_t* in, int64_t n, float* out, int nthreads);
+void q80_quantize(const float* x, int64_t n, uint8_t* out, int nthreads);
+void q80_dequantize(const uint8_t* in, int64_t n, float* out, int nthreads);
+void q40_repack_kmajor(const uint8_t* in, int64_t rows, int64_t cols,
+                       float* scales, int8_t* codes, int nthreads);
+void* bpe_create(const uint8_t* vocab_bytes, const int64_t* offsets,
+                 const float* scores, int32_t n_vocab, int32_t max_len);
+void bpe_destroy(void* handle);
+int64_t bpe_merge(void* handle, int32_t* tokens, int64_t n);
+}
+
+namespace {
+
+constexpr int64_t kN = 32 * 2048;   // elements per worker (64 KiB of codes)
+constexpr int64_t kRows = 32, kCols = 2048;
+
+void quant_worker(unsigned seed) {
+  std::vector<float> x(kN);
+  for (int64_t i = 0; i < kN; ++i) {
+    seed = seed * 1664525u + 1013904223u;
+    x[i] = static_cast<float>(static_cast<int32_t>(seed >> 8)) * 1e-7f;
+  }
+  std::vector<uint8_t> q40(kN / 32 * 18), q80(kN / 32 * 34);
+  std::vector<float> back(kN);
+  // inner pools (nthreads=4) are the race surface: block-range splits over
+  // shared input/output spans
+  q40_quantize(x.data(), kN, q40.data(), 4);
+  q40_dequantize(q40.data(), kN, back.data(), 4);
+  q80_quantize(x.data(), kN, q80.data(), 4);
+  q80_dequantize(q80.data(), kN, back.data(), 4);
+  static_assert(kRows * kCols == kN, "repack reuses the same buffer");
+  std::vector<float> scales(kCols / 32 * kRows);
+  std::vector<int8_t> codes(kCols * kRows);
+  q40_repack_kmajor(q40.data(), kRows, kCols, scales.data(), codes.data(), 4);
+}
+
+void bpe_worker() {
+  // tiny byte vocab + a few merges, one handle per caller (the contract)
+  std::vector<uint8_t> vocab_bytes;
+  std::vector<int64_t> offsets;
+  std::vector<float> scores;
+  for (int b = 0; b < 256; ++b) {
+    offsets.push_back(static_cast<int64_t>(vocab_bytes.size()));
+    vocab_bytes.push_back(static_cast<uint8_t>(b));
+    scores.push_back(0.0f);
+  }
+  const char* merges[] = {"ab", "bc", "abc"};
+  for (int i = 0; i < 3; ++i) {
+    offsets.push_back(static_cast<int64_t>(vocab_bytes.size()));
+    vocab_bytes.insert(vocab_bytes.end(), merges[i],
+                       merges[i] + std::strlen(merges[i]));
+    scores.push_back(static_cast<float>(i + 1));
+  }
+  offsets.push_back(static_cast<int64_t>(vocab_bytes.size()));
+  // every token is lookup-eligible (n_regular == n): the merge tokens must
+  // participate or the heap/merge machinery never runs and the tier only
+  // exercises the validation loop
+  const auto n_vocab = static_cast<int32_t>(scores.size());
+  void* h = bpe_create(vocab_bytes.data(), offsets.data(), scores.data(),
+                       n_vocab, n_vocab);
+  if (!h) { std::fprintf(stderr, "bpe_create failed\n"); return; }
+  int64_t merged = -1;
+  for (int round = 0; round < 50; ++round) {
+    int32_t toks[] = {'a', 'b', 'c', 'a', 'b', 'x', 'b', 'c'};
+    merged = bpe_merge(h, toks, 8);
+  }
+  bpe_destroy(h);
+  if (merged != 4) {  // abc, ab, x, bc — the heap genuinely merged
+    std::fprintf(stderr, "bpe merge inert: got %lld\n",
+                 static_cast<long long>(merged));
+  }
+}
+
+}  // namespace
+
+int main() {
+  std::vector<std::thread> ts;
+  for (unsigned i = 0; i < 4; ++i) ts.emplace_back(quant_worker, 7u + i);
+  for (int i = 0; i < 2; ++i) ts.emplace_back(bpe_worker);
+  for (auto& t : ts) t.join();
+  std::puts("tsan stress ok");
+  return 0;
+}

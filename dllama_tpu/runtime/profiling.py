@@ -47,12 +47,13 @@ _NOISE_RE = re.compile(
     r"(ExecuteHelper|Handle inputs|CreateOutputs|Execute$|::)")
 
 
-def _union_ms(intervals: list[tuple[int, int]]) -> float:
-    """Total covered time (ms) of possibly-overlapping [start, end] ps spans —
-    nested profiler events (a rendezvous wait inside a psum span) must not
-    double-count."""
+def union_span(intervals: list[tuple[int, int]]) -> int:
+    """Total covered length of possibly-overlapping [start, end] spans, in
+    the caller's units — nested profiler events (a rendezvous wait inside a
+    psum span) must not double-count. THE one interval-union sweep (the
+    Eval/Sync split and tools/profile_decode both use it)."""
     if not intervals:
-        return 0.0
+        return 0
     intervals.sort()
     total = 0
     cur_s, cur_e = intervals[0]
@@ -63,7 +64,12 @@ def _union_ms(intervals: list[tuple[int, int]]) -> float:
         else:
             cur_e = max(cur_e, e)
     total += cur_e - cur_s
-    return total / 1e9
+    return total
+
+
+def _union_ms(intervals: list[tuple[int, int]]) -> float:
+    """:func:`union_span` over ps spans, in ms."""
+    return union_span(intervals) / 1e9
 
 
 def _device_lines(xspace):

@@ -7,6 +7,8 @@ test_convert.py). Mirrors the reference's converter/writer-test.py golden-hex
 approach plus nn-cpu-ops-test.cpp's quantize→dequantize round-trips.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -157,3 +159,27 @@ def test_bpe_native_encode_is_fast():
     dt = time.perf_counter() - t0
     assert t.decode_all(ids) == text
     assert dt < 1.5, f"native-backed encode took {dt:.2f}s"
+
+
+def test_native_tsan_tier():
+    """Race-detection tier (SURVEY §5 'partial' row): the threaded codec +
+    BPE paths run under ThreadSanitizer in a standalone instrumented binary
+    (TSAN can't load late into python via dlopen). halt_on_error turns any
+    detected race into a nonzero exit."""
+    import shutil
+    import subprocess
+    from pathlib import Path
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    d = Path(__file__).parent.parent / "dllama_tpu" / "native"
+    build = subprocess.run(["make", "-C", str(d), "-s", "tsan"],
+                           capture_output=True, text=True, timeout=180)
+    if build.returncode != 0:
+        pytest.skip(f"tsan build unavailable: {build.stderr[-200:]}")
+    run = subprocess.run(
+        [str(d / "tsan_stress")], capture_output=True, text=True, timeout=120,
+        env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1 exitcode=66"})
+    assert run.returncode == 0, (run.returncode, run.stderr[-800:])
+    assert "ThreadSanitizer" not in run.stderr, run.stderr[-800:]
+    assert "tsan stress ok" in run.stdout

@@ -87,8 +87,10 @@ capture() {
 import json,sys
 d=json.load(open('$cdir/BENCH_promoted.json'))
 pc = d.get('promoted_config') or {}
+# combo + no error = the promotion record loaded and governed this run
+# (applied_env alone would reject a run whose knobs were already exported)
 ok = (d.get('value') and not d.get('fallback')
-      and pc.get('applied_env') and not pc.get('error'))
+      and pc.get('combo') and not pc.get('error'))
 sys.exit(0 if ok else 1)" 2>/dev/null; then
             cp "$cdir/BENCH_live.json" "$cdir/BENCH_auto.json"
             cp "$cdir/BENCH_promoted.json" "$cdir/BENCH_live.json"

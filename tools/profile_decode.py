@@ -67,19 +67,7 @@ def main() -> None:
         return
     xs = _load_xplane(max(paths, key=os.path.getmtime))
 
-    def union_ns(intervals: list[tuple[int, int]]) -> int:
-        """Total covered time (ns) of possibly-overlapping [start, end)."""
-        total, cur_s, cur_e = 0, None, None
-        for s, e in sorted(intervals):
-            if cur_e is None or s > cur_e:
-                if cur_e is not None:
-                    total += cur_e - cur_s
-                cur_s, cur_e = s, e
-            else:
-                cur_e = max(cur_e, e)
-        if cur_e is not None:
-            total += cur_e - cur_s
-        return total
+    from dllama_tpu.runtime.profiling import union_span as union_ns
 
     # Per-lane sum vs interval-UNION: the round-4 open question is a ~1.7x
     # systematic between summed per-op times and measured chain time. A
