@@ -150,9 +150,8 @@ def _sharded_flash(cfg: ModelConfig, plan, q, k_cache, v_cache, start_pos):
             f"attn_impl='flash' forced but the sharded kernel does not apply "
             f"(plan axes {dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))}, "
             f"q={q.shape}, kv={k_cache.shape}; irregular q-head/kv-group "
-            f"splits (tp % n_kv != 0 with n_kv % tp != 0) and "
-            f"non-128-multiple cache lengths use the XLA oracle — drop "
-            f"attn_impl or use 'auto')")
+            f"splits (tp % n_kv != 0 with n_kv % tp != 0) use the XLA "
+            f"oracle — drop attn_impl or use 'auto')")
     return res
 
 

@@ -220,13 +220,18 @@ class InferenceEngine:
                        and _tp_ok(self.cfg, tp * 2)):
                     tp *= 2
         self.tp, self.sp, self.pp, self.dp = tp, sp, pp, dp
-        if sp > 1 and self.cfg.seq_len % sp != 0:
+        if sp > 1:
             # sp = sequence parallelism: KV cache seq-sharded, ring attention
             # (parallel/ring.py) — long-context capability with no reference
-            # analogue (SURVEY.md §5)
-            raise ValueError(
-                f"seq_len {self.cfg.seq_len} not divisible by sp={sp} "
-                f"(adjust --max-seq-len)")
+            # analogue (SURVEY.md §5). The cache's PHYSICAL rows pad to a
+            # 128-multiple (runtime.kvcache), so any power-of-2 sp divides;
+            # only an exotic sp could fail this.
+            from .kvcache import padded_cache_len
+
+            if padded_cache_len(self.cfg.seq_len) % sp != 0:
+                raise ValueError(
+                    f"cache rows {padded_cache_len(self.cfg.seq_len)} not "
+                    f"divisible by sp={sp} (adjust --max-seq-len)")
         if pp > 1:
             # pp = pipeline parallelism: layer stages (parallel/pipeline.py);
             # another new capability (SURVEY.md §2.2: reference has none)

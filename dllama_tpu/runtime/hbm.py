@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import os
 
+from .kvcache import padded_cache_len
+
 # dense-equivalent bytes per weight for each on-device representation
 # (quantized planes carry f32 block scales in exact configs, bf16 in fast
 # ones — the f32 value is kept as the conservative estimate either way)
@@ -111,7 +113,8 @@ def estimate_device_bytes(cfg, *, weight_repr: str, kv_dtype_bytes: int,
             largest_leaf = cfg.n_layers * cfg.dim * cfg.hidden_dim * (
                 cfg.n_experts if cfg.is_moe else 1)
             weights += largest_leaf + 4 * cfg.dim * dense_cols
-    kv = 2 * cfg.n_layers * cfg.seq_len * cfg.kv_dim * batch * kv_dtype_bytes
+    kv = (2 * cfg.n_layers * padded_cache_len(cfg.seq_len) * cfg.kv_dim
+          * batch * kv_dtype_bytes)
     need = int(((weights + kv) / max(1, n_shards)) * _MARGIN) + _FIXED_OVERHEAD
     return {"weights_bytes": weights, "kv_bytes": kv,
             "need_per_device": need}

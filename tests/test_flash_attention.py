@@ -116,3 +116,39 @@ def test_ragged_forward_forced_flash_matches_oracle():
             sharded, cfg, tokens, starts, kv)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_non_128_seq_len_takes_flash():
+    """A --max-seq-len that isn't a 128-multiple used to silently fall back
+    to the XLA oracle (the kernel's block grid needs S % 128 == 0); the
+    cache now allocates padded to the block grid (runtime.kvcache), so
+    forced flash runs — and matches the oracle — at any logical length."""
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.formats import mfile
+    from dllama_tpu.models import ModelConfig, forward, init_random_params
+    from dllama_tpu.runtime import KVCache
+    from dllama_tpu.runtime.kvcache import padded_cache_len
+
+    assert padded_cache_len(100) == 128 and padded_cache_len(128) == 128
+    cfg = ModelConfig(
+        arch=mfile.ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=16, vocab_size=128, seq_len=100,
+        norm_epsilon=1e-5, rope_theta=10000.0, rope_type=mfile.RopeType.LLAMA,
+        attn_impl="flash")
+    params = init_random_params(cfg, seed=2)
+    tokens = jnp.asarray([[3, 1, 4, 1, 5]], dtype=jnp.int32)
+    kv = KVCache.create(cfg)
+    assert kv.seq_len == 128  # physical rows padded; logical cap stays 100
+    got, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg, tokens, jnp.int32(0), kv)
+
+    from dataclasses import replace
+
+    cfg_o = replace(cfg, attn_impl="xla")
+    want, _ = jax.jit(forward, static_argnums=1)(
+        params, cfg_o, tokens, jnp.int32(0), KVCache.create(cfg_o))
+    import numpy as np
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

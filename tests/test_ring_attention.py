@@ -351,3 +351,26 @@ def test_forward_sp_ragged_matches_unsharded():
             sharded, cfg, tokens, start, kv)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_engine_sp_with_non_divisible_seq_len(tmp_path):
+    """seq_len 100 under sp=2: the padded cache (128 rows) divides the sp
+    axis, so the old 'seq_len not divisible by sp' rejection is gone and
+    generation matches the unsharded engine."""
+    import numpy as np
+
+    from dllama_tpu.formats import tfile
+    from dllama_tpu.runtime.engine import InferenceEngine
+    from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+    m, t = tmp_path / "m.m", tmp_path / "t.t"
+    write_tiny_model(m, tiny_header_params(vocab_size=268, seq_len=100),
+                     np.random.default_rng(51))
+    tfile.write_tfile(t, byte_vocab_tokenizer())
+    solo = InferenceEngine(str(m), str(t), tp=1, temperature=0.0)
+    want = solo.generate("hello world", 6, stop_on_eos=False).tokens
+    solo.close()
+    spe = InferenceEngine(str(m), str(t), tp=1, sp=2, temperature=0.0)
+    got = spe.generate("hello world", 6, stop_on_eos=False).tokens
+    spe.close()
+    assert got == want
