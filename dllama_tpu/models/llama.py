@@ -122,8 +122,9 @@ def _sharded_flash(cfg: ModelConfig, plan, q, k_cache, v_cache, start_pos):
         return None
     if plan.axis_size("pp") > 1:
         # inside the manual pp shard_map a nested pallas shard_map can't
-        # partition; per-stage attention uses the XLA oracle (validate_pp
-        # rejects forced 'flash' up front)
+        # partition; per-stage attention uses the XLA oracle when other
+        # axes are in play (validate_pp rejects forced 'flash' for
+        # pp×(tp|dp|sp); PURE pp runs the plain kernel via _use_flash)
         return None
     if plan.axis_size("sp") > 1:
         # sp attention is owned by the ring path (parallel/ring.py); landing

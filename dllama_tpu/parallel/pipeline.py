@@ -99,6 +99,11 @@ def pp_forward(plan: "MeshPlan", cfg: "ModelConfig", params, tokens, start_pos,
         def run_layers(x, k, v, pos_rows, sp0_rows):
             def body(xc, xs):
                 lp, k1, v1 = xs
+                if cfg.offload:
+                    # per-stage host streaming: this stage's layer shard
+                    # lives in pinned host memory; each layer transfers on
+                    # use, same as models.llama.forward's offload scan
+                    lp = jax.device_put(lp, jax.memory.Space.Device)
                 xo, k1, v1 = _layer_step(cfg, xc, lp, k1, v1, cos, sin,
                                          sp0_rows, pos_rows)
                 return xo, (k1, v1)
@@ -198,19 +203,18 @@ def pp_forward(plan: "MeshPlan", cfg: "ModelConfig", params, tokens, start_pos,
     return logits, KVCache(k=new_k, v=new_v)
 
 
-def validate_pp(cfg: "ModelConfig", pp: int, tp: int = 1, dp: int = 1) -> None:
+def validate_pp(cfg: "ModelConfig", pp: int, tp: int = 1, dp: int = 1,
+                sp: int = 1) -> None:
     """Pipeline divisibility and composition rules."""
     if cfg.n_layers % pp != 0:
         raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp={pp}")
-    if cfg.offload:
-        raise ValueError("pp does not compose with --weight-mode offload yet "
-                         "(per-stage host streaming is future work)")
-    if cfg.attn_impl == "flash" and (tp > 1 or dp > 1):
+    if cfg.attn_impl == "flash" and (tp > 1 or dp > 1 or sp > 1):
         # pure pp is fine: inside the manual pp shard_map every stage's
         # arrays are fully local, so the plain kernel runs per stage
-        # (models.llama._use_flash); with tp/dp auto axes inside the manual
-        # region a pallas_call can't partition
+        # (models.llama._use_flash); with tp/dp/sp auto axes inside the
+        # manual region a pallas_call can't partition — a forced kernel
+        # must fail HERE, not silently run the oracle
         raise ValueError(
-            "attn_impl='flash' under pp×(tp|dp) is unsupported (the Pallas "
-            "kernel can't nest inside the manual pp shard_map with auto "
-            "axes); use 'auto' or 'xla', or pure pp")
+            "attn_impl='flash' under pp×(tp|dp|sp) is unsupported (the "
+            "Pallas kernel can't nest inside the manual pp shard_map with "
+            "auto axes); use 'auto' or 'xla', or pure pp")

@@ -237,10 +237,12 @@ class InferenceEngine:
             # another new capability (SURVEY.md §2.2: reference has none)
             from ..parallel.pipeline import validate_pp
 
-            validate_pp(self.cfg, pp, tp=tp, dp=dp)
-            if sp > 1:
-                raise ValueError("pp does not compose with sp yet "
-                                 "(nested shard_maps)")
+            validate_pp(self.cfg, pp, tp=tp, dp=dp, sp=sp)
+            # sp composes with pp: inside the pp-manual region sp stays an
+            # AUTO mesh axis, so the per-stage attention runs the XLA
+            # oracle over the seq-sharded cache (XLA inserts the
+            # collectives; the manual ring schedule stays pp==1-only).
+            # The seq-axis memory split — sp's job — holds either way.
         # dp = data parallelism over the BATCH axis: meaningful for batched
         # serving (--batch-slots N with N % dp == 0 shards the slot pool);
         # single-sequence paths run batch 1, which degrades to replicated
