@@ -725,6 +725,17 @@ def init_random_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02,
             return _stack_weights([quantize_weight_q40(w[l]) for l in range(cfg.n_layers)])
         return jnp.asarray(w, dtype=dtype)
 
+    def mk_experts(out, in_) -> Weight:
+        if quantized:
+            w = rand(cfg.n_layers, cfg.n_experts, out, in_)
+            return _stack_weights([
+                _stack_weights([quantize_weight_q40(w[l, e])
+                                for e in range(cfg.n_experts)])
+                for l in range(cfg.n_layers)])
+        # dense experts store IN-major (ragged_dot rhs layout)
+        return jnp.asarray(rand(cfg.n_layers, cfg.n_experts, in_, out),
+                           dtype=cfg.compute_dtype)
+
     qwen3 = cfg.arch == ArchType.QWEN3
     moe = cfg.is_moe
     layers = LayerParams(
@@ -741,13 +752,11 @@ def init_random_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02,
         norm_k=jnp.asarray(1.0 + rand(cfg.n_layers, cfg.head_dim)) if qwen3 else None,
         moe_gate=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.dim))
                   if moe else None),
-        # in-major expert layout (see LayerParams)
-        we1=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.dim, cfg.hidden_dim),
-                         dtype=cfg.compute_dtype) if moe else None),
-        we2=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.hidden_dim, cfg.dim),
-                         dtype=cfg.compute_dtype) if moe else None),
-        we3=(jnp.asarray(rand(cfg.n_layers, cfg.n_experts, cfg.dim, cfg.hidden_dim),
-                         dtype=cfg.compute_dtype) if moe else None),
+        # in-major expert layout (see LayerParams); quantized=True mirrors
+        # the loader's Q40 expert planes ([L, E]-stacked QuantizedWeight)
+        we1=mk_experts(cfg.hidden_dim, cfg.dim) if moe else None,
+        we2=mk_experts(cfg.dim, cfg.hidden_dim) if moe else None,
+        we3=mk_experts(cfg.hidden_dim, cfg.dim) if moe else None,
     )
     logits = rand(cfg.vocab_size, cfg.dim)
     return Params(
