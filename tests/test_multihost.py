@@ -240,24 +240,9 @@ def test_two_process_sp_sampled_decode(tiny_files):
                             seed=77)
     expect = local.generate([1, 2, 3], max_tokens=6, stop_on_eos=False).tokens
 
-    coord = f"127.0.0.1:{PORT + 3}"
-    root = _spawn_root(SP_SAMPLED_ROOT_SCRIPT, coord, m, t)
-    worker = _spawn_worker(coord, m, t, "--sp", "2", "--tp", "1",
-                           "--buffer-float-type", "f32")
-    try:
-        root_out, _ = root.communicate(timeout=420)
-        worker_out, _ = worker.communicate(timeout=120)
-    except subprocess.TimeoutExpired:
-        root.kill()
-        worker.kill()
-        raise
-    rtxt = root_out.decode(errors="replace")
-    wtxt = worker_out.decode(errors="replace")
-    assert root.returncode == 0, f"root failed:\n{rtxt[-3000:]}"
-    assert worker.returncode == 0, f"worker failed:\n{wtxt[-3000:]}"
-    line = [ln for ln in rtxt.splitlines() if ln.startswith("TOKENS=")]
-    assert line, rtxt[-2000:]
-    got = [int(x) for x in line[0][len("TOKENS="):].split(",")]
+    got, _, wtxt = _run_two_proc_tokens(
+        SP_SAMPLED_ROOT_SCRIPT, 3, m, t,
+        ("--sp", "2", "--tp", "1", "--buffer-float-type", "f32"))
     assert got == expect
     assert "served" in wtxt and "served 0" not in wtxt, wtxt[-1000:]
 
@@ -290,24 +275,9 @@ def test_two_process_chunked_decode(tiny_files):
     local = InferenceEngine(m, t, tp=1, temperature=0.8, topp=0.9, seed=31)
     expect = local.generate([1, 2, 3], max_tokens=9, stop_on_eos=False).tokens
 
-    coord = f"127.0.0.1:{PORT + 5}"
-    root = _spawn_root(CHUNK_ROOT_SCRIPT, coord, m, t)
-    worker = _spawn_worker(coord, m, t, "--buffer-float-type", "f32",
-                           "--decode-chunk", "4")
-    try:
-        root_out, _ = root.communicate(timeout=420)
-        worker_out, _ = worker.communicate(timeout=120)
-    except subprocess.TimeoutExpired:
-        root.kill()
-        worker.kill()
-        raise
-    rtxt = root_out.decode(errors="replace")
-    wtxt = worker_out.decode(errors="replace")
-    assert root.returncode == 0, f"root failed:\n{rtxt[-3000:]}"
-    assert worker.returncode == 0, f"worker failed:\n{wtxt[-3000:]}"
-    line = [ln for ln in rtxt.splitlines() if ln.startswith("TOKENS=")]
-    assert line, rtxt[-2000:]
-    got = [int(x) for x in line[0][len("TOKENS="):].split(",")]
+    got, _, wtxt = _run_two_proc_tokens(
+        CHUNK_ROOT_SCRIPT, 5, m, t,
+        ("--buffer-float-type", "f32", "--decode-chunk", "4"))
     assert got == expect
     # 9 tokens = 2 chunk packets (4+4) + 1 single-step tail + prefill, so
     # far fewer dispatches than tokens
@@ -479,6 +449,31 @@ def _spawn_worker(coord: str, m: str, t: str, *extra: str, nprocs: int = 2,
          "--procid", str(procid),
          "--model", m, "--tokenizer", t, "--tp", str(tp), *extra],
         env=_two_proc_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def _run_two_proc_tokens(script, port_offset, m, t, worker_args,
+                         root_timeout=420):
+    """Spawn root(script) + a worker, wait for both, assert clean exits,
+    and return ``(tokens, root_text, worker_text)`` parsed from the root's
+    TOKENS= line — the shared protocol of every 2-process decode test."""
+    coord = f"127.0.0.1:{PORT + port_offset}"
+    root = _spawn_root(script, coord, m, t)
+    worker = _spawn_worker(coord, m, t, *worker_args)
+    try:
+        root_out, _ = root.communicate(timeout=root_timeout)
+        worker_out, _ = worker.communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        root.kill()
+        worker.kill()
+        raise
+    rtxt = root_out.decode(errors="replace")
+    wtxt = worker_out.decode(errors="replace")
+    assert root.returncode == 0, f"root failed:\n{rtxt[-3000:]}"
+    assert worker.returncode == 0, f"worker failed:\n{wtxt[-3000:]}"
+    line = [ln for ln in rtxt.splitlines() if ln.startswith("TOKENS=")]
+    assert line, rtxt[-2000:]
+    got = [int(x) for x in line[0][len("TOKENS="):].split(",")]
+    return got, rtxt, wtxt
 
 
 def _wait_for_line(proc: subprocess.Popen, needle: str, timeout: float) -> str:
@@ -946,22 +941,45 @@ def test_two_process_turbo_decode(tmp_path, monkeypatch):
                             compute_dtype="bfloat16")
     expect = local.generate([1, 2, 3], max_tokens=6, stop_on_eos=False).tokens
 
-    coord = f"127.0.0.1:{PORT + 50}"
-    root = _spawn_root(TURBO_ROOT_SCRIPT, coord, m, t)
-    worker = _spawn_worker(coord, m, t, "--compute-dtype", "bf16",
-                           "--buffer-float-type", "f32")
-    try:
-        root_out, _ = root.communicate(timeout=420)
-        worker_out, _ = worker.communicate(timeout=120)
-    except subprocess.TimeoutExpired:
-        root.kill()
-        worker.kill()
-        raise
-    rtxt = root_out.decode(errors="replace")
-    wtxt = worker_out.decode(errors="replace")
-    assert root.returncode == 0, f"root failed:\n{rtxt[-3000:]}"
-    assert worker.returncode == 0, f"worker failed:\n{wtxt[-3000:]}"
-    line = [ln for ln in rtxt.splitlines() if ln.startswith("TOKENS=")]
-    assert line, rtxt[-2000:]
-    got = [int(x) for x in line[0][len("TOKENS="):].split(",")]
+    got, _, _ = _run_two_proc_tokens(
+        TURBO_ROOT_SCRIPT, 50, m, t,
+        ("--compute-dtype", "bf16", "--buffer-float-type", "f32"))
     assert got == expect
+
+
+# root driving PIPELINE stages across processes: pp is the DCN-friendly
+# axis (per-forward activation traffic independent of depth), so a
+# 2-process pp=2 cluster is the distributed deployment it exists for
+PP_ROOT_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, sys.argv[1])
+    from dllama_tpu.parallel.multihost import init_distributed
+    init_distributed(sys.argv[2], 2, 0, platform="cpu")
+    from dllama_tpu.runtime.engine import InferenceEngine
+    eng = InferenceEngine(sys.argv[3], sys.argv[4], tp=1, pp=2,
+                          temperature=0.0, multihost=True)
+    res = eng.generate([1, 2, 3], max_tokens=6, stop_on_eos=False)
+    print("TOKENS=" + ",".join(map(str, res.tokens)), flush=True)
+    eng.close()
+""")
+
+
+@pytest.mark.slow
+def test_two_process_pp_decode(tiny_files):
+    """2-process run with pp=2: each process holds ONE pipeline stage (half
+    the layer stack + its KV slice) and the activation ppermutes between
+    processes — the distributed deployment pp exists for. Root tokens must
+    match a single-process engine."""
+    m, t = tiny_files
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    local = InferenceEngine(m, t, tp=1, temperature=0.0)
+    expect = local.generate([1, 2, 3], max_tokens=6, stop_on_eos=False).tokens
+    local.close()
+
+    got, _, wtxt = _run_two_proc_tokens(
+        PP_ROOT_SCRIPT, 11, m, t,
+        ("--pp", "2", "--tp", "1", "--buffer-float-type", "f32"))
+    assert got == expect
+    assert "served" in wtxt and "served 0" not in wtxt, wtxt[-1000:]
