@@ -75,6 +75,20 @@ def main() -> None:
 
     bench = _load_bench(os.path.join(cdir, "BENCH_live.json"))
     if bench:
+        if bench.get("fallback"):
+            print("*** FALLBACK emission: chip was down at bench time; "
+                  f"numbers come from {bench['fallback'].get('source')} ***")
+        pc = bench.get("promoted_config")
+        if pc and pc.get("error"):
+            print(f"*** promotion file FAILED to apply ({pc['error'][:120]}) "
+                  f"— headline below measured under plain auto ***")
+        elif pc:
+            ev = pc.get("evidence") or {}
+            print(f"PROMOTED serving config: {pc.get('combo')} "
+                  f"(decode {ev.get('decode_tok_per_s')} vs auto "
+                  f"{ev.get('auto_decode_tok_per_s')} = {ev.get('gain')}x, "
+                  f"from {ev.get('source')}) — headline below measured "
+                  f"under it; BENCH_auto.json holds the auto twin")
         print(f"headline: {bench.get('metric')} = {bench.get('value')} "
               f"{bench.get('unit')}  (vs north star {NORTH_STAR:.0f}: "
               f"{100 * float(bench.get('value') or 0) / NORTH_STAR:.1f}%)")
@@ -109,20 +123,31 @@ def main() -> None:
                   f" {str(res.get('prefill_tok_per_s', '-')):>10s}"
                   + (f"   ({res['error'][:40]})" if res.get("error") else ""))
 
+    promo = _load_bench(os.path.join(cdir, "promotion.json"))
+    if promo is not None:
+        print(f"\npromotion decision: {json.dumps(promo)[:400]}")
+
     tpu_log = os.path.join(cdir, "pytest_tpu.log")
     if os.path.exists(tpu_log):
         with open(tpu_log) as f:
-            tail = f.read().splitlines()[-3:]
+            body = f.read()
+        tail = body.splitlines()[-3:]
         print("\ntpu tier: " + " / ".join(tail))
+        if "macbeth" in body:
+            # `pytest -q` only prints test NAMES on failure: the substring
+            # appearing means the 2049-step determinism chain (VERDICT r4
+            # next #8) FAILED or errored on chip — surface it loudly
+            print("  *** macbeth-on-chip appears in the log: the transcript "
+                  "chain failed/errored — see pytest_tpu.log ***")
 
     for preset in ("8b", "1b"):
         plog = os.path.join(cdir, f"profile_{preset}.log")
         if os.path.exists(plog):
             with open(plog) as f:
-                # skip jax startup warnings: the summary lines are the ones
-                # profile_decode prints itself
+                # profile_decode's own summary lines, incl. the RECONCILE
+                # line that settles the 1.7x profiler-vs-chain systematic
                 head = [ln for ln in f.read().splitlines()
-                        if ln.startswith(("wall for", "device lanes"))][:2]
+                        if ln.startswith(("wall for", "lanes (", "RECONCILE"))][:3]
             print(f"profile {preset}: " + " | ".join(head))
 
     # reference context: its best published number is Llama 2 7B at
