@@ -312,7 +312,13 @@ def run_inference(args) -> int:
         sp = engine.split
         tr = engine.traffic
         print(f"  eval/sync: {sp.eval_ms:.2f}/{sp.sync_ms:.2f} ms device time "
-              f"per step (sync {100 * sp.sync_frac:.1f}%)")
+              f"per decode step (sync {100 * sp.sync_frac:.1f}%)")
+        pf = engine.split_prefill
+        if pf is not None and pf.n_steps > 0:
+            # the prefill program's own fraction (MXU-bound wide chunks
+            # sync differently than HBM-bound decode)
+            print(f"             {pf.eval_ms:.2f}/{pf.sync_ms:.2f} ms per "
+                  f"prefill chunk (sync {100 * pf.sync_frac:.1f}%)")
         if tr:
             print(f"    traffic: {tr.sent_kb:.1f} kB/token/device over "
                   f"{tr.n_collectives} collectives "
