@@ -222,6 +222,19 @@ def _codes_kernel():
     return _codes
 
 
+def bench_weight_repr() -> str:
+    """On-device weight representation for the bench stages: ``q40``
+    (default — the production quantized planes) or ``bf16``
+    (DLLAMA_BENCH_WEIGHTS=bf16: dense planes, the engine's
+    ``--weight-mode bf16``). The dense row measures the NO-DEQUANT
+    streaming ceiling — on the 1b preset it fits HBM and isolates how
+    much of the decode gap is the fused dequant's VPU work."""
+    w = os.environ.get("DLLAMA_BENCH_WEIGHTS", "q40")
+    if w not in ("q40", "bf16"):
+        raise ValueError(f"DLLAMA_BENCH_WEIGHTS must be q40|bf16, got {w!r}")
+    return w
+
+
 def device_random_params(cfg):
     """Random Q40-plane params generated ON DEVICE (no host RAM spike, no
     multi-GB host->device transfer: an 8B-shape Q40 stack is ~8.5 GB).
@@ -245,7 +258,14 @@ def device_random_params(cfg):
     fast = fast_numerics_resolved(cfg.compute_dtype)
     scale_dtype = jnp.bfloat16 if fast else jnp.float32
 
+    dense_w = bench_weight_repr() == "bf16"
+
     def qw(out, in_, stacked=True):
+        if dense_w:
+            # dense planes use the reference [out, in] orientation
+            shape_d = (cfg.n_layers, out, in_) if stacked else (out, in_)
+            return jax.random.uniform(next(key), shape_d, jnp.bfloat16,
+                                      minval=-0.02, maxval=0.02)
         shape_s = (cfg.n_layers, in_ // 32, out) if stacked else (in_ // 32, out)
         shape_c = (cfg.n_layers, in_, out) if stacked else (in_, out)
         scales = jax.random.uniform(next(key), shape_s, scale_dtype,
@@ -439,9 +459,14 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
 
         cfg = _replace(cfg, seq_len=seq_len)
     # record the quant numerics the stage ran so captures are attributable
-    from dllama_tpu.ops.linear import quant_mode_label
+    from dllama_tpu.ops.linear import quant_mode_label, turbo_mode
 
     out["quant_mode"] = quant_mode_label(cfg.compute_dtype == "bfloat16")
+    out["weights"] = bench_weight_repr()
+    if out["weights"] == "bf16" and turbo_mode() is not None:
+        raise ValueError(
+            "DLLAMA_BENCH_WEIGHTS=bf16 has no quantized planes to "
+            "requantize — dense numerics would be mislabeled as turbo")
     # pre-staging HBM guardrail (runtime.hbm): a preset that can't fit must
     # refuse HERE with a clean stage error — an OOM mid-staging wedges the
     # chip for hours (the round-1/2 outage; reference prints its own
@@ -454,7 +479,7 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     if kv_env not in _kv_map:
         raise ValueError(
             f"DLLAMA_BENCH_KV must be one of {sorted(_kv_map)}, got {kv_env!r}")
-    est = estimate_device_bytes(cfg, weight_repr="q40",
+    est = estimate_device_bytes(cfg, weight_repr=bench_weight_repr(),
                                 kv_dtype_bytes=jnp.dtype(_kv_map[kv_env]).itemsize,
                                 batch=batch)
     out["hbm_need_gb"] = round(est["need_per_device"] / 1024 ** 3, 2)
@@ -467,8 +492,6 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     out["fetch_rtt_ms"] = round(1e3 * rtt, 1)
     params = device_random_params(cfg)
     jax.block_until_ready(params)  # staging is forced by the compile sync below
-    from dllama_tpu.ops.linear import turbo_mode
-
     if turbo_mode() is not None:
         # measure what the engine would serve: integer-dot planes (source
         # buffers freed leaf-by-leaf, same as the engine)
@@ -872,11 +895,14 @@ def main() -> None:
                 specs[0].partition("@")[0])
     head_res = stages.get(head, {})
     n_params = matmul_param_count(head)
-    weight_gb = n_params * (1 + 4 / 32) / 1e9  # Q40 planes: 1B codes + f32/32 scales
+    # bytes/weight by the measured representation (the stage records it):
+    # Q40 planes = 1B codes + f32/32 scales; bf16 dense = 2B
+    wrepr = head_res.get("weights", "q40")
+    weight_gb = n_params * (2.0 if wrepr == "bf16" else 1 + 4 / 32) / 1e9
     if head_res.get("decode_tok_per_s"):
         v = head_res["decode_tok_per_s"]
         result["value"] = v
-        result["metric"] = f"decode_tok_per_s_llama{head}_q40_1chip"
+        result["metric"] = f"decode_tok_per_s_llama{head}_{wrepr}_1chip"
         result["vs_baseline"] = round(v / NORTH_STAR_TOK_S, 4)
         # roofline + efficiency context
         result["roofline_decode_tok_per_s"] = round(gbps / weight_gb, 1)

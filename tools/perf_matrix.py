@@ -12,6 +12,10 @@ per combo plus a final summary. The knobs:
   DLLAMA_TPU_QUANT_MODE    fast | exact | turbo | turbo16  (ops/linear.py)
   DLLAMA_TPU_DENSE_LOGITS  on | off      (resident bf16 head vs Q40)
   DLLAMA_TPU_SCAN_UNROLL   N             (layer-scan unroll, models/llama.py)
+  DLLAMA_BENCH_WEIGHTS     q40 | bf16    (dense planes: the no-dequant
+                                          streaming ceiling; 1b-only — the
+                                          8b dense stack exceeds HBM and the
+                                          budget check refuses it cleanly)
 
 Usage:
   python tools/perf_matrix.py [preset] [per-stage-budget-s]
@@ -42,18 +46,21 @@ import bench  # noqa: E402 — the bench parent module is deliberately jax-free
 # old pallas-vs-xla fast rows collapsed into one "pallas" comparison row.)
 COMBOS = [
     # (label, quant_kernel, attn_impl, kv_dtype, quant_mode, dense_logits,
-    #  scan_unroll)
-    ("auto", None, None, None, None, None, None),          # production
-    ("pallas", "pallas", "flash", None, None, None, None), # Pallas kernel
-    ("xla-attn", None, "xla", None, None, None, None),     # oracle attention
-    ("exact", None, None, None, "exact", None, None),      # parity numerics
-    ("auto+f8kv", None, None, "f8", None, None, None),     # fp8 KV storage
-    ("q40-logits", None, None, None, None, "off", None),   # quantized head
-    ("unroll4", None, None, None, None, None, "4"),        # layer-scan unroll
+    #  scan_unroll, weights)
+    ("auto", None, None, None, None, None, None, None),          # production
+    ("pallas", "pallas", "flash", None, None, None, None, None), # Pallas kernel
+    ("xla-attn", None, "xla", None, None, None, None, None),     # oracle attention
+    ("exact", None, None, None, "exact", None, None, None),      # parity numerics
+    ("auto+f8kv", None, None, "f8", None, None, None, None),     # fp8 KV storage
+    ("q40-logits", None, None, None, None, "off", None, None),   # quantized head
+    ("unroll4", None, None, None, None, None, "4", None),        # layer-scan unroll
     # integer-dot turbo modes (ops/turbo.py): per-column int8 planes,
     # scales in the epilogue; a8 = s8xs8 MXU dots, a16 = bf16 activations
-    ("turbo", None, None, None, "turbo", None, None),
-    ("turbo16", None, None, None, "turbo16", None, None),
+    ("turbo", None, None, None, "turbo", None, None, None),
+    ("turbo16", None, None, None, "turbo16", None, None, None),
+    # dense bf16 planes: the no-dequant streaming ceiling (fits HBM on the
+    # 1b preset only; the 8b row fails its budget check with a clean error)
+    ("bf16-dense", None, None, None, None, None, None, "bf16"),
 ]
 
 
@@ -61,7 +68,8 @@ def run_combo(preset: str, budget: float, quant: str | None,
               attn: str | None, kv: str | None = None,
               qmode: str | None = None,
               dense_logits: str | None = None,
-              scan_unroll: str | None = None) -> dict:
+              scan_unroll: str | None = None,
+              weights: str | None = None) -> dict:
     """Set the combo's knobs in this process's env and delegate to
     bench.run_stage (subprocess isolation, live phase tracking, stderr tail,
     kill+reap — no second implementation to drift)."""
@@ -70,7 +78,8 @@ def run_combo(preset: str, budget: float, quant: str | None,
                      ("DLLAMA_BENCH_KV", kv),
                      ("DLLAMA_TPU_QUANT_MODE", qmode),
                      ("DLLAMA_TPU_DENSE_LOGITS", dense_logits),
-                     ("DLLAMA_TPU_SCAN_UNROLL", scan_unroll)):
+                     ("DLLAMA_TPU_SCAN_UNROLL", scan_unroll),
+                     ("DLLAMA_BENCH_WEIGHTS", weights)):
         if val:
             os.environ[var] = val
         else:
@@ -85,9 +94,10 @@ def main() -> None:
     preset = sys.argv[1] if len(sys.argv) > 1 else "1b"
     budget = float(sys.argv[2]) if len(sys.argv) > 2 else 420.0
     rows: dict = {}
-    for label, quant, attn, kv, qmode, dense, unroll in COMBOS:
+    for label, quant, attn, kv, qmode, dense, unroll, weights in COMBOS:
         t0 = time.monotonic()
-        res = run_combo(preset, budget, quant, attn, kv, qmode, dense, unroll)
+        res = run_combo(preset, budget, quant, attn, kv, qmode, dense,
+                        unroll, weights)
         res["combo_s"] = round(time.monotonic() - t0, 1)
         rows[label] = res
         print(json.dumps({label: res}), flush=True)

@@ -45,14 +45,20 @@ COMBO_ENV = {
     "unroll4": {"DLLAMA_TPU_SCAN_UNROLL": "4"},
     "turbo": {"DLLAMA_TPU_QUANT_MODE": "turbo"},
     "turbo16": {"DLLAMA_TPU_QUANT_MODE": "turbo16"},
+    # dense bf16 planes: exact numerics (no quantization), 2x the HBM —
+    # only ever wins the 1b preset (the 8b dense stack exceeds HBM, so the
+    # 8b-first promotion logic keeps q40 for the headline shape)
+    "bf16-dense": {"DLLAMA_BENCH_WEIGHTS": "bf16"},
 }
 # Promotion-eligible combos: kernel/layout knobs (bit-preserving or
 # value-identical) plus the numerics-changing modes whose drift class the
 # round-5 CPU gate validated (turbo/turbo16 ppl drift ≈ fast's, PERF.md).
-# Excluded: `exact` (a parity mode, not a serving config) and `auto+f8kv`
-# (fp8 KV storage is a lossy numerics change with no drift gate yet —
-# bench reports its numbers, but it can't displace the default).
-ELIGIBLE = set(COMBO_ENV) - {"exact", "auto+f8kv"}
+# Excluded: `exact` (a parity mode, not a serving config), `auto+f8kv`
+# (fp8 KV storage is a lossy numerics change with no drift gate yet), and
+# `bf16-dense` (a promoted DLLAMA_BENCH_WEIGHTS would break the 8b
+# headline stages — the dense 8b stack exceeds HBM; it stays a
+# diagnostic row).
+ELIGIBLE = set(COMBO_ENV) - {"exact", "auto+f8kv", "bf16-dense"}
 
 
 def parse_matrix(path: str) -> tuple[str | None, dict]:
