@@ -466,7 +466,10 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     if out["weights"] == "bf16" and turbo_mode() is not None:
         raise ValueError(
             "DLLAMA_BENCH_WEIGHTS=bf16 has no quantized planes to "
-            "requantize — dense numerics would be mislabeled as turbo")
+            "requantize — dense numerics would be mislabeled as turbo. "
+            "If the turbo mode came from bench_promoted.json (the parent "
+            "applies promotions), set DLLAMA_BENCH_NO_PROMO=1 for the "
+            "dense-ceiling run")
     # pre-staging HBM guardrail (runtime.hbm): a preset that can't fit must
     # refuse HERE with a clean stage error — an OOM mid-staging wedges the
     # chip for hours (the round-1/2 outage; reference prints its own

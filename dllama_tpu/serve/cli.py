@@ -19,6 +19,7 @@ runWorkerApp, app.cpp:299-358).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -194,6 +195,38 @@ _cli_wrote_wire = False
 _env_wire_before_cli: str | None = None
 
 
+def _promoted_serving_env():
+    """``(env, evidence)`` when an on-chip A/B promoted a serving config
+    (tools/promote_config.py wrote ``bench_promoted.json``), else None.
+
+    This is how a perf-matrix win becomes the SERVING default, not just a
+    bench configuration: every ``DLLAMA_TPU_*`` knob of the promotion (the
+    engine-scoped ones — quant mode, kernel choice, scan unroll, logits
+    residency; ``DLLAMA_BENCH_*`` knobs are bench-only) applies when the
+    user hasn't set it, with provenance printed and flags/env as the
+    override. The file lives at the repo root (absent for installed
+    packages — promotion is a checkout-level record).
+    ``DLLAMA_TPU_PROMOTED_CONFIG`` overrides the path; the value ``off``
+    disables promotion entirely (the test suite pins it off so an
+    operator's local promotion can't flip test numerics)."""
+    override = os.environ.get("DLLAMA_TPU_PROMOTED_CONFIG")
+    if override == "off":
+        return None
+    path = override or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "bench_promoted.json")
+    try:
+        with open(path) as f:
+            promo = json.load(f)
+    except (OSError, ValueError):
+        return None
+    env = {k: str(v) for k, v in (promo.get("env") or {}).items()
+           if k.startswith("DLLAMA_TPU_")}
+    if not env:
+        return None
+    return env, promo.get("evidence") or {}
+
+
 def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
     if multihost is None:
         multihost = getattr(args, "_multihost", False)
@@ -215,6 +248,31 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         else:
             os.environ["DLLAMA_TPU_QUANT_MODE"] = _env_quant_before_cli
         _cli_wrote_quant_mode = False
+    promo = _promoted_serving_env()
+    if promo is not None:
+        # the on-chip A/B's winner serves by default (with provenance); an
+        # explicit flag or user env always wins per knob
+        env, ev = promo
+        applied = {}
+        for var, val in env.items():
+            if var == "DLLAMA_TPU_QUANT_MODE":
+                if (getattr(args, "quant_mode", "auto") != "auto"
+                        or "DLLAMA_TPU_QUANT_MODE" in os.environ):
+                    continue
+                os.environ[var] = val
+                _cli_wrote_quant_mode = True  # restore discipline applies
+            elif var not in os.environ:
+                os.environ[var] = val
+            else:
+                continue
+            applied[var] = val
+        if applied:
+            print(f"⚡ promoted serving config: "
+                  + " ".join(f"{k.removeprefix('DLLAMA_TPU_')}={v}"
+                             for k, v in applied.items())
+                  + f" — on-chip A/B (decode {ev.get('decode_tok_per_s')} vs "
+                    f"auto {ev.get('auto_decode_tok_per_s')} tok/s, "
+                    f"{ev.get('gain')}x); flags/env override")
     # --wire mirrors the quant-mode discipline: an explicit flag value is
     # set (and overrides a user export), the unset default restores
     # whatever a PRIOR make_engine in this process overwrote

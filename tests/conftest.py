@@ -13,6 +13,10 @@ import os
 # (pytest -m tpu); default is the 8-device virtual CPU mesh.
 _TPU_TIER = os.environ.get("DLLAMA_TESTS_TPU") == "1"
 
+# an operator's local bench_promoted.json must not flip test numerics:
+# promotion is off for the whole suite unless a test opts in explicitly
+os.environ.setdefault("DLLAMA_TPU_PROMOTED_CONFIG", "off")
+
 if not _TPU_TIER:
     os.environ["JAX_PLATFORMS"] = "cpu"  # force: the live session exposes a TPU
     xla_flags = os.environ.get("XLA_FLAGS", "")

@@ -63,3 +63,44 @@ def test_chat_mode_replies_and_exits_on_eof(model_files, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert "🤖" in out  # the assistant turn streamed something
     assert "context is full" not in out.split("🤖")[0]  # prompt fit
+
+
+def test_promoted_quant_mode_becomes_default(model_files, tmp_path,
+                                             monkeypatch, capsys):
+    """A perf-matrix promotion (bench_promoted.json) becomes the SERVING
+    default: --quant-mode auto with no user env resolves to the promoted
+    mode with a provenance line; an explicit flag still wins."""
+    import json as _json
+
+    from dllama_tpu.ops.turbo import TurboWeight
+
+    promo = tmp_path / "bench_promoted.json"
+    promo.write_text(_json.dumps({
+        "env": {"DLLAMA_TPU_QUANT_MODE": "turbo16"}, "combo": "turbo16",
+        "evidence": {"decode_tok_per_s": 70.2, "auto_decode_tok_per_s": 34.5,
+                     "gain": 2.03}}))
+    monkeypatch.setenv("DLLAMA_TPU_PROMOTED_CONFIG", str(promo))
+    monkeypatch.delenv("DLLAMA_TPU_QUANT_MODE", raising=False)
+    monkeypatch.delenv("DLLAMA_TPU_SCAN_UNROLL", raising=False)
+    base = ["inference", "--model", model_files[0],
+            "--tokenizer", model_files[1], "--compute-dtype", "bf16",
+            "--temperature", "0"]
+    try:
+        eng = cli.make_engine(cli.build_parser().parse_args(base))
+        assert isinstance(eng.params.layers.wq, TurboWeight)
+        eng.close()
+        assert "promoted serving config" in capsys.readouterr().out
+        # explicit --quant-mode overrides the promotion
+        eng2 = cli.make_engine(cli.build_parser().parse_args(
+            base + ["--quant-mode", "fast"]))
+        assert not isinstance(eng2.params.layers.wq, TurboWeight)
+        eng2.close()
+        # user-exported env overrides it too
+        monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "fast")
+        cli._cli_wrote_quant_mode = False
+        eng3 = cli.make_engine(cli.build_parser().parse_args(base))
+        assert not isinstance(eng3.params.layers.wq, TurboWeight)
+        eng3.close()
+    finally:
+        cli._cli_wrote_quant_mode = False
+        cli._env_quant_before_cli = None
