@@ -707,8 +707,16 @@ def _find_fallback_capture():
     # timestamps, not the 'capture_' literal
     cands.sort(key=lambda p: os.path.basename(os.path.dirname(p))
                .removeprefix("capture_"), reverse=True)
+    def _round_no(p: str) -> int:
+        # BENCH_r<NN>_manual.json — numeric sort (lexicographic would rank
+        # r9 above r10)
+        import re
+
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
     cands += sorted(glob.glob(os.path.join(here, "BENCH_r*_manual.json")),
-                    reverse=True)
+                    key=_round_no, reverse=True)
     for p in cands:
         try:
             with open(p) as f:

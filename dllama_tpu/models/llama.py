@@ -106,6 +106,14 @@ def _use_flash(cfg: ModelConfig, q_shape, kv_shape) -> bool:
     if cfg.attn_impl == "flash":
         if not ok:
             raise ValueError(f"flash attention unsupported for q={q_shape}, S={s}")
+        if plan is not None and plan.axis_size("pp") > 1 and not plan_ok:
+            # direct-forward pp meshes with extra axes never pass through
+            # validate_pp: a forced kernel must still fail loudly here, not
+            # silently run the oracle
+            raise ValueError(
+                "attn_impl='flash' under pp×(tp|dp|sp|ep) is unsupported "
+                "(the Pallas kernel can't nest inside the manual pp "
+                "shard_map with auto axes); use 'auto' or 'xla', or pure pp")
         return plan_ok
     return ok and _fa.default_enabled() and plan_ok
 

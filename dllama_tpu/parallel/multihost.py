@@ -306,6 +306,10 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         # (ops/linear.py _fast_mode); `auto` resolves identically on both
         # sides because compute_dtype is fingerprinted above
         s32(os.environ.get("DLLAMA_TPU_QUANT_MODE", "auto")),
+        # kernel-dispatch choice (pallas vs xla) compiles different programs
+        # — and is now promotable (serve.cli promoted serving config), so a
+        # root/worker bench_promoted.json divergence must fail fast here
+        s32(os.environ.get("DLLAMA_TPU_QUANT_KERNEL", "auto")),
         # wire format changes the collective program (qcollectives.py)
         s32(os.environ.get("DLLAMA_TPU_WIRE", "f32")),
         # layer-scan unroll factor shapes the forward program (models.llama);

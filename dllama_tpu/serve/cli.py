@@ -193,6 +193,10 @@ _cli_wrote_quant_mode = False
 _env_quant_before_cli: str | None = None
 _cli_wrote_wire = False
 _env_wire_before_cli: str | None = None
+# non-quant-mode env knobs a promotion applied (var -> value WE wrote):
+# retired when the promotion stops covering them, so stale knobs can't
+# outlive their evidence
+_promo_applied: dict = {}
 
 
 def _promoted_serving_env():
@@ -249,6 +253,15 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
             os.environ["DLLAMA_TPU_QUANT_MODE"] = _env_quant_before_cli
         _cli_wrote_quant_mode = False
     promo = _promoted_serving_env()
+    # retire knobs a PRIOR make_engine promoted that no longer apply (the
+    # promotion file changed, was removed, or was turned off) — a user's
+    # own exports are untouched because only values WE wrote are tracked
+    env_now = promo[0] if promo is not None else {}
+    for var, val in list(_promo_applied.items()):
+        if env_now.get(var) != val:
+            if os.environ.get(var) == val:
+                os.environ.pop(var, None)
+            _promo_applied.pop(var, None)
     if promo is not None:
         # the on-chip A/B's winner serves by default (with provenance); an
         # explicit flag or user env always wins per knob
@@ -261,8 +274,9 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
                     continue
                 os.environ[var] = val
                 _cli_wrote_quant_mode = True  # restore discipline applies
-            elif var not in os.environ:
+            elif var not in os.environ or _promo_applied.get(var) == val:
                 os.environ[var] = val
+                _promo_applied[var] = val
             else:
                 continue
             applied[var] = val
