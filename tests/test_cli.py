@@ -4,6 +4,7 @@ covers the inference printout contract and the chat REPL loop (template
 render → prefill → sampled decode → EOS/seq-len stop) end to end."""
 
 import io
+import os
 
 import numpy as np
 import pytest
@@ -80,8 +81,13 @@ def test_promoted_quant_mode_becomes_default(model_files, tmp_path,
         "evidence": {"decode_tok_per_s": 70.2, "auto_decode_tok_per_s": 34.5,
                      "gain": 2.03}}))
     monkeypatch.setenv("DLLAMA_TPU_PROMOTED_CONFIG", str(promo))
-    monkeypatch.delenv("DLLAMA_TPU_QUANT_MODE", raising=False)
     monkeypatch.delenv("DLLAMA_TPU_SCAN_UNROLL", raising=False)
+    # DLLAMA_TPU_QUANT_MODE is managed MANUALLY, not via monkeypatch:
+    # make_engine itself writes the var by design, and monkeypatch.setenv
+    # would record that cli-written value as "previous" and re-instate it
+    # at teardown — leaking turbo/fast numerics into the rest of the suite
+    # (the round-5 full-suite golden failures).
+    prev_qm = os.environ.pop("DLLAMA_TPU_QUANT_MODE", None)
     base = ["inference", "--model", model_files[0],
             "--tokenizer", model_files[1], "--compute-dtype", "bf16",
             "--temperature", "0"]
@@ -96,7 +102,7 @@ def test_promoted_quant_mode_becomes_default(model_files, tmp_path,
         assert not isinstance(eng2.params.layers.wq, TurboWeight)
         eng2.close()
         # user-exported env overrides it too
-        monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "fast")
+        os.environ["DLLAMA_TPU_QUANT_MODE"] = "fast"
         cli._cli_wrote_quant_mode = False
         eng3 = cli.make_engine(cli.build_parser().parse_args(base))
         assert not isinstance(eng3.params.layers.wq, TurboWeight)
@@ -104,3 +110,8 @@ def test_promoted_quant_mode_becomes_default(model_files, tmp_path,
     finally:
         cli._cli_wrote_quant_mode = False
         cli._env_quant_before_cli = None
+        cli._promo_applied.clear()
+        if prev_qm is None:
+            os.environ.pop("DLLAMA_TPU_QUANT_MODE", None)
+        else:
+            os.environ["DLLAMA_TPU_QUANT_MODE"] = prev_qm
