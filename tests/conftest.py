@@ -55,3 +55,24 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip_tpu)
         elif _TPU_TIER and not has_tpu:
             item.add_marker(skip_cpu)
+
+
+import pytest as _pt
+
+
+@_pt.fixture(autouse=True)
+def _dllama_env_leak_sentinel():
+    """Fail the OFFENDING test when it leaks a DLLAMA_* env knob.
+
+    The quant/serving knobs are read at trace time, so a leaked var flips
+    numerics for every later test — the round-5 full-suite incident was 36
+    order-dependent golden failures traced to one test's env interplay.
+    Autouse + declared first => torn down last, AFTER monkeypatch undo."""
+    before = {k: v for k, v in os.environ.items() if k.startswith("DLLAMA_")}
+    yield
+    after = {k: v for k, v in os.environ.items() if k.startswith("DLLAMA_")}
+    assert after == before, (
+        "test leaked DLLAMA_* env state: "
+        + str({k: (before.get(k), after.get(k))
+               for k in set(before) | set(after)
+               if before.get(k) != after.get(k)}))
