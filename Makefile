@@ -19,7 +19,7 @@ PY ?= python
 .PHONY: test test-tpu test-all native tsan bench graft clean
 
 test:
-	$(PY) -m pytest tests/ -x -q
+	$(PY) -m pytest tests/ -q
 
 test-tpu:
 	DLLAMA_TESTS_TPU=1 $(PY) -m pytest tests/ -m tpu -q

@@ -1,7 +1,8 @@
 #!/bin/bash
 # Chip watcher: probe the axon TPU every PROBE_INTERVAL seconds; in the first
 # healthy window, automatically run the full perf capture sequence
-# (bench.py -> tpu-tier pytest -> perf_matrix 1b -> perf_matrix 8b) and save
+# (bench.py -> perf_matrix 8b -> perf_matrix 1b -> promotion re-bench ->
+# tpu-tier pytest -> f8 twin -> profiles) and save
 # everything under bench_results/.  Designed to survive a wedged chip: every
 # probe and every capture stage is a killable subprocess with a hard timeout.
 #
@@ -38,10 +39,27 @@ print(ds[0].platform, ds[0].device_kind)
     [ $rc -eq 0 ]
 }
 
+mirror() {
+    # copy whatever artifacts exist so far into the TRACKED mirror dir —
+    # called after EVERY stage, not just at capture end: a window that
+    # truncates mid-capture (session end, wedge) must still hand the
+    # completed stages to the end-of-round auto-commit
+    local cdir=$1 adir=$2
+    mkdir -p "$adir"
+    local f
+    for f in BENCH_live.json BENCH_auto.json BENCH_promoted.json \
+             promotion.json status pytest_tpu.log matrix_1b.log \
+             matrix_8b.log profile_8b.log profile_1b.log bench.stderr \
+             s8k_f8.json INVALID; do
+        [ -f "$cdir/$f" ] && cp "$cdir/$f" "$adir/" 2>/dev/null
+    done
+}
+
 capture() {
-    local ts cdir
+    local ts cdir adir
     ts=$(date -u +%Y%m%dT%H%M%SZ)
     cdir=$OUT/capture_$ts
+    adir=$REPO/capture_artifacts/$ts
     mkdir -p "$cdir"
     echo "capture start $ts" >> "$OUT/probe_log.jsonl.notes"
     cd "$REPO" || return 1
@@ -59,13 +77,19 @@ capture() {
     #    honest prefill number and clean chunked/verify numbers.
     timeout 3600 python bench.py > "$cdir/BENCH_live.json" 2> "$cdir/bench.stderr"
     echo "bench rc=$?" >> "$cdir/status"
+    mirror "$cdir" "$adir"
 
     # 2+3. kernel-choice sweeps — the turbo/scan-unroll A/B the round's
-    #    perf verdict rides on (1b first: always banks something)
-    timeout 3600 python tools/perf_matrix.py 1b 300 > "$cdir/matrix_1b.log" 2>&1
-    echo "matrix_1b rc=$?" >> "$cdir/status"
+    #    perf verdict rides on. 8b FIRST: it is the headline shape and the
+    #    combos are in decision-value order, so even a window truncated
+    #    minutes after it opens banks the auto-vs-turbo verdict (step 1's
+    #    bench already banked both presets' production decode numbers).
     timeout 4800 python tools/perf_matrix.py 8b 420 > "$cdir/matrix_8b.log" 2>&1
     echo "matrix_8b rc=$?" >> "$cdir/status"
+    mirror "$cdir" "$adir"
+    timeout 3600 python tools/perf_matrix.py 1b 300 > "$cdir/matrix_1b.log" 2>&1
+    echo "matrix_1b rc=$?" >> "$cdir/status"
+    mirror "$cdir" "$adir"
 
     # 4. promote the winning combo (>=10% over auto writes
     #    bench_promoted.json, which bench.py applies with provenance) and
@@ -95,12 +119,14 @@ sys.exit(0 if ok else 1)" 2>/dev/null; then
             cp "$cdir/BENCH_live.json" "$cdir/BENCH_auto.json"
             cp "$cdir/BENCH_promoted.json" "$cdir/BENCH_live.json"
         fi
+        mirror "$cdir" "$adir"
     fi
 
     # 5. TPU hardware test tier (incl. the 2049-step macbeth chain on chip)
     timeout 1800 env DLLAMA_TESTS_TPU=1 python -m pytest tests -m tpu -q \
         > "$cdir/pytest_tpu.log" 2>&1
     echo "pytest_tpu rc=$?" >> "$cdir/status"
+    mirror "$cdir" "$adir"
 
     # 6. the f8-KV long-context comparison: the bench's default stages
     #    already measure 1b@s8k with a bf16 cache; this is the f8 twin
@@ -109,6 +135,7 @@ sys.exit(0 if ok else 1)" 2>/dev/null; then
         DLLAMA_BENCH_NO_PROMO=1 \
         python bench.py > "$cdir/s8k_f8.json" 2> "$cdir/s8k_f8.stderr"
     echo "s8k_f8 rc=$?" >> "$cdir/status"
+    mirror "$cdir" "$adir"
 
     # 7+8. where the milliseconds go: per-op decode profiles (both presets;
     #    profile_decode prints the per-op-sum vs chain-time reconciliation)
@@ -121,17 +148,8 @@ sys.exit(0 if ok else 1)" 2>/dev/null; then
     rm -f "$OUT/RERUN"
     echo "capture end $(date -u +%FT%TZ)" >> "$OUT/probe_log.jsonl.notes"
 
-    # bench_results/ is gitignored; mirror the capture into a TRACKED dir so
-    # the driver's end-of-round auto-commit preserves it even when the
-    # healthy window lands after the session's last manual commit
-    adir=$REPO/capture_artifacts/$ts
-    mkdir -p "$adir"
-    for f in BENCH_live.json BENCH_auto.json BENCH_promoted.json \
-             promotion.json status pytest_tpu.log matrix_1b.log \
-             matrix_8b.log profile_8b.log profile_1b.log bench.stderr \
-             s8k_f8.json INVALID; do
-        [ -f "$cdir/$f" ] && cp "$cdir/$f" "$adir/" 2>/dev/null
-    done
+    # final mirror + human-readable summary into the TRACKED dir
+    mirror "$cdir" "$adir"
     python "$REPO/tools/analyze_capture.py" "$cdir" \
         > "$adir/ANALYSIS.txt" 2>&1 || true
 }
