@@ -334,55 +334,127 @@ def stage_child(spec: str) -> None:
     print(json.dumps({"stage_result": dict(st)}), flush=True)
 
 
+CHIP_LOCK = "/tmp/dllama-chip.lock"
+# stage children currently holding chip residency (the watchdog must kill
+# them before force-exiting — a force-exit releases the chip lock while an
+# orphan keeps the model staged: the double-residency the lock prevents)
+_LIVE_CHILDREN: set = set()
+# seconds spent WAITING for the chip lock this run: legitimate contention,
+# not a wedge — main's watchdog extends its deadline by this
+_LOCK_WAIT_TOTAL = [0.0]
+
+
+class _chip_lock:
+    """Exclusive cross-process lock around anything that stages a model on
+    the chip. Two concurrent 8B residencies (the driver's end-of-round bench
+    interleaving with the watcher's capture in the same healthy window)
+    would OOM-wedge the backend for hours — the round-1/2/4 failure mode.
+    Per-STAGE granularity so both holders make progress; falls through
+    after ``timeout`` (measuring under contention beats not measuring)."""
+
+    def __init__(self, timeout: float = 900.0):
+        self._timeout = timeout
+        self._fh = None
+
+    def __enter__(self):
+        import fcntl
+
+        try:
+            self._fh = open(CHIP_LOCK, "a+")
+        except OSError as e:
+            print(f"chip lock unavailable ({e}); proceeding UNLOCKED",
+                  file=sys.stderr, flush=True)
+            return self
+        t0 = time.monotonic()
+        while True:
+            try:
+                fcntl.flock(self._fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return self
+            except OSError:
+                if time.monotonic() - t0 > self._timeout:
+                    print(f"chip lock not acquired in {self._timeout:.0f}s; "
+                          f"proceeding UNLOCKED (contention beats silence)",
+                          file=sys.stderr, flush=True)
+                    return self
+                time.sleep(2.0)
+
+    def __exit__(self, *exc):
+        if self._fh is not None:
+            import fcntl
+
+            try:
+                fcntl.flock(self._fh, fcntl.LOCK_UN)
+            except OSError:
+                pass
+            self._fh.close()
+        return False
+
+
 def run_stage(spec: str, budget: float) -> dict:
-    """Run one stage in a subprocess with a hard kill at ``budget``."""
+    """Run one stage in a subprocess with a hard kill at ``budget``
+    (holding the chip lock: see _chip_lock)."""
     import threading
     from collections import deque
 
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ,
                DLLAMA_BENCH_CHILD_BUDGET=str(max(30.0, budget - 20.0)))
-    child = subprocess.Popen(
-        [sys.executable, os.path.join(here, "bench.py"), "--stage", spec],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=env, cwd=here)
     rec: dict = {"phase": "spawn"}
     err_tail: deque = deque(maxlen=30)
+    child = None
+    threads: list = []
+    t_lock = time.monotonic()
+    with _chip_lock():
+        # lock WAITING must not be charged to the wedge watchdog — the
+        # accumulated wait extends the parent deadline (see main's watchdog)
+        wait_s = time.monotonic() - t_lock
+        _LOCK_WAIT_TOTAL[0] += wait_s
+        if wait_s > 1.0:
+            rec["lock_wait_s"] = round(wait_s, 1)
+        child = subprocess.Popen(
+            [sys.executable, os.path.join(here, "bench.py"), "--stage", spec],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=here)
+        _LIVE_CHILDREN.add(child)
 
-    def read_out():
-        for line in child.stdout:
-            line = line.strip()
-            if not line.startswith("{"):
-                continue
-            try:
-                obj = json.loads(line)
-            except ValueError:
-                continue
-            if "stage_result" in obj:
-                rec["result"] = obj["stage_result"]
-            elif "phase" in obj:
-                rec["phase"] = obj["phase"]
+        def read_out():
+            for line in child.stdout:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if "stage_result" in obj:
+                    rec["result"] = obj["stage_result"]
+                elif "phase" in obj:
+                    rec["phase"] = obj["phase"]
 
-    def read_err():  # drain: a full pipe would block the child
-        for line in child.stderr:
-            err_tail.append(line.rstrip())
+        def read_err():  # drain: a full pipe would block the child
+            for line in child.stderr:
+                err_tail.append(line.rstrip())
 
-    threads = [threading.Thread(target=read_out, daemon=True),
-               threading.Thread(target=read_err, daemon=True)]
-    for th in threads:
-        th.start()
-    try:
-        child.wait(timeout=budget)
-    except subprocess.TimeoutExpired:
-        child.kill()
-        rec["killed"] = f"stage killed at {budget:.0f}s budget"
+        threads = [threading.Thread(target=read_out, daemon=True),
+                   threading.Thread(target=read_err, daemon=True)]
+        for th in threads:
+            th.start()
         try:
-            child.wait(timeout=10)  # reap; readers see EOF
+            child.wait(timeout=budget)
         except subprocess.TimeoutExpired:
-            pass
+            child.kill()
+            rec["killed"] = f"stage killed at {budget:.0f}s budget"
+            try:
+                child.wait(timeout=10)  # reap; readers see EOF
+            except subprocess.TimeoutExpired:
+                pass
+        finally:
+            _LIVE_CHILDREN.discard(child)
     for th in threads:
         th.join(timeout=10)
     if "result" in rec:
+        if "lock_wait_s" in rec and isinstance(rec["result"], dict):
+            rec["result"]["lock_wait_s"] = rec["lock_wait_s"]
         return rec["result"]
     out = {"phase": rec.get("phase"),
            "error": rec.get("killed")
@@ -861,7 +933,25 @@ def main() -> None:
     # A daemon timer force-emits the JSON line and exits 0 at the deadline.
     import threading
 
+    _wd_done = threading.Event()
+
     def _watchdog():
+        # poll instead of a fixed Timer: time spent WAITING on the chip
+        # lock (legitimate contention with a concurrent capture, not a
+        # wedge) extends the effective deadline
+        while not _wd_done.wait(10.0):
+            if time.monotonic() > deadline + _LOCK_WAIT_TOTAL[0] + 60:
+                break
+        if _wd_done.is_set():
+            return
+        # kill in-flight stage children FIRST: os._exit releases the chip
+        # lock while an orphan would keep its model staged — the exact
+        # double-residency wedge the lock exists to prevent
+        for ch in list(_LIVE_CHILDREN):
+            try:
+                ch.kill()
+            except Exception:  # noqa: BLE001
+                pass
         try:
             result.setdefault("stages", {})
             result["error"] = (result.get("error")
@@ -879,8 +969,7 @@ def main() -> None:
         finally:
             os._exit(0)
 
-    wd = threading.Timer(max(1.0, deadline - time.monotonic() + 60), _watchdog)
-    wd.daemon = True
+    wd = threading.Thread(target=_watchdog, daemon=True)
     wd.start()
 
     stages: dict = {}
@@ -933,11 +1022,14 @@ def main() -> None:
             env = dict(os.environ, DLLAMA_TESTS_TPU="1")
             env.pop("JAX_PLATFORMS", None)
             env.pop("XLA_FLAGS", None)
-            tp = subprocess.run(
-                [sys.executable, "-m", "pytest", "tests", "-m", "tpu", "-q",
-                 "--no-header", "-p", "no:cacheprovider"],
-                capture_output=True, timeout=budget,
-                cwd=os.path.dirname(os.path.abspath(__file__)), env=env)
+            t_lk = time.monotonic()
+            with _chip_lock():  # the tier stages real models on the chip
+                _LOCK_WAIT_TOTAL[0] += time.monotonic() - t_lk
+                tp = subprocess.run(
+                    [sys.executable, "-m", "pytest", "tests", "-m", "tpu", "-q",
+                     "--no-header", "-p", "no:cacheprovider"],
+                    capture_output=True, timeout=budget,
+                    cwd=os.path.dirname(os.path.abspath(__file__)), env=env)
             result["tpu_test_tier"] = {
                 "rc": tp.returncode,
                 "tail": _tail(tp.stdout)[-400:],
@@ -949,7 +1041,7 @@ def main() -> None:
             result["tpu_test_tier"] = {"rc": None, "tail": f"{type(e).__name__}: {e}"}
 
     result["elapsed_s"] = round(time.monotonic() - t_start, 1)
-    wd.cancel()
+    _wd_done.set()
     emit(result)
 
 

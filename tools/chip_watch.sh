@@ -123,7 +123,8 @@ sys.exit(0 if ok else 1)" 2>/dev/null; then
     fi
 
     # 5. TPU hardware test tier (incl. the 2049-step macbeth chain on chip)
-    timeout 1800 env DLLAMA_TESTS_TPU=1 python -m pytest tests -m tpu -q \
+    timeout 1800 flock -w 600 /tmp/dllama-chip.lock \
+        env DLLAMA_TESTS_TPU=1 python -m pytest tests -m tpu -q \
         > "$cdir/pytest_tpu.log" 2>&1
     echo "pytest_tpu rc=$?" >> "$cdir/status"
     mirror "$cdir" "$adir"
@@ -139,9 +140,11 @@ sys.exit(0 if ok else 1)" 2>/dev/null; then
 
     # 7+8. where the milliseconds go: per-op decode profiles (both presets;
     #    profile_decode prints the per-op-sum vs chain-time reconciliation)
-    timeout 1200 python tools/profile_decode.py 8b 4 > "$cdir/profile_8b.log" 2>&1
+    timeout 1200 flock -w 600 /tmp/dllama-chip.lock \
+        python tools/profile_decode.py 8b 4 > "$cdir/profile_8b.log" 2>&1
     echo "profile_8b rc=$?" >> "$cdir/status"
-    timeout 900 python tools/profile_decode.py 1b 4 > "$cdir/profile_1b.log" 2>&1
+    timeout 900 flock -w 450 /tmp/dllama-chip.lock \
+        python tools/profile_decode.py 1b 4 > "$cdir/profile_1b.log" 2>&1
     echo "profile_1b rc=$?" >> "$cdir/status"
 
     touch "$OUT/capture_done"
