@@ -10,13 +10,15 @@
 #                   real chip (compiles actual Pallas kernels).
 #   make test-all   Both CPU tiers, then the TPU tier if a chip answers.
 #   make native     Build the C++ host-runtime library (quant codecs, BPE).
+#   make lint       Telemetry metric-name lint: every registered name is
+#                   convention-clean and documented in PERF.md.
 #   make bench      The driver's benchmark: ONE JSON line on stdout.
 #   make graft      Compile-check the jittable entry + the 8-device
 #                   multi-chip dry run (tp/pp/dp/sp/ep shardings).
 
 PY ?= python
 
-.PHONY: test test-tpu test-all native tsan bench graft clean
+.PHONY: test test-tpu test-all native tsan bench graft lint clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -32,6 +34,9 @@ native:
 tsan:
 	$(MAKE) -C dllama_tpu/native tsan
 	TSAN_OPTIONS="halt_on_error=1 exitcode=66" ./dllama_tpu/native/tsan_stress
+
+lint:
+	$(PY) tools/check_metrics_names.py
 
 bench:
 	$(PY) bench.py

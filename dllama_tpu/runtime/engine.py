@@ -43,6 +43,7 @@ from ..parallel.api import MeshPlan, make_mesh, use_plan
 from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.sampler import Sampler, xorshift_random_f32
+from . import telemetry
 from .kvcache import KVCache
 
 DEFAULT_N_BATCHES = 32  # reference default nBatches (app.cpp:28)
@@ -97,13 +98,20 @@ class GenerationResult:
 
     @property
     def pred_tok_per_s(self) -> float:
+        # both guards matter: a request that produced 0 predicted tokens has
+        # no "pred" steps (duration 0), and a sub-resolution clock can hand
+        # back ms == 0.0 for a nonzero token count — neither may divide
         n = sum(s.n_tokens for s in self.steps if s.kind == "pred")
-        return n / (self.pred_ms / 1000.0) if self.pred_ms > 0 else 0.0
+        if n <= 0 or self.pred_ms <= 0.0:
+            return 0.0
+        return n / (self.pred_ms / 1000.0)
 
     @property
     def eval_tok_per_s(self) -> float:
         n = sum(s.n_tokens for s in self.steps if s.kind == "eval")
-        return n / (self.eval_ms / 1000.0) if self.eval_ms > 0 else 0.0
+        if n <= 0 or self.eval_ms <= 0.0:
+            return 0.0
+        return n / (self.eval_ms / 1000.0)
 
 
 class InferenceEngine:
@@ -298,8 +306,21 @@ class InferenceEngine:
             n_shards=self.tp * self.pp,
             offload=(weight_mode == "offload"))
         self.hbm_estimate = est
-        check_budget(est["need_per_device"],
-                     f"model {model_path} ({weight_mode})")
+        limit = check_budget(est["need_per_device"],
+                             f"model {model_path} ({weight_mode})")
+        # telemetry (runtime.telemetry): cached metric handles — the decode
+        # hot path records through attribute reads, no registry lookups
+        self._tm = telemetry.registry()
+        self._tm.gauge(telemetry.HBM_NEED_BYTES).set(est["need_per_device"])
+        self._tm.gauge(telemetry.HBM_LIMIT_BYTES).set(limit or 0)
+        self._m_prefill_ms = self._tm.histogram(telemetry.PREFILL_CHUNK_MS)
+        self._m_prefill_tok = self._tm.counter(telemetry.PREFILL_TOKENS)
+        self._m_step_ms = self._tm.histogram(telemetry.DECODE_STEP_MS)
+        self._m_decode_tok = self._tm.counter(telemetry.DECODE_TOKENS)
+        self._m_kv = self._tm.gauge(telemetry.KV_OCCUPANCY)
+        # request id stamped onto trace spans by the serving layer (the
+        # engine itself has no request concept; -1 = unattributed)
+        self.trace_rid = -1
 
         # streaming loader: shard-direct reads from the mmap, host memory
         # bounded by one tensor shard (VERDICT round-1 missing #4)
@@ -476,6 +497,7 @@ class InferenceEngine:
         last_logits = None
         i = 0
         n = len(token_ids)
+        trace_t0 = telemetry.now_ns() if telemetry.tracer().enabled else 0
         while i < n:
             size = self._prefill_chunk_size(n - i)
             chunk = token_ids[i:i + size]
@@ -490,9 +512,15 @@ class InferenceEngine:
             logits_np = np.asarray(logits[0, valid - 1])
             ms = (time.perf_counter() - t0) * 1000.0
             metrics.append(StepMetrics("eval", ms, valid))
+            self._m_prefill_ms.record(ms)
             last_logits = logits_np
             self.pos += valid
             i += valid
+        self._m_prefill_tok.inc(n)
+        self._m_kv.set(self.pos / self.cfg.seq_len)
+        if trace_t0:
+            telemetry.tracer().emit(self.trace_rid, "prefill", trace_t0,
+                                    telemetry.now_ns(), n_tokens=n)
         return last_logits, metrics
 
     def decode_step(self, token: int) -> np.ndarray:
@@ -513,17 +541,21 @@ class InferenceEngine:
         oracle path (the parity reference)."""
         if self.pos >= self.cfg.seq_len:
             raise ValueError(f"position {self.pos} reached seq_len {self.cfg.seq_len}")
+        t0 = time.perf_counter()
         if self.sampler.temperature == 0.0:
             nxt = self._dispatch(self._greedy_step, np.asarray([[token]]), self.pos)
             self.pos += 1
-            return int(nxt[0])
-        if self.host_sampling:
-            return self.sampler.sample(self.decode_step(token))
-        coin, self.sampler.rng_state = xorshift_random_f32(self.sampler.rng_state)
-        nxt = self._dispatch(
-            self._sampled_step, np.asarray([[token]]), self.pos,
-            extras=(self.sampler.temperature, self.sampler.topp, coin))
-        self.pos += 1
+        elif self.host_sampling:
+            nxt = (self.sampler.sample(self.decode_step(token)),)
+        else:
+            coin, self.sampler.rng_state = xorshift_random_f32(self.sampler.rng_state)
+            nxt = self._dispatch(
+                self._sampled_step, np.asarray([[token]]), self.pos,
+                extras=(self.sampler.temperature, self.sampler.topp, coin))
+            self.pos += 1
+        self._m_step_ms.record((time.perf_counter() - t0) * 1000.0)
+        self._m_decode_tok.inc()
+        self._m_kv.set(self.pos / self.cfg.seq_len)
         return int(nxt[0])
 
     def decode_chunk_tokens(self, token: int, k: int) -> list[int]:
@@ -552,9 +584,11 @@ class InferenceEngine:
                 CTRL_GREEDY_CHUNK if greedy else CTRL_SAMPLED_CHUNK,
                 token, self.pos, k, coins=coins,
                 temp=self.sampler.temperature, topp=self.sampler.topp))
+        t0 = time.perf_counter()
         toks = self._run_chunk(token, self.pos, k, greedy,
                                self.sampler.temperature, self.sampler.topp,
                                coins)
+        self._m_step_ms.record((time.perf_counter() - t0) * 1000.0)
         return [int(t) for t in toks[0]]
 
     def _run_chunk(self, token: int, start_pos: int, k: int, greedy: bool,
@@ -596,7 +630,15 @@ class InferenceEngine:
             from ..parallel.multihost import CTRL_SPEC_VERIFY
 
             self._ctrl.send(self._ctrl.encode(CTRL_SPEC_VERIFY, toks, self.pos))
+        t0 = time.perf_counter()
+        trace_t0 = telemetry.now_ns() if telemetry.tracer().enabled else 0
         n_acc, preds = self._run_verify(toks, self.pos)
+        if trace_t0:
+            telemetry.tracer().emit(self.trace_rid, "verify", trace_t0,
+                                    telemetry.now_ns(), n_tokens=n_acc + 1)
+        self._m_step_ms.record((time.perf_counter() - t0) * 1000.0)
+        self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(len(drafts))
+        self._tm.counter(telemetry.SPEC_ACCEPTED_TOKENS).inc(n_acc)
         return [int(t) for t in preds[0, : n_acc + 1]]
 
     def _run_verify(self, tokens_2d, start_pos: int):
@@ -615,6 +657,8 @@ class InferenceEngine:
             for _ in range(n_keep):
                 _, st = xorshift_random_f32(st)
             self.sampler.rng_state = st
+        self._m_decode_tok.inc(n_keep)
+        self._m_kv.set(self.pos / self.cfg.seq_len)
 
     # -- eval/sync split ----------------------------------------------------
 
@@ -664,6 +708,7 @@ class InferenceEngine:
             self.split = EvalSyncSplit(eval_ms=0.0, sync_ms=0.0,
                                        n_steps=0, n_lanes=0)
             self.split_prefill = self.split  # no collectives in any program
+            self._publish_split_metrics()
             return self.split
 
         def _scratch():
@@ -705,7 +750,26 @@ class InferenceEngine:
                 self.split_prefill = measure_eval_sync(_scratch_p, n_steps)
                 if self.split_prefill.sync_ms > 0.0:
                     break
+        self._publish_split_metrics()
         return self.split
+
+    def _publish_split_metrics(self) -> None:
+        """Fold the one-off static accounting into the live registry: a
+        ``/metrics`` scrape then carries the reference's full per-token
+        picture (eval/sync fraction + wire bytes) next to the serving
+        metrics the reference never had."""
+        if self.traffic is not None:
+            self._tm.gauge(telemetry.COLLECTIVE_SENT_KB).set(
+                self.traffic.sent_kb)
+            self._tm.gauge(telemetry.COLLECTIVE_RECV_KB).set(
+                self.traffic.recv_kb)
+            self._tm.gauge(telemetry.COLLECTIVE_OPS).set(
+                self.traffic.n_collectives)
+        if self.split is not None:
+            self._tm.gauge(telemetry.SYNC_FRACTION).set(self.split.sync_frac)
+        if self.split_prefill is not None:
+            self._tm.gauge(telemetry.SYNC_FRACTION_PREFILL).set(
+                self.split_prefill.sync_frac)
 
     # -- generation ---------------------------------------------------------
 

@@ -27,6 +27,7 @@ fixed-seed reproducibility.
 from __future__ import annotations
 
 import threading
+import time
 from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable
@@ -34,6 +35,8 @@ from typing import TYPE_CHECKING, Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from . import telemetry
 
 from ..models.llama import forward, sampled_step
 from ..parallel.api import use_plan
@@ -104,6 +107,12 @@ class Request:
     rng_state: int = 0
     error: str | None = None
     decoder: object = None  # per-request streaming UTF-8 decoder
+    # telemetry timeline (monotonic ns; 0 = not reached): submit → admission
+    # start → decode armed. Spans derived from these feed the --trace-out
+    # JSONL stream and the queue-wait histogram.
+    t_submit: int = 0
+    t_admit: int = 0
+    t_decode: int = 0
 
     def __post_init__(self):
         self.rng_state = self.seed & _MASK64
@@ -120,6 +129,7 @@ class _Admission:
     slot: int
     col: KVCache  # the slot's gathered cache column, being filled
     pos: int = 0
+    reused: int = 0  # prefix tokens skipped via cross-slot KV reuse
 
 
 class BatchedGenerator:
@@ -234,6 +244,13 @@ class BatchedGenerator:
                 static_argnums=1, donate_argnums=(4,))
         self._prefill_fwd = jax.jit(forward, static_argnums=1,
                                     donate_argnums=(4,))
+        # telemetry: cached handles (no registry lookups per step)
+        self._tm = telemetry.registry()
+        self._tm.gauge(telemetry.BATCH_SLOTS).set(n_slots)
+        self._m_step_ms = self._tm.histogram(telemetry.BATCH_STEP_MS)
+        self._m_occupancy = self._tm.gauge(telemetry.BATCH_OCCUPANCY)
+        self._m_tokens = self._tm.counter(telemetry.BATCH_TOKENS)
+        self._m_kv = self._tm.gauge(telemetry.KV_OCCUPANCY)
         # slot-column gather/scatter for per-slot prefill
         self._take = jax.jit(
             lambda kv, b: KVCache(
@@ -333,8 +350,21 @@ class BatchedGenerator:
         src, k = self._best_prefix(ids[:-1])
         self._bcast(CTRL_SRV_TAKE, src if k else slot, [slot])
         adm = _Admission(req=req, slot=slot,
-                         col=self._exec_take(src if k else slot))
+                         col=self._exec_take(src if k else slot),
+                         reused=k)
         adm.pos = k  # prefill resumes after the reused prefix
+        # telemetry AFTER the last failable call: a raise anywhere above
+        # (prompt too long, device error) leaves ADMISSIONS untouched, so
+        # the scheduler's reject path never skews admissions - retires
+        req.t_admit = telemetry.now_ns()
+        self._tm.counter(telemetry.ADMISSIONS).inc()
+        if k:
+            self._tm.counter(telemetry.PREFIX_REUSE_TOKENS).inc(k)
+        if req.t_submit:
+            self._tm.histogram(telemetry.QUEUE_WAIT_MS).record(
+                (req.t_admit - req.t_submit) / 1e6)
+            telemetry.tracer().emit(req.rid, "queue", req.t_submit,
+                                    req.t_admit, slot=slot)
         return adm
 
     def _best_prefix(self, rest: list[int]) -> tuple[int, int]:
@@ -390,6 +420,13 @@ class BatchedGenerator:
 
             self._proposers[adm.slot] = NgramProposer(self.spec)
             self._proposers[adm.slot].extend(req.prompt_ids)
+        req.t_decode = telemetry.now_ns()
+        if req.t_admit:
+            # n_tokens = positions actually prefilled (after prefix reuse),
+            # so span counts cross-check dllama_prefix_reuse_tokens_total
+            telemetry.tracer().emit(req.rid, "prefill", req.t_admit,
+                                    req.t_decode, slot=adm.slot,
+                                    n_tokens=adm.pos - adm.reused)
         self.slots[adm.slot] = req
         return True
 
@@ -403,6 +440,11 @@ class BatchedGenerator:
         req = self.slots[slot]
         self.slots[slot] = None
         self._proposers[slot] = None
+        self._tm.counter(telemetry.RETIRES).inc()
+        if req.t_decode:
+            telemetry.tracer().emit(req.rid, "decode", req.t_decode,
+                                    telemetry.now_ns(), slot=slot,
+                                    n_tokens=len(req.tokens))
         req.done.set()
 
     # -- the batched step ---------------------------------------------------
@@ -450,11 +492,14 @@ class BatchedGenerator:
             self._bcast(CTRL_SRV_STEP, 0, np.concatenate([
                 self.next_token.astype(np.int32), self.pos.astype(np.int32),
                 self._f32bits(temps, topps, coins)]))
+        t0 = time.perf_counter()
         nxt = self._exec_step(self.next_token, self.pos, temps, topps, coins)
+        ms = (time.perf_counter() - t0) * 1000.0
 
         emitted = 0
         for i in active:
             emitted += self._emit_run(i, [int(nxt[i])])
+        self._record_step(len(active), ms, emitted)
         return emitted
 
     def step_chunk(self, k: int) -> int:
@@ -497,8 +542,10 @@ class BatchedGenerator:
             self._bcast(CTRL_SRV_STEP_CHUNK, k, np.concatenate([
                 self.next_token.astype(np.int32), self.pos.astype(np.int32),
                 self._f32bits(temps, topps, coins.reshape(-1))]))
+        t0 = time.perf_counter()
         toks = self._exec_step_chunk(self.next_token, self.pos, temps,
                                      topps, coins, k)
+        step_ms = (time.perf_counter() - t0) * 1000.0
         emitted = 0
         for i in active:
             req = self.slots[i]
@@ -510,7 +557,21 @@ class BatchedGenerator:
                 for _ in range(n):  # commit exactly the kept draws
                     _, st = xorshift_random_f32(st)
                 req.rng_state = st
+        self._record_step(len(active), step_ms, emitted)
         return emitted
+
+    def _record_step(self, n_active: int, ms: float, emitted: int) -> None:
+        """Per-dispatch telemetry: occupancy, step latency, emitted tokens,
+        pooled KV occupancy (rows holding LIVE requests' context / total
+        rows — retired slots keep stale pos for prefix reuse but their rows
+        are reclaimable, so they must not count as occupied)."""
+        self._m_occupancy.set(n_active)
+        self._m_step_ms.record(ms)
+        if emitted:
+            self._m_tokens.inc(emitted)
+        live = sum(int(self.pos[i]) for i, s in enumerate(self.slots)
+                   if s is not None)
+        self._m_kv.set(live / (self.n_slots * self.cfg.seq_len))
 
     def _emit_run(self, i: int, run: list[int]) -> int:
         """Deliver a run of tokens to slot ``i``'s request: append, stream,
@@ -557,11 +618,19 @@ class BatchedGenerator:
             self._bcast(CTRL_SRV_VERIFY, self.spec, np.concatenate([
                 toks.reshape(-1), self.pos.astype(np.int32),
                 self._f32bits(temps, topps, coins)]))
+        t0 = time.perf_counter()
         n_acc, preds = self._exec_verify(toks, self.pos, temps, topps, coins)
+        ms = (time.perf_counter() - t0) * 1000.0
+        n_greedy = sum(1 for i in active if self.slots[i].temperature <= 0.0)
+        self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(n_greedy * self.spec)
         emitted = 0
         for i in active:
-            run = [int(t) for t in preds[i, : int(n_acc[i]) + 1]]
+            acc = int(n_acc[i])
+            if self.slots[i].temperature <= 0.0 and acc:
+                self._tm.counter(telemetry.SPEC_ACCEPTED_TOKENS).inc(acc)
+            run = [int(t) for t in preds[i, : acc + 1]]
             emitted += self._emit_run(i, run)
+        self._record_step(len(active), ms, emitted)
         return emitted
 
 
@@ -593,7 +662,10 @@ class BatchScheduler:
                           max_tokens=max_tokens, temperature=temperature,
                           topp=topp, seed=seed, stop_on_eos=stop_on_eos,
                           on_token=on_token)
+            req.t_submit = telemetry.now_ns()
             self._queue.append(req)
+            telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
+                len(self._queue))
         self._wake.set()
         return req
 
@@ -627,11 +699,16 @@ class BatchScheduler:
                         continue
                     self._admissions.append(adm)
                     reserved.add(adm.slot)
+                telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
+                    len(self._queue))
             # ONE prefill chunk per in-flight admission per loop tick, so a
             # long prompt interleaves with (not stalls) active decode steps
             for adm in list(self._admissions):
                 if adm.req.cancel.is_set():
                     self._admissions.remove(adm)
+                    # counted as admitted in begin_admit: balance the pair so
+                    # admissions_total - retires_total stays "live requests"
+                    telemetry.registry().counter(telemetry.RETIRES).inc()
                     adm.req.done.set()
                     continue
                 try:
@@ -639,6 +716,7 @@ class BatchScheduler:
                         self._admissions.remove(adm)
                 except Exception as e:  # noqa: BLE001 — reject, don't wedge
                     self._admissions.remove(adm)
+                    telemetry.registry().counter(telemetry.RETIRES).inc()
                     adm.req.error = f"{type(e).__name__}: {e}"
                     adm.req.done.set()
             if self.gen.n_active == 0 and not self._admissions:

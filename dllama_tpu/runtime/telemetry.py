@@ -1,0 +1,456 @@
+"""Runtime telemetry — metrics registry + per-request span tracing.
+
+The reference prints per-token ``Eval ms / Sync ms / Sent kB / Recv kB``
+console lines (src/dllama.cpp:59-67) and nothing else; once a request
+enters batched serving or the HTTP API there is no continuous record of
+latency, throughput, queue depth, or cache behavior. This module is the
+missing operational layer, dependency-free (stdlib only, importable
+without jax) and cheap enough for the decode hot path:
+
+* **Metrics registry** — monotonic :class:`Counter`, :class:`Gauge`, and
+  fixed-bucket :class:`Histogram` (a ``record()`` is one lock + one bisect
+  + three float ops, ~1 µs against a multi-ms decode step). Every metric
+  name is declared once in :data:`SPECS` (the lint surface for
+  ``tools/check_metrics_names.py``) and rendered as Prometheus text by
+  :meth:`Registry.render` for the API server's ``GET /metrics``.
+* **Span tracer** — per-request phase spans (``queue|prefill|decode|
+  verify``) emitted as JSONL to an operator-chosen file (``--trace-out``).
+  Disabled by default: the ``enabled`` check is one attribute read.
+
+The same registry also carries the reference-parity static accounting:
+the engine publishes per-token collective bytes (``profiling.
+collective_traffic``) and the measured sync fraction (``measure_split``)
+as gauges, so one ``/metrics`` scrape gives the full eval/sync/bytes
+picture plus the serving metrics the reference never had.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from bisect import bisect_left
+from dataclasses import dataclass
+
+# -- metric name constants ----------------------------------------------------
+# One declaration point: instrumentation imports these; the lint
+# (tools/check_metrics_names.py) checks every name matches dllama_[a-z_]+
+# and is documented in PERF.md.
+
+# engine (runtime/engine.py)
+PREFILL_CHUNK_MS = "dllama_prefill_chunk_ms"
+PREFILL_TOKENS = "dllama_prefill_tokens_total"
+DECODE_STEP_MS = "dllama_decode_step_ms"
+DECODE_TOKENS = "dllama_decode_tokens_total"
+SPEC_DRAFT_TOKENS = "dllama_spec_draft_tokens_total"
+SPEC_ACCEPTED_TOKENS = "dllama_spec_accepted_tokens_total"
+KV_OCCUPANCY = "dllama_kv_occupancy"
+HBM_NEED_BYTES = "dllama_hbm_need_bytes"
+HBM_LIMIT_BYTES = "dllama_hbm_limit_bytes"
+# reference-parity static accounting (runtime/profiling.py, published by
+# InferenceEngine.measure_split)
+SYNC_FRACTION = "dllama_sync_fraction"
+SYNC_FRACTION_PREFILL = "dllama_sync_fraction_prefill"
+COLLECTIVE_SENT_KB = "dllama_collective_sent_kb_per_token"
+COLLECTIVE_RECV_KB = "dllama_collective_recv_kb_per_token"
+COLLECTIVE_OPS = "dllama_collective_ops_per_step"
+
+# batched serving (runtime/serving.py)
+QUEUE_WAIT_MS = "dllama_queue_wait_ms"
+QUEUE_DEPTH = "dllama_queue_depth"
+BATCH_STEP_MS = "dllama_batch_step_ms"
+BATCH_OCCUPANCY = "dllama_batch_occupancy"
+BATCH_SLOTS = "dllama_batch_slots"
+BATCH_TOKENS = "dllama_batch_tokens_total"
+ADMISSIONS = "dllama_admissions_total"
+RETIRES = "dllama_retires_total"
+PREFIX_REUSE_TOKENS = "dllama_prefix_reuse_tokens_total"
+
+# HTTP layer (serve/api.py)
+HTTP_REQUESTS = "dllama_http_requests_total"
+REQUESTS_IN_FLIGHT = "dllama_requests_in_flight"
+TTFT_MS = "dllama_ttft_ms"
+ITL_MS = "dllama_itl_ms"
+PROMPT_TOKENS = "dllama_prompt_tokens_total"
+COMPLETION_TOKENS = "dllama_completion_tokens_total"
+
+# latency buckets in ms: sub-ms CPU ticks through multi-second TPU compiles
+_LATENCY_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                       500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    name: str
+    kind: str  # "counter" | "gauge" | "histogram"
+    help: str
+    buckets: tuple = ()
+
+
+def _spec(name, kind, help, buckets=_LATENCY_BUCKETS_MS):
+    if kind != "histogram":
+        buckets = ()
+    return MetricSpec(name, kind, help, buckets)
+
+
+SPECS: dict[str, MetricSpec] = {s.name: s for s in (
+    _spec(PREFILL_CHUNK_MS, "histogram",
+          "Wall time of one prefill chunk dispatch"),
+    _spec(PREFILL_TOKENS, "counter", "Prompt tokens prefilled"),
+    _spec(DECODE_STEP_MS, "histogram",
+          "Wall time of one decode dispatch (single, fused-chunk, or "
+          "speculative verify)"),
+    _spec(DECODE_TOKENS, "counter",
+          "Tokens emitted by single-sequence decode"),
+    _spec(SPEC_DRAFT_TOKENS, "counter",
+          "Speculative draft tokens submitted to verify dispatches"),
+    _spec(SPEC_ACCEPTED_TOKENS, "counter",
+          "Speculative draft tokens accepted (rate = accepted / draft)"),
+    _spec(KV_OCCUPANCY, "gauge",
+          "KV cache rows holding live context / total rows (pooled over "
+          "slots in batched serving; retired slots' rows are reclaimable "
+          "and do not count)"),
+    _spec(HBM_NEED_BYTES, "gauge",
+          "Estimated per-device HBM bytes for the loaded model"),
+    _spec(HBM_LIMIT_BYTES, "gauge",
+          "Reported per-device HBM limit (0 = unknown)"),
+    _spec(SYNC_FRACTION, "gauge",
+          "Measured collective share of decode-step device time "
+          "(measure_split)"),
+    _spec(SYNC_FRACTION_PREFILL, "gauge",
+          "Measured collective share of a prefill chunk's device time"),
+    _spec(COLLECTIVE_SENT_KB, "gauge",
+          "Per-token per-device collective bytes sent, kB (from the "
+          "compiled HLO)"),
+    _spec(COLLECTIVE_RECV_KB, "gauge",
+          "Per-token per-device collective bytes received, kB"),
+    _spec(COLLECTIVE_OPS, "gauge",
+          "Collective ops executed per decode step"),
+    _spec(QUEUE_WAIT_MS, "histogram",
+          "Submit-to-admission wait in the batch scheduler queue"),
+    _spec(QUEUE_DEPTH, "gauge", "Requests waiting for a slot"),
+    _spec(BATCH_STEP_MS, "histogram",
+          "Wall time of one ragged batched decode dispatch"),
+    _spec(BATCH_OCCUPANCY, "gauge", "Active slots in the last batched step"),
+    _spec(BATCH_SLOTS, "gauge", "Configured slot-pool size"),
+    _spec(BATCH_TOKENS, "counter", "Tokens emitted by batched serving"),
+    _spec(ADMISSIONS, "counter", "Requests admitted into a slot"),
+    _spec(RETIRES, "counter", "Slots retired (EOS, limits, or cancel)"),
+    _spec(PREFIX_REUSE_TOKENS, "counter",
+          "Prompt tokens skipped via cross-slot KV prefix reuse"),
+    _spec(HTTP_REQUESTS, "counter",
+          "HTTP requests by route and status code"),
+    _spec(REQUESTS_IN_FLIGHT, "gauge", "Completions currently executing"),
+    _spec(TTFT_MS, "histogram", "Time to first generated token per request"),
+    _spec(ITL_MS, "histogram", "Inter-token latency between emitted tokens"),
+    _spec(PROMPT_TOKENS, "counter", "Prompt tokens received over HTTP"),
+    _spec(COMPLETION_TOKENS, "counter", "Completion tokens served over HTTP"),
+)}
+
+
+# -- metric types -------------------------------------------------------------
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: tuple, extra: str = "") -> str:
+    parts = [f'{k}="{_escape(str(v))}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    # integral values print without a trailing .0 (Prometheus-conventional)
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class _Metric:
+    def __init__(self, spec: MetricSpec):
+        self.spec = spec
+        self._lock = threading.Lock()
+        self._series: dict[tuple, object] = {}
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+class Counter(_Metric):
+    """Monotonic counter; ``labels`` select an independent series."""
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def total(self, **labels) -> float:
+        """Sum over every series whose labels are a superset of ``labels``
+        (no labels = everything), so ``total(route="/x")`` aggregates all
+        statuses of one route."""
+        want = set(_label_key(labels))
+        with self._lock:
+            return float(sum(v for k, v in self._series.items()
+                             if want <= set(k)))
+
+    def _render(self, out: list[str]) -> None:
+        with self._lock:
+            items = sorted(self._series.items())
+        if not items and not self.spec.buckets:
+            items = [((), 0.0)]  # an unlabeled counter always renders
+        for key, v in items:
+            if key == () and len(items) > 1:
+                continue  # labeled metric: skip the phantom unlabeled row
+            out.append(f"{self.spec.name}{_fmt_labels(key)} {_fmt_value(v)}")
+
+
+class Gauge(_Metric):
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def add(self, delta: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + delta
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+    def _render(self, out: list[str]) -> None:
+        with self._lock:
+            items = sorted(self._series.items()) or [((), 0.0)]
+        for key, v in items:
+            out.append(f"{self.spec.name}{_fmt_labels(key)} {_fmt_value(v)}")
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram: per-series ``[counts..., +Inf count]`` plus
+    sum and count. ``record`` is the hot-path call."""
+
+    def record(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        i = bisect_left(self.spec.buckets, value)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                # [bucket counts..., overflow] , total count, total sum
+                s = self._series[key] = [
+                    [0] * (len(self.spec.buckets) + 1), 0, 0.0]
+            s[0][i] += 1
+            s[1] += 1
+            s[2] += value
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return int(s[1]) if s else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return float(s[2]) if s else 0.0
+
+    def quantile(self, q: float, **labels) -> float:
+        """Bucket-upper-bound estimate of the q-quantile (0..1); 0.0 when
+        empty. Good enough for the --stats one-liner, not for SLOs."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if not s or s[1] == 0:
+                return 0.0
+            counts, total = list(s[0]), s[1]
+        rank = q * total
+        seen = 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= rank and c:
+                return (self.spec.buckets[i] if i < len(self.spec.buckets)
+                        else self.spec.buckets[-1])
+        return self.spec.buckets[-1]
+
+    def _render(self, out: list[str]) -> None:
+        with self._lock:
+            items = sorted((k, (list(v[0]), v[1], v[2]))
+                           for k, v in self._series.items())
+        if not items:
+            items = [((), ([0] * (len(self.spec.buckets) + 1), 0, 0.0))]
+        name = self.spec.name
+        for key, (counts, count, total) in items:
+            cum = 0
+            for i, bound in enumerate(self.spec.buckets):
+                cum += counts[i]
+                le = 'le="%s"' % _fmt_value(bound)
+                out.append(f"{name}_bucket{_fmt_labels(key, le)} {cum}")
+            cum += counts[-1]
+            le = 'le="+Inf"'
+            out.append(f"{name}_bucket{_fmt_labels(key, le)} {cum}")
+            out.append(f"{name}_sum{_fmt_labels(key)} {_fmt_value(total)}")
+            out.append(f"{name}_count{_fmt_labels(key)} {count}")
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class Registry:
+    """All metrics of one process. Metrics are created eagerly from
+    :data:`SPECS` so a scrape always shows the full schema (zero-valued
+    until first use); handles stay valid across :meth:`reset`."""
+
+    def __init__(self, specs: dict[str, MetricSpec] = SPECS):
+        self._metrics: dict[str, _Metric] = {
+            name: _KINDS[s.kind](s) for name, s in specs.items()}
+
+    def _get(self, name: str, kind: type) -> _Metric:
+        m = self._metrics[name]  # KeyError = unregistered name, on purpose
+        if not isinstance(m, kind):
+            raise TypeError(f"{name} is {type(m).__name__}, not {kind.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def reset(self) -> None:
+        """Zero every series (tests); metric handles stay valid."""
+        for m in self._metrics.values():
+            m._reset()
+
+    def render(self) -> str:
+        """Prometheus text exposition (text/plain; version=0.0.4)."""
+        out: list[str] = []
+        for name, m in self._metrics.items():
+            out.append(f"# HELP {name} {m.spec.help}")
+            out.append(f"# TYPE {name} {m.spec.kind}")
+            m._render(out)
+        return "\n".join(out) + "\n"
+
+
+_registry = Registry()
+
+
+def registry() -> Registry:
+    """The process-wide default registry (what ``GET /metrics`` renders)."""
+    return _registry
+
+
+# -- per-request span tracing -------------------------------------------------
+
+PHASES = ("queue", "prefill", "decode", "verify")
+
+
+class SpanTracer:
+    """JSONL span sink. One line per completed span:
+
+    ``{"request_id": int, "phase": "queue|prefill|decode|verify",
+       "start_ns": int, "end_ns": int, "slot": int, "n_tokens": int}``
+
+    Timestamps are ``time.monotonic_ns`` (durations, not wall clock).
+    Disabled (no file) costs one attribute read per check site.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._f = None
+        self.enabled = False
+
+    def configure(self, path: str | None) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+            if path:
+                self._f = open(path, "a", encoding="utf-8")
+            self.enabled = self._f is not None
+
+    def emit(self, request_id: int, phase: str, start_ns: int, end_ns: int,
+             *, slot: int = -1, n_tokens: int = 0) -> None:
+        if not self.enabled:
+            return
+        line = json.dumps({"request_id": request_id, "phase": phase,
+                           "start_ns": start_ns, "end_ns": end_ns,
+                           "slot": slot, "n_tokens": n_tokens})
+        with self._lock:
+            if self._f is not None:
+                self._f.write(line + "\n")
+                self._f.flush()
+
+
+_tracer = SpanTracer()
+
+
+def tracer() -> SpanTracer:
+    return _tracer
+
+
+def now_ns() -> int:
+    return time.monotonic_ns()
+
+
+# -- request-level timing helper (HTTP layer) ---------------------------------
+
+
+class RequestTimer:
+    """TTFT / inter-token-latency recorder for one completion: call
+    :meth:`token` per emitted token, :meth:`done` once at the end."""
+
+    def __init__(self, reg: Registry | None = None):
+        self._reg = reg or registry()
+        self._t0 = time.monotonic_ns()
+        self._last: int | None = None
+
+    def token(self) -> None:
+        now = time.monotonic_ns()
+        if self._last is None:
+            self._reg.histogram(TTFT_MS).record((now - self._t0) / 1e6)
+        else:
+            self._reg.histogram(ITL_MS).record((now - self._last) / 1e6)
+        self._last = now
+
+    def done(self, prompt_tokens: int, completion_tokens: int) -> None:
+        self._reg.counter(PROMPT_TOKENS).inc(prompt_tokens)
+        self._reg.counter(COMPLETION_TOKENS).inc(completion_tokens)
+
+
+def stats_line(reg: Registry | None = None, *,
+               window_tokens: float | None = None,
+               window_s: float | None = None) -> str:
+    """One-line operator summary (the ``--stats`` periodic print) — the
+    serving-era analogue of the reference's per-token console line."""
+    reg = reg or registry()
+    ttft = reg.histogram(TTFT_MS)
+    itl = reg.histogram(ITL_MS)
+    # reqs = completions only — /metrics scrapes and health probes are
+    # monitoring self-traffic and would otherwise read as inference load
+    n_reqs = reg.counter(HTTP_REQUESTS).total(route="/v1/chat/completions")
+    parts = [
+        f"reqs={int(n_reqs)}",
+        f"inflight={int(reg.gauge(REQUESTS_IN_FLIGHT).value())}",
+        f"queue={int(reg.gauge(QUEUE_DEPTH).value())}",
+        f"occ={int(reg.gauge(BATCH_OCCUPANCY).value())}"
+        f"/{int(reg.gauge(BATCH_SLOTS).value())}",
+        f"kv={reg.gauge(KV_OCCUPANCY).value():.2f}",
+    ]
+    if window_tokens is not None and window_s:
+        parts.append(f"tok/s={window_tokens / window_s:.1f}")
+    parts.append(f"ttft_p50={ttft.quantile(0.5):.0f}ms")
+    parts.append(f"itl_p50={itl.quantile(0.5):.0f}ms")
+    sync = reg.gauge(SYNC_FRACTION).value()
+    sent = reg.gauge(COLLECTIVE_SENT_KB).value()
+    if sync or sent:
+        parts.append(f"sync={100 * sync:.1f}%")
+        parts.append(f"sent={sent:.1f}kB/tok")
+    return "📈 " + " ".join(parts)

@@ -32,9 +32,15 @@ import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
+from ..runtime import telemetry
 from ..runtime.engine import InferenceEngine
 from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
                               ChatTemplateType, EosDetector, EosResult)
+
+# known routes for the HTTP request counter's route label — anything else is
+# folded into "other" so a scanner can't explode the label cardinality
+_ROUTES = ("/v1/chat/completions", "/v1/models", "/metrics",
+           "/health", "/healthz")
 
 
 @dataclass
@@ -131,6 +137,7 @@ class ApiState:
         self.stop_pieces = [tok.vocab[t].decode("utf-8", "replace")
                             for t in tok.eos_token_ids]
         self.cache = NaiveCache()
+        self._rid = 0  # request counter for trace spans (single-threaded)
 
     def complete(self, body: dict, emit=None) -> dict:
         """Run one chat completion; ``emit(text)`` streams deltas when set.
@@ -144,6 +151,9 @@ class ApiState:
         messages = body.get("messages", [])
         if not messages:
             raise ValueError("messages required")
+        self._rid += 1
+        engine.trace_rid = self._rid  # stamps the engine's prefill span
+        rt = telemetry.RequestTimer()
         if "temperature" in body:
             engine.sampler.set_temp(float(body["temperature"]))
         if "seed" in body:
@@ -188,12 +198,14 @@ class ApiState:
 
         n_completion = 0
         finish_reason = "length"
+        t_decode = telemetry.now_ns()
         while engine.pos < max_pred:
             if (proposer is not None
                     and max_pred - engine.pos >= engine.spec_lookup + 1):
                 run = engine.speculative_tokens(token, proposer.draft())
                 n_keep, stopped = len(run), False
                 for j, t in enumerate(run):
+                    rt.token()
                     if gate.feed(t, tok.decode(t)):
                         n_keep, stopped = j + 1, True
                         break
@@ -207,11 +219,15 @@ class ApiState:
                 continue
             token = engine.next_token(token)
             n_completion += 1
+            rt.token()
             if gate.feed(token, tok.decode(token)):
                 finish_reason = "stop"
                 break
         if finish_reason == "length":
             gate.flush_tail()
+        rt.done(len(ids), n_completion)
+        telemetry.tracer().emit(self._rid, "decode", t_decode,
+                                telemetry.now_ns(), n_tokens=n_completion)
 
         if not (custom_stops and finish_reason == "stop"):
             # a custom-stop finish leaves the hidden stop text and an
@@ -281,6 +297,7 @@ class BatchedApiState:
         gate = _EosGate(tok, _request_stops(self.stop_pieces, body), emit)
         if prompt.public_prompt:
             gate._out(prompt.public_prompt)
+        rt = telemetry.RequestTimer()
         n_completion = 0
         finish_reason = "length"
         while True:
@@ -291,6 +308,7 @@ class BatchedApiState:
                     break
                 continue
             n_completion += 1
+            rt.token()
             if gate.feed(t, piece):
                 # stop STRING matched (spelled by ordinary tokens — the
                 # scheduler's raw-eos check can't see it): cancel the slot
@@ -303,6 +321,7 @@ class BatchedApiState:
             gate.flush_tail()
         if req.error:
             raise ValueError(req.error)
+        rt.done(len(ids), n_completion)
         return {
             "text": "".join(gate.parts),
             "finish_reason": finish_reason,
@@ -343,11 +362,27 @@ def _chunk_json(state: ApiState, delta: dict, finish_reason=None) -> dict:
 def make_handler(state: ApiState):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # whole-socket timeout (reads AND writes): a client that declares a
+        # Content-Length then stalls, or an SSE consumer that stops reading
+        # for 2 minutes while the send buffer fills, can otherwise block
+        # the single-threaded server forever. Disconnecting such clients is
+        # intended; generation itself does no socket ops during a step, so
+        # a slow MODEL never trips this — only a stalled PEER does
+        timeout = 120
 
         def log_message(self, fmt, *args):  # quieter default logging
             print(f"🕸️ {self.address_string()} {fmt % args}")
 
+        _counted = False  # whether THIS request hit the telemetry counter
+
+        def _count(self, code: int) -> None:
+            route = self.path if self.path in _ROUTES else "other"
+            telemetry.registry().counter(telemetry.HTTP_REQUESTS).inc(
+                route=route, status=str(code))
+            self._counted = True
+
         def _json(self, code: int, payload: dict) -> None:
+            self._count(code)
             body = json.dumps(payload).encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -355,20 +390,52 @@ def make_handler(state: ApiState):
             self.end_headers()
             self.wfile.write(body)
 
+        def _not_found(self) -> None:
+            # always a JSON body, never a silent empty response: clients and
+            # probes get something parseable plus the route list
+            self._json(404, {"error": "not found", "path": self.path,
+                             "routes": list(_ROUTES)})
+
         def do_GET(self):
             if self.path == "/v1/models":
                 self._json(200, {"object": "list", "data": [{
                     "id": state.model_name, "object": "model",
                     "created": int(time.time()), "owned_by": "dllama_tpu",
                 }]})
+            elif self.path == "/metrics":
+                self._count(200)
+                body = telemetry.registry().render().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif self.path in ("/health", "/healthz"):
                 self._json(200, {"status": "ok"})
             else:
-                self._json(404, {"error": "not found"})
+                self._not_found()
 
         def do_POST(self):
             if self.path not in ("/v1/chat/completions",):
-                self._json(404, {"error": "not found"})
+                # drain a SMALL body before responding (closing with unread
+                # request bytes can RST the connection under the client's
+                # feet before it reads the 404) — but never trust the
+                # client's Content-Length for an unbounded read on a path
+                # that's being rejected anyway: oversized declarations skip
+                # the drain and drop keep-alive instead
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    length = 0
+                if 0 < length <= (1 << 20):
+                    try:
+                        self.rfile.read(length)
+                    except OSError:
+                        pass
+                elif length:
+                    self.close_connection = True
+                self._not_found()
                 return
             try:
                 length = int(self.headers.get("Content-Length", 0))
@@ -377,6 +444,14 @@ def make_handler(state: ApiState):
                 self._json(400, {"error": "invalid JSON body"})
                 return
             stream = bool(body.get("stream", False))
+            inflight = telemetry.registry().gauge(telemetry.REQUESTS_IN_FLIGHT)
+            inflight.add(1)
+            # the finally records whatever happened: streamed requests can't
+            # count via _json, and a non-ValueError engine failure in either
+            # mode would otherwise vanish from the counter entirely — the
+            # failing requests are exactly the ones an operator must see
+            self._counted = False
+            stream_status = 500
             try:
                 if stream:
                     self.send_response(200)
@@ -396,6 +471,7 @@ def make_handler(state: ApiState):
                     self.wfile.write(
                         b"data: " + json.dumps(final).encode("utf-8") + b"\n\n")
                     self.wfile.write(b"data: [DONE]\n\n")
+                    stream_status = 200
                 else:
                     out = state.complete(body)
                     self._json(200, _completion_json(state, out))
@@ -404,18 +480,29 @@ def make_handler(state: ApiState):
                     self._json(400, {"error": str(e)})
                 else:
                     raise
+            finally:
+                inflight.add(-1)
+                if stream:
+                    self._count(stream_status)
+                elif not self._counted:  # non-ValueError escape: still count
+                    self._count(500)
 
     return Handler
 
 
 def run_api_server(args) -> int:
-    from .cli import make_engine
+    from .cli import make_engine, start_stats_reporter
 
     if getattr(args, "dp", 1) > 1 and (getattr(args, "batch_slots", 0) or 0) <= 1:
         raise SystemExit("--dp shards the --batch-slots pool; without "
                          "batched serving it only replicates batch-1 work "
                          "(set --batch-slots N with N % dp == 0, or drop --dp)")
+    if getattr(args, "trace_out", None):
+        telemetry.tracer().configure(args.trace_out)
+        print(f"🔬 request trace (JSONL spans) → {args.trace_out}")
     engine = make_engine(args)
+    if getattr(args, "stats", 0):
+        start_stats_reporter(float(args.stats))
     n_slots = getattr(args, "batch_slots", 0) or 0
     ttype = ChatTemplateType(getattr(args, "chat_template", None) or "unknown")
     if n_slots > 1:
@@ -440,4 +527,5 @@ def run_api_server(args) -> int:
         if isinstance(state, BatchedApiState):
             state.close()
         engine.close()
+        telemetry.tracer().configure(None)
     return 0

@@ -141,6 +141,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "device time; traffic comes from the compiled HLO "
                         "(costs one extra XLA compile, absorbed by the "
                         "persistent compile cache)")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="append per-request phase spans (queue/prefill/"
+                        "decode/verify) as JSONL trace events to FILE "
+                        "(runtime.telemetry.SpanTracer; schema documented "
+                        "in PERF.md)")
+    p.add_argument("--stats", type=float, default=0.0, metavar="SEC",
+                   help="api mode: print a one-line telemetry summary every "
+                        "SEC seconds (requests, in-flight, queue depth, "
+                        "batch/KV occupancy, tok/s, ttft/itl p50, eval/sync "
+                        "share) — the serving-era version of the reference's "
+                        "per-token console line")
     p.add_argument("--port", type=int, default=9990, help="api mode port")
     p.add_argument("--host", default="127.0.0.1", help="api mode bind host")
     p.add_argument("--batch-slots", type=int, default=0, metavar="N",
@@ -173,6 +184,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workers", nargs="*", default=None, help=argparse.SUPPRESS)
     p.add_argument("--net-turbo", type=int, default=None, help=argparse.SUPPRESS)
     return p
+
+
+def start_stats_reporter(interval: float) -> "threading.Thread":
+    """Daemon thread printing one telemetry summary line every ``interval``
+    seconds (``--stats``). Tok/s is the PER-STEP emission counters' delta
+    over the window (batched + single-sequence decode), so the rate is live
+    during a long in-flight generation — not a burst when it finishes —
+    and an idle server prints 0.0 instead of a lifetime average."""
+    import threading
+
+    from ..runtime import telemetry
+
+    reg = telemetry.registry()
+
+    def _emitted() -> float:
+        return (reg.counter(telemetry.BATCH_TOKENS).total()
+                + reg.counter(telemetry.DECODE_TOKENS).total())
+
+    def _loop() -> None:
+        prev = _emitted()
+        while True:
+            time.sleep(interval)
+            cur = _emitted()
+            print(telemetry.stats_line(reg, window_tokens=cur - prev,
+                                       window_s=interval), flush=True)
+            prev = cur
+
+    t = threading.Thread(target=_loop, daemon=True, name="dllama-stats")
+    t.start()
+    return t
 
 
 def _maybe_init_distributed(args) -> bool:
@@ -690,6 +731,13 @@ def main(argv=None) -> int:
                 f"{len(jax.devices())} visible (for a virtual mesh: "
                 f"JAX_PLATFORMS=cpu "
                 f"XLA_FLAGS=--xla_force_host_platform_device_count={need})")
+    if args.trace_out and args.mode != "api":
+        # api mode configures (and closes) the tracer itself so the banner
+        # prints next to the listen line; other modes wire it here
+        from ..runtime import telemetry
+
+        telemetry.tracer().configure(args.trace_out)
+        print(f"🔬 request trace (JSONL spans) → {args.trace_out}")
     if args.mode == "inference":
         return run_inference(args)
     if args.mode == "chat":

@@ -1,0 +1,256 @@
+"""Telemetry registry semantics: counter/gauge/histogram behavior, Prometheus
+text rendering, JSONL span schema, thread-safety (raw and under the
+BatchScheduler loop), and the zero-duration GenerationResult guards."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime import telemetry as tm
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+def fresh() -> tm.Registry:
+    return tm.Registry()
+
+
+# -- counter/gauge/histogram semantics ---------------------------------------
+
+
+def test_counter_monotonic_and_labels():
+    r = fresh()
+    c = r.counter(tm.HTTP_REQUESTS)
+    c.inc(route="/metrics", status="200")
+    c.inc(2, route="/metrics", status="200")
+    c.inc(route="/v1/models", status="404")
+    assert c.total(route="/metrics", status="200") == 3
+    assert c.total(route="/v1/models", status="404") == 1
+    assert c.total() == 4  # unlabeled total sums every series
+    c.inc(route="/metrics", status="500")
+    assert c.total(route="/metrics") == 4  # subset match sums all statuses
+    assert c.total(status="200") == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_add_value():
+    r = fresh()
+    g = r.gauge(tm.QUEUE_DEPTH)
+    assert g.value() == 0.0
+    g.set(5)
+    g.add(-2)
+    assert g.value() == 3.0
+
+
+def test_histogram_buckets_sum_count_quantile():
+    r = fresh()
+    h = r.histogram(tm.TTFT_MS)
+    for v in (0.2, 3.0, 3.0, 40.0, 10**6):  # 10**6 lands in +Inf overflow
+        h.record(v)
+    assert h.count() == 5
+    assert h.sum() == pytest.approx(0.2 + 3.0 + 3.0 + 40.0 + 10**6)
+    # median of {0.2, 3, 3, 40, 1e6} is 3.0 -> bucket upper bound 5.0
+    assert h.quantile(0.5) == 5.0
+    assert h.quantile(0.0) <= h.quantile(1.0)
+
+
+def test_registry_rejects_unknown_and_mistyped_names():
+    r = fresh()
+    with pytest.raises(KeyError):
+        r.counter("dllama_not_a_metric")
+    with pytest.raises(TypeError):
+        r.counter(tm.QUEUE_DEPTH)  # registered as a gauge
+
+
+def test_reset_keeps_handles_valid():
+    r = fresh()
+    c = r.counter(tm.ADMISSIONS)
+    c.inc(7)
+    r.reset()
+    assert c.total() == 0
+    c.inc()
+    assert c.total() == 1
+
+
+# -- Prometheus text rendering ------------------------------------------------
+
+
+def test_render_prometheus_text():
+    r = fresh()
+    r.counter(tm.HTTP_REQUESTS).inc(route="/v1/models", status="200")
+    h = r.histogram(tm.ITL_MS)
+    h.record(0.7)
+    h.record(3.0)
+    text = r.render()
+    assert '# TYPE dllama_http_requests_total counter' in text
+    assert 'dllama_http_requests_total{route="/v1/models",status="200"} 1' \
+        in text
+    # histogram: cumulative buckets, +Inf, sum, count
+    assert 'dllama_itl_ms_bucket{le="1"} 1' in text
+    assert 'dllama_itl_ms_bucket{le="5"} 2' in text
+    assert 'dllama_itl_ms_bucket{le="+Inf"} 2' in text
+    assert 'dllama_itl_ms_count 2' in text
+    assert 'dllama_itl_ms_sum 3.7' in text
+    # an untouched metric still renders (full schema per scrape)
+    assert 'dllama_kv_occupancy 0' in text
+    # every spec'd metric has HELP + TYPE headers
+    for name in tm.SPECS:
+        assert f"# TYPE {name} " in text
+
+
+def test_render_escapes_label_values():
+    r = fresh()
+    r.counter(tm.HTTP_REQUESTS).inc(route='a"b\nc', status="200")
+    text = r.render()
+    assert 'route="a\\"b\\nc"' in text
+
+
+# -- JSONL span tracing -------------------------------------------------------
+
+
+def test_span_tracer_jsonl_schema(tmp_path):
+    out = tmp_path / "trace.jsonl"
+    tr = tm.SpanTracer()
+    assert not tr.enabled
+    tr.emit(1, "queue", 0, 1)  # disabled: no file, no error
+    tr.configure(str(out))
+    assert tr.enabled
+    tr.emit(7, "decode", 100, 250, slot=3, n_tokens=12)
+    tr.emit(8, "prefill", 50, 90)
+    tr.configure(None)
+    assert not tr.enabled
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert lines == [
+        {"request_id": 7, "phase": "decode", "start_ns": 100, "end_ns": 250,
+         "slot": 3, "n_tokens": 12},
+        {"request_id": 8, "phase": "prefill", "start_ns": 50, "end_ns": 90,
+         "slot": -1, "n_tokens": 0},
+    ]
+    assert all(ln["phase"] in tm.PHASES for ln in lines)
+
+
+# -- thread safety ------------------------------------------------------------
+
+
+def test_registry_thread_safety_exact_totals():
+    r = fresh()
+    c = r.counter(tm.BATCH_TOKENS)
+    h = r.histogram(tm.QUEUE_WAIT_MS)
+    n_threads, n_iter = 8, 2000
+
+    def hammer():
+        for i in range(n_iter):
+            c.inc()
+            h.record(float(i % 100))
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.total() == n_threads * n_iter
+    assert h.count() == n_threads * n_iter
+    # bucket counts are consistent with the total count
+    assert f"dllama_queue_wait_ms_count {n_threads * n_iter}" in r.render()
+
+
+# -- instrumentation under the BatchScheduler loop ---------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine(tmp_path_factory):
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    d = tmp_path_factory.mktemp("telemetry")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(11)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    engine = InferenceEngine(str(mpath), str(tpath), temperature=0.0, seed=3)
+    yield engine
+    engine.close()
+
+
+def test_batch_scheduler_records_metrics(tiny_engine, tmp_path):
+    from dllama_tpu.runtime.serving import BatchScheduler
+
+    reg = tm.registry()
+    trace = tmp_path / "sched.jsonl"
+    tm.tracer().configure(str(trace))
+    admissions0 = reg.counter(tm.ADMISSIONS).total()
+    retires0 = reg.counter(tm.RETIRES).total()
+    tokens0 = reg.counter(tm.BATCH_TOKENS).total()
+    waits0 = reg.histogram(tm.QUEUE_WAIT_MS).count()
+    steps0 = reg.histogram(tm.BATCH_STEP_MS).count()
+    sched = BatchScheduler(tiny_engine, n_slots=2)
+    try:
+        tok = tiny_engine.tokenizer
+        prompts = [tok.encode(p) for p in ("hello", "world", "hi there")]
+        reqs = [sched.submit(ids, 5) for ids in prompts]
+        for r in reqs:
+            assert r.done.wait(timeout=120)
+    finally:
+        sched.close()
+        tm.tracer().configure(None)
+    assert reg.counter(tm.ADMISSIONS).total() - admissions0 == 3
+    assert reg.counter(tm.RETIRES).total() - retires0 == 3
+    assert reg.counter(tm.BATCH_TOKENS).total() - tokens0 >= 3
+    assert reg.histogram(tm.QUEUE_WAIT_MS).count() - waits0 == 3
+    assert reg.histogram(tm.BATCH_STEP_MS).count() - steps0 >= 1
+    assert reg.gauge(tm.BATCH_SLOTS).value() == 2
+    # all requests retired: their rows are reclaimable (kept only for
+    # prefix reuse), so pooled KV occupancy must have dropped back to 0
+    assert reg.gauge(tm.KV_OCCUPANCY).value() == 0.0
+    # every request traced a queue→prefill→decode span chain, slots recorded
+    spans = [json.loads(ln) for ln in trace.read_text().splitlines()]
+    by_rid: dict = {}
+    for s in spans:
+        by_rid.setdefault(s["request_id"], set()).add(s["phase"])
+    done_rids = [rid for rid, phases in by_rid.items()
+                 if {"queue", "prefill", "decode"} <= phases]
+    assert len(done_rids) >= 3
+    decode_spans = [s for s in spans if s["phase"] == "decode"]
+    assert all(s["end_ns"] >= s["start_ns"] and s["slot"] in (0, 1)
+               for s in decode_spans)
+    assert any(s["n_tokens"] > 0 for s in decode_spans)
+
+
+def test_engine_decode_and_prefill_metrics(tiny_engine):
+    reg = tm.registry()
+    steps0 = reg.histogram(tm.DECODE_STEP_MS).count()
+    dec0 = reg.counter(tm.DECODE_TOKENS).total()
+    pre0 = reg.counter(tm.PREFILL_TOKENS).total()
+    tiny_engine.reset()
+    res = tiny_engine.generate("hello world", 4, stop_on_eos=False)
+    assert len(res.tokens) == 4
+    assert reg.counter(tm.DECODE_TOKENS).total() - dec0 == 4
+    assert reg.histogram(tm.DECODE_STEP_MS).count() - steps0 == 4
+    assert reg.counter(tm.PREFILL_TOKENS).total() - pre0 >= 1
+    assert reg.histogram(tm.PREFILL_CHUNK_MS).count() >= 1
+    assert reg.gauge(tm.HBM_NEED_BYTES).value() > 0
+    assert reg.gauge(tm.KV_OCCUPANCY).value() == pytest.approx(
+        tiny_engine.pos / tiny_engine.cfg.seq_len)
+
+
+# -- GenerationResult zero-duration guards (satellite) ------------------------
+
+
+def test_generation_result_zero_token_rates():
+    from dllama_tpu.runtime.engine import GenerationResult, StepMetrics
+
+    # 0 predicted tokens: no "pred" steps at all
+    r = GenerationResult(tokens=[], text="", prompt_tokens=3,
+                         steps=[StepMetrics("eval", 1.5, 3)])
+    assert r.pred_tok_per_s == 0.0
+    assert r.eval_tok_per_s > 0.0
+    # a sub-resolution clock can report 0.0 ms for a real step
+    r2 = GenerationResult(tokens=[1], text="x", prompt_tokens=1,
+                          steps=[StepMetrics("pred", 0.0, 1),
+                                 StepMetrics("eval", 0.0, 1)])
+    assert r2.pred_tok_per_s == 0.0
+    assert r2.eval_tok_per_s == 0.0
+    assert GenerationResult([], "", 0).pred_tok_per_s == 0.0

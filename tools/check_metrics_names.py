@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Metric-name lint (Makefile ``lint`` target).
+
+Three checks, all against the single declaration point
+(``dllama_tpu.runtime.telemetry.SPECS``):
+
+1. every registered metric name matches ``dllama_[a-z_]+`` (the wire
+   convention Prometheus relabeling and the dashboards assume);
+2. every registered name is documented in PERF.md (the telemetry section
+   is the operator contract — an undocumented metric is a doc bug);
+3. every quoted ``dllama_*`` metric-shaped literal in the package source
+   is registered (catches typo'd or orphaned instrumentation that would
+   KeyError at runtime or silently never render).
+
+Importing only the telemetry module keeps this runnable without jax.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dllama_tpu.runtime.telemetry import SPECS  # noqa: E402
+
+NAME_RE = re.compile(r"^dllama_[a-z_]+$")
+# quoted dllama_* literals in source; names continuing with '.' or '-' are
+# module paths / model ids, not metrics
+LITERAL_RE = re.compile(r"""["'](dllama_[a-z_]+)["']""")
+# package-name strings that legitimately appear quoted in source
+NOT_METRICS = {"dllama_tpu"}
+
+
+def main() -> int:
+    errors: list[str] = []
+
+    for name, spec in SPECS.items():
+        if not NAME_RE.match(name):
+            errors.append(f"registered metric {name!r} violates "
+                          f"dllama_[a-z_]+ naming")
+        if spec.kind not in ("counter", "gauge", "histogram"):
+            errors.append(f"{name}: unknown kind {spec.kind!r}")
+        if spec.kind == "counter" and not name.endswith("_total"):
+            errors.append(f"counter {name} must end in _total "
+                          f"(Prometheus convention)")
+        if not spec.help:
+            errors.append(f"{name}: empty help text")
+
+    perf = (REPO / "PERF.md").read_text(encoding="utf-8")
+    for name in SPECS:
+        if name not in perf:
+            errors.append(f"metric {name} is not documented in PERF.md")
+
+    for py in sorted((REPO / "dllama_tpu").rglob("*.py")):
+        for lit in LITERAL_RE.findall(py.read_text(encoding="utf-8")):
+            if lit in NOT_METRICS or lit in SPECS:
+                continue
+            errors.append(f"{py.relative_to(REPO)}: literal {lit!r} looks "
+                          f"like a metric name but is not registered in "
+                          f"telemetry.SPECS")
+
+    if errors:
+        for e in errors:
+            print(f"❌ {e}", file=sys.stderr)
+        return 1
+    print(f"✅ {len(SPECS)} metric names: convention + PERF.md docs + "
+          f"source literals all consistent")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
