@@ -10,8 +10,10 @@
 #                   real chip (compiles actual Pallas kernels).
 #   make test-all   Both CPU tiers, then the TPU tier if a chip answers.
 #   make native     Build the C++ host-runtime library (quant codecs, BPE).
-#   make lint       Telemetry metric-name lint: every registered name is
-#                   convention-clean and documented in PERF.md.
+#   make lint       Telemetry metric-name lint (every registered name is
+#                   convention-clean and documented in PERF.md) + the
+#                   exception-hygiene lint (no bare excepts; broad handlers
+#                   in runtime//serve/ must surface their failures).
 #   make bench      The driver's benchmark: ONE JSON line on stdout.
 #   make graft      Compile-check the jittable entry + the 8-device
 #                   multi-chip dry run (tp/pp/dp/sp/ep shardings).
@@ -37,6 +39,7 @@ tsan:
 
 lint:
 	$(PY) tools/check_metrics_names.py
+	$(PY) tools/check_exception_hygiene.py
 
 bench:
 	$(PY) bench.py

@@ -44,7 +44,7 @@ from ..ops.linear import (
     quantize_weight_q40,
 )
 from ..ops.norms import rms_norm, rms_norm_per_head
-from ..parallel.api import constrain
+from ..parallel.api import constrain, shard_map
 from ..parallel.api import current_plan as _current_plan
 from ..runtime.kvcache import KVCache, update_layer
 from .config import ModelConfig
@@ -389,7 +389,7 @@ def _moe_ffn_sparse(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Arra
             lambda _leaf, axes: P(ep_ax, *(hid_ax if a == "hidden" else None
                                            for a in axes)))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=plan.mesh,
         in_specs=(P(), P(), P(),
                   we_spec(lp.we1, hid_on_out=True),
@@ -649,9 +649,14 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         # activation along the ring (parallel/pipeline.py — new capability).
         # Ragged [B] start_pos (batched serving) rides along: each stage's
         # _layer_step gets the per-row depths.
-        from ..parallel.pipeline import pp_forward
+        from ..parallel.pipeline import pp_forward, pp_manual_supported
 
-        return pp_forward(plan, cfg, params, tokens, start_pos, kv)
+        if pp_manual_supported(plan):
+            return pp_forward(plan, cfg, params, tokens, start_pos, kv)
+        # mixed pp mesh on a jax whose partial-auto shard_map is broken
+        # (see pp_manual_supported): fall through to the auto-sharded
+        # body — XLA derives the stage transfers from the layer-stack
+        # sharding, value-identical to the manual schedule
 
     B, T = tokens.shape
     x = params.embedding[tokens].astype(cfg.compute_dtype)

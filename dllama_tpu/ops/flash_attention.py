@@ -33,6 +33,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..parallel.api import shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -294,7 +296,7 @@ def flash_attention_sharded(plan, q: jax.Array, k_cache: jax.Array,
     # scalar start_pos replicates; a [B] vector (ragged batched serving)
     # shards with the batch rows
     pos_spec = P(dp_ax) if start_pos.ndim else P()
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=plan.mesh,
         in_specs=(P(dp_ax, None, "tp", None), kv_spec, kv_spec, pos_spec),
         out_specs=P(dp_ax, None, "tp", None),

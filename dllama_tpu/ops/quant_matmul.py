@@ -36,6 +36,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..parallel.api import shard_map
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -237,7 +239,7 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
                                 interpret=interpret, fast=fast)
             return wire_psum(part, k_ax, plan._axis_size(k_ax))
 
-        fn = jax.shard_map(
+        fn = shard_map(
             local, mesh=plan.mesh,
             in_specs=(P(dp_ax, None, k_ax), P(k_ax, None), P(k_ax, None)),
             out_specs=P(dp_ax, None, None), check_vma=False)
@@ -246,7 +248,7 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
             return quant_matmul(xl, QuantizedWeight(scales=sc, codes=cd),
                                 interpret=interpret, fast=fast)
 
-        fn = jax.shard_map(
+        fn = shard_map(
             local, mesh=plan.mesh,
             in_specs=(P(dp_ax, None, None), P(None, n_ax), P(None, n_ax)),
             out_specs=P(dp_ax, None, n_ax), check_vma=False)

@@ -115,6 +115,50 @@ def use_plan(plan: MeshPlan | None):
         _state.plan = prev
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+              axis_names=None):
+    """Version-compat ``shard_map``: the top-level ``jax.shard_map``
+    (jax ≥ 0.5: ``check_vma`` / ``axis_names``) or the 0.4.x
+    ``jax.experimental.shard_map`` (``check_rep`` / ``auto`` — the axes
+    NOT named manual). All manual-SPMD call sites route through here so
+    a jax upgrade/downgrade is one shim, not six edits."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if axis_names is None else {"axis_names": set(axis_names)}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kw = {}
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma, **kw)
+
+
+def plan_scoped_jit(fun, **jit_kwargs):
+    """``jax.jit`` with a function identity unique to THIS call.
+
+    Model functions bake the active :class:`MeshPlan` into their traced
+    program (:func:`constrain` reads the thread-local plan at trace
+    time), but jax's trace cache is keyed on the function's identity —
+    so two engines jitting the SAME module-level function (``forward``,
+    ``sampled_step``, ...) under DIFFERENT plans would share cache
+    entries, and the second engine would dispatch a program whose
+    sharding constraints belong to the first engine's mesh
+    ("Received incompatible devices ... sharding_constraint inside
+    jit"). Wrapping in a fresh per-call closure makes the cache
+    per-engine, which is the true scope of a plan-dependent trace.
+    ``functools.wraps`` preserves the signature so ``static_argnums`` /
+    ``donate_argnums`` resolve exactly as on the original."""
+    import functools
+
+    @functools.wraps(fun)
+    def _plan_scoped(*args, **kwargs):
+        return fun(*args, **kwargs)
+
+    return jax.jit(_plan_scoped, **jit_kwargs)
+
+
 def constrain(x: jax.Array, *logical_axes: str | None) -> jax.Array:
     """Apply a sharding constraint by logical axis names; no-op without a plan.
 

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .api import shard_map
+
 if TYPE_CHECKING:
     from .api import MeshPlan
 
@@ -339,7 +341,7 @@ def sp_attention(plan: "MeshPlan", q: jax.Array, k_cache: jax.Array,
     # scalar start_pos replicates; a [B] vector (ragged batched serving:
     # per-slot depths) shards with the batch rows
     sp0_spec = P(dp_ax) if start_pos.ndim else P()
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fn, mesh=mesh,
         in_specs=(q_spec, cache_spec, cache_spec, new_spec, new_spec,
                   pos_spec, sp0_spec),

@@ -39,7 +39,7 @@ from ..models.llama import (
     sampled_steps,
     verify_step,
 )
-from ..parallel.api import MeshPlan, make_mesh, use_plan
+from ..parallel.api import MeshPlan, make_mesh, plan_scoped_jit, use_plan
 from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.sampler import Sampler, xorshift_random_f32
@@ -367,39 +367,47 @@ class InferenceEngine:
                 replicated_sampled_steps,
             )
 
-            self._step = jax.jit(replicated_forward, static_argnums=1,
-                                 donate_argnums=(4,))
-            self._greedy_step = jax.jit(replicated_greedy, static_argnums=1,
-                                        donate_argnums=(4,))
-            self._sampled_step = jax.jit(replicated_sampled, static_argnums=1,
+            # plan_scoped_jit: the traced programs bake in THIS engine's
+            # mesh plan (constrain reads it at trace time), so the trace
+            # cache must key on this engine, not the shared module-level
+            # function — a second engine with a different plan would
+            # otherwise dispatch the first engine's sharding constraints
+            self._step = plan_scoped_jit(replicated_forward, static_argnums=1,
                                          donate_argnums=(4,))
-            self._greedy_steps = jax.jit(replicated_greedy_steps,
-                                         static_argnums=(1, 5),
-                                         donate_argnums=(4,))
-            self._sampled_steps = jax.jit(replicated_sampled_steps,
-                                          static_argnums=(1, 8),
-                                          donate_argnums=(4,))
+            self._greedy_step = plan_scoped_jit(
+                replicated_greedy, static_argnums=1, donate_argnums=(4,))
+            self._sampled_step = plan_scoped_jit(
+                replicated_sampled, static_argnums=1, donate_argnums=(4,))
+            self._greedy_steps = plan_scoped_jit(replicated_greedy_steps,
+                                                 static_argnums=(1, 5),
+                                                 donate_argnums=(4,))
+            self._sampled_steps = plan_scoped_jit(replicated_sampled_steps,
+                                                  static_argnums=(1, 8),
+                                                  donate_argnums=(4,))
             from ..parallel.multihost import replicated_verify
 
-            self._verify_step = jax.jit(replicated_verify, static_argnums=1,
-                                        donate_argnums=(4,))
+            self._verify_step = plan_scoped_jit(
+                replicated_verify, static_argnums=1, donate_argnums=(4,))
         else:
-            self._step = jax.jit(forward, static_argnums=1, donate_argnums=(4,))
+            self._step = plan_scoped_jit(forward, static_argnums=1,
+                                         donate_argnums=(4,))
             # greedy fast path: argmax fused into the step — ONE dispatch per
             # token and a 4-byte host transfer instead of a full logits row;
             # used by next_token() when temperature == 0. The sampled twin
             # fuses temperature/top-p on device the same way (temp/topp/coin
             # are traced scalars, so knob changes never recompile).
-            self._greedy_step = jax.jit(greedy_step, static_argnums=1,
-                                        donate_argnums=(4,))
-            self._sampled_step = jax.jit(sampled_step, static_argnums=1,
-                                         donate_argnums=(4,))
-            self._greedy_steps = jax.jit(greedy_steps, static_argnums=(1, 5),
-                                         donate_argnums=(4,))
-            self._sampled_steps = jax.jit(sampled_steps, static_argnums=(1, 8),
-                                          donate_argnums=(4,))
-            self._verify_step = jax.jit(verify_step, static_argnums=1,
-                                        donate_argnums=(4,))
+            self._greedy_step = plan_scoped_jit(greedy_step, static_argnums=1,
+                                                donate_argnums=(4,))
+            self._sampled_step = plan_scoped_jit(
+                sampled_step, static_argnums=1, donate_argnums=(4,))
+            self._greedy_steps = plan_scoped_jit(greedy_steps,
+                                                 static_argnums=(1, 5),
+                                                 donate_argnums=(4,))
+            self._sampled_steps = plan_scoped_jit(sampled_steps,
+                                                  static_argnums=(1, 8),
+                                                  donate_argnums=(4,))
+            self._verify_step = plan_scoped_jit(verify_step, static_argnums=1,
+                                                donate_argnums=(4,))
 
     def _quant_resolution(self) -> tuple:
         """The env's quant-mode RESOLUTION (not the display label): what the

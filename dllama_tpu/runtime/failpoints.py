@@ -1,0 +1,158 @@
+"""Fault-injection registry — named failpoints for chaos testing.
+
+The serving stack's failure semantics (scheduler supervision, load
+shedding, deadline cancellation, drain — runtime/serving.py, serve/api.py)
+are only trustworthy if every path can be *driven*, not just reasoned
+about. This module is the driver: a telemetry-style process-global
+registry of named failpoints. Production code calls
+:func:`fire` at its injection sites; a disarmed site costs one attribute
+read + one dict bool check (no lock), so the hooks stay in the hot path
+permanently — the same always-on philosophy as the metrics registry.
+
+Arming is programmatic (tests: ``failpoints.arm("step", times=1)``) or
+via the environment for operator-driven game days::
+
+    DLLAMA_FAILPOINTS=step:raise,emit:broken_pipe python -m dllama_tpu api ...
+
+Spec grammar: ``name:action[:times]`` joined by commas. Actions map to
+exception types (``raise`` → :class:`FailpointError`, ``broken_pipe`` →
+``BrokenPipeError``, ``conn_reset`` → ``ConnectionResetError``,
+``oserror`` → ``OSError``); ``times`` bounds how often the point fires
+(default: every hit). Every fire increments
+``dllama_failpoints_fired_total{name=...}`` so chaos tests assert
+injection *and* recovery through the same telemetry registry.
+
+Known sites (grep ``failpoints.fire`` for ground truth):
+
+* ``step`` — the batch scheduler's decode dispatch (supervised: a raise
+  here exercises crash → fail-all → restart).
+* ``admit`` — slot admission (exercises the per-request reject path).
+* ``emit`` — the HTTP SSE write (a ``broken_pipe`` here exercises the
+  client-disconnect accounting).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+
+
+class FailpointError(RuntimeError):
+    """The generic injected failure (action ``raise``)."""
+
+
+_ACTIONS = {
+    "raise": FailpointError,
+    "broken_pipe": BrokenPipeError,
+    "conn_reset": ConnectionResetError,
+    "oserror": OSError,
+}
+
+
+@dataclass
+class _Armed:
+    action: str
+    times: int | None  # None = fire on every hit
+
+
+class FailpointRegistry:
+    """Thread-safe armed-failpoint table + per-name fire counts."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: dict[str, _Armed] = {}
+        self._fired: dict[str, int] = {}
+
+    def arm(self, name: str, action: str = "raise",
+            times: int | None = None) -> None:
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown failpoint action {action!r} "
+                             f"(known: {sorted(_ACTIONS)})")
+        if times is not None and times <= 0:
+            raise ValueError("times must be positive (or None for always)")
+        with self._lock:
+            self._armed[name] = _Armed(action, times)
+
+    def disarm(self, name: str) -> None:
+        with self._lock:
+            self._armed.pop(name, None)
+
+    def clear(self) -> None:
+        """Disarm everything and zero fire counts (tests)."""
+        with self._lock:
+            self._armed.clear()
+            self._fired.clear()
+
+    def armed(self, name: str) -> bool:
+        with self._lock:
+            return name in self._armed
+
+    def fired(self, name: str) -> int:
+        with self._lock:
+            return self._fired.get(name, 0)
+
+    def fire(self, name: str) -> None:
+        """Raise the armed exception for ``name``; no-op when disarmed.
+
+        The disarmed fast path takes no lock: ``_armed`` is read as a
+        plain attribute and arming between the check and the locked
+        re-check only delays the injection by one hit — fine for a test
+        hook, and it keeps per-step cost negligible."""
+        if not self._armed:
+            return
+        with self._lock:
+            fp = self._armed.get(name)
+            if fp is None:
+                return
+            if fp.times is not None:
+                fp.times -= 1
+                if fp.times <= 0:
+                    del self._armed[name]
+            self._fired[name] = self._fired.get(name, 0) + 1
+        from . import telemetry
+
+        telemetry.registry().counter(telemetry.FAILPOINTS_FIRED).inc(name=name)
+        raise _ACTIONS[fp.action](f"failpoint {name!r} fired")
+
+    def configure(self, spec: str | None) -> None:
+        """Arm from a ``name:action[:times],...`` spec (the
+        ``DLLAMA_FAILPOINTS`` grammar); ``None``/empty clears."""
+        self.clear()
+        if not spec:
+            return
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) not in (2, 3):
+                raise ValueError(
+                    f"bad failpoint spec {part!r} (want name:action[:times])")
+            name, action = fields[0], fields[1]
+            times = int(fields[2]) if len(fields) == 3 else None
+            self.arm(name, action, times)
+
+
+_registry = FailpointRegistry()
+
+
+def registry() -> FailpointRegistry:
+    return _registry
+
+
+def fire(name: str) -> None:
+    _registry.fire(name)
+
+
+def arm(name: str, action: str = "raise", times: int | None = None) -> None:
+    _registry.arm(name, action, times)
+
+
+def configure_from_env() -> bool:
+    """Arm from ``DLLAMA_FAILPOINTS`` if set; True when anything armed."""
+    spec = os.environ.get("DLLAMA_FAILPOINTS")
+    if not spec:
+        return False
+    _registry.configure(spec)
+    return True

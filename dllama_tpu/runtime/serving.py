@@ -36,10 +36,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import telemetry
+from . import failpoints, telemetry
 
 from ..models.llama import forward, sampled_step
-from ..parallel.api import use_plan
+from ..parallel.api import plan_scoped_jit, use_plan
 from ..parallel.multihost import (
     CTRL_SRV_COMMIT,
     CTRL_SRV_INIT,
@@ -56,6 +56,26 @@ if TYPE_CHECKING:
     from .engine import InferenceEngine
 
 _MASK64 = (1 << 64) - 1
+
+
+class SchedulerError(RuntimeError):
+    """Base for admission-time scheduler failures (serve/api.py maps each
+    subclass to an HTTP status)."""
+
+
+class QueueFullError(SchedulerError):
+    """Bounded admission: the wait queue is at --max-queue (HTTP 429)."""
+
+
+class SchedulerUnavailableError(SchedulerError):
+    """The scheduler is draining, closed, or crashed past its restart
+    budget (HTTP 503)."""
+
+
+class RequestTimeoutError(SchedulerError):
+    """A request's deadline expired before it produced any output
+    (HTTP 408). Deadline expiry mid-generation instead finishes the
+    request with ``finish_reason="timeout"`` and partial output."""
 
 
 def _replicated_ragged_step(params, cfg, tokens, pos, kv, temps, topps, coins):
@@ -100,6 +120,10 @@ class Request:
     # filled by the generator:
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
+    # True when `error` was set by a SERVER-side failure (scheduler crash,
+    # shutdown) rather than a per-request reject — the HTTP layer maps
+    # these to 503, not 400
+    server_error: bool = False
     # set by the CLIENT to stop decoding early (e.g. a stop STRING matched in
     # the emitted text — the raw-token EOS check can't see those); the slot
     # is retired at the next step boundary
@@ -107,6 +131,11 @@ class Request:
     rng_state: int = 0
     error: str | None = None
     decoder: object = None  # per-request streaming UTF-8 decoder
+    # deadline (monotonic ns; 0 = none): past it the scheduler fails the
+    # request if still queued, or cancels its slot at the next step
+    # boundary — done is ALWAYS set within one loop tick + one step
+    deadline_ns: int = 0
+    timed_out: bool = False
     # telemetry timeline (monotonic ns; 0 = not reached): submit → admission
     # start → decode armed. Spans derived from these feed the --trace-out
     # JSONL stream and the queue-wait histogram.
@@ -215,20 +244,28 @@ class BatchedGenerator:
         # accept counts) must be REPLICATED or np.asarray on a
         # non-addressable global array throws — the ragged twin of
         # parallel.multihost's replicated_* wrappers.
-        self._step = jax.jit(
-            _replicated_ragged_step if engine.multihost else sampled_step,
-            static_argnums=1, donate_argnums=(4,))
+        # plan_scoped_jit everywhere a shared module-level model function
+        # is jitted: the traced program bakes in THIS engine's mesh plan
+        # (constrain is trace-time), so the trace cache must be scoped to
+        # the ENGINE, not shared via the bare function's identity. Where
+        # the engine already wrapped the exact same function with the
+        # same jit options (same plan — this generator serves that
+        # engine), its callable is reused instead of re-wrapped: a fresh
+        # wrapper here would recompile the full-model program the engine
+        # already owns (minutes on real models).
+        self._step = (plan_scoped_jit(_replicated_ragged_step,
+                                      static_argnums=1, donate_argnums=(4,))
+                      if engine.multihost else engine._sampled_step)
         # chunked ragged decode (engine --decode-chunk composed with
         # --batch-slots): K fused steps over the whole pool per dispatch —
         # K× fewer dispatches and host-loop ticks (and control packets,
         # under multihost) when every active slot has K rows of headroom.
         # sampled_steps broadcasts over rows (vector temps/topps, [K, B]
         # coins), so the engine's chunk program IS the ragged chunk program.
-        from ..models.llama import sampled_steps as _sampled_steps
-
-        self._steps = jax.jit(
-            _replicated_ragged_steps if engine.multihost else _sampled_steps,
-            static_argnums=(1, 8), donate_argnums=(4,))
+        self._steps = (plan_scoped_jit(_replicated_ragged_steps,
+                                       static_argnums=(1, 8),
+                                       donate_argnums=(4,))
+                       if engine.multihost else engine._sampled_steps)
         # speculative serving (engine --spec-lookup): per-slot prompt-lookup
         # drafts verified in the ragged program. Greedy rows accept runs;
         # sampled rows keep their exact one-token/one-coin behavior, so every
@@ -238,12 +275,17 @@ class BatchedGenerator:
         if self.spec:
             from ..models.llama import ragged_verify_step
 
-            self._verify = jax.jit(
+            self._verify = plan_scoped_jit(
                 _replicated_ragged_verify if engine.multihost
                 else ragged_verify_step,
                 static_argnums=1, donate_argnums=(4,))
-        self._prefill_fwd = jax.jit(forward, static_argnums=1,
-                                    donate_argnums=(4,))
+        # non-multihost engine._step IS jit(forward) with these options;
+        # multihost needs plain forward (the engine's replicated_forward
+        # constrains logits this path discards, but matching the seed's
+        # prefill program exactly keeps worker mirrors bit-identical)
+        self._prefill_fwd = (plan_scoped_jit(forward, static_argnums=1,
+                                             donate_argnums=(4,))
+                             if engine.multihost else engine._step)
         # telemetry: cached handles (no registry lookups per step)
         self._tm = telemetry.registry()
         self._tm.gauge(telemetry.BATCH_SLOTS).set(n_slots)
@@ -447,6 +489,22 @@ class BatchedGenerator:
                                     n_tokens=len(req.tokens))
         req.done.set()
 
+    def reset_state(self) -> None:
+        """Forget every slot, cached prefix, and proposer — crash
+        recovery. The pool restarts logically empty: ``_ctx`` is cleared
+        so no later admission can prefix-match rows a half-finished
+        dispatch may have corrupted, and positions return to 0 (the next
+        prefill overwrites the rows it needs). Device buffers are kept;
+        if a crash left ``self.kv`` donated/invalid, the next dispatch
+        raises and the supervisor's restart budget converges to unready."""
+        self.slots = [None] * self.n_slots
+        self._ctx = [None] * self.n_slots
+        self._proposers = [None] * self.n_slots
+        self.pos[:] = 0
+        self.next_token[:] = 0
+        self._m_occupancy.set(0)
+        self._m_kv.set(0.0)
+
     # -- the batched step ---------------------------------------------------
 
     def step(self) -> int:
@@ -638,24 +696,67 @@ class BatchScheduler:
     """Thread-safe front end: queue beyond the slot pool + a step loop.
 
     HTTP handler threads call :meth:`generate` (blocking) or submit+wait;
-    a single background thread owns the generator and runs admit/step."""
+    a single background thread owns the generator and runs admit/step.
 
-    def __init__(self, engine: "InferenceEngine", n_slots: int = 4):
+    Fault tolerance (the serving layer's explicit failure semantics —
+    nothing in here may leave a waiter hanging on ``done.wait()``):
+
+    * **deadlines** — ``submit(..., timeout_s=...)`` stamps a monotonic
+      deadline; past it, a queued request fails immediately and an
+      in-flight one is cancelled at the next step boundary, both marked
+      ``timed_out`` (``dllama_request_timeouts_total``).
+    * **bounded admission** — ``max_queue > 0`` sheds submits beyond the
+      bound with :class:`QueueFullError` (``dllama_requests_shed_total``).
+    * **supervision** — an unexpected exception in the loop fails every
+      queued and in-flight request with the error, resets the generator
+      pool, and restarts (``dllama_scheduler_crashes_total`` /
+      ``_restarts_total``); past ``max_restarts`` — or on any crash under
+      multihost, where a restart would desync the worker mirrors — the
+      scheduler goes permanently unready and further submits raise
+      :class:`SchedulerUnavailableError`.
+    * **graceful drain** — :meth:`close` (optionally after
+      :meth:`begin_drain`) stops admitting, lets active slots finish up
+      to ``drain_s``, then fails the remainder explicitly.
+    """
+
+    def __init__(self, engine: "InferenceEngine", n_slots: int = 4, *,
+                 max_queue: int = 0, max_restarts: int = 3,
+                 _start_thread: bool = True):
         self.gen = BatchedGenerator(engine, n_slots)
+        self.n_slots = n_slots
+        self.max_queue = max_queue
+        self.max_restarts = max_restarts
         self._queue: list[Request] = []
         self._admissions: list[_Admission] = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._next_rid = 0
         self._stop = False
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        self._draining = False
+        self._healthy = True
+        self._crashes = 0
+        self._thread: threading.Thread | None = None
+        if _start_thread:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    # -- admission-side API (handler threads) -------------------------------
 
     def submit(self, prompt_ids: list[int], max_tokens: int, *,
                temperature: float = 0.0, topp: float = 0.9,
                seed: int = 0xB1A5, stop_on_eos: bool = True,
-               on_token=None) -> Request:
+               timeout_s: float | None = None, on_token=None) -> Request:
         with self._lock:
+            if self._stop or self._draining or not self._healthy or (
+                    self._thread is not None and not self._thread.is_alive()):
+                raise SchedulerUnavailableError(
+                    "scheduler is draining" if self._draining
+                    else "scheduler is not running")
+            if self.max_queue and len(self._queue) >= self.max_queue:
+                telemetry.registry().counter(telemetry.REQUESTS_SHED).inc()
+                raise QueueFullError(
+                    f"queue full ({len(self._queue)} waiting, "
+                    f"--max-queue {self.max_queue}); retry later")
             rid = self._next_rid
             self._next_rid += 1
             req = Request(rid=rid, prompt_ids=list(prompt_ids),
@@ -663,6 +764,8 @@ class BatchScheduler:
                           topp=topp, seed=seed, stop_on_eos=stop_on_eos,
                           on_token=on_token)
             req.t_submit = telemetry.now_ns()
+            if timeout_s is not None and timeout_s > 0:
+                req.deadline_ns = req.t_submit + int(timeout_s * 1e9)
             self._queue.append(req)
             telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
                 len(self._queue))
@@ -675,60 +778,209 @@ class BatchScheduler:
         req.done.wait()
         return req.tokens
 
-    def close(self) -> None:
+    def is_alive(self) -> bool:
+        """Loop thread running and not crash-exhausted."""
+        return (self._healthy and not self._stop
+                and (self._thread is None or self._thread.is_alive()))
+
+    def readiness(self) -> tuple[bool, str]:
+        """(ready, reason) for ``GET /readyz``: scheduler alive ∧ not
+        draining ∧ queue below the shed threshold."""
+        if not self._healthy:
+            return False, "scheduler crashed (restart budget exhausted)"
+        if self._thread is not None and not self._thread.is_alive():
+            return False, "scheduler thread is not running"
+        if self._stop or self._draining:
+            return False, "draining"
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            return False, "queue full (shedding)"
+        return True, "ok"
+
+    # -- shutdown ------------------------------------------------------------
+
+    def begin_drain(self) -> None:
+        """Stop admitting (submits raise 503-shaped errors, ``/readyz``
+        flips) while in-flight work keeps stepping — phase one of a
+        graceful shutdown."""
+        self._draining = True
+        telemetry.registry().gauge(telemetry.SERVER_DRAINING).set(1)
+        self._wake.set()
+
+    def _pending(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._admissions)
+
+    def close(self, drain_s: float = 0.0) -> None:
+        """Stop admitting, drain active work up to ``drain_s`` seconds,
+        then stop the loop and fail whatever remains — every waiter's
+        ``done`` is set by the time this returns."""
+        self.begin_drain()
+        if drain_s > 0 and self._thread is not None \
+                and self._thread.is_alive():
+            deadline = time.monotonic() + drain_s
+            while time.monotonic() < deadline and (
+                    self._pending() or self.gen.n_active):
+                time.sleep(0.01)
         self._stop = True
         self._wake.set()
-        self._thread.join(timeout=30)
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        # the remainder fails EXPLICITLY (the close() that used to leak
+        # waiters would leave these threads in done.wait() forever)
+        self._fail_all("server shutting down")
+
+    # -- failure plumbing ----------------------------------------------------
+
+    def _fail_request(self, req: Request, msg: str) -> None:
+        if not req.done.is_set():
+            if not req.timed_out:
+                req.error = msg
+                req.server_error = True
+            req.done.set()
+
+    def _timeout_request(self, req: Request) -> None:
+        req.timed_out = True
+        telemetry.registry().counter(telemetry.REQUEST_TIMEOUTS).inc()
+
+    def _fail_all(self, msg: str) -> None:
+        """Fail every queued, admitting, and in-flight request with
+        ``msg`` (idempotent; timed-out requests keep their flag)."""
+        with self._lock:
+            victims = list(self._queue)
+            self._queue.clear()
+            victims += [a.req for a in self._admissions]
+            self._admissions.clear()
+            telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(0)
+        for s in list(self.gen.slots):
+            if s is not None:
+                victims.append(s)
+        for req in victims:
+            self._fail_request(req, msg)
+
+    def _check_deadlines(self) -> None:
+        """Queued requests past deadline fail now; in-flight ones are
+        cancelled (their slot retires at the next step boundary)."""
+        now = telemetry.now_ns()
+        expired: list[Request] = []
+        with self._lock:
+            for req in list(self._queue):
+                if req.deadline_ns and now >= req.deadline_ns:
+                    self._queue.remove(req)
+                    expired.append(req)
+            if expired:
+                telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
+                    len(self._queue))
+        for req in expired:
+            self._timeout_request(req)
+            req.done.set()
+        for holder in (a.req for a in self._admissions):
+            if holder.deadline_ns and now >= holder.deadline_ns \
+                    and not holder.timed_out:
+                self._timeout_request(holder)
+                holder.cancel.set()
+        for s in self.gen.slots:
+            if s is not None and s.deadline_ns and now >= s.deadline_ns \
+                    and not s.timed_out:
+                self._timeout_request(s)
+                s.cancel.set()
+
+    def _on_crash(self, exc: BaseException) -> None:
+        """Supervision: surface the crash to every pending request, then
+        restart with a fresh pool — or go permanently unready once the
+        restart budget is spent (or under multihost, where replaying a
+        reset through the worker mirrors isn't implemented)."""
+        self._crashes += 1
+        telemetry.registry().counter(telemetry.SCHEDULER_CRASHES).inc()
+        msg = f"scheduler crashed: {type(exc).__name__}: {exc}"
+        print(f"🛑 {msg} (crash {self._crashes}/{self.max_restarts})",
+              flush=True)
+        dead = self._crashes > self.max_restarts or self.gen.eng.multihost
+
+        def _go_unready() -> None:
+            # flags flip UNDER the lock and BEFORE _fail_all: a submit
+            # racing in after the fail sweep would otherwise enqueue a
+            # request nobody ever fails — a hung done.wait()
+            with self._lock:
+                self._healthy = False
+                self._stop = True
+
+        if dead:
+            _go_unready()
+        self._fail_all(msg)
+        if dead:
+            print("🛑 scheduler restart budget exhausted — marking unready",
+                  flush=True)
+            return
+        try:
+            self.gen.reset_state()
+        except Exception as e:  # noqa: BLE001 — reset failed: go unready
+            _go_unready()
+            self._fail_all(msg)  # submits that raced in during the reset
+            print(f"🛑 scheduler state reset failed ({e}) — marking unready",
+                  flush=True)
+            return
+        telemetry.registry().counter(telemetry.SCHEDULER_RESTARTS).inc()
+
+    # -- the loop ------------------------------------------------------------
 
     def _loop(self) -> None:
         while not self._stop:
-            reserved = {a.slot for a in self._admissions}
-            with self._lock:
-                # start admissions into free, unreserved slots
-                while self._queue:
-                    free = [s for s in self.gen.free_slots()
-                            if s not in reserved]
-                    if not free:
-                        break
-                    req = self._queue.pop(0)
-                    try:
-                        adm = self.gen.begin_admit(req, free[0])
-                    except Exception as e:  # noqa: BLE001 — reject, don't wedge
-                        req.error = f"{type(e).__name__}: {e}"
-                        req.done.set()
-                        continue
-                    self._admissions.append(adm)
-                    reserved.add(adm.slot)
-                telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
-                    len(self._queue))
-            # ONE prefill chunk per in-flight admission per loop tick, so a
-            # long prompt interleaves with (not stalls) active decode steps
-            for adm in list(self._admissions):
-                if adm.req.cancel.is_set():
-                    self._admissions.remove(adm)
-                    # counted as admitted in begin_admit: balance the pair so
-                    # admissions_total - retires_total stays "live requests"
-                    telemetry.registry().counter(telemetry.RETIRES).inc()
-                    adm.req.done.set()
-                    continue
+            try:
+                self._tick()
+            except Exception as exc:  # noqa: BLE001 — supervised: fail-all + bounded restart
+                self._on_crash(exc)
+
+    def _tick(self) -> None:
+        self._check_deadlines()
+        reserved = {a.slot for a in self._admissions}
+        with self._lock:
+            # start admissions into free, unreserved slots
+            while self._queue:
+                free = [s for s in self.gen.free_slots()
+                        if s not in reserved]
+                if not free:
+                    break
+                req = self._queue.pop(0)
                 try:
-                    if self.gen.continue_admit(adm):
-                        self._admissions.remove(adm)
+                    failpoints.fire("admit")
+                    adm = self.gen.begin_admit(req, free[0])
                 except Exception as e:  # noqa: BLE001 — reject, don't wedge
-                    self._admissions.remove(adm)
-                    telemetry.registry().counter(telemetry.RETIRES).inc()
-                    adm.req.error = f"{type(e).__name__}: {e}"
-                    adm.req.done.set()
-            if self.gen.n_active == 0 and not self._admissions:
-                self._wake.wait(timeout=0.05)
-                self._wake.clear()
+                    req.error = f"{type(e).__name__}: {e}"
+                    req.done.set()
+                    continue
+                self._admissions.append(adm)
+                reserved.add(adm.slot)
+            telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
+                len(self._queue))
+        # ONE prefill chunk per in-flight admission per loop tick, so a
+        # long prompt interleaves with (not stalls) active decode steps
+        for adm in list(self._admissions):
+            if adm.req.cancel.is_set():
+                self._admissions.remove(adm)
+                # counted as admitted in begin_admit: balance the pair so
+                # admissions_total - retires_total stays "live requests"
+                telemetry.registry().counter(telemetry.RETIRES).inc()
+                adm.req.done.set()
                 continue
-            # --decode-chunk composes with batched serving: K fused steps
-            # per tick (admissions then interleave per-K-tokens instead of
-            # per-token — the same latency/throughput trade as the engine's
-            # chunked decode)
-            chunk = getattr(self.gen.eng, "decode_chunk", 1)
-            if chunk > 1:
-                self.gen.step_chunk(chunk)
-            else:
-                self.gen.step()
+            try:
+                if self.gen.continue_admit(adm):
+                    self._admissions.remove(adm)
+            except Exception as e:  # noqa: BLE001 — reject, don't wedge
+                self._admissions.remove(adm)
+                telemetry.registry().counter(telemetry.RETIRES).inc()
+                adm.req.error = f"{type(e).__name__}: {e}"
+                adm.req.done.set()
+        if self.gen.n_active == 0 and not self._admissions:
+            self._wake.wait(timeout=0.05)
+            self._wake.clear()
+            return
+        failpoints.fire("step")
+        # --decode-chunk composes with batched serving: K fused steps
+        # per tick (admissions then interleave per-K-tokens instead of
+        # per-token — the same latency/throughput trade as the engine's
+        # chunked decode)
+        chunk = getattr(self.gen.eng, "decode_chunk", 1)
+        if chunk > 1:
+            self.gen.step_chunk(chunk)
+        else:
+            self.gen.step()

@@ -65,6 +65,13 @@ BATCH_TOKENS = "dllama_batch_tokens_total"
 ADMISSIONS = "dllama_admissions_total"
 RETIRES = "dllama_retires_total"
 PREFIX_REUSE_TOKENS = "dllama_prefix_reuse_tokens_total"
+# fault tolerance (runtime/serving.py, runtime/failpoints.py)
+REQUESTS_SHED = "dllama_requests_shed_total"
+REQUEST_TIMEOUTS = "dllama_request_timeouts_total"
+SCHEDULER_CRASHES = "dllama_scheduler_crashes_total"
+SCHEDULER_RESTARTS = "dllama_scheduler_restarts_total"
+SERVER_DRAINING = "dllama_server_draining"
+FAILPOINTS_FIRED = "dllama_failpoints_fired_total"
 
 # HTTP layer (serve/api.py)
 HTTP_REQUESTS = "dllama_http_requests_total"
@@ -138,6 +145,23 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
     _spec(RETIRES, "counter", "Slots retired (EOS, limits, or cancel)"),
     _spec(PREFIX_REUSE_TOKENS, "counter",
           "Prompt tokens skipped via cross-slot KV prefix reuse"),
+    _spec(REQUESTS_SHED, "counter",
+          "Requests rejected at admission because the queue was full "
+          "(HTTP 429 load shedding)"),
+    _spec(REQUEST_TIMEOUTS, "counter",
+          "Requests cancelled because their deadline expired (queued or "
+          "in-flight)"),
+    _spec(SCHEDULER_CRASHES, "counter",
+          "Unexpected batch-scheduler loop crashes (each fails every "
+          "pending request)"),
+    _spec(SCHEDULER_RESTARTS, "counter",
+          "Successful batch-scheduler restarts after a crash (bounded; "
+          "exhaustion marks the server unready)"),
+    _spec(SERVER_DRAINING, "gauge",
+          "1 while the server is draining (shutdown started, no new "
+          "admissions), else 0"),
+    _spec(FAILPOINTS_FIRED, "counter",
+          "Fault-injection failpoint fires by name (runtime/failpoints)"),
     _spec(HTTP_REQUESTS, "counter",
           "HTTP requests by route and status code"),
     _spec(REQUESTS_IN_FLIGHT, "gauge", "Completions currently executing"),

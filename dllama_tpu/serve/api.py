@@ -26,21 +26,93 @@ modes:
 from __future__ import annotations
 
 import json
+import os
 import queue
 import time
 import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
-from ..runtime import telemetry
+from ..runtime import failpoints, telemetry
 from ..runtime.engine import InferenceEngine
+from ..runtime.serving import (QueueFullError, RequestTimeoutError,
+                               SchedulerUnavailableError)
 from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
                               ChatTemplateType, EosDetector, EosResult)
 
 # known routes for the HTTP request counter's route label — anything else is
 # folded into "other" so a scanner can't explode the label cardinality
 _ROUTES = ("/v1/chat/completions", "/v1/models", "/metrics",
-           "/health", "/healthz")
+           "/health", "/healthz", "/readyz")
+
+# absurd-deadline guard: a request may not park a slot (or a queue entry)
+# for more than an hour — longer values are a client bug, rejected 400
+_MAX_TIMEOUT_S = 3600.0
+
+
+class ClientDisconnect(Exception):
+    """The SSE peer vanished mid-stream (BrokenPipeError /
+    ConnectionResetError on the socket). Counted per route as
+    ``status="client_disconnect"`` — an aborted download is load
+    information, not a server error."""
+
+
+def _validate_body(body: dict) -> None:
+    """Schema-check a /v1/chat/completions body; raises ``ValueError``
+    (→ HTTP 400) with a client-actionable message. Every malformed shape
+    must die here — a 500 from a typed field is a server bug
+    (tests/test_fuzz.py sweeps this)."""
+    if not isinstance(body, dict):
+        raise ValueError("body must be a JSON object")
+    # an explicit JSON null means "absent" (OpenAI semantics): drop the
+    # key so downstream float()/int() conversions see their defaults
+    # instead of None (a null temperature must not become a 500)
+    for k in [k for k, v in body.items() if v is None]:
+        del body[k]
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        raise ValueError("messages must be a non-empty list")
+    for i, m in enumerate(messages):
+        if not isinstance(m, dict):
+            raise ValueError(f"messages[{i}] must be an object")
+        if not isinstance(m.get("role", "user"), str):
+            raise ValueError(f"messages[{i}].role must be a string")
+        if not isinstance(m.get("content", ""), str):
+            raise ValueError(f"messages[{i}].content must be a string")
+
+    def _number(key, lo, hi):
+        v = body.get(key)
+        if v is None:
+            return
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ValueError(f"{key} must be a number")
+        if not (lo <= float(v) <= hi):
+            raise ValueError(f"{key} must be in [{lo}, {hi}]")
+
+    _number("temperature", 0.0, 100.0)
+    _number("top_p", 0.0, 1.0)
+    mt = body.get("max_tokens")
+    if mt is not None:
+        if isinstance(mt, bool) or not isinstance(mt, int):
+            raise ValueError("max_tokens must be an integer")
+        if mt < 0:
+            raise ValueError("max_tokens must be >= 0")
+    seed = body.get("seed")
+    if seed is not None and (isinstance(seed, bool)
+                             or not isinstance(seed, int)):
+        raise ValueError("seed must be an integer")
+    timeout = body.get("timeout")
+    if timeout is not None:
+        if isinstance(timeout, bool) or not isinstance(timeout, (int, float)):
+            raise ValueError("timeout must be a number (seconds)")
+        if not (0 < float(timeout) <= _MAX_TIMEOUT_S):
+            raise ValueError(
+                f"timeout must be in (0, {_MAX_TIMEOUT_S:.0f}] seconds")
+    stop = body.get("stop")
+    if stop is not None and not isinstance(stop, (str, list)):
+        raise ValueError("stop must be a string or a list of strings")
+    if isinstance(stop, list) and not all(isinstance(s, str) for s in stop):
+        raise ValueError("stop must be a string or a list of strings")
 
 
 @dataclass
@@ -126,9 +198,11 @@ class ApiState:
     """Engine + chat plumbing shared across requests."""
 
     def __init__(self, engine: InferenceEngine, model_name: str = "dllama-tpu",
-                 template_type: ChatTemplateType = ChatTemplateType.UNKNOWN):
+                 template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
+                 request_timeout: float = 0.0):
         self.engine = engine
         self.model_name = model_name
+        self.request_timeout = request_timeout  # server default (0 = none)
         tok = engine.tokenizer
         eos_piece = (tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
                      if tok.eos_token_ids else "")
@@ -139,6 +213,11 @@ class ApiState:
         self.cache = NaiveCache()
         self._rid = 0  # request counter for trace spans (single-threaded)
 
+    def readiness(self) -> tuple[bool, str]:
+        """Single-sequence mode has no queue or supervisor: ready iff
+        the engine exists (liveness == readiness)."""
+        return True, "ok"
+
     def complete(self, body: dict, emit=None) -> dict:
         """Run one chat completion; ``emit(text)`` streams deltas when set.
 
@@ -148,9 +227,11 @@ class ApiState:
         """
         engine = self.engine
         tok = engine.tokenizer
-        messages = body.get("messages", [])
-        if not messages:
-            raise ValueError("messages required")
+        _validate_body(body)
+        messages = body["messages"]
+        timeout_s = float(body.get("timeout") or self.request_timeout or 0)
+        deadline = (telemetry.now_ns() + int(timeout_s * 1e9)
+                    if timeout_s > 0 else 0)
         self._rid += 1
         engine.trace_rid = self._rid  # stamps the engine's prefill span
         rt = telemetry.RequestTimer()
@@ -200,6 +281,16 @@ class ApiState:
         finish_reason = "length"
         t_decode = telemetry.now_ns()
         while engine.pos < max_pred:
+            if deadline and telemetry.now_ns() >= deadline:
+                # in-line deadline: the decode loop runs on the handler
+                # thread, so cancelling is simply stopping the loop
+                telemetry.registry().counter(
+                    telemetry.REQUEST_TIMEOUTS).inc()
+                if n_completion == 0:
+                    raise RequestTimeoutError(
+                        f"no output within timeout ({timeout_s:g}s)")
+                finish_reason = "timeout"
+                break
             if (proposer is not None
                     and max_pred - engine.pos >= engine.spec_lookup + 1):
                 run = engine.speculative_tokens(token, proposer.draft())
@@ -223,7 +314,7 @@ class ApiState:
             if gate.feed(token, tok.decode(token)):
                 finish_reason = "stop"
                 break
-        if finish_reason == "length":
+        if finish_reason in ("length", "timeout"):
             gate.flush_tail()
         rt.done(len(ids), n_completion)
         telemetry.tracer().emit(self._rid, "decode", t_decode,
@@ -254,11 +345,13 @@ class BatchedApiState:
 
     def __init__(self, engine: InferenceEngine, n_slots: int,
                  model_name: str = "dllama-tpu",
-                 template_type: ChatTemplateType = ChatTemplateType.UNKNOWN):
+                 template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
+                 max_queue: int = 0, request_timeout: float = 0.0):
         from ..runtime.serving import BatchScheduler
 
         self.engine = engine
         self.model_name = model_name
+        self.request_timeout = request_timeout  # server default (0 = none)
         tok = engine.tokenizer
         eos_piece = (tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
                      if tok.eos_token_ids else "")
@@ -266,16 +359,21 @@ class BatchedApiState:
                                               type=template_type)
         self.stop_pieces = [tok.vocab[t].decode("utf-8", "replace")
                             for t in tok.eos_token_ids]
-        self.sched = BatchScheduler(engine, n_slots)
+        self.sched = BatchScheduler(engine, n_slots, max_queue=max_queue)
 
-    def close(self) -> None:
-        self.sched.close()
+    def readiness(self) -> tuple[bool, str]:
+        return self.sched.readiness()
+
+    def begin_drain(self) -> None:
+        self.sched.begin_drain()
+
+    def close(self, drain_s: float = 0.0) -> None:
+        self.sched.close(drain_s)
 
     def complete(self, body: dict, emit=None) -> dict:
         tok = self.engine.tokenizer
-        messages = body.get("messages", [])
-        if not messages:
-            raise ValueError("messages required")
+        _validate_body(body)
+        messages = body["messages"]
         items = [ChatItem(m.get("role", "user"), m.get("content", ""))
                  for m in messages]
         prompt = self.template.generate(items, append_generation_prompt=True)
@@ -283,6 +381,7 @@ class BatchedApiState:
         max_tokens = int(body.get("max_tokens") or 0)
         if max_tokens <= 0:
             max_tokens = max(1, self.engine.cfg.seq_len - len(ids))
+        timeout_s = float(body.get("timeout") or self.request_timeout or 0)
 
         sampler = self.engine.sampler  # CLI flags are the per-request defaults
         q: queue.Queue = queue.Queue()
@@ -292,35 +391,60 @@ class BatchedApiState:
             topp=float(body.get("top_p", sampler.topp)),
             seed=int(body.get("seed", 0xB1A5)),
             stop_on_eos=True,
+            timeout_s=timeout_s if timeout_s > 0 else None,
             on_token=lambda t, p: q.put((t, p)))
 
         gate = _EosGate(tok, _request_stops(self.stop_pieces, body), emit)
-        if prompt.public_prompt:
-            gate._out(prompt.public_prompt)
         rt = telemetry.RequestTimer()
         n_completion = 0
         finish_reason = "length"
-        while True:
-            try:
-                t, piece = q.get(timeout=0.1)
-            except queue.Empty:
-                if req.done.is_set() and q.empty():
+        try:
+            # inside the try: the public-prompt echo is the FIRST socket
+            # write, so a peer that disconnected right after POSTing must
+            # cancel the slot here too, not only mid-stream
+            if prompt.public_prompt:
+                gate._out(prompt.public_prompt)
+            while True:
+                try:
+                    t, piece = q.get(timeout=0.1)
+                except queue.Empty:
+                    if req.done.is_set() and q.empty():
+                        break
+                    continue
+                n_completion += 1
+                rt.token()
+                if gate.feed(t, piece):
+                    # stop STRING matched (spelled by ordinary tokens — the
+                    # scheduler's raw-eos check can't see it): cancel the slot
+                    # so it stops burning batch steps, and stop consuming
+                    finish_reason = "stop"
+                    req.cancel.set()
                     break
-                continue
-            n_completion += 1
-            rt.token()
-            if gate.feed(t, piece):
-                # stop STRING matched (spelled by ordinary tokens — the
-                # scheduler's raw-eos check can't see it): cancel the slot
-                # so it stops burning batch steps, and stop consuming
-                finish_reason = "stop"
-                req.cancel.set()
-                break
-        req.done.wait()
-        if finish_reason == "length":
-            gate.flush_tail()
-        if req.error:
+        except (BrokenPipeError, ConnectionResetError) as e:
+            # the SSE peer vanished mid-stream (emit raised inside
+            # gate.feed): free the slot and reclassify — this is not a 500
+            req.cancel.set()
+            raise ClientDisconnect(str(e)) from e
+        # the scheduler guarantees done is set on every path (retire,
+        # timeout, crash fail-all, shutdown); the alive check is the belt
+        # against the scheduler thread dying in a way supervision missed
+        while not req.done.wait(timeout=5.0):
+            if not self.sched.is_alive():
+                raise SchedulerUnavailableError(
+                    "scheduler stopped while the request was in flight")
+        if req.timed_out and finish_reason == "length":
+            # "length" here just means "no stop matched yet" — the real
+            # cause was the deadline (a stop-string finish keeps "stop")
+            if n_completion == 0:
+                raise RequestTimeoutError(
+                    f"no output within timeout ({timeout_s:g}s)")
+            finish_reason = "timeout"
+        elif req.error:
+            if req.server_error:  # crash/shutdown: 503 + retry, not a 400
+                raise SchedulerUnavailableError(req.error)
             raise ValueError(req.error)
+        if finish_reason in ("length", "timeout"):
+            gate.flush_tail()
         rt.done(len(ids), n_completion)
         return {
             "text": "".join(gate.parts),
@@ -375,18 +499,23 @@ def make_handler(state: ApiState):
 
         _counted = False  # whether THIS request hit the telemetry counter
 
-        def _count(self, code: int) -> None:
+        def _count(self, status: int | str) -> None:
+            # status is an HTTP code or a symbolic outcome like
+            # "client_disconnect" (an aborted SSE peer is not a 500)
             route = self.path if self.path in _ROUTES else "other"
             telemetry.registry().counter(telemetry.HTTP_REQUESTS).inc(
-                route=route, status=str(code))
+                route=route, status=str(status))
             self._counted = True
 
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(self, code: int, payload: dict,
+                  headers: dict | None = None) -> None:
             self._count(code)
             body = json.dumps(payload).encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -412,7 +541,16 @@ def make_handler(state: ApiState):
                 self.end_headers()
                 self.wfile.write(body)
             elif self.path in ("/health", "/healthz"):
+                # liveness: the process is up and serving HTTP — always 200
+                # (readiness is /readyz; the split matters during drain and
+                # after a crash-exhausted scheduler, when the process should
+                # NOT be restarted but should stop receiving traffic)
                 self._json(200, {"status": "ok"})
+            elif self.path == "/readyz":
+                ready, reason = state.readiness()
+                self._json(200 if ready else 503,
+                           {"status": "ok" if ready else "unready",
+                            "reason": reason})
             else:
                 self._not_found()
 
@@ -443,6 +581,9 @@ def make_handler(state: ApiState):
             except (ValueError, json.JSONDecodeError):
                 self._json(400, {"error": "invalid JSON body"})
                 return
+            if not isinstance(body, dict):
+                self._json(400, {"error": "body must be a JSON object"})
+                return
             stream = bool(body.get("stream", False))
             inflight = telemetry.registry().gauge(telemetry.REQUESTS_IN_FLIGHT)
             inflight.add(1)
@@ -451,46 +592,105 @@ def make_handler(state: ApiState):
             # mode would otherwise vanish from the counter entirely — the
             # failing requests are exactly the ones an operator must see
             self._counted = False
-            stream_status = 500
+            status: int | str = 500
+            # SSE headers are sent lazily at the FIRST delta, so failures
+            # before any output (shed, timeout, malformed body, scheduler
+            # down) still return a real status code even on stream requests
+            headers_sent = False
+
+            def start_stream() -> None:
+                nonlocal headers_sent
+                if headers_sent:
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                headers_sent = True
+
+            def emit(text: str) -> None:
+                failpoints.fire("emit")
+                start_stream()
+                chunk = _chunk_json(state, {"content": text})
+                self.wfile.write(
+                    b"data: " + json.dumps(chunk).encode("utf-8") + b"\n\n")
+                self.wfile.flush()
+
+            def stream_abort(reason: str) -> None:
+                # headers already went out as 200: terminate the SSE
+                # stream with an explicit finish_reason + [DONE] so the
+                # client can tell a server-side abort from a dropped
+                # socket (the status COUNTER still records the real
+                # outcome; the wire status can no longer change)
+                try:
+                    final = _chunk_json(state, {}, reason)
+                    self.wfile.write(b"data: "
+                                     + json.dumps(final).encode("utf-8")
+                                     + b"\n\n")
+                    self.wfile.write(b"data: [DONE]\n\n")
+                except OSError:
+                    pass
+                self.close_connection = True
+
             try:
                 if stream:
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/event-stream")
-                    self.send_header("Cache-Control", "no-cache")
-                    self.send_header("Connection", "close")
-                    self.end_headers()
-
-                    def emit(text: str) -> None:
-                        chunk = _chunk_json(state, {"content": text})
-                        self.wfile.write(
-                            b"data: " + json.dumps(chunk).encode("utf-8") + b"\n\n")
-                        self.wfile.flush()
-
                     out = state.complete(body, emit=emit)
+                    start_stream()  # zero-delta completion: headers now
                     final = _chunk_json(state, {}, out["finish_reason"])
                     self.wfile.write(
                         b"data: " + json.dumps(final).encode("utf-8") + b"\n\n")
                     self.wfile.write(b"data: [DONE]\n\n")
-                    stream_status = 200
+                    status = 200
                 else:
                     out = state.complete(body)
                     self._json(200, _completion_json(state, out))
-            except ValueError as e:
-                if not stream:
-                    self._json(400, {"error": str(e)})
+                    status = 200
+            except QueueFullError as e:
+                status = 429  # load shed: bounded queue, explicit backoff
+                if not headers_sent:
+                    self._json(429, {"error": str(e)},
+                               headers={"Retry-After": "1"})
                 else:
-                    raise
+                    stream_abort("error")
+            except SchedulerUnavailableError as e:
+                status = 503  # draining or crashed-unready
+                if not headers_sent:
+                    self._json(503, {"error": str(e)},
+                               headers={"Retry-After": "5"})
+                else:
+                    stream_abort("error")
+            except RequestTimeoutError as e:
+                status = 408  # deadline expired before any output
+                if not headers_sent:
+                    self._json(408, {"error": str(e)})
+                else:
+                    stream_abort("timeout")
+            except (ClientDisconnect, BrokenPipeError,
+                    ConnectionResetError):
+                # the peer hung up: nothing left to write, and this is
+                # load information rather than a server error
+                status = "client_disconnect"
+                self.close_connection = True
+            except ValueError as e:
+                status = 400
+                if not headers_sent:
+                    self._json(400, {"error": str(e)})
+                else:  # mid-stream model/request failure: can't re-status
+                    status = 500
+                    stream_abort("error")
             finally:
                 inflight.add(-1)
-                if stream:
-                    self._count(stream_status)
-                elif not self._counted:  # non-ValueError escape: still count
-                    self._count(500)
+                if not self._counted:
+                    self._count(status)
 
     return Handler
 
 
 def run_api_server(args) -> int:
+    import signal
+    import threading
+
     from .cli import make_engine, start_stats_reporter
 
     if getattr(args, "dp", 1) > 1 and (getattr(args, "batch_slots", 0) or 0) <= 1:
@@ -500,23 +700,51 @@ def run_api_server(args) -> int:
     if getattr(args, "trace_out", None):
         telemetry.tracer().configure(args.trace_out)
         print(f"🔬 request trace (JSONL spans) → {args.trace_out}")
+    if failpoints.configure_from_env():
+        print("💣 fault injection armed from DLLAMA_FAILPOINTS="
+              f"{os.environ['DLLAMA_FAILPOINTS']}")
     engine = make_engine(args)
     if getattr(args, "stats", 0):
         start_stats_reporter(float(args.stats))
     n_slots = getattr(args, "batch_slots", 0) or 0
+    max_queue = getattr(args, "max_queue", 0) or 0
+    request_timeout = getattr(args, "request_timeout", 0.0) or 0.0
+    drain_timeout = getattr(args, "drain_timeout", 5.0)
     ttype = ChatTemplateType(getattr(args, "chat_template", None) or "unknown")
     if n_slots > 1:
         state: ApiState | BatchedApiState = BatchedApiState(
-            engine, n_slots, template_type=ttype)
+            engine, n_slots, template_type=ttype, max_queue=max_queue,
+            request_timeout=request_timeout)
         server = ThreadingHTTPServer((args.host, args.port),
                                      make_handler(state))
-        print(f"🕸️ continuous batching: {n_slots} slots")
+        print(f"🕸️ continuous batching: {n_slots} slots"
+              + (f", queue bound {max_queue} (429 beyond)" if max_queue
+                 else ""))
         if engine.spec_lookup:
             print(f"🕸️ speculative serving: verify K={engine.spec_lookup} "
                   f"per slot (greedy requests)")
     else:
-        state = ApiState(engine, template_type=ttype)
+        state = ApiState(engine, template_type=ttype,
+                         request_timeout=request_timeout)
         server = HTTPServer((args.host, args.port), make_handler(state))
+    if request_timeout:
+        print(f"🕸️ per-request deadline: {request_timeout:g}s "
+              f"(request 'timeout' field overrides)")
+
+    def _on_sigterm(signum, frame):
+        # graceful drain: flip /readyz (load balancer stops routing), stop
+        # admitting, then stop the accept loop from ANOTHER thread —
+        # shutdown() called here would deadlock the serve_forever poll
+        print("🛑 SIGTERM: draining (readyz → 503, no new admissions)",
+              flush=True)
+        if isinstance(state, BatchedApiState):
+            state.begin_drain()
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded/test usage): no signal hook
     print(f"🕸️ listening on http://{args.host}:{args.port}")
     try:
         server.serve_forever()
@@ -525,7 +753,10 @@ def run_api_server(args) -> int:
     finally:
         server.server_close()
         if isinstance(state, BatchedApiState):
-            state.close()
+            # drain active slots up to the deadline, then fail the
+            # remainder explicitly (their handler threads get errors,
+            # never a silent hang)
+            state.close(drain_s=drain_timeout)
         engine.close()
         telemetry.tracer().configure(None)
     return 0

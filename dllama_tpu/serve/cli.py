@@ -159,6 +159,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "sequence slots (one ragged decode program; requests "
                         "queue beyond the pool). 0/1 = single-sequence mode "
                         "with prefix KV reuse")
+    p.add_argument("--max-queue", type=int, default=0, metavar="N",
+                   help="api mode, batched serving: bound the admission "
+                        "queue at N waiting requests; submits beyond it are "
+                        "shed with HTTP 429 + Retry-After instead of "
+                        "building unbounded latency (0 = unbounded). "
+                        "/readyz reports unready while the queue is full")
+    p.add_argument("--request-timeout", type=float, default=0.0,
+                   metavar="SEC",
+                   help="api mode: default per-request deadline. Past it a "
+                        "queued request fails (HTTP 408) and an in-flight "
+                        "one is cancelled at the next step boundary "
+                        "(finish_reason \"timeout\", partial output). The "
+                        "request body's 'timeout' field overrides per "
+                        "request; 0 = no deadline")
+    p.add_argument("--drain-timeout", type=float, default=5.0, metavar="SEC",
+                   help="api mode: on SIGTERM/shutdown, stop admitting "
+                        "(readyz → 503) and let active requests finish for "
+                        "up to SEC seconds before failing the remainder "
+                        "explicitly")
     # multi-host SPMD (replaces the reference's --workers TCP list; every
     # process — root and workers — runs the same binary with the same model
     # files, reference runWorkerApp → parallel.multihost):

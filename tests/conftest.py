@@ -32,6 +32,24 @@ if not _TPU_TIER:
     # "axon,cpu") at interpreter start, which overrides the env var — undo it
     # here, before any backend initializes.
     jax.config.update("jax_platforms", "cpu")
+    # Persistent XLA compile cache for the CPU tier: since plan_scoped_jit
+    # (parallel/api.py) scoped trace caches per engine, every engine
+    # legitimately compiles its own programs — identical HLO across the
+    # suite's hundreds of tiny engines now hits this disk cache instead of
+    # recompiling (~30% wall time; keeps the tier-1 run inside its budget).
+    # An explicit JAX_COMPILATION_CACHE_DIR env wins.
+    if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+        import tempfile
+
+        _cache = os.path.join(tempfile.gettempdir(), "dllama-tests-xla-cache")
+        try:
+            os.makedirs(_cache, exist_ok=True)
+            os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache
+            jax.config.update("jax_compilation_cache_dir", _cache)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
+        except OSError:
+            pass  # unwritable tmp: run uncached
 
 
 def pytest_configure(config):

@@ -135,6 +135,77 @@ def test_unknown_route(server):
     assert e.value.code == 404
 
 
+def test_single_mode_request_timeout(server):
+    """The single-sequence path honors the body `timeout` inline: the
+    decode loop stops at the deadline with finish_reason "timeout" (or
+    408 when nothing was produced) instead of running to max_tokens."""
+    from dllama_tpu.runtime import telemetry as tm
+
+    url, _ = server
+    before = tm.registry().counter(tm.REQUEST_TIMEOUTS).total()
+    try:
+        with _post(url, {"messages": [{"role": "user", "content": "hello"}],
+                         "max_tokens": 80, "timeout": 0.015}) as r:
+            out = json.loads(r.read())
+        assert out["choices"][0]["finish_reason"] == "timeout"
+        assert out["usage"]["completion_tokens"] < 80
+    except urllib.error.HTTPError as e:
+        assert e.code == 408  # deadline expired before the first token
+    assert tm.registry().counter(tm.REQUEST_TIMEOUTS).total() >= before + 1
+
+
+def test_healthz_and_readyz(server):
+    url, _ = server
+    for path in ("/health", "/healthz", "/readyz"):
+        with urllib.request.urlopen(url + path, timeout=30) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["status"] == "ok"
+
+
+def test_malformed_bodies_return_400_never_500(server):
+    """Typed-field garbage must die as a 400 JSON error, not a 500
+    (ISSUE 2 satellite; the fault-tolerance contract's input edge)."""
+    url, _ = server
+    ok_msgs = [{"role": "user", "content": "hi"}]
+    bad_bodies = [
+        {"max_tokens": 3},                                   # no messages
+        {"messages": "not a list", "max_tokens": 3},         # non-list
+        {"messages": [], "max_tokens": 3},                   # empty list
+        {"messages": ["loose string"]},                      # non-dict item
+        {"messages": [{"role": 5, "content": "hi"}]},        # non-str role
+        {"messages": [{"role": "user", "content": 7}]},      # non-str content
+        {"messages": ok_msgs, "max_tokens": -4},             # negative
+        {"messages": ok_msgs, "max_tokens": 2.5},            # non-int
+        {"messages": ok_msgs, "max_tokens": True},           # bool-as-int
+        {"messages": ok_msgs, "temperature": "hot"},         # non-numeric
+        {"messages": ok_msgs, "temperature": -1},            # out of range
+        {"messages": ok_msgs, "top_p": 40},                  # out of range
+        {"messages": ok_msgs, "seed": "lucky"},              # non-int
+        {"messages": ok_msgs, "timeout": "soon"},            # non-numeric
+        {"messages": ok_msgs, "timeout": -3},                # non-positive
+        {"messages": ok_msgs, "timeout": 1e9},               # absurd
+        {"messages": ok_msgs, "stop": 42},                   # non str/list
+        {"messages": ok_msgs, "stop": [42]},                 # non-str item
+        {"messages": ok_msgs, "stop": ["x", None]},          # null item
+        [1, 2, 3],                                           # non-object body
+    ]
+    for body in bad_bodies:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, body)
+        assert e.value.code == 400, body
+        assert "error" in json.loads(e.value.read()), body
+    # stream requests get the same 400 (SSE headers are sent lazily, so a
+    # pre-flight failure can still carry a real status code)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, {"messages": ok_msgs, "max_tokens": -1, "stream": True})
+    assert e.value.code == 400
+    # explicit JSON null means "absent" (OpenAI semantics), never a 500
+    with _post(url, {"messages": ok_msgs, "max_tokens": 3,
+                     "temperature": None, "top_p": None, "seed": None,
+                     "timeout": None, "stop": None}) as r:
+        assert json.loads(r.read())["usage"]["completion_tokens"] >= 1
+
+
 # -- continuous batching mode (--batch-slots; runtime/serving.py) ----------
 
 

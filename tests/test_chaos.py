@@ -1,0 +1,374 @@
+"""Chaos tier: fault-injection driven coverage of the serving stack's
+failure semantics (runtime/failpoints.py + the ISSUE-2 fault-tolerance
+layer). Every behavior README's "Failure semantics" promises is DRIVEN
+here, not assumed: scheduler crash → fail-all → supervised restart →
+unready; load shedding (429); deadlines (queued and in-flight); graceful
+drain (/readyz flip + explicit failure of the remainder); SSE client
+disconnect accounting — all asserted through the telemetry registry.
+
+Scheduler-level tests drive ``_tick`` by hand (``_start_thread=False``)
+where determinism matters; thread-level tests use the real loop."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime import failpoints as fp
+from dllama_tpu.runtime import telemetry as tm
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.runtime.serving import (BatchScheduler, QueueFullError,
+                                        SchedulerUnavailableError)
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    """A leaked armed failpoint would crash unrelated schedulers."""
+    fp.registry().clear()
+    yield
+    fp.registry().clear()
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    d = tmp_path_factory.mktemp("chaos")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(17)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    return InferenceEngine(str(mpath), str(tpath), tp=1, temperature=0.0,
+                           seed=3)
+
+
+def _enc(engine, text="hello"):
+    return engine.tokenizer.encode(text, is_start=True)
+
+
+# -- failpoint registry ------------------------------------------------------
+
+
+def test_failpoint_registry_arm_fire_times():
+    reg = fp.registry()
+    fired = tm.registry().counter(tm.FAILPOINTS_FIRED)
+    before = fired.total(name="chaos.x")
+    reg.arm("chaos.x", "raise", times=2)
+    for _ in range(2):
+        with pytest.raises(fp.FailpointError):
+            reg.fire("chaos.x")
+    reg.fire("chaos.x")  # exhausted: no-op
+    assert reg.fired("chaos.x") == 2
+    assert fired.total(name="chaos.x") == before + 2
+    assert not reg.armed("chaos.x")
+
+
+def test_failpoint_actions_and_spec_grammar(monkeypatch):
+    reg = fp.registry()
+    reg.configure("a:broken_pipe,b:conn_reset:1, c:oserror")
+    with pytest.raises(BrokenPipeError):
+        reg.fire("a")
+    with pytest.raises(ConnectionResetError):
+        reg.fire("b")
+    reg.fire("b")  # times=1: disarmed
+    with pytest.raises(OSError):
+        reg.fire("c")
+    with pytest.raises(ValueError, match="unknown failpoint action"):
+        reg.arm("x", "explode")
+    with pytest.raises(ValueError, match="bad failpoint spec"):
+        reg.configure("justaname")
+    reg.clear()
+    monkeypatch.setenv("DLLAMA_FAILPOINTS", "step:raise")
+    assert fp.configure_from_env()
+    assert reg.armed("step")
+    reg.clear()
+    monkeypatch.delenv("DLLAMA_FAILPOINTS")
+    assert not fp.configure_from_env()
+
+
+# -- satellite: close() must not leak waiters --------------------------------
+
+
+def test_close_fails_queued_waiters_instead_of_hanging(engine):
+    sched = BatchScheduler(engine, n_slots=2, _start_thread=False)
+    reqs = [sched.submit(_enc(engine), 8) for _ in range(3)]
+    sched.close()
+    for r in reqs:
+        assert r.done.is_set()  # the old close() left these waiting forever
+        assert r.error is not None and "shutting down" in r.error
+    with pytest.raises(SchedulerUnavailableError):
+        sched.submit(_enc(engine), 4)
+
+
+def test_drain_close_lets_active_work_finish(engine):
+    sched = BatchScheduler(engine, n_slots=2)
+    req = sched.submit(_enc(engine), 4, stop_on_eos=False)
+    sched.close(drain_s=60.0)
+    assert req.done.is_set()
+    assert req.error is None, req.error  # drained, not failed
+    assert len(req.tokens) == 4
+
+
+# -- load shedding -----------------------------------------------------------
+
+
+def test_submit_sheds_beyond_max_queue(engine):
+    shed = tm.registry().counter(tm.REQUESTS_SHED)
+    before = shed.total()
+    sched = BatchScheduler(engine, n_slots=2, max_queue=2,
+                           _start_thread=False)
+    try:
+        sched.submit(_enc(engine), 4)
+        sched.submit(_enc(engine), 4)
+        assert sched.readiness() == (False, "queue full (shedding)")
+        with pytest.raises(QueueFullError, match="queue full"):
+            sched.submit(_enc(engine), 4)
+        assert shed.total() == before + 1
+    finally:
+        sched.close()
+
+
+# -- deadlines ---------------------------------------------------------------
+
+
+def test_queued_request_past_deadline_fails_with_timeout(engine):
+    timeouts = tm.registry().counter(tm.REQUEST_TIMEOUTS)
+    before = timeouts.total()
+    sched = BatchScheduler(engine, n_slots=2, _start_thread=False)
+    try:
+        req = sched.submit(_enc(engine), 8, timeout_s=1e-6)
+        time.sleep(0.002)  # deadline long past
+        sched._tick()
+        assert req.done.is_set()
+        assert req.timed_out and not req.tokens
+        assert timeouts.total() == before + 1
+    finally:
+        sched.close()
+
+
+def test_inflight_deadline_cancels_at_next_step_boundary(engine):
+    timeouts = tm.registry().counter(tm.REQUEST_TIMEOUTS)
+    before = timeouts.total()
+    sched = BatchScheduler(engine, n_slots=2, _start_thread=False)
+    try:
+        req = sched.submit(_enc(engine), 50, stop_on_eos=False,
+                           timeout_s=3600.0)
+        for _ in range(20):
+            sched._tick()
+            if len(req.tokens) >= 2:
+                break
+        assert len(req.tokens) >= 2 and not req.done.is_set()
+        n_before = len(req.tokens)
+        req.deadline_ns = tm.now_ns() - 1  # deadline just expired
+        sched._tick()  # cancel marked + slot retired this boundary
+        assert req.done.is_set()
+        assert req.timed_out
+        assert len(req.tokens) == n_before  # partial output preserved
+        assert timeouts.total() == before + 1
+    finally:
+        sched.close()
+
+
+# -- scheduler supervision ---------------------------------------------------
+
+
+def test_scheduler_crash_fails_all_pending_then_restarts(engine):
+    crashes = tm.registry().counter(tm.SCHEDULER_CRASHES)
+    restarts = tm.registry().counter(tm.SCHEDULER_RESTARTS)
+    c0, r0 = crashes.total(), restarts.total()
+    fp.arm("step", "raise", times=1)
+    sched = BatchScheduler(engine, n_slots=2)
+    try:
+        reqs = [sched.submit(_enc(engine, p), 30, stop_on_eos=False)
+                for p in ("hello", " world")]
+        for r in reqs:
+            assert r.done.wait(timeout=60)  # NOT a hung done.wait()
+            assert r.error is not None and "scheduler crashed" in r.error
+            assert "failpoint" in r.error
+            assert r.server_error  # maps to HTTP 503, not 400
+        assert crashes.total() == c0 + 1
+        assert restarts.total() == r0 + 1
+        # the restarted loop serves fresh work on a fresh pool
+        req = sched.submit(_enc(engine), 4, stop_on_eos=False)
+        assert req.done.wait(timeout=60)
+        assert req.error is None and len(req.tokens) == 4
+        assert sched.readiness()[0]
+    finally:
+        sched.close()
+
+
+def test_scheduler_crash_budget_exhausted_marks_unready(engine):
+    fp.arm("step", "raise")  # every dispatch crashes
+    sched = BatchScheduler(engine, n_slots=2, max_restarts=1)
+    try:
+        r1 = sched.submit(_enc(engine), 8)
+        assert r1.done.wait(timeout=60) and r1.error
+        # crash #1 consumed the whole restart budget's headroom; the next
+        # crash (still armed) exceeds it
+        deadline = time.monotonic() + 60
+        while sched.is_alive() and time.monotonic() < deadline:
+            try:
+                r = sched.submit(_enc(engine), 8)
+            except SchedulerUnavailableError:
+                break
+            assert r.done.wait(timeout=60)
+        fp.registry().clear()
+        ready, reason = sched.readiness()
+        assert not ready and "crash" in reason
+        with pytest.raises(SchedulerUnavailableError):
+            sched.submit(_enc(engine), 4)
+    finally:
+        fp.registry().clear()
+        sched.close()
+
+
+def test_admit_failpoint_rejects_one_request_without_crashing(engine):
+    crashes = tm.registry().counter(tm.SCHEDULER_CRASHES)
+    c0 = crashes.total()
+    fp.arm("admit", "raise", times=1)
+    sched = BatchScheduler(engine, n_slots=2)
+    try:
+        bad = sched.submit(_enc(engine), 4)
+        assert bad.done.wait(timeout=60)
+        assert bad.error is not None and "FailpointError" in bad.error
+        ok = sched.submit(_enc(engine), 4, stop_on_eos=False)
+        assert ok.done.wait(timeout=60)
+        assert ok.error is None and len(ok.tokens) == 4
+        assert crashes.total() == c0  # a rejected admit is not a crash
+    finally:
+        sched.close()
+
+
+# -- HTTP layer: drain/readyz, shed, timeout, client disconnect -------------
+
+
+@pytest.fixture(scope="module")
+def batched_server(tmp_path_factory):
+    from http.server import ThreadingHTTPServer
+
+    from dllama_tpu.serve.api import BatchedApiState, make_handler
+
+    d = tmp_path_factory.mktemp("chaos_api")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(9)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96), rng)
+    td = byte_vocab_tokenizer()
+    td.chat_template = "<|start_header_id|>"  # detected as llama3
+    tfile.write_tfile(tpath, td)
+    eng = InferenceEngine(str(mpath), str(tpath), temperature=0.0, seed=3)
+    state = BatchedApiState(eng, n_slots=2, max_queue=4)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}", state
+    httpd.shutdown()
+    state.close()
+    eng.close()
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url + "/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_healthz_and_readyz_in_normal_operation(batched_server):
+    url, _ = batched_server
+    for path in ("/healthz", "/readyz"):
+        with urllib.request.urlopen(url + path, timeout=30) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["status"] == "ok"
+
+
+def test_readyz_flips_to_503_during_drain(batched_server):
+    url, state = batched_server
+    draining = tm.registry().gauge(tm.SERVER_DRAINING)
+    state.begin_drain()
+    try:
+        assert draining.value() == 1
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url + "/readyz", timeout=30)
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["reason"] == "draining"
+        # liveness stays green: a draining pod must not be restarted
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+            assert r.status == 200
+        # admissions are refused with an explicit 503
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, {"messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 3})
+        assert e.value.code == 503
+    finally:
+        # un-drain: this server fixture is shared with the tests below
+        state.sched._draining = False
+        draining.set(0)
+    with urllib.request.urlopen(url + "/readyz", timeout=30) as r:
+        assert r.status == 200
+
+
+def test_http_shed_returns_429_with_retry_after(batched_server, monkeypatch):
+    url, state = batched_server
+
+    def full(*a, **kw):
+        raise QueueFullError("queue full (3 waiting, --max-queue 3)")
+
+    monkeypatch.setattr(state.sched, "submit", full)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, {"messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 3})
+    assert e.value.code == 429
+    assert e.value.headers["Retry-After"] is not None
+    assert "queue full" in json.loads(e.value.read())["error"]
+
+
+def test_http_request_timeout_bounded_and_counted(batched_server):
+    url, _ = batched_server
+    timeouts = tm.registry().counter(tm.REQUEST_TIMEOUTS)
+    before = timeouts.total()
+    t0 = time.monotonic()
+    try:
+        with _post(url, {"messages": [{"role": "user", "content": "hello"}],
+                         "max_tokens": 80, "timeout": 0.02}) as r:
+            out = json.loads(r.read())
+        # deadline hit mid-generation: partial output, explicit reason
+        assert out["choices"][0]["finish_reason"] == "timeout"
+    except urllib.error.HTTPError as e:
+        assert e.code == 408  # deadline expired before any output
+    # "within timeout + one step": generous CI bound, but decisively below
+    # an 80-token run that would otherwise be free to take forever
+    assert time.monotonic() - t0 < 60
+    assert timeouts.total() >= before + 1
+
+
+def test_sse_client_disconnect_counted_not_500(batched_server):
+    url, state = batched_server
+    http = tm.registry().counter(tm.HTTP_REQUESTS)
+    route = "/v1/chat/completions"
+    dc0 = http.total(route=route, status="client_disconnect")
+    e500 = http.total(route=route, status="500")
+    fp.arm("emit", "broken_pipe", times=1)
+    try:
+        with _post(url, {"messages": [{"role": "user", "content": "hello"}],
+                         "max_tokens": 6, "stream": True}, timeout=60) as r:
+            raw = r.read().decode()
+        assert "[DONE]" not in raw
+    except (urllib.error.URLError, ConnectionError, OSError):
+        pass  # server aborted before/while streaming: expected
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and \
+            http.total(route=route, status="client_disconnect") == dc0:
+        time.sleep(0.05)
+    assert http.total(route=route, status="client_disconnect") == dc0 + 1
+    assert http.total(route=route, status="500") == e500  # NOT a 500
+    # the slot was cancelled and reclaimed: a fresh request still serves
+    with _post(url, {"messages": [{"role": "user", "content": "again"}],
+                     "max_tokens": 3}) as r:
+        assert json.loads(r.read())["usage"]["completion_tokens"] >= 1

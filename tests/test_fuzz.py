@@ -69,6 +69,36 @@ def test_fixture_tokenizer_roundtrip_fuzz():
         assert rt == s, repr(s)
 
 
+def test_chat_body_validation_fuzz_rejects_cleanly():
+    """Randomly-typed /v1/chat/completions bodies through the schema
+    check: the ONLY acceptable failure is ValueError (HTTP 400). Any
+    other exception is the 500-from-a-typed-field bug class the
+    fault-tolerance contract forbids (ISSUE 2 satellite)."""
+    from dllama_tpu.serve.api import _validate_body
+
+    rng = np.random.default_rng(5)
+    junk = [None, True, False, 0, -1, 7, 3.5, -0.1, float("nan"),
+            float("inf"), 1e308, "x", "🦊", b"bytes", [], [1, "a"], {},
+            {"a": 1}, [{"role": 1}], [{"content": []}]]
+    keys = ["messages", "max_tokens", "temperature", "top_p", "seed",
+            "timeout", "stop", "stream", "unknown_extra"]
+    n_ok = n_rejected = 0
+    for _ in range(300):
+        body = {}
+        for k in keys:
+            if rng.random() < 0.4:
+                body[k] = junk[int(rng.integers(0, len(junk)))]
+        if rng.random() < 0.4:  # sometimes a valid messages list rides along
+            body["messages"] = [{"role": "user", "content": "hi"}]
+        try:
+            _validate_body(body)
+            n_ok += 1
+        except ValueError:
+            n_rejected += 1  # 400: the contract
+    assert n_ok + n_rejected == 300
+    assert n_rejected > 0  # the sweep actually exercised rejections
+
+
 def test_native_python_merge_fuzz_on_fixture():
     """Random byte soup (valid UTF-8) through native vs Python mergers."""
     from dllama_tpu import native
