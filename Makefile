@@ -13,7 +13,9 @@
 #   make lint       Telemetry metric-name lint (every registered name is
 #                   convention-clean and documented in PERF.md) + the
 #                   exception-hygiene lint (no bare excepts; broad handlers
-#                   in runtime//serve/ must surface their failures).
+#                   in runtime//serve/ must surface their failures) + the
+#                   route-label lint (every route a handler matches is in
+#                   serve/api.py _ROUTES, keeping the label closed-world).
 #   make bench      The driver's benchmark: ONE JSON line on stdout.
 #   make graft      Compile-check the jittable entry + the 8-device
 #                   multi-chip dry run (tp/pp/dp/sp/ep shardings).
@@ -40,6 +42,7 @@ tsan:
 lint:
 	$(PY) tools/check_metrics_names.py
 	$(PY) tools/check_exception_hygiene.py
+	$(PY) tools/check_route_labels.py
 
 bench:
 	$(PY) bench.py

@@ -135,7 +135,8 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
                       check_rep=check_vma, **kw)
 
 
-def plan_scoped_jit(fun, **jit_kwargs):
+def plan_scoped_jit(fun, *, program: str | None = None,
+                    scope: str | None = None, **jit_kwargs):
     """``jax.jit`` with a function identity unique to THIS call.
 
     Model functions bake the active :class:`MeshPlan` into their traced
@@ -149,14 +150,25 @@ def plan_scoped_jit(fun, **jit_kwargs):
     jit"). Wrapping in a fresh per-call closure makes the cache
     per-engine, which is the true scope of a plan-dependent trace.
     ``functools.wraps`` preserves the signature so ``static_argnums`` /
-    ``donate_argnums`` resolve exactly as on the original."""
+    ``donate_argnums`` resolve exactly as on the original.
+
+    Every callable built here is ALSO the compile ledger's hook point
+    (runtime/introspection): the returned proxy records each trace+compile
+    event — program name (default: the function's ``__name__``), ``scope``
+    (the owning engine's namespace; retrace steadiness is per scope) — at
+    two thread-local writes per call (compiles are detected via
+    jax.monitoring events; the pjit cache size is NOT a compile signal)."""
     import functools
+
+    from ..runtime.introspection import observe
 
     @functools.wraps(fun)
     def _plan_scoped(*args, **kwargs):
         return fun(*args, **kwargs)
 
-    return jax.jit(_plan_scoped, **jit_kwargs)
+    return observe(jax.jit(_plan_scoped, **jit_kwargs),
+                   scope=scope or "default",
+                   program=program or getattr(fun, "__name__", "jit"))
 
 
 def constrain(x: jax.Array, *logical_axes: str | None) -> jax.Array:

@@ -18,6 +18,7 @@ mask (``s <= pos``), and every slot is rewritten by its real token's
 
 from __future__ import annotations
 
+import itertools
 import time
 from contextlib import nullcontext
 from dataclasses import dataclass, field
@@ -47,6 +48,10 @@ from . import telemetry
 from .kvcache import KVCache
 
 DEFAULT_N_BATCHES = 32  # reference default nBatches (app.cpp:28)
+
+# compile-ledger scope names (engine-1, engine-2, ...): per process, so two
+# engines' programs never share a retrace-sentinel namespace
+_ENGINE_SEQ = itertools.count(1)
 
 # TPU-sized prefill chunking: the reference's 32-token default is a
 # Pi-cluster constant — on a TPU a 32-token dispatch leaves the MXU idle, so
@@ -308,6 +313,11 @@ class InferenceEngine:
         self.hbm_estimate = est
         limit = check_budget(est["need_per_device"],
                              f"model {model_path} ({weight_mode})")
+        # compile-ledger scope (runtime.introspection): every jitted program
+        # below registers under this engine's namespace, so the retrace
+        # sentinel's steady-state is per engine — a second engine warming up
+        # can never trip the first one's alarm
+        self.introspection_scope = f"engine-{next(_ENGINE_SEQ)}"
         # telemetry (runtime.telemetry): cached metric handles — the decode
         # hot path records through attribute reads, no registry lookups
         self._tm = telemetry.registry()
@@ -371,42 +381,54 @@ class InferenceEngine:
             # mesh plan (constrain reads it at trace time), so the trace
             # cache must key on this engine, not the shared module-level
             # function — a second engine with a different plan would
-            # otherwise dispatch the first engine's sharding constraints
-            self._step = plan_scoped_jit(replicated_forward, static_argnums=1,
+            # otherwise dispatch the first engine's sharding constraints.
+            # scope= files every program under this engine in the compile
+            # ledger (runtime.introspection).
+            _sc = self.introspection_scope
+            self._step = plan_scoped_jit(replicated_forward, scope=_sc,
+                                         static_argnums=1,
                                          donate_argnums=(4,))
             self._greedy_step = plan_scoped_jit(
-                replicated_greedy, static_argnums=1, donate_argnums=(4,))
+                replicated_greedy, scope=_sc, static_argnums=1,
+                donate_argnums=(4,))
             self._sampled_step = plan_scoped_jit(
-                replicated_sampled, static_argnums=1, donate_argnums=(4,))
+                replicated_sampled, scope=_sc, static_argnums=1,
+                donate_argnums=(4,))
             self._greedy_steps = plan_scoped_jit(replicated_greedy_steps,
+                                                 scope=_sc,
                                                  static_argnums=(1, 5),
                                                  donate_argnums=(4,))
             self._sampled_steps = plan_scoped_jit(replicated_sampled_steps,
+                                                  scope=_sc,
                                                   static_argnums=(1, 8),
                                                   donate_argnums=(4,))
             from ..parallel.multihost import replicated_verify
 
             self._verify_step = plan_scoped_jit(
-                replicated_verify, static_argnums=1, donate_argnums=(4,))
+                replicated_verify, scope=_sc, static_argnums=1,
+                donate_argnums=(4,))
         else:
-            self._step = plan_scoped_jit(forward, static_argnums=1,
+            _sc = self.introspection_scope
+            self._step = plan_scoped_jit(forward, scope=_sc, static_argnums=1,
                                          donate_argnums=(4,))
             # greedy fast path: argmax fused into the step — ONE dispatch per
             # token and a 4-byte host transfer instead of a full logits row;
             # used by next_token() when temperature == 0. The sampled twin
             # fuses temperature/top-p on device the same way (temp/topp/coin
             # are traced scalars, so knob changes never recompile).
-            self._greedy_step = plan_scoped_jit(greedy_step, static_argnums=1,
+            self._greedy_step = plan_scoped_jit(greedy_step, scope=_sc,
+                                                static_argnums=1,
                                                 donate_argnums=(4,))
             self._sampled_step = plan_scoped_jit(
-                sampled_step, static_argnums=1, donate_argnums=(4,))
-            self._greedy_steps = plan_scoped_jit(greedy_steps,
+                sampled_step, scope=_sc, static_argnums=1, donate_argnums=(4,))
+            self._greedy_steps = plan_scoped_jit(greedy_steps, scope=_sc,
                                                  static_argnums=(1, 5),
                                                  donate_argnums=(4,))
-            self._sampled_steps = plan_scoped_jit(sampled_steps,
+            self._sampled_steps = plan_scoped_jit(sampled_steps, scope=_sc,
                                                   static_argnums=(1, 8),
                                                   donate_argnums=(4,))
-            self._verify_step = plan_scoped_jit(verify_step, static_argnums=1,
+            self._verify_step = plan_scoped_jit(verify_step, scope=_sc,
+                                                static_argnums=1,
                                                 donate_argnums=(4,))
 
     def _quant_resolution(self) -> tuple:
@@ -505,7 +527,9 @@ class InferenceEngine:
         last_logits = None
         i = 0
         n = len(token_ids)
-        trace_t0 = telemetry.now_ns() if telemetry.tracer().enabled else 0
+        # unguarded: the span also feeds the always-on /debug/requests ring,
+        # which must show the prefill phase without --trace-out
+        trace_t0 = telemetry.now_ns()
         while i < n:
             size = self._prefill_chunk_size(n - i)
             chunk = token_ids[i:i + size]
@@ -526,9 +550,8 @@ class InferenceEngine:
             i += valid
         self._m_prefill_tok.inc(n)
         self._m_kv.set(self.pos / self.cfg.seq_len)
-        if trace_t0:
-            telemetry.tracer().emit(self.trace_rid, "prefill", trace_t0,
-                                    telemetry.now_ns(), n_tokens=n)
+        telemetry.tracer().emit(self.trace_rid, "prefill", trace_t0,
+                                telemetry.now_ns(), n_tokens=n)
         return last_logits, metrics
 
     def decode_step(self, token: int) -> np.ndarray:
@@ -639,11 +662,12 @@ class InferenceEngine:
 
             self._ctrl.send(self._ctrl.encode(CTRL_SPEC_VERIFY, toks, self.pos))
         t0 = time.perf_counter()
-        trace_t0 = telemetry.now_ns() if telemetry.tracer().enabled else 0
+        # unguarded (feeds the always-on /debug/requests ring too): one
+        # dict + deque append per verify dispatch, µs against a ms dispatch
+        trace_t0 = telemetry.now_ns()
         n_acc, preds = self._run_verify(toks, self.pos)
-        if trace_t0:
-            telemetry.tracer().emit(self.trace_rid, "verify", trace_t0,
-                                    telemetry.now_ns(), n_tokens=n_acc + 1)
+        telemetry.tracer().emit(self.trace_rid, "verify", trace_t0,
+                                telemetry.now_ns(), n_tokens=n_acc + 1)
         self._m_step_ms.record((time.perf_counter() - t0) * 1000.0)
         self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(len(drafts))
         self._tm.counter(telemetry.SPEC_ACCEPTED_TOKENS).inc(n_acc)
@@ -667,6 +691,53 @@ class InferenceEngine:
             self.sampler.rng_state = st
         self._m_decode_tok.inc(n_keep)
         self._m_kv.set(self.pos / self.cfg.seq_len)
+
+    # -- compile/HBM introspection -------------------------------------------
+
+    def aot_compiled(self, kind: str):
+        """AOT-compile one of the engine's programs for introspection
+        (``kind``: ``"decode"`` = the fused greedy step, ``"prefill"`` = the
+        largest prefill bucket that fits the current tail). Returns
+        ``(program label, compiled)`` — the label is the compile ledger's
+        program name, so the gauges this feeds line up with
+        ``/debug/compiles`` entries. Goes through ``.lower().compile()``,
+        which does not share the jit wrapper's executable cache; the
+        persistent compile cache absorbs the duplicate (cost note on
+        :meth:`measure_split`)."""
+        pos = min(self.pos, self.cfg.seq_len - 1)
+        with (use_plan(self.plan) if self.plan is not None else nullcontext()):
+            if kind == "decode":
+                fn = self._greedy_step
+                compiled = fn.lower(
+                    self.params, self.cfg, jnp.zeros((1, 1), jnp.int32),
+                    jnp.int32(pos), self.kv).compile()
+            elif kind == "prefill":
+                fn = self._step
+                chunk = next((b for b in self.prefill_buckets
+                              if b <= self.cfg.seq_len - pos),
+                             self.prefill_buckets[-1])
+                compiled = fn.lower(
+                    self.params, self.cfg, jnp.zeros((1, chunk), jnp.int32),
+                    jnp.int32(pos), self.kv).compile()
+            else:
+                raise ValueError(f"unknown program kind {kind!r} "
+                                 f"(decode | prefill)")
+        return getattr(fn, "program", kind), compiled
+
+    def collect_traffic(self):
+        """Compute (once) and cache the decode program's static collective
+        traffic from its compiled HLO (profiling.collective_traffic) —
+        shared by :meth:`measure_split` and ``POST /debug/profile``."""
+        if self.traffic is None:
+            from .profiling import collective_traffic
+
+            _, compiled = self.aot_compiled("decode")
+            # per-layer collectives sit inside the layer-scan's while body:
+            # once in the HLO text, n_layers executions per step
+            self.traffic = collective_traffic(
+                compiled.as_text(), len(jax.devices()),
+                loop_multiplier=self.cfg.n_layers)
+        return self.traffic
 
     # -- eval/sync split ----------------------------------------------------
 
@@ -696,22 +767,11 @@ class InferenceEngine:
         compile unless the persistent compile cache (on by default in the
         CLI, ``--compile-cache``) absorbs it. Opt-in diagnostics only.
         """
-        from .profiling import (
-            EvalSyncSplit,
-            collective_traffic,
-            measure_eval_sync,
-        )
+        from .profiling import EvalSyncSplit, measure_eval_sync
 
         pos = min(self.pos, self.cfg.seq_len - 1)
         tokens = np.asarray([[0]])
-        with (use_plan(self.plan) if self.plan is not None else nullcontext()):
-            txt = self._greedy_step.lower(
-                self.params, self.cfg, jnp.asarray(tokens, jnp.int32),
-                jnp.int32(pos), self.kv).compile().as_text()
-        # per-layer collectives sit inside the layer-scan's while body: once
-        # in the HLO text, n_layers executions per step
-        self.traffic = collective_traffic(txt, len(jax.devices()),
-                                          loop_multiplier=self.cfg.n_layers)
+        self.collect_traffic()
         if not self.traffic:
             self.split = EvalSyncSplit(eval_ms=0.0, sync_ms=0.0,
                                        n_steps=0, n_lanes=0)

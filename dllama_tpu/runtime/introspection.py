@@ -1,0 +1,462 @@
+"""XLA compile-and-device introspection — the layer PR 2's bugs hid under.
+
+The telemetry registry (runtime/telemetry.py) sees wall time, queues, and
+tokens, but every one of PR 2's worst bugs lived BELOW it, in what XLA
+compiled: cross-engine trace-cache poisoning, duplicate full-model compiles,
+a shard_map path that never traced. Nothing recorded what was compiled, when,
+or why — each was diagnosed by hand. This module is that record:
+
+* **Compile ledger** — every ``plan_scoped_jit`` callable is wrapped in an
+  :class:`ObservedJit` proxy whose per-call cost is two thread-local writes
+  (~100 ns against multi-ms dispatches). Real compiles are detected through
+  ``jax.monitoring`` duration events (``jaxpr_trace_duration`` /
+  ``backend_compile_duration``), which fire only on genuine retraces and
+  XLA compiles — NOT on pjit fastpath-cache entry churn, which a
+  cache-size probe would misreport as compiles. The ledger records program
+  name, engine scope, active mesh plan, per-leaf argument signature, and
+  wall/backend time into ``dllama_compile_total`` /
+  ``dllama_compile_seconds``; with ``ledger().analyze`` set it also
+  AOT-relowers the same arguments to pull ``memory_analysis()`` bytes
+  (``dllama_program_hbm_bytes{program,kind}``) and ``cost_analysis()``
+  FLOPs (``dllama_program_flops``) — a second backend compile of identical
+  HLO, absorbed by the persistent compile cache, so it is on by default
+  only in api serving mode.
+* **Retrace sentinel** — once an engine scope is marked steady (the batch
+  scheduler does this after two compile-quiet ticks; single-sequence mode
+  after one compile-quiet completion), any further compile in that scope is
+  counted in ``dllama_retrace_unexpected_total`` and WARN-logged with the
+  per-leaf shape/plan diff that caused it. Creating a new wrapper in a scope
+  re-opens it (the program set is no longer closed).
+* **HBM startup report** — :func:`hbm_startup_report` AOT-compiles the
+  engine's decode and prefill programs at load, emits a budget table
+  (weights vs KV from runtime/hbm.py vs per-program temp/output bytes from
+  ``memory_analysis()``) and publishes the same gauges.
+
+``GET /debug/compiles`` (serve/api.py) dumps :meth:`CompileLedger.snapshot`.
+Dependency-free at import (jax/parallel imports are call-time) so the
+telemetry lint tooling can import it without a backend.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from . import telemetry
+
+# a broken analysis pass must never break the dispatch it rode in on; cap
+# the WARN spam one misbehaving program can emit
+_MAX_WARNS_PER_PROGRAM = 8
+_MAX_DIFF_LINES = 12
+
+
+def _describe_leaf(x) -> str:
+    """Short shape/dtype tag for one argument leaf: ``f32[1,8]``-style for
+    arrays, ``repr`` (bounded) for static scalars/objects."""
+    aval = getattr(x, "aval", None)
+    if aval is not None and hasattr(aval, "shape"):
+        dt = getattr(aval, "dtype", None)
+        name = getattr(dt, "name", str(dt))
+        return f"{name}[{','.join(str(d) for d in aval.shape)}]"
+    shape = getattr(x, "shape", None)
+    if shape is not None and getattr(x, "dtype", None) is not None:
+        return f"{x.dtype}[{','.join(str(d) for d in shape)}]"
+    r = repr(x)
+    return r if len(r) <= 80 else r[:77] + "..."
+
+
+def _signature(args: tuple, kwargs: dict) -> dict[str, str]:
+    """Flat per-leaf description of a call's arguments — the diffable
+    identity of one compiled specialization (static values included: a
+    changed ``n_steps`` static is a legitimate retrace cause and must show
+    in the diff)."""
+    import jax
+
+    sig: dict[str, str] = {}
+    leaves = jax.tree_util.tree_flatten_with_path((args, kwargs))[0]
+    for path, leaf in leaves:
+        key = jax.tree_util.keystr(path)
+        sig[key] = _describe_leaf(leaf)
+    return sig
+
+
+def _plan_desc() -> str:
+    """The active mesh plan at call (= trace) time, e.g. ``tp=2,sp=2``."""
+    try:
+        from ..parallel.api import current_plan
+
+        plan = current_plan()
+    except Exception:  # noqa: BLE001 — introspection never breaks a dispatch
+        return "unknown"
+    if plan is None:
+        return "none"
+    return ",".join(f"{a}={n}" for a, n in plan.mesh.shape.items()) or "none"
+
+
+def _sig_diff(old: dict[str, str] | None, new: dict[str, str]) -> list[str]:
+    if not old:
+        return ["(first compile in scope — no prior signature)"]
+    lines = []
+    for k, v in new.items():
+        if k not in old:
+            lines.append(f"+ {k} = {v}")
+        elif old[k] != v:
+            lines.append(f"~ {k}: {old[k]} -> {v}")
+    for k in old:
+        if k not in new:
+            lines.append(f"- {k} = {old[k]}")
+    if not lines:
+        lines = ["(identical leaf shapes — an input-sharding, weak-type, or "
+                 "mesh-plan change keyed a new executable; e.g. a program's "
+                 "first dispatch on its own donated output)"]
+    return lines[:_MAX_DIFF_LINES]
+
+
+_HBM_KINDS = (("temp", "temp_size_in_bytes"),
+              ("output", "output_size_in_bytes"),
+              ("argument", "argument_size_in_bytes"),
+              ("alias", "alias_size_in_bytes"),
+              ("code", "generated_code_size_in_bytes"))
+
+
+def analyze_compiled(program: str, compiled, *,
+                     scope: str = "default") -> dict:
+    """Pull ``memory_analysis()`` bytes and ``cost_analysis()`` FLOPs off a
+    compiled stage and publish them as per-(scope, program) gauges — two
+    engines share program NAMES (``forward``, ``sampled_step``) but not
+    shapes or shardings, so a scope-less gauge would let whichever engine
+    compiled last silently overwrite the other's bytes. Best-effort: a
+    backend without either analysis yields a partial dict, never a raise."""
+    out: dict = {}
+    reg = telemetry.registry()
+    try:
+        ma = compiled.memory_analysis()
+        hbm = {kind: int(getattr(ma, attr, 0) or 0)
+               for kind, attr in _HBM_KINDS}
+        out["hbm_bytes"] = hbm
+        out["hbm_total_bytes"] = (hbm["temp"] + hbm["output"]
+                                  + hbm["argument"])
+        g = reg.gauge(telemetry.PROGRAM_HBM_BYTES)
+        for kind, v in hbm.items():
+            g.set(v, scope=scope, program=program, kind=kind)
+    except Exception as e:  # noqa: BLE001 — analysis is advisory, record why
+        out["memory_analysis_error"] = f"{type(e).__name__}: {e}"
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # jax<=0.4.x returns [dict]
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        out["flops"] = flops
+        reg.gauge(telemetry.PROGRAM_FLOPS).set(flops, scope=scope,
+                                               program=program)
+    except Exception as e:  # noqa: BLE001 — analysis is advisory, record why
+        out["cost_analysis_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+class CompileLedger:
+    """Process-wide record of what XLA compiled, keyed (scope, program).
+
+    A *scope* is one engine's program namespace (``engine-N``); steadiness
+    is per scope so a second engine warming up never trips the first
+    engine's retrace sentinel."""
+
+    def __init__(self, max_events: int = 256):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max_events)
+        self._programs: dict[tuple[str, str], dict] = {}
+        self._steady: dict[str, bool] = {}
+        self._compiles_by_scope: dict[str, int] = {}
+        self._seq = 0
+        # per-miss AOT memory/cost analysis (a second compile of identical
+        # HLO): on for api serving, opt-in elsewhere. Env overrides both
+        # ways for operators (DLLAMA_INTROSPECT_ANALYZE=0/1).
+        self.analyze = os.environ.get("DLLAMA_INTROSPECT_ANALYZE") == "1"
+
+    # -- wrap-time ----------------------------------------------------------
+
+    def register(self, scope: str, program: str) -> dict:
+        """Create/fetch the (scope, program) aggregate. Registering re-opens
+        the scope: a new wrapper means the compiled-program set is no longer
+        closed, so steady-state flips off until re-marked."""
+        with self._lock:
+            self._steady[scope] = False
+            entry = self._programs.get((scope, program))
+            if entry is None:
+                entry = {"scope": scope, "program": program, "compiles": 0,
+                         "hits": 0, "warns": 0, "last_sig": None,
+                         "last_plan": None, "last_compile_s": 0.0,
+                         "total_compile_s": 0.0, "analysis": None,
+                         "unexpected": 0}
+                self._programs[(scope, program)] = entry
+            return entry
+
+    # -- steady-state -------------------------------------------------------
+
+    def compile_count(self, scope: str) -> int:
+        with self._lock:
+            return self._compiles_by_scope.get(scope, 0)
+
+    def steady(self, scope: str) -> bool:
+        with self._lock:
+            return self._steady.get(scope, False)
+
+    def mark_steady(self, scope: str) -> None:
+        """Arm the retrace sentinel for ``scope``: from here on, any compile
+        in the scope is unexpected (counted + WARN-logged with its diff)."""
+        with self._lock:
+            self._steady[scope] = True
+
+    # -- miss/hit recording (ObservedJit) ------------------------------------
+
+    def record(self, entry: dict, compile_s: float, signature: dict,
+               plan: str, analysis: dict | None, *,
+               backend_s: float = 0.0) -> None:
+        """File one trace+compile event. ``compile_s`` is the observed call
+        wall time (trace + compile + first execution); ``backend_s`` the XLA
+        backend portion (0 when the persistent compile cache served the
+        executable — the retrace still cost the trace)."""
+        scope, program = entry["scope"], entry["program"]
+        reg = telemetry.registry()
+        with self._lock:
+            unexpected = self._steady.get(scope, False)
+            diff = _sig_diff(entry["last_sig"], signature) if unexpected \
+                else None
+            if unexpected and entry["last_plan"] not in (None, plan):
+                diff = [f"~ mesh plan: {entry['last_plan']} -> {plan}"] + diff
+            entry["compiles"] += 1
+            entry["last_sig"] = signature
+            entry["last_plan"] = plan
+            entry["last_compile_s"] = compile_s
+            entry["total_compile_s"] += compile_s
+            if analysis:
+                entry["analysis"] = analysis
+            if unexpected:
+                entry["unexpected"] += 1
+            self._compiles_by_scope[scope] = \
+                self._compiles_by_scope.get(scope, 0) + 1
+            self._seq += 1
+            self._events.append({
+                "seq": self._seq, "time": time.time(), "scope": scope,
+                "program": program, "compile_s": round(compile_s, 6),
+                "backend_s": round(backend_s, 6),
+                "plan": plan, "n_leaves": len(signature),
+                "unexpected": unexpected, "diff": diff,
+                "analysis": analysis,
+            })
+            warn = unexpected and entry["warns"] < _MAX_WARNS_PER_PROGRAM
+            if warn:
+                entry["warns"] += 1
+        reg.counter(telemetry.COMPILE_TOTAL).inc(scope=scope,
+                                                 program=program)
+        reg.histogram(telemetry.COMPILE_SECONDS).record(compile_s)
+        if unexpected:
+            reg.counter(telemetry.RETRACE_UNEXPECTED).inc(program=program)
+        if warn:
+            lines = "\n".join(f"      {d}" for d in (diff or []))
+            print(f"⚠️ unexpected recompile after steady state: "
+                  f"{scope}/{program} took {compile_s * 1e3:.0f} ms "
+                  f"(plan {plan})\n{lines}", flush=True)
+
+    # -- export --------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able ledger dump (``GET /debug/compiles``)."""
+        with self._lock:
+            programs = []
+            for entry in self._programs.values():
+                e = {k: v for k, v in entry.items() if k != "last_sig"}
+                e["hbm_total_bytes"] = (entry["analysis"] or {}).get(
+                    "hbm_total_bytes", 0)
+                programs.append(e)
+            return {
+                "steady": dict(self._steady),
+                "analyze": self.analyze,
+                "programs": sorted(
+                    programs, key=lambda e: (e["scope"], e["program"])),
+                "events": list(self._events),
+            }
+
+    def reset(self) -> None:
+        """Forget everything (tests). Registry metrics are NOT zeroed —
+        use ``telemetry.registry().reset()`` for that."""
+        with self._lock:
+            self._events.clear()
+            self._programs.clear()
+            self._steady.clear()
+            self._compiles_by_scope.clear()
+
+
+_ledger = CompileLedger()
+
+
+def ledger() -> CompileLedger:
+    """The process-wide compile ledger."""
+    return _ledger
+
+
+# -- compile detection via jax.monitoring --------------------------------
+#
+# The pjit wrapper's C++ cache size is NOT a compile signal: its fastpath
+# cache keys more finely than the executable cache (input sharding objects,
+# committed-ness), so entries appear without any retrace — e.g. the first
+# dispatch after engine.reset(). jax.monitoring's duration events fire only
+# for the real thing: ``jaxpr_trace_duration`` on a genuine retrace,
+# ``backend_compile_duration`` on an XLA compile (absent when the
+# persistent compile cache serves the executable — the trace event still
+# fires, and a steady-state retrace is a latency cliff either way).
+# Attribution is a thread-local window: the listener runs on the thread
+# doing the compile, which is the thread inside ObservedJit.__call__.
+
+_tls = threading.local()
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
+_monitoring_state: list = []  # [] = untried, [True] = on, [False] = absent
+
+
+def _event_listener(name: str, duration_s: float, **_kw) -> None:
+    win = getattr(_tls, "window", None)
+    if win is None:
+        return
+    if name == _BACKEND_EVENT:
+        win["backend_s"] += duration_s
+        win["n_backend"] += 1
+    elif name == _TRACE_EVENT:
+        win["n_trace"] += 1
+
+
+def _monitoring_on() -> bool:
+    if not _monitoring_state:
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(_event_listener)
+            _monitoring_state.append(True)
+        except Exception:  # noqa: BLE001 — degrade to pass-through, no ledger
+            _monitoring_state.append(False)
+    return _monitoring_state[0]
+
+
+class ObservedJit:
+    """Identity-preserving proxy over a ``jax.jit`` callable that feeds the
+    compile ledger. Hit path: two thread-local writes. Compile path (a
+    retrace/compile just happened — already 100 ms+): build the leaf
+    signature, optionally AOT-relower for memory/cost analysis, record.
+    AOT attributes (``lower``, ``eval_shape``, ...) delegate."""
+
+    def __init__(self, jitted, scope: str, program: str):
+        self._jitted = jitted
+        self.scope = scope
+        self.program = program
+        self._observed = _monitoring_on()
+        self._entry = _ledger.register(scope, program)
+
+    def __call__(self, *args, **kwargs):
+        if not self._observed:
+            return self._jitted(*args, **kwargs)
+        prev = getattr(_tls, "window", None)
+        win = {"backend_s": 0.0, "n_backend": 0, "n_trace": 0}
+        _tls.window = win
+        t0 = time.perf_counter()
+        try:
+            out = self._jitted(*args, **kwargs)
+        finally:
+            _tls.window = prev  # restore BEFORE any analysis compiles below
+        if not (win["n_trace"] or win["n_backend"]):
+            self._entry["hits"] += 1  # GIL-atomic enough for a debug count
+            return out
+        compile_s = time.perf_counter() - t0
+        analysis = None
+        try:
+            sig = _signature(args, kwargs)
+            if _ledger.analyze:
+                # donated inputs stay abstractly valid (avals survive
+                # deletion), so re-lowering with the same args is safe; the
+                # second backend compile of identical HLO is absorbed by
+                # the persistent compile cache when it is enabled
+                analysis = analyze_compiled(
+                    self.program,
+                    self._jitted.lower(*args, **kwargs).compile(),
+                    scope=self.scope)
+        except Exception as e:  # noqa: BLE001 — never break the dispatch
+            analysis = {"error": f"{type(e).__name__}: {e}"}
+            sig = {}
+        _ledger.record(self._entry, compile_s, sig, _plan_desc(), analysis,
+                       backend_s=win["backend_s"])
+        return out
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+
+def observe(jitted, *, scope: str, program: str) -> ObservedJit:
+    """Wrap a jitted callable for the compile ledger (plan_scoped_jit's
+    hook point)."""
+    return ObservedJit(jitted, scope, program)
+
+
+# -- HBM startup report --------------------------------------------------------
+
+
+def _gb(n: float) -> str:
+    return f"{n / 1024 ** 3:.2f} GB" if n >= 1024 ** 2 else f"{n / 1024:.0f} kB"
+
+
+def hbm_startup_report(engine, emit=print) -> dict:
+    """Per-device HBM budget table at engine load: the shape-algebra
+    estimate (runtime/hbm.py — weights + KV + margin) cross-checked against
+    what XLA actually allocated per program (``memory_analysis()`` of the
+    AOT-compiled decode and prefill programs). Emits one table to the log,
+    publishes ``dllama_program_hbm_bytes`` / ``dllama_program_flops``
+    gauges, and returns the raw dict. Cost: one AOT compile per program,
+    shared with the first dispatch via the persistent compile cache."""
+    from .hbm import device_memory_bytes
+
+    est = dict(engine.hbm_estimate)
+    limit = device_memory_bytes()
+    report: dict = {
+        "weights_bytes": est["weights_bytes"],
+        "kv_bytes": est["kv_bytes"],
+        "need_per_device": est["need_per_device"],
+        "limit_bytes": limit,
+        "n_shards": engine.tp * engine.pp,
+        "programs": {},
+    }
+    emit(f"🧮 HBM budget/device: weights {_gb(est['weights_bytes'])} + "
+         f"KV {_gb(est['kv_bytes'])} over {report['n_shards']} shard(s) "
+         f"+ margin → need {_gb(est['need_per_device'])}"
+         + (f" of {_gb(limit)}" if limit else " (device limit unknown)"))
+    max_temp = 0
+    scope = getattr(engine, "introspection_scope", "default")
+    for name in ("decode", "prefill"):
+        try:
+            info = analyze_compiled(*engine.aot_compiled(name), scope=scope)
+        except Exception as e:  # noqa: BLE001 — report is advisory, say why
+            emit(f"🧮   program {name}: analysis unavailable "
+                 f"({type(e).__name__}: {e})")
+            report["programs"][name] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        report["programs"][name] = info
+        hbm = info.get("hbm_bytes") or {}
+        max_temp = max(max_temp, hbm.get("temp", 0))
+        flops = info.get("flops")
+        emit(f"🧮   program {name}: temp {_gb(hbm.get('temp', 0))}, "
+             f"output {_gb(hbm.get('output', 0))}, "
+             f"args {_gb(hbm.get('argument', 0))}"
+             + (f", {flops:.3g} flops/dispatch" if flops else ""))
+    actual = est["weights_bytes"] + est["kv_bytes"]
+    actual = actual // max(1, report["n_shards"]) + max_temp
+    report["actual_floor_bytes"] = actual
+    if limit and actual > limit:
+        emit(f"⚠️ 🧮 measured floor {_gb(actual)} exceeds the device limit "
+             f"{_gb(limit)} — the shape-algebra margin was optimistic")
+    elif actual > est["need_per_device"]:
+        emit(f"⚠️ 🧮 measured floor {_gb(actual)} exceeds the hbm.py "
+             f"estimate {_gb(est['need_per_device'])} — estimate drift, "
+             f"check runtime/hbm.py against this model")
+    return report

@@ -22,12 +22,42 @@ Both are cheap after the first call and neither touches the decode hot path.
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import os
 import re
 import sys
 import tempfile
+import threading
+import time
 from dataclasses import dataclass
+
+
+class CaptureBusyError(RuntimeError):
+    """A profiler capture is already running (the profiler supports one
+    session per process; ``POST /debug/profile`` maps this to HTTP 409)."""
+
+
+# THE jax.profiler.trace entry point: the CLI's --profile, the HTTP
+# POST /debug/profile window, and measure_eval_sync all come through here,
+# so session-at-a-time serialization lives in exactly one place.
+_capture_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def capture(trace_dir: str):
+    """Run one profiler session writing xplane traces under ``trace_dir``.
+    Raises :class:`CaptureBusyError` instead of the profiler's internal
+    error when a session is already active."""
+    import jax
+
+    if not _capture_lock.acquire(timeout=0.5):
+        raise CaptureBusyError("a profiler capture is already in progress")
+    try:
+        with jax.profiler.trace(trace_dir):
+            yield
+    finally:
+        _capture_lock.release()
 
 # -- xplane trace parsing ----------------------------------------------------
 
@@ -72,18 +102,41 @@ def _union_ms(intervals: list[tuple[int, int]]) -> float:
     return union_span(intervals) / 1e9
 
 
+# CPU-backend executor lane families, in preference order. The naming has
+# changed across jaxlib's CPU-runtime rewrites: tf_XLAPjRt* client threads
+# (older), then the thunk runtime's tf_XLAEigen* per-device intra-op pools
+# (which carry the thunk-level op events, collectives included) with
+# tf_XLATfrtCpuClient* dispatch threads around them.
+_CPU_LANE_FAMILIES = ("tf_XLAPjRt", "tf_XLAEigen", "tf_XLATfrtCpuClient")
+
+
 def _device_lines(xspace):
-    """Yield (plane, line) pairs for lanes that carry per-op device events:
+    """(plane, line) pairs for lanes that carry per-op device events:
     TPU/GPU ``/device:*`` planes ("XLA Ops" lines), or the CPU backend's
-    per-virtual-device ``tf_XLAPjRt*`` executor lanes."""
+    executor lanes. Exactly ONE lane family is used — the first in
+    preference order with any events — because mixing families would
+    inflate the lane count (client dispatch threads are not devices) and
+    skew the per-lane average the Eval/Sync split divides by."""
+    device: list = []
+    families: dict[str, list] = {f: [] for f in _CPU_LANE_FAMILIES}
     for plane in xspace.planes:
         is_dev = "/device:" in plane.name
         for line in plane.lines:
             if is_dev and plane.lines and (
                     "XLA Ops" in line.name or len(plane.lines) == 1):
-                yield plane, line
-            elif line.name.startswith("tf_XLAPjRt"):
-                yield plane, line
+                device.append((plane, line))
+                continue
+            for fam in _CPU_LANE_FAMILIES:
+                if line.name.startswith(fam):
+                    families[fam].append((plane, line))
+                    break
+    if device:
+        return device
+    for fam in _CPU_LANE_FAMILIES:
+        lanes = families[fam]
+        if any(len(line.events) for _, line in lanes):
+            return lanes
+    return []
 
 
 _xplane_pb2 = None
@@ -115,7 +168,11 @@ def _load_xplane(path: str):
 
     xs = _xplane_pb2.XSpace()
     with open(path, "rb") as f:
-        xs.ParseFromString(f.read())
+        raw = f.read()
+    try:
+        xs.ParseFromString(raw)
+    except Exception as e:  # proto DecodeError: surface a uniform error
+        raise RuntimeError(f"malformed xplane trace {path}: {e}") from e
     return xs
 
 
@@ -177,15 +234,62 @@ def measure_eval_sync(step, n_steps: int = 3) -> EvalSyncSplit:
     misses most thunk-level device events (observed on the CPU backend:
     an almost-empty first capture, a rich second one) — so a throwaway
     warm-up session runs first."""
-    import jax
-
     with tempfile.TemporaryDirectory(prefix="dllama-prof-") as d:
-        with jax.profiler.trace(os.path.join(d, "warmup")):
+        with capture(os.path.join(d, "warmup")):
             step()
-        with jax.profiler.trace(os.path.join(d, "capture")):
+        with capture(os.path.join(d, "capture")):
             for _ in range(n_steps):
                 step()
         return split_from_trace(os.path.join(d, "capture"), n_steps)
+
+
+def live_split_summary(engine, duration_s: float) -> dict:
+    """``POST /debug/profile``: hold a profiler window open over whatever
+    decode steps the serving loop dispatches in the next ``duration_s``
+    seconds, then classify the captured device time into the Eval/Sync
+    split and attach the engine's static collective-traffic accounting.
+    Zero live traffic gives a zero split (still parseable), never an error.
+
+    Unlike :func:`measure_eval_sync` this cannot run a warm-up session
+    first (the steps are live, not scratch), so the process's very first
+    capture may be event-poor — drive traffic and call it twice when the
+    first summary comes back empty."""
+    from . import telemetry
+
+    reg = telemetry.registry()
+
+    def _steps() -> int:
+        return (reg.histogram(telemetry.BATCH_STEP_MS).count()
+                + reg.histogram(telemetry.DECODE_STEP_MS).count())
+
+    n0 = _steps()
+    with tempfile.TemporaryDirectory(prefix="dllama-live-prof-") as d:
+        with capture(d):
+            time.sleep(duration_s)
+        n = _steps() - n0
+        try:
+            split = split_from_trace(d, max(1, n))
+        except RuntimeError:
+            # no xplane written (idle window on some backends): empty split
+            split = EvalSyncSplit(eval_ms=0.0, sync_ms=0.0, n_steps=0,
+                                  n_lanes=0)
+    out = {
+        "duration_ms": duration_s * 1000.0,
+        "n_steps": n,
+        "eval_ms": split.eval_ms,
+        "sync_ms": split.sync_ms,
+        "sync_frac": split.sync_frac,
+        "n_lanes": split.n_lanes,
+        "collective_traffic": None,
+    }
+    try:
+        tr = engine.collect_traffic()
+        out["collective_traffic"] = {
+            "sent_kb_per_token": tr.sent_kb, "recv_kb_per_token": tr.recv_kb,
+            "n_collectives": tr.n_collectives, "by_kind": tr.by_kind}
+    except Exception as e:  # noqa: BLE001 — traffic is additive; say why
+        out["collective_traffic_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 # -- static collective-traffic accounting ------------------------------------

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import failpoints, telemetry
+from . import failpoints, introspection, telemetry
 
 from ..models.llama import forward, sampled_step
 from ..parallel.api import plan_scoped_jit, use_plan
@@ -253,7 +253,8 @@ class BatchedGenerator:
         # engine), its callable is reused instead of re-wrapped: a fresh
         # wrapper here would recompile the full-model program the engine
         # already owns (minutes on real models).
-        self._step = (plan_scoped_jit(_replicated_ragged_step,
+        _sc = getattr(engine, "introspection_scope", None) or "default"
+        self._step = (plan_scoped_jit(_replicated_ragged_step, scope=_sc,
                                       static_argnums=1, donate_argnums=(4,))
                       if engine.multihost else engine._sampled_step)
         # chunked ragged decode (engine --decode-chunk composed with
@@ -262,7 +263,7 @@ class BatchedGenerator:
         # under multihost) when every active slot has K rows of headroom.
         # sampled_steps broadcasts over rows (vector temps/topps, [K, B]
         # coins), so the engine's chunk program IS the ragged chunk program.
-        self._steps = (plan_scoped_jit(_replicated_ragged_steps,
+        self._steps = (plan_scoped_jit(_replicated_ragged_steps, scope=_sc,
                                        static_argnums=(1, 8),
                                        donate_argnums=(4,))
                        if engine.multihost else engine._sampled_steps)
@@ -278,12 +279,13 @@ class BatchedGenerator:
             self._verify = plan_scoped_jit(
                 _replicated_ragged_verify if engine.multihost
                 else ragged_verify_step,
-                static_argnums=1, donate_argnums=(4,))
+                scope=_sc, static_argnums=1, donate_argnums=(4,))
         # non-multihost engine._step IS jit(forward) with these options;
         # multihost needs plain forward (the engine's replicated_forward
         # constrains logits this path discards, but matching the seed's
         # prefill program exactly keeps worker mirrors bit-identical)
-        self._prefill_fwd = (plan_scoped_jit(forward, static_argnums=1,
+        self._prefill_fwd = (plan_scoped_jit(forward, scope=_sc,
+                                             static_argnums=1,
                                              donate_argnums=(4,))
                              if engine.multihost else engine._step)
         # telemetry: cached handles (no registry lookups per step)
@@ -735,6 +737,12 @@ class BatchScheduler:
         self._draining = False
         self._healthy = True
         self._crashes = 0
+        # retrace sentinel (runtime.introspection): after STEADY_TICKS
+        # consecutive work-carrying loop ticks with zero compiles in this
+        # engine's scope, serving is declared steady — any later compile is
+        # an unexpected retrace (WARNed + dllama_retrace_unexpected_total)
+        self._introspect_scope = getattr(engine, "introspection_scope", None)
+        self._quiet_ticks = 0
         self._thread: threading.Thread | None = None
         if _start_thread:
             self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -930,7 +938,24 @@ class BatchScheduler:
             except Exception as exc:  # noqa: BLE001 — supervised: fail-all + bounded restart
                 self._on_crash(exc)
 
+    STEADY_TICKS = 2  # compile-quiet work ticks before steady is declared
+
+    def _mark_steady_if_quiet(self, compiles_before: int) -> None:
+        scope = self._introspect_scope
+        led = introspection.ledger()
+        if scope is None or led.steady(scope):
+            return
+        if led.compile_count(scope) == compiles_before:
+            self._quiet_ticks += 1
+            if self._quiet_ticks >= self.STEADY_TICKS:
+                led.mark_steady(scope)
+        else:
+            self._quiet_ticks = 0
+
     def _tick(self) -> None:
+        compiles_before = (
+            introspection.ledger().compile_count(self._introspect_scope)
+            if self._introspect_scope else 0)
         self._check_deadlines()
         reserved = {a.slot for a in self._admissions}
         with self._lock:
@@ -984,3 +1009,6 @@ class BatchScheduler:
             self.gen.step_chunk(chunk)
         else:
             self.gen.step()
+        # only work-carrying ticks advance the steady countdown: an idle
+        # server must not declare itself steady before ever compiling
+        self._mark_steady_if_quiet(compiles_before)

@@ -30,6 +30,7 @@ import json
 import threading
 import time
 from bisect import bisect_left
+from collections import deque
 from dataclasses import dataclass
 
 # -- metric name constants ----------------------------------------------------
@@ -80,10 +81,21 @@ TTFT_MS = "dllama_ttft_ms"
 ITL_MS = "dllama_itl_ms"
 PROMPT_TOKENS = "dllama_prompt_tokens_total"
 COMPLETION_TOKENS = "dllama_completion_tokens_total"
+# XLA compile introspection (runtime/introspection.py)
+COMPILE_TOTAL = "dllama_compile_total"
+COMPILE_SECONDS = "dllama_compile_seconds"
+PROGRAM_HBM_BYTES = "dllama_program_hbm_bytes"
+PROGRAM_FLOPS = "dllama_program_flops"
+RETRACE_UNEXPECTED = "dllama_retrace_unexpected_total"
 
 # latency buckets in ms: sub-ms CPU ticks through multi-second TPU compiles
 _LATENCY_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                        500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+# compile wall-time buckets in SECONDS: ms-scale CPU-mesh traces through
+# multi-minute cold TPU compiles of the full-model program
+_COMPILE_BUCKETS_S = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                      60.0, 120.0, 300.0)
 
 
 @dataclass(frozen=True)
@@ -162,6 +174,22 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "admissions), else 0"),
     _spec(FAILPOINTS_FIRED, "counter",
           "Fault-injection failpoint fires by name (runtime/failpoints)"),
+    _spec(COMPILE_TOTAL, "counter",
+          "XLA trace+compile events by program and engine scope "
+          "(runtime/introspection ledger)"),
+    _spec(COMPILE_SECONDS, "histogram",
+          "Wall time of one trace+compile event, seconds (includes the "
+          "triggering dispatch's first execution)",
+          buckets=_COMPILE_BUCKETS_S),
+    _spec(PROGRAM_HBM_BYTES, "gauge",
+          "Per-program device bytes by kind (temp/output/argument/code/"
+          "alias) from compiled.memory_analysis()"),
+    _spec(PROGRAM_FLOPS, "gauge",
+          "Per-program FLOPs per dispatch from compiled.cost_analysis()"),
+    _spec(RETRACE_UNEXPECTED, "counter",
+          "Recompiles observed AFTER an engine scope reached serving "
+          "steady state (each is a latency cliff; the shape/plan diff is "
+          "WARN-logged and kept in the /debug/compiles ledger)"),
     _spec(HTTP_REQUESTS, "counter",
           "HTTP requests by route and status code"),
     _spec(REQUESTS_IN_FLIGHT, "gauge", "Completions currently executing"),
@@ -377,19 +405,27 @@ PHASES = ("queue", "prefill", "decode", "verify")
 
 
 class SpanTracer:
-    """JSONL span sink. One line per completed span:
+    """JSONL span sink + bounded in-memory span ring. One record per
+    completed span:
 
     ``{"request_id": int, "phase": "queue|prefill|decode|verify",
        "start_ns": int, "end_ns": int, "slot": int, "n_tokens": int}``
 
     Timestamps are ``time.monotonic_ns`` (durations, not wall clock).
-    Disabled (no file) costs one attribute read per check site.
+    The file sink is opt-in (``--trace-out``; ``enabled`` is one attribute
+    read for per-dispatch call sites). The ring is ALWAYS on — request-level
+    spans arrive a few times per request, so keeping the last ``RING_SPANS``
+    of them costs one dict + deque append each and gives ``GET
+    /debug/requests`` a phase timeline without any operator setup.
     """
+
+    RING_SPANS = 512
 
     def __init__(self):
         self._lock = threading.Lock()
         self._f = None
         self.enabled = False
+        self._ring: deque = deque(maxlen=self.RING_SPANS)
 
     def configure(self, path: str | None) -> None:
         with self._lock:
@@ -402,15 +438,45 @@ class SpanTracer:
 
     def emit(self, request_id: int, phase: str, start_ns: int, end_ns: int,
              *, slot: int = -1, n_tokens: int = 0) -> None:
-        if not self.enabled:
-            return
-        line = json.dumps({"request_id": request_id, "phase": phase,
-                           "start_ns": start_ns, "end_ns": end_ns,
-                           "slot": slot, "n_tokens": n_tokens})
+        rec = {"request_id": request_id, "phase": phase,
+               "start_ns": start_ns, "end_ns": end_ns,
+               "slot": slot, "n_tokens": n_tokens}
         with self._lock:
+            self._ring.append(rec)
             if self._f is not None:
-                self._f.write(line + "\n")
+                self._f.write(json.dumps(rec) + "\n")
                 self._f.flush()
+
+    def recent_requests(self, limit: int = 64) -> list[dict]:
+        """Most-recent per-request phase timelines from the span ring
+        (``GET /debug/requests``), newest first. Request ids are per
+        engine/scheduler counters, so two engines in one process can
+        collide on an id — a best-effort debug view, not an audit log."""
+        with self._lock:
+            spans = list(self._ring)
+        by_rid: dict[int, list[dict]] = {}
+        order: list[int] = []
+        for s in spans:
+            rid = s["request_id"]
+            if rid not in by_rid:
+                by_rid[rid] = []
+                order.append(rid)
+            by_rid[rid].append(s)
+        out = []
+        for rid in reversed(order[-limit:]):
+            ss = by_rid[rid]
+            t0 = min(s["start_ns"] for s in ss)
+            t1 = max(s["end_ns"] for s in ss)
+            out.append({
+                "request_id": rid,
+                "total_ms": (t1 - t0) / 1e6,
+                "phases": [{"phase": s["phase"],
+                            "start_ms": (s["start_ns"] - t0) / 1e6,
+                            "ms": (s["end_ns"] - s["start_ns"]) / 1e6,
+                            "slot": s["slot"],
+                            "n_tokens": s["n_tokens"]} for s in ss],
+            })
+        return out
 
 
 _tracer = SpanTracer()
@@ -477,4 +543,13 @@ def stats_line(reg: Registry | None = None, *,
     if sync or sent:
         parts.append(f"sync={100 * sync:.1f}%")
         parts.append(f"sent={sent:.1f}kB/tok")
+    # compile-layer health (runtime/introspection): total compiles, and the
+    # retrace sentinel's count when it ever fired (a steady-state server
+    # should show a stable compile count and no retrace= at all)
+    n_compiles = reg.counter(COMPILE_TOTAL).total()
+    if n_compiles:
+        parts.append(f"compiles={int(n_compiles)}")
+    n_retrace = reg.counter(RETRACE_UNEXPECTED).total()
+    if n_retrace:
+        parts.append(f"retrace={int(n_retrace)}!")
     return "📈 " + " ".join(parts)

@@ -33,7 +33,7 @@ import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
-from ..runtime import failpoints, telemetry
+from ..runtime import failpoints, introspection, profiling, telemetry
 from ..runtime.engine import InferenceEngine
 from ..runtime.serving import (QueueFullError, RequestTimeoutError,
                                SchedulerUnavailableError)
@@ -41,9 +41,17 @@ from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
                               ChatTemplateType, EosDetector, EosResult)
 
 # known routes for the HTTP request counter's route label — anything else is
-# folded into "other" so a scanner can't explode the label cardinality
+# folded into "other" so a scanner can't explode the label cardinality.
+# Closed-world: every route literal a handler matches on must be listed here
+# (tools/check_route_labels.py enforces it in `make lint`).
 _ROUTES = ("/v1/chat/completions", "/v1/models", "/metrics",
-           "/health", "/healthz", "/readyz")
+           "/health", "/healthz", "/readyz",
+           "/debug/compiles", "/debug/requests", "/debug/profile")
+
+# POST /debug/profile capture-window bounds (ms): long enough to catch a few
+# decode steps, short enough that a handler thread never parks for minutes
+_PROFILE_MS_DEFAULT = 500
+_PROFILE_MS_MAX = 10_000
 
 # absurd-deadline guard: a request may not park a slot (or a queue entry)
 # for more than an hour — longer values are a client bug, rejected 400
@@ -228,6 +236,12 @@ class ApiState:
         engine = self.engine
         tok = engine.tokenizer
         _validate_body(body)
+        # retrace sentinel (runtime.introspection): a completion that ran
+        # end-to-end without a single compile is the single-sequence
+        # definition of steady state — from then on, recompiles are WARNed
+        led = introspection.ledger()
+        scope = getattr(engine, "introspection_scope", None)
+        compiles_before = led.compile_count(scope) if scope else 0
         messages = body["messages"]
         timeout_s = float(body.get("timeout") or self.request_timeout or 0)
         deadline = (telemetry.now_ns() + int(timeout_s * 1e9)
@@ -329,6 +343,8 @@ class ApiState:
             self.cache.push(
                 [{"role": "assistant", "content": "".join(gate.parts)}],
                 engine.pos)
+        if scope and led.compile_count(scope) == compiles_before:
+            led.mark_steady(scope)
         return {
             "text": "".join(gate.parts),
             "finish_reason": finish_reason,
@@ -499,10 +515,17 @@ def make_handler(state: ApiState):
 
         _counted = False  # whether THIS request hit the telemetry counter
 
+        def _route(self) -> str:
+            # route matching and the counter label both ignore the query
+            # string (`/debug/profile?ms=200` is the /debug/profile route,
+            # not an "other")
+            return self.path.split("?", 1)[0]
+
         def _count(self, status: int | str) -> None:
             # status is an HTTP code or a symbolic outcome like
             # "client_disconnect" (an aborted SSE peer is not a 500)
-            route = self.path if self.path in _ROUTES else "other"
+            path = self._route()
+            route = path if path in _ROUTES else "other"
             telemetry.registry().counter(telemetry.HTTP_REQUESTS).inc(
                 route=route, status=str(status))
             self._counted = True
@@ -526,12 +549,13 @@ def make_handler(state: ApiState):
                              "routes": list(_ROUTES)})
 
         def do_GET(self):
-            if self.path == "/v1/models":
+            path = self._route()
+            if path == "/v1/models":
                 self._json(200, {"object": "list", "data": [{
                     "id": state.model_name, "object": "model",
                     "created": int(time.time()), "owned_by": "dllama_tpu",
                 }]})
-            elif self.path == "/metrics":
+            elif path == "/metrics":
                 self._count(200)
                 body = telemetry.registry().render().encode("utf-8")
                 self.send_response(200)
@@ -540,39 +564,81 @@ def make_handler(state: ApiState):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-            elif self.path in ("/health", "/healthz"):
+            elif path in ("/health", "/healthz"):
                 # liveness: the process is up and serving HTTP — always 200
                 # (readiness is /readyz; the split matters during drain and
                 # after a crash-exhausted scheduler, when the process should
                 # NOT be restarted but should stop receiving traffic)
                 self._json(200, {"status": "ok"})
-            elif self.path == "/readyz":
+            elif path == "/readyz":
                 ready, reason = state.readiness()
                 self._json(200 if ready else 503,
                            {"status": "ok" if ready else "unready",
                             "reason": reason})
+            elif path == "/debug/compiles":
+                # the compile ledger: every trace+compile event with program,
+                # scope, plan, wall time, HBM/FLOPs analysis, and the retrace
+                # sentinel's per-scope steady flags
+                self._json(200, introspection.ledger().snapshot())
+            elif path == "/debug/requests":
+                # bounded in-memory ring of recent per-request phase
+                # timelines (SpanTracer; no --trace-out needed)
+                self._json(200,
+                           {"requests": telemetry.tracer().recent_requests()})
             else:
                 self._not_found()
 
-        def do_POST(self):
-            if self.path not in ("/v1/chat/completions",):
-                # drain a SMALL body before responding (closing with unread
-                # request bytes can RST the connection under the client's
-                # feet before it reads the 404) — but never trust the
-                # client's Content-Length for an unbounded read on a path
-                # that's being rejected anyway: oversized declarations skip
-                # the drain and drop keep-alive instead
+        def _drain_small_body(self) -> None:
+            # drain a SMALL body before responding (closing with unread
+            # request bytes can RST the connection under the client's
+            # feet before it reads the response) — but never trust the
+            # client's Content-Length for an unbounded read on a path
+            # that doesn't consume the body anyway: oversized declarations
+            # skip the drain and drop keep-alive instead
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                length = 0
+            if 0 < length <= (1 << 20):
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                except ValueError:
-                    length = 0
-                if 0 < length <= (1 << 20):
-                    try:
-                        self.rfile.read(length)
-                    except OSError:
-                        pass
-                elif length:
-                    self.close_connection = True
+                    self.rfile.read(length)
+                except OSError:
+                    pass
+            elif length:
+                self.close_connection = True
+
+        def _debug_profile(self) -> None:
+            # POST /debug/profile?ms=N — hold a live jax.profiler window
+            # over the serving loop's decode steps and return the
+            # Eval/Sync split + static collective traffic as JSON
+            from urllib.parse import parse_qs, urlsplit
+
+            self._drain_small_body()
+            try:
+                qs = parse_qs(urlsplit(self.path).query)
+                ms = int(qs.get("ms", [_PROFILE_MS_DEFAULT])[0])
+            except ValueError:
+                self._json(400, {"error": "ms must be an integer"})
+                return
+            if not (10 <= ms <= _PROFILE_MS_MAX):
+                self._json(400, {"error": f"ms must be in "
+                                          f"[10, {_PROFILE_MS_MAX}]"})
+                return
+            try:
+                self._json(200, profiling.live_split_summary(
+                    state.engine, ms / 1000.0))
+            except profiling.CaptureBusyError as e:
+                self._json(409, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — diagnostics must fail as JSON, never wedge serving
+                self._json(503, {"error": f"{type(e).__name__}: {e}"})
+
+        def do_POST(self):
+            path = self._route()
+            if path == "/debug/profile":
+                self._debug_profile()
+                return
+            if path not in ("/v1/chat/completions",):
+                self._drain_small_body()
                 self._not_found()
                 return
             try:
@@ -704,6 +770,17 @@ def run_api_server(args) -> int:
         print("💣 fault injection armed from DLLAMA_FAILPOINTS="
               f"{os.environ['DLLAMA_FAILPOINTS']}")
     engine = make_engine(args)
+    # compile introspection: per-miss memory/cost analysis is ON in serving
+    # mode (it re-lowers and re-compiles identical HLO, which the persistent
+    # compile cache absorbs); DLLAMA_INTROSPECT_ANALYZE=0 opts out for
+    # cold-start-critical deploys. The startup report then prints the HBM
+    # budget table (weights vs KV vs per-program temp/output bytes).
+    if os.environ.get("DLLAMA_INTROSPECT_ANALYZE") != "0":
+        introspection.ledger().analyze = True
+    try:
+        introspection.hbm_startup_report(engine)
+    except Exception as e:  # noqa: BLE001 — the report is advisory; serving must start anyway
+        print(f"🚧 HBM startup report unavailable: {type(e).__name__}: {e}")
     if getattr(args, "stats", 0):
         start_stats_reporter(float(args.stats))
     n_slots = getattr(args, "batch_slots", 0) or 0

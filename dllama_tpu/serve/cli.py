@@ -401,12 +401,12 @@ def run_inference(args) -> int:
         sys.stdout.write(piece if piece is not None else "")
         sys.stdout.flush()
 
-    if args.profile:
-        import jax
+    # one jax.profiler.trace code path for every capture surface: the CLI,
+    # POST /debug/profile, and measure_eval_sync all go through
+    # profiling.capture (which also serializes sessions)
+    from ..runtime import profiling
 
-        prof = jax.profiler.trace(args.profile)
-    else:
-        prof = nullcontext()
+    prof = profiling.capture(args.profile) if args.profile else nullcontext()
     with prof:
         result = engine.generate(ids, max_new, on_token=on_token,
                                  stop_on_eos=False)

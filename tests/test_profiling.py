@@ -4,14 +4,21 @@ the reference's per-token `Eval ms / Sync ms / Sent kB / Recv kB` metrics
 the TPU way: measured collective device time from a profiler capture, and
 exact payload bytes from the compiled HLO."""
 
+import os
+import shutil
+
 import numpy as np
 import pytest
 
 from dllama_tpu.formats import tfile
 from dllama_tpu.runtime.engine import InferenceEngine
-from dllama_tpu.runtime.profiling import TrafficStats, collective_traffic
+from dllama_tpu.runtime.profiling import (TrafficStats, collective_traffic,
+                                          split_from_trace, union_span)
 
 from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+GOLDEN_XPLANE = os.path.join(os.path.dirname(__file__), "goldens",
+                             "synthetic.xplane.pb")
 
 
 @pytest.fixture(scope="module")
@@ -22,6 +29,168 @@ def model_files(tmp_path_factory):
     write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=48), rng)
     tfile.write_tfile(tpath, byte_vocab_tokenizer())
     return str(mpath), str(tpath)
+
+
+# -- xplane parsing against the checked-in synthetic fixture -----------------
+# (regenerate with tools/make_xplane_fixture.py; the expected numbers are
+# derived in that script's docstring)
+
+
+def test_union_span_basics():
+    assert union_span([]) == 0
+    assert union_span([(0, 10)]) == 10
+    assert union_span([(0, 10), (20, 30)]) == 20          # disjoint
+    assert union_span([(0, 10), (5, 15)]) == 15           # overlapping
+    assert union_span([(0, 10), (2, 8)]) == 10            # nested
+    assert union_span([(0, 10), (10, 20)]) == 20          # adjacent
+    # unsorted input with a span swallowing everything
+    assert union_span([(50, 60), (0, 100), (10, 20)]) == 100
+
+
+def test_split_from_trace_synthetic_fixture(tmp_path):
+    """Known-answer test: two device lanes, nested rendezvous inside an
+    all-reduce (must not double-count), compute overlapping sync (counts
+    once, as sync), an ExecuteHelper noise event, and a host plane that must
+    be ignored — numbers from tools/make_xplane_fixture.py."""
+    shutil.copy(GOLDEN_XPLANE, tmp_path / "t.xplane.pb")
+    s = split_from_trace(str(tmp_path), n_steps=2)
+    assert s.n_lanes == 2
+    assert s.n_steps == 2
+    assert s.sync_ms == pytest.approx(0.75)
+    assert s.eval_ms == pytest.approx(2.0)
+    assert s.sync_frac == pytest.approx(0.75 / 2.75)
+
+
+def test_split_from_trace_nested_dirs_picks_newest(tmp_path):
+    """The capture layout nests xplane.pb files under plugins/...; the
+    recursive glob must find them."""
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    shutil.copy(GOLDEN_XPLANE, d / "host.xplane.pb")
+    s = split_from_trace(str(tmp_path), n_steps=1)
+    assert s.n_lanes == 2
+    assert s.sync_ms == pytest.approx(1.5)  # n_steps=1: per-lane avg only
+
+
+def test_split_from_trace_empty_dir_raises(tmp_path):
+    with pytest.raises(RuntimeError, match="no xplane.pb"):
+        split_from_trace(str(tmp_path), n_steps=1)
+
+
+def test_split_from_trace_malformed_pb_raises(tmp_path):
+    (tmp_path / "bad.xplane.pb").write_bytes(b"\xff\xff\x9c\x01garbage")
+    with pytest.raises(RuntimeError, match="malformed xplane trace"):
+        split_from_trace(str(tmp_path), n_steps=1)
+
+
+def test_split_from_trace_no_device_lanes(tmp_path):
+    """A structurally valid trace with zero device events (an idle window,
+    or the profiler's occasionally-empty first session) yields the zero
+    split, not an error — POST /debug/profile depends on this."""
+    (tmp_path / "empty.xplane.pb").write_bytes(b"")  # valid: empty XSpace
+    s = split_from_trace(str(tmp_path), n_steps=3)
+    assert s.n_lanes == 0
+    assert s.eval_ms == 0.0 and s.sync_ms == 0.0
+    assert s.sync_frac == 0.0
+
+
+def _xplane_module():
+    """The lazily-loaded xplane proto module (shared with the parser so the
+    test can synthesize traces in the exact format it reads)."""
+    from dllama_tpu.runtime import profiling
+
+    profiling._load_xplane(os.devnull)  # empty file = valid empty XSpace
+    return profiling._xplane_pb2
+
+
+def _write_trace(path, planes):
+    """planes: [(plane_name, [(line_name, [(event, start_ps, dur_ps)])])]"""
+    pb = _xplane_module()
+    xs = pb.XSpace()
+    mid = 0
+    for pname, lines in planes:
+        plane = xs.planes.add()
+        plane.name = pname
+        for lname, events in lines:
+            line = plane.lines.add()
+            line.name = lname
+            for name, start, dur in events:
+                mid += 1
+                plane.event_metadata[mid].id = mid
+                plane.event_metadata[mid].name = name
+                ev = line.events.add()
+                ev.metadata_id = mid
+                ev.offset_ps = start
+                ev.duration_ps = dur
+    with open(path, "wb") as f:
+        f.write(xs.SerializeToString())
+
+
+def test_split_lane_family_priority(tmp_path):
+    """The thunk-based CPU runtime puts op events on tf_XLAEigen* pools and
+    scaffolding on tf_XLATfrtCpuClient* dispatch threads: only ONE family
+    may count as device lanes, or the per-lane average is diluted by
+    threads that aren't devices."""
+    ms = 10 ** 9
+    _write_trace(tmp_path / "cpu.xplane.pb", [
+        ("/host:CPU", [
+            ("python", [("$builtins isinstance", 0, ms)]),
+            ("tf_XLAEigen/-111", [("fusion.1", 0, 3 * ms),
+                                  ("all-reduce.2", 3 * ms, ms)]),
+            ("tf_XLAEigen/-222", [("fusion.1", 0, 3 * ms),
+                                  ("all-reduce.2", 3 * ms, ms)]),
+            ("tf_XLATfrtCpuClient/-333", [
+                ("TfrtCpuExecutable::ExecuteHelper", 0, 5 * ms),
+                ("broadcast.9", 0, ms)]),
+        ]),
+    ])
+    s = split_from_trace(str(tmp_path), n_steps=1)
+    assert s.n_lanes == 2  # the Eigen pools only, not the client thread
+    assert s.sync_ms == pytest.approx(1.0)
+    assert s.eval_ms == pytest.approx(3.0)
+
+
+def test_split_falls_back_to_client_lanes(tmp_path):
+    """With no PjRt/Eigen lanes at all, the TfrtCpuClient dispatch threads
+    are better than nothing (small thunks can execute inline there)."""
+    ms = 10 ** 9
+    _write_trace(tmp_path / "cpu.xplane.pb", [
+        ("/host:CPU", [
+            ("tf_XLATfrtCpuClient/-1", [("dot_fusion.3", 0, 2 * ms),
+                                        ("psum.1", 2 * ms, 2 * ms)]),
+        ]),
+    ])
+    s = split_from_trace(str(tmp_path), n_steps=2)
+    assert s.n_lanes == 1
+    assert s.sync_ms == pytest.approx(1.0)
+    assert s.eval_ms == pytest.approx(1.0)
+
+
+def test_collective_traffic_empty_and_collective_free_hlo():
+    assert not collective_traffic("", n_devices=8)
+    hlo = "%add.1 = f32[4] add(f32[4] %a, f32[4] %b)"
+    tr = collective_traffic(hlo, n_devices=8)
+    assert tr.n_collectives == 0 and tr.sent_kb == 0.0 and not tr
+
+
+def test_capture_serializes_sessions(tmp_path):
+    """capture() is THE jax.profiler.trace entry point (CLI --profile, POST
+    /debug/profile, measure_eval_sync): a second concurrent session must
+    fail fast with CaptureBusyError, not corrupt the active one."""
+    from dllama_tpu.runtime import profiling
+
+    assert profiling._capture_lock.acquire(timeout=1)
+    try:
+        with pytest.raises(profiling.CaptureBusyError):
+            with profiling.capture(str(tmp_path)):
+                pass
+    finally:
+        profiling._capture_lock.release()
+    # and the lock is released on normal exit: a second session works
+    with profiling.capture(str(tmp_path / "a")):
+        pass
+    with profiling.capture(str(tmp_path / "b")):
+        pass
 
 
 def test_collective_traffic_parses_hlo():

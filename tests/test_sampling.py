@@ -121,12 +121,25 @@ def test_engine_sampled_decode_under_tp(model_files):
 
 def test_sampling_knob_change_does_not_recompile(model_files):
     """temperature/topp are traced scalars: changing them between calls must
-    reuse the compiled sampled step. (The jit cache is shared across engines
-    built on the same function, so assert no NEW entries, not a count of 1.)"""
+    reuse the compiled sampled step. Asserted through the compile ledger
+    (runtime/introspection), which counts real trace/compile events — the
+    pjit wrapper's `_cache_size()` is NOT a compile signal: its fastpath
+    cache also keys on input-sharding lineage, so entries appear across
+    generations without any recompile."""
+    from dllama_tpu.runtime import introspection
+
     e = InferenceEngine(*model_files, temperature=0.8, topp=0.9, seed=1)
+
+    def sampled_compiles() -> int:
+        return [p["compiles"]
+                for p in introspection.ledger().snapshot()["programs"]
+                if p["scope"] == e.introspection_scope
+                and p["program"] == "sampled_step"][0]
+
     e.generate("hello", 2, stop_on_eos=False)
-    compiled_before = e._sampled_step._cache_size()
+    before = sampled_compiles()
+    assert before >= 1  # the first generation really compiled it
     e.sampler.set_temp(1.2)
     e.sampler.topp = 0.5
     e.generate("world", 2, stop_on_eos=False)
-    assert e._sampled_step._cache_size() == compiled_before
+    assert sampled_compiles() == before

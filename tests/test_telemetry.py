@@ -133,6 +133,39 @@ def test_span_tracer_jsonl_schema(tmp_path):
     assert all(ln["phase"] in tm.PHASES for ln in lines)
 
 
+def test_span_tracer_ring_and_recent_requests():
+    tr = tm.SpanTracer()
+    # the ring records regardless of the file sink (GET /debug/requests
+    # must work without --trace-out)
+    tr.emit(3, "queue", 0, 1_000_000, slot=1)
+    tr.emit(3, "prefill", 1_000_000, 3_000_000, slot=1, n_tokens=5)
+    tr.emit(3, "decode", 3_000_000, 9_000_000, slot=1, n_tokens=4)
+    tr.emit(4, "decode", 0, 2_000_000)
+    out = tr.recent_requests()
+    assert [r["request_id"] for r in out] == [4, 3]  # newest first
+    r3 = out[1]
+    assert r3["total_ms"] == pytest.approx(9.0)
+    assert [p["phase"] for p in r3["phases"]] == ["queue", "prefill",
+                                                  "decode"]
+    assert r3["phases"][2]["ms"] == pytest.approx(6.0)
+    assert r3["phases"][2]["start_ms"] == pytest.approx(3.0)
+    # bounded: the ring caps at RING_SPANS spans, oldest dropped
+    for i in range(tm.SpanTracer.RING_SPANS + 10):
+        tr.emit(100 + i, "decode", 0, 1)
+    assert len(tr._ring) == tm.SpanTracer.RING_SPANS
+    assert tr.recent_requests(limit=10_000)[-1]["request_id"] > 4
+
+
+def test_stats_line_folds_in_compile_counts():
+    r = fresh()
+    assert "compiles=" not in tm.stats_line(r)
+    r.counter(tm.COMPILE_TOTAL).inc(3, scope="engine-1", program="forward")
+    line = tm.stats_line(r)
+    assert "compiles=3" in line and "retrace" not in line
+    r.counter(tm.RETRACE_UNEXPECTED).inc(program="forward")
+    assert "retrace=1!" in tm.stats_line(r)
+
+
 # -- thread safety ------------------------------------------------------------
 
 

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Route-label lint (Makefile ``lint`` target).
+
+``serve/api.py`` folds unknown paths into the ``other`` route label so a
+scanner can't explode ``dllama_http_requests_total``'s cardinality — which
+only works if every route a handler actually matches on is listed in
+``_ROUTES``. A handler added for ``/debug/foo`` without the ``_ROUTES``
+entry silently reports its traffic as ``other`` and per-route dashboards
+go blind. This lint keeps the set closed-world:
+
+1. parse ``serve/api.py``'s AST (no imports — runnable without jax);
+2. collect ``_ROUTES`` from its assignment;
+3. collect every string literal that a handler compares against the
+   request path (any ``==`` / ``in`` comparison whose other side mentions
+   ``path``, e.g. ``self.path``, ``self._route()``, or a local ``path``);
+4. every compared literal must appear in ``_ROUTES``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+API = REPO / "dllama_tpu" / "serve" / "api.py"
+
+
+def _mentions_path(node: ast.expr) -> bool:
+    """True when the expression reads the request path: a name or attribute
+    called ``path``, or a call of ``_route`` (the query-stripping helper)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("path", "_route"):
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "path":
+            return True
+    return False
+
+
+def _route_literals(node: ast.expr) -> list[str]:
+    """String constants that look like routes inside a comparator."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and sub.value.startswith("/"):
+            out.append(sub.value)
+    return out
+
+
+def main() -> int:
+    tree = ast.parse(API.read_text(encoding="utf-8"), filename=str(API))
+
+    routes: set[str] | None = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "_ROUTES":
+                    routes = set(ast.literal_eval(node.value))
+    if routes is None:
+        print("❌ serve/api.py: no _ROUTES assignment found", file=sys.stderr)
+        return 1
+
+    errors: list[str] = []
+    compared: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left, *node.comparators]
+        if not any(_mentions_path(s) for s in sides):
+            continue
+        for s in sides:
+            if _mentions_path(s):
+                continue
+            for lit in _route_literals(s):
+                compared.add(lit)
+                if lit not in routes:
+                    errors.append(
+                        f"serve/api.py:{node.lineno}: handler matches "
+                        f"{lit!r} but it is not in _ROUTES — its traffic "
+                        f"would be folded into the 'other' label")
+
+    if errors:
+        for e in errors:
+            print(f"❌ {e}", file=sys.stderr)
+        return 1
+    print(f"✅ route labels closed-world: {len(compared)} handler-matched "
+          f"routes all listed in _ROUTES ({len(routes)} registered)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Regenerate tests/goldens/synthetic.xplane.pb — the known-answer trace for
+tests/test_profiling.py's xplane-parsing tier.
+
+The fixture encodes two device lanes with hand-computable eval/sync content
+(all numbers in picoseconds; 1 ms = 1e9 ps):
+
+* ``/device:TPU:0`` / "XLA Ops":
+    - ``fusion.1``        [0, 4e9]        → 4 ms eval
+    - ``all-reduce.1``    [4e9, 6e9]      → 2 ms sync
+    - ``wait:rendezvous`` [4.5e9, 5.5e9]  → nested inside the all-reduce:
+      must NOT double-count (union_span)
+    - ``fusion.2``        [5e9, 7e9]      → overlaps the sync span; the
+      overlapped 1 ms counts once, as sync → eval contributes 1 ms
+    - ``ExecuteHelper``   [0, 10e9]       → runtime noise, excluded
+* ``/device:TPU:1`` / "XLA Ops":
+    - ``fusion.3``        [0, 3e9]        → 3 ms eval
+    - ``psum.3``          [3e9, 4e9]      → 1 ms sync (CPU-backend thunk name)
+* ``/host:CPU`` plane: one event on a non-device lane — must be ignored.
+
+With ``n_steps=2``: sync = (2+1)/2 lanes/2 steps = 0.75 ms,
+eval = ((4+1)+3)/2/2 = 2.0 ms (test_profiling asserts these exactly).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dllama_tpu.runtime.profiling import _load_xplane  # noqa: E402
+
+
+def build() -> bytes:
+    # reuse the lazy proto loader so the generator and the parser can never
+    # disagree about which xplane_pb2 they use
+    import importlib
+
+    _load_xplane.__globals__["_xplane_pb2"] = None
+    try:
+        _load_xplane(os.devnull)
+    except Exception:
+        pass  # devnull parses as an empty XSpace or raises; either is fine
+    pb = _load_xplane.__globals__["_xplane_pb2"]
+    assert pb is not None, "xplane proto unavailable"
+
+    xs = pb.XSpace()
+
+    def add_plane(name: str, line_name: str, events: list[tuple[str, int, int]]):
+        plane = xs.planes.add()
+        plane.name = name
+        line = plane.lines.add()
+        line.name = line_name
+        for mid, (ev_name, start, dur) in enumerate(events, start=1):
+            plane.event_metadata[mid].id = mid
+            plane.event_metadata[mid].name = ev_name
+            ev = line.events.add()
+            ev.metadata_id = mid
+            ev.offset_ps = start
+            ev.duration_ps = dur
+
+    ms = 10 ** 9  # ps per ms
+    add_plane("/device:TPU:0", "XLA Ops", [
+        ("fusion.1", 0, 4 * ms),
+        ("all-reduce.1", 4 * ms, 2 * ms),
+        ("wait:rendezvous", 4 * ms + ms // 2, ms),
+        ("fusion.2", 5 * ms, 2 * ms),
+        ("ExecuteHelper", 0, 10 * ms),
+    ])
+    add_plane("/device:TPU:1", "XLA Ops", [
+        ("fusion.3", 0, 3 * ms),
+        ("psum.3", 3 * ms, ms),
+    ])
+    add_plane("/host:CPU", "python threads", [
+        ("fusion.9", 0, 50 * ms),
+    ])
+    return xs.SerializeToString()
+
+
+def main() -> int:
+    out = os.path.join(os.path.dirname(__file__), "..", "tests", "goldens",
+                       "synthetic.xplane.pb")
+    data = build()
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"wrote {os.path.normpath(out)} ({len(data)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
