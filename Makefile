@@ -43,6 +43,7 @@ lint:
 	$(PY) tools/check_metrics_names.py
 	$(PY) tools/check_exception_hygiene.py
 	$(PY) tools/check_route_labels.py
+	$(PY) tools/check_failpoint_sites.py
 
 bench:
 	$(PY) bench.py

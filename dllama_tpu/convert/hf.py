@@ -10,13 +10,15 @@ needed for the safetensors path; the Meta path uses torch only to unpickle.
 from __future__ import annotations
 
 import json
+import zlib
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable, Iterable
 
 import numpy as np
 
-from ..formats.mfile import ArchType, HiddenAct, RopeType, write_header
+from ..formats.mfile import (ArchType, HiddenAct, ModelFile, RopeType,
+                             write_header, write_manifest)
 from ..formats.quants import F16, F32, Q40, Q80, quantize_q40, quantize_q80
 
 FLOAT_TYPE_BY_NAME = {"f32": F32, "f16": F16, "q40": Q40, "q80": Q80}
@@ -33,6 +35,21 @@ ARCH_BY_MODEL_TYPE = {
 }
 
 HIDDEN_ACT_BY_NAME = {"gelu": HiddenAct.GELU, "silu": HiddenAct.SILU}
+
+
+def _keyed_checksums(path: str | Path, crcs: list[int]) -> dict[str, int]:
+    """Attach walker keys to crc32s accumulated in emission order — the
+    .m tensor walk IS the converter's emission order, and the directory
+    walk reads only the header, so the manifest costs zero re-reads of a
+    multi-GB model (write_manifest's recompute path would read it all
+    again)."""
+    with ModelFile.open(path, load_checksums=False) as mf:
+        keys = list(mf.tensors)
+    if len(keys) != len(crcs):  # a plan/walk disagreement is a format bug
+        raise ValueError(f"converter emitted {len(crcs)} tensors but the "
+                         f"walker found {len(keys)} — refusing to write a "
+                         f"misaligned checksum manifest")
+    return dict(zip(keys, crcs))
 
 
 def parse_float_type(name: str) -> int:
@@ -287,6 +304,7 @@ def convert_hf(source_dir: str | Path, weight_float_type: int | str,
     src = SafetensorsDirectory(files)
 
     plan = hf_tensor_plan(params)
+    crcs: list[int] = []
     try:
         with open(output_path, "wb") as out:
             write_header(out, params)
@@ -300,9 +318,16 @@ def convert_hf(source_dir: str | Path, weight_float_type: int | str,
                 if progress:
                     print(f"🔶 Writing {key} {tensor.shape} as "
                           f"{FLOAT_NAME_BY_TYPE[item.float_type]}")
-                out.write(encode_tensor(tensor, item.float_type))
+                data = encode_tensor(tensor, item.float_type)
+                crcs.append(zlib.crc32(data) & 0xFFFFFFFF)
+                out.write(data)
     finally:
         src.close()
+    # per-tensor crc32 sidecar: the streaming loader verifies each tensor
+    # against it at load and names the exact corrupt tensor on mismatch
+    sums = write_manifest(output_path, _keyed_checksums(output_path, crcs))
+    if progress:
+        print(f"🔏 checksum manifest → {sums}")
     return str(output_path)
 
 
@@ -388,6 +413,7 @@ def convert_meta_llama(source_dir: str | Path, weight_float_type: int | str,
                      or name.endswith(".feed_forward.w2.weight")) else 0
         return np.concatenate(parts, axis=axis)
 
+    crcs: list[int] = []
     with open(output_path, "wb") as out:
         write_header(out, params)
         for name in names:
@@ -397,7 +423,12 @@ def convert_meta_llama(source_dir: str | Path, weight_float_type: int | str,
             tensor = merged(name)
             if progress:
                 print(f"🔶 Writing {name} {tensor.shape} as {FLOAT_NAME_BY_TYPE[ft]}")
-            out.write(encode_tensor(tensor, ft))
+            data = encode_tensor(tensor, ft)
+            crcs.append(zlib.crc32(data) & 0xFFFFFFFF)
+            out.write(data)
+    sums = write_manifest(output_path, _keyed_checksums(output_path, crcs))
+    if progress:
+        print(f"🔏 checksum manifest → {sums}")
     return str(output_path)
 
 

@@ -21,8 +21,11 @@ in :mod:`dllama_tpu.runtime.weights`.
 from __future__ import annotations
 
 import enum
+import json
 import mmap
+import os
 import struct
+import zlib
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -33,6 +36,14 @@ from .quants import (F16, F32, Q40, Q40_BLOCK_BYTES, Q40_BLOCK_SIZE, Q80,
                      tensor_bytes, unpack_q40)
 
 MODEL_MAGIC = 0xA00ABCD
+
+# checksum manifest sidecar (``<model>.m.sums``): per-tensor crc32 of the
+# on-disk bytes, written by the converter and verified by the streaming
+# loader. A sidecar (not a trailer) keeps the .m byte stream wire-compatible
+# with the reference reader, whose walk requires walk-end == file size.
+MANIFEST_SUFFIX = ".sums"
+MANIFEST_VERSION = 1
+MANIFEST_ALGO = "crc32"
 
 
 def _dequant_any(buf, n: int, float_type: int) -> np.ndarray:
@@ -259,12 +270,20 @@ class ModelFile:
     # False when an MoE file was written without our block_moe_gate extension
     # (i.e. by the reference converter) — parseable but not runnable.
     has_moe_router: bool = True
+    # per-tensor crc32 from the .m.sums sidecar; None when the model has no
+    # manifest (pre-manifest files stay loadable, just unverified)
+    checksums: dict[str, int] | None = None
 
     _mm: mmap.mmap | None = None
     _file: object | None = None
 
     @classmethod
-    def open(cls, path: str | Path, max_seq_len: int = 0, sync_type: int = F32) -> "ModelFile":
+    def open(cls, path: str | Path, max_seq_len: int = 0, sync_type: int = F32,
+             load_checksums: bool = True) -> "ModelFile":
+        """``load_checksums=False`` skips the .m.sums sidecar entirely —
+        the manifest WRITER's recompute path needs this (validating the
+        stale sidecar it is about to replace would make regeneration
+        circular)."""
         path = str(path)
         f = open(path, "rb")
         try:
@@ -295,6 +314,12 @@ class ModelFile:
             mm.close()
             f.close()
             raise
+        if load_checksums:
+            try:
+                mf.checksums = load_manifest(path, file_size=header.file_size)
+            except Exception:
+                mf.close()
+                raise
         return mf
 
     def close(self) -> None:
@@ -464,6 +489,45 @@ class ModelFile:
         return (np.ascontiguousarray(scales.T.astype(np.float32)),
                 np.ascontiguousarray(codes.T))
 
+    def tensor_crc32(self, key: str) -> int:
+        """crc32 of a tensor's raw on-disk bytes (the manifest unit)."""
+        return zlib.crc32(self.raw(key)) & 0xFFFFFFFF
+
+    def tensor_scales_kmajor_sub(self, key: str, out_lo: int, out_hi: int,
+                                 in_lo: int, in_hi: int) -> np.ndarray:
+        """ONLY the K-major scales plane of a block-quantized weight:
+        ``f32 [(in_hi-in_lo)/32, out_hi-out_lo]``.
+
+        Both block formats lead each block with a float16 scale (Q40: 2+16
+        bytes, Q80: 2+32 — quants.py module docstring), so the scales come
+        out of a strided view without ever decoding the codes. This is what
+        keeps the streaming loader's scales CALLBACK allocation proportional
+        to the scales slice itself — the shared pair reader materializes the
+        ~16x larger codes plane just to throw it away
+        (tests/test_streaming_loader.py bounds this)."""
+        rec = self.tensors[key]
+        assert rec.float_type in (Q40, Q80), rec
+        from .quants import Q80_BLOCK_BYTES
+
+        block_bytes = Q40_BLOCK_BYTES if rec.float_type == Q40 \
+            else Q80_BLOCK_BYTES
+        rows, cols = rec.shape
+        assert 0 <= out_lo <= out_hi <= rows, (key, out_lo, out_hi)
+        assert 0 <= in_lo <= in_hi <= cols and in_lo % QUANT_BLOCK_SIZE == 0 \
+            and in_hi % QUANT_BLOCK_SIZE == 0, (key, in_lo, in_hi)
+        n_blk = cols // QUANT_BLOCK_SIZE
+        blk_lo, blk_hi = in_lo // QUANT_BLOCK_SIZE, in_hi // QUANT_BLOCK_SIZE
+        row_bytes = rec.n_bytes // rows
+        sub_rows = memoryview(self._mm)[rec.offset + out_lo * row_bytes:
+                                        rec.offset + out_hi * row_bytes]
+        as_blocks = np.frombuffer(sub_rows, dtype=np.uint8).reshape(
+            out_hi - out_lo, n_blk, block_bytes)
+        d16 = np.ascontiguousarray(
+            as_blocks[:, blk_lo:blk_hi, :2]).view(np.float16)
+        # -> [n_blocks, out] f32, matching _quant_kmajor_sub's scales plane
+        return np.ascontiguousarray(
+            d16.reshape(out_hi - out_lo, blk_hi - blk_lo).T.astype(np.float32))
+
     def tensor_q40_kmajor_sub(self, key: str, out_lo: int, out_hi: int,
                               in_lo: int, in_hi: int) -> tuple[np.ndarray, np.ndarray]:
         """A K-major sub-block of a Q40 weight (see _quant_kmajor_sub)."""
@@ -520,3 +584,74 @@ def write_header(f, params: dict) -> None:
     f.write(struct.pack("<i", MODEL_MAGIC))
     f.write(struct.pack("<i", 8 + len(data)))
     f.write(data)
+
+
+# ---------------------------------------------------------------------------
+# Checksum manifest (sidecar <model>.m.sums)
+# ---------------------------------------------------------------------------
+
+
+def manifest_path(path: str | Path) -> str:
+    return str(path) + MANIFEST_SUFFIX
+
+
+def compute_checksums(mf: "ModelFile") -> dict[str, int]:
+    """crc32 of every tensor's on-disk bytes, keyed by walker key
+    (``name[.layer[.expert]]``) — one sequential pass over the mmap."""
+    return {key: mf.tensor_crc32(key) for key in mf.tensors}
+
+
+def write_manifest(path: str | Path,
+                   checksums: dict[str, int] | None = None) -> str:
+    """Write the checksum sidecar for an existing .m file. ``checksums``
+    skips the recompute when the caller already has them (the converter
+    checksums as it writes). Atomic: written to a temp file then renamed,
+    so a crashed writer can never leave a half-manifest that would make a
+    GOOD model look corrupt."""
+    path = str(path)
+    if checksums is None:
+        # load_checksums=False: regeneration must not validate (and choke
+        # on) the stale sidecar it exists to replace
+        with ModelFile.open(path, load_checksums=False) as mf:
+            checksums = compute_checksums(mf)
+    out = manifest_path(path)
+    doc = {"version": MANIFEST_VERSION, "algo": MANIFEST_ALGO,
+           "file_size": os.path.getsize(path), "tensors": checksums}
+    tmp = out + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=0, sort_keys=True)
+    os.replace(tmp, out)
+    return out
+
+
+def load_manifest(path: str | Path,
+                  file_size: int | None = None) -> dict[str, int] | None:
+    """Load the checksum sidecar for a .m file; None when absent (legacy
+    files load unverified). A malformed or STALE manifest (recorded
+    file_size differs from the actual file) raises — silently skipping
+    verification because the sidecar rotted would defeat its purpose."""
+    mpath = manifest_path(path)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath, encoding="utf-8") as f:
+            doc = json.load(f)
+        algo, tensors = doc["algo"], doc["tensors"]
+        recorded = int(doc["file_size"])
+        sums = {str(k): int(v) for k, v in tensors.items()}
+    except (ValueError, KeyError, TypeError, AttributeError) as e:
+        raise ValueError(f"malformed checksum manifest {mpath}: {e} — "
+                         f"regenerate it (python -m dllama_tpu verify "
+                         f"--model {path} --write) or delete it to load "
+                         f"unverified") from e
+    if algo != MANIFEST_ALGO:
+        raise ValueError(f"checksum manifest {mpath} uses unsupported "
+                         f"algo {algo!r} (want {MANIFEST_ALGO!r})")
+    actual = os.path.getsize(path) if file_size is None else file_size
+    if recorded != actual:
+        raise ValueError(
+            f"checksum manifest {mpath} is stale or the model is "
+            f"truncated: manifest records {recorded} bytes, file has "
+            f"{actual} — reconvert, regenerate the manifest, or delete "
+            f"it to load unverified")
+    return sums

@@ -44,8 +44,9 @@ from ..parallel.api import MeshPlan, make_mesh, plan_scoped_jit, use_plan
 from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.sampler import Sampler, xorshift_random_f32
-from . import telemetry
+from . import failpoints, telemetry
 from .kvcache import KVCache
+from .watchdog import StepWatchdog
 
 DEFAULT_N_BATCHES = 32  # reference default nBatches (app.cpp:28)
 
@@ -131,7 +132,8 @@ class InferenceEngine:
                  temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5,
                  multihost: bool = False, host_sampling: bool = False,
                  decode_chunk: int = 1, spec_lookup: int = 0,
-                 kv_dtype: str = "auto", profile_split: bool = False):
+                 kv_dtype: str = "auto", profile_split: bool = False,
+                 verify_weights: bool = False):
         from ..ops.linear import turbo_mode
 
         if turbo_mode() is not None and weight_mode != "auto":
@@ -318,6 +320,15 @@ class InferenceEngine:
         # sentinel's steady-state is per engine — a second engine warming up
         # can never trip the first one's alarm
         self.introspection_scope = f"engine-{next(_ENGINE_SEQ)}"
+        # step watchdog (runtime.watchdog): every device dispatch below
+        # runs under a deadline guard; the batch scheduler registers its
+        # fail-all in watchdog.on_stall. Budget shape comes from env knobs
+        # (DLLAMA_WATCHDOG*, README "Failure semantics").
+        self.watchdog = StepWatchdog(name=self.introspection_scope)
+        # prefill bucket widths this engine has actually dispatched — the
+        # HBM admission guard charges an uncompiled bucket's temp estimate
+        # on top of the measured programs (runtime.hbm.admission_check)
+        self.seen_buckets: set[int] = set()
         # telemetry (runtime.telemetry): cached metric handles — the decode
         # hot path records through attribute reads, no registry lookups
         self._tm = telemetry.registry()
@@ -332,6 +343,37 @@ class InferenceEngine:
         # engine itself has no request concept; -1 = unattributed)
         self.trace_rid = -1
 
+        try:
+            if verify_weights:
+                # offline-grade full verification BEFORE any device
+                # staging (--verify-weights): every tensor crc-checked
+                # against the .m.sums manifest, all corrupt tensors named
+                from .weights import WeightIntegrityError
+                from .weights import verify_weights as _verify_all
+
+                res = _verify_all(self.model_file)
+                if res["corrupt"]:
+                    raise WeightIntegrityError(
+                        f"--verify-weights: {len(res['corrupt'])} of "
+                        f"{res['tensors']} tensors corrupt in {model_path}: "
+                        + ", ".join(res["corrupt"]))
+            self._load_and_build(profile_split)
+        except BaseException:
+            # atomic failure: a load/build that dies partway (corrupt
+            # tensor, exhausted read retries, device staging error) must
+            # not hand back — or leak — a half-initialized engine: drop
+            # any partially placed device buffers, stop the watchdog, and
+            # close the mmap before re-raising
+            self._teardown_partial()
+            raise
+
+    def _load_and_build(self, profile_split: bool) -> None:
+        """Weight load + device staging + jitted-program construction —
+        the failable tail of ``__init__``, split out so its caller can
+        guarantee atomic teardown on ANY exception."""
+        from ..ops.linear import turbo_mode
+
+        weight_mode, multihost = self.weight_mode, self.multihost
         # streaming loader: shard-direct reads from the mmap, host memory
         # bounded by one tensor shard (VERDICT round-1 missing #4)
         self.params: Params = load_params_from_mfile(
@@ -431,6 +473,18 @@ class InferenceEngine:
                                                 static_argnums=1,
                                                 donate_argnums=(4,))
 
+    def _teardown_partial(self) -> None:
+        """Explicit teardown after a failed load/build: no half-placed
+        params tree stays reachable (device buffers free with the refs),
+        the watchdog monitor stops, and the mmap closes. Idempotent."""
+        self.params = None  # type: ignore[assignment]
+        self.kv = None  # type: ignore[assignment]
+        self.watchdog.close()
+        try:
+            self.model_file.close()
+        except Exception:  # noqa: BLE001 — teardown must not mask the original load failure
+            pass
+
     def _quant_resolution(self) -> tuple:
         """The env's quant-mode RESOLUTION (not the display label): what the
         loader bakes into the weights. Label spellings that resolve the same
@@ -465,6 +519,7 @@ class InferenceEngine:
             from ..parallel.multihost import CTRL_STOP
 
             self._ctrl.send(self._ctrl.encode(CTRL_STOP))
+        self.watchdog.close()
         self.model_file.close()
 
     # -- low-level steps ----------------------------------------------------
@@ -495,11 +550,15 @@ class InferenceEngine:
             self._ctrl.send(self._ctrl.encode(
                 kind, tokens_2d, start_pos,
                 scalars=extras if kind == CTRL_SAMPLED else None))
-        with (use_plan(self.plan) if self.plan is not None else nullcontext()):
-            out, self.kv = step_fn(
-                self.params, self.cfg, jnp.asarray(tokens_2d, dtype=jnp.int32),
-                jnp.int32(start_pos), self.kv,
-                *(jnp.float32(e) for e in extras))
+        with self.watchdog.guard("dispatch"):
+            failpoints.fire("step_hang")
+            with (use_plan(self.plan) if self.plan is not None
+                    else nullcontext()):
+                out, self.kv = step_fn(
+                    self.params, self.cfg,
+                    jnp.asarray(tokens_2d, dtype=jnp.int32),
+                    jnp.int32(start_pos), self.kv,
+                    *(jnp.float32(e) for e in extras))
         return out
 
     def _forward(self, tokens_2d: np.ndarray, start_pos: int) -> jax.Array:
@@ -542,6 +601,11 @@ class InferenceEngine:
             t0 = time.perf_counter()
             logits = self._forward(np.asarray([padded]), self.pos)
             logits_np = np.asarray(logits[0, valid - 1])
+            # pad_to, not size: at the context tail the dispatched (and
+            # compiled) program is pad_to wide — the admission guard must
+            # not see a full-width bucket as compiled when only the
+            # tail-width one is
+            self.seen_buckets.add(pad_to)
             ms = (time.perf_counter() - t0) * 1000.0
             metrics.append(StepMetrics("eval", ms, valid))
             self._m_prefill_ms.record(ms)
@@ -626,17 +690,20 @@ class InferenceEngine:
                    temp: float, topp: float, coins) -> np.ndarray:
         """Dispatch one fused K-step decode (root and worker replay path)."""
         tok0 = jnp.asarray([token], dtype=jnp.int32)
-        with (use_plan(self.plan) if self.plan is not None else nullcontext()):
-            if greedy:
-                toks, self.kv = self._greedy_steps(
-                    self.params, self.cfg, tok0, jnp.int32(start_pos),
-                    self.kv, k)
-            else:
-                toks, self.kv = self._sampled_steps(
-                    self.params, self.cfg, tok0, jnp.int32(start_pos),
-                    self.kv, jnp.float32(temp), jnp.float32(topp),
-                    jnp.asarray(coins, dtype=jnp.float32), k)
-        return np.asarray(toks)
+        with self.watchdog.guard("chunk"):
+            failpoints.fire("step_hang")
+            with (use_plan(self.plan) if self.plan is not None
+                    else nullcontext()):
+                if greedy:
+                    toks, self.kv = self._greedy_steps(
+                        self.params, self.cfg, tok0, jnp.int32(start_pos),
+                        self.kv, k)
+                else:
+                    toks, self.kv = self._sampled_steps(
+                        self.params, self.cfg, tok0, jnp.int32(start_pos),
+                        self.kv, jnp.float32(temp), jnp.float32(topp),
+                        jnp.asarray(coins, dtype=jnp.float32), k)
+            return np.asarray(toks)
 
     @property
     def spec_active(self) -> bool:
@@ -675,11 +742,14 @@ class InferenceEngine:
 
     def _run_verify(self, tokens_2d, start_pos: int):
         """Dispatch one verify step (root and worker replay path)."""
-        with (use_plan(self.plan) if self.plan is not None else nullcontext()):
-            n_acc, preds, self.kv = self._verify_step(
-                self.params, self.cfg, jnp.asarray(tokens_2d, jnp.int32),
-                jnp.int32(start_pos), self.kv)
-        return int(np.asarray(n_acc)[0]), np.asarray(preds)
+        with self.watchdog.guard("verify"):
+            failpoints.fire("step_hang")
+            with (use_plan(self.plan) if self.plan is not None
+                    else nullcontext()):
+                n_acc, preds, self.kv = self._verify_step(
+                    self.params, self.cfg, jnp.asarray(tokens_2d, jnp.int32),
+                    jnp.int32(start_pos), self.kv)
+            return int(np.asarray(n_acc)[0]), np.asarray(preds)
 
     def commit_chunk(self, n_keep: int) -> None:
         """Advance position and sampler RNG by the kept prefix of a chunk."""

@@ -17,24 +17,39 @@ via the environment for operator-driven game days::
 Spec grammar: ``name:action[:times]`` joined by commas. Actions map to
 exception types (``raise`` → :class:`FailpointError`, ``broken_pipe`` →
 ``BrokenPipeError``, ``conn_reset`` → ``ConnectionResetError``,
-``oserror`` → ``OSError``); ``times`` bounds how often the point fires
-(default: every hit). Every fire increments
-``dllama_failpoints_fired_total{name=...}`` so chaos tests assert
-injection *and* recovery through the same telemetry registry.
+``oserror`` → ``OSError``, ``short_read`` → :class:`ShortReadError`, an
+``OSError`` so the loader's transient-retry path treats it as such) —
+except ``sleep``, which does not raise at all: the armed site blocks for
+``delay_s`` seconds (default 30; programmatic ``arm(..., delay_s=...)``
+overrides), simulating a wedged device dispatch for the step watchdog.
+``times`` bounds how often the point fires (default: every hit). Every
+fire increments ``dllama_failpoints_fired_total{name=...}`` so chaos
+tests assert injection *and* recovery through the same telemetry
+registry.
 
-Known sites (grep ``failpoints.fire`` for ground truth):
+Site registry — the closed world ``tools/check_failpoint_sites.py``
+lints against: every ``failpoints.fire("<name>")`` call site in the
+package must use a name listed here, and every name listed here must
+have at least one call site:
 
 * ``step`` — the batch scheduler's decode dispatch (supervised: a raise
   here exercises crash → fail-all → restart).
 * ``admit`` — slot admission (exercises the per-request reject path).
 * ``emit`` — the HTTP SSE write (a ``broken_pipe`` here exercises the
   client-disconnect accounting).
+* ``load_read`` — the streaming weight loader's per-tensor read callback
+  (``runtime/weights.py``; ``short_read``/``oserror`` exercise the
+  bounded-retry path, ``raise`` the atomic load-failure path).
+* ``step_hang`` — inside every watchdog-guarded device dispatch (engine
+  and batched generator; the ``sleep`` action simulates a wedged XLA
+  dispatch and exercises the step-watchdog trip).
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
 from dataclasses import dataclass
 
 
@@ -42,11 +57,20 @@ class FailpointError(RuntimeError):
     """The generic injected failure (action ``raise``)."""
 
 
+class ShortReadError(OSError):
+    """Injected truncated read (action ``short_read``) — an ``OSError``
+    so transient-IO retry paths classify it as retryable."""
+
+
+DEFAULT_SLEEP_S = 30.0
+
 _ACTIONS = {
     "raise": FailpointError,
     "broken_pipe": BrokenPipeError,
     "conn_reset": ConnectionResetError,
     "oserror": OSError,
+    "short_read": ShortReadError,
+    "sleep": None,  # blocks instead of raising (step-hang injection)
 }
 
 
@@ -54,6 +78,7 @@ _ACTIONS = {
 class _Armed:
     action: str
     times: int | None  # None = fire on every hit
+    delay_s: float = DEFAULT_SLEEP_S  # sleep action only
 
 
 class FailpointRegistry:
@@ -65,14 +90,15 @@ class FailpointRegistry:
         self._fired: dict[str, int] = {}
 
     def arm(self, name: str, action: str = "raise",
-            times: int | None = None) -> None:
+            times: int | None = None,
+            delay_s: float = DEFAULT_SLEEP_S) -> None:
         if action not in _ACTIONS:
             raise ValueError(f"unknown failpoint action {action!r} "
                              f"(known: {sorted(_ACTIONS)})")
         if times is not None and times <= 0:
             raise ValueError("times must be positive (or None for always)")
         with self._lock:
-            self._armed[name] = _Armed(action, times)
+            self._armed[name] = _Armed(action, times, delay_s)
 
     def disarm(self, name: str) -> None:
         with self._lock:
@@ -113,6 +139,11 @@ class FailpointRegistry:
         from . import telemetry
 
         telemetry.registry().counter(telemetry.FAILPOINTS_FIRED).inc(name=name)
+        if fp.action == "sleep":
+            # simulate a wedged dispatch: block the calling thread, then
+            # return normally — the step watchdog must notice, not this code
+            time.sleep(fp.delay_s)
+            return
         raise _ACTIONS[fp.action](f"failpoint {name!r} fired")
 
     def configure(self, spec: str | None) -> None:
@@ -145,8 +176,9 @@ def fire(name: str) -> None:
     _registry.fire(name)
 
 
-def arm(name: str, action: str = "raise", times: int | None = None) -> None:
-    _registry.arm(name, action, times)
+def arm(name: str, action: str = "raise", times: int | None = None,
+        delay_s: float = DEFAULT_SLEEP_S) -> None:
+    _registry.arm(name, action, times, delay_s)
 
 
 def configure_from_env() -> bool:

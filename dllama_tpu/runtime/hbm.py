@@ -120,6 +120,73 @@ def estimate_device_bytes(cfg, *, weight_repr: str, kv_dtype_bytes: int,
             "need_per_device": need}
 
 
+def fit_batch_slots(cfg, n_slots: int, *, weight_repr: str,
+                    kv_dtype_bytes: int, n_shards: int = 1, dp: int = 1,
+                    offload: bool = False) -> tuple[int, dict]:
+    """Largest slot-pool size ``<= n_slots`` (stepping by ``dp`` so the
+    dp-sharded batch axis stays divisible) whose estimate fits the device
+    limit — the HBM admission guard's DEGRADE path: a pool that would OOM
+    shrinks instead of crashing the process at staging time. Returns
+    ``(n_fit, estimate)``; ``n_fit == 0`` when even a ``dp``-slot pool
+    doesn't fit (the caller refuses, same as before)."""
+    limit = (None if os.environ.get("DLLAMA_SKIP_HBM_CHECK")
+             else device_memory_bytes())
+    n = max(dp, (n_slots // dp) * dp)
+    while n >= dp:
+        # +1: the engine's batch-1 cache stays allocated alongside the pool
+        est = estimate_device_bytes(
+            cfg, weight_repr=weight_repr, kv_dtype_bytes=kv_dtype_bytes,
+            batch=n // dp + 1, n_shards=n_shards, offload=offload)
+        if limit is None or est["need_per_device"] <= limit:
+            return n, est
+        n -= dp
+    return 0, est
+
+
+def estimate_prefill_temp_bytes(cfg, tokens: int) -> int:
+    """Coarse XLA-temporary estimate for a ``tokens``-wide prefill chunk
+    the engine has NOT compiled yet: per-layer activations (residual
+    stream, QKV, FFN hidden) plus the logits row block, all f32. Like the
+    rest of this module it aims at catching the 2x misfits, not byte
+    accounting — once the program compiles, the measured
+    ``memory_analysis()`` bytes supersede it (admission_check)."""
+    act = tokens * (3 * cfg.dim + 2 * cfg.hidden_dim + cfg.q_dim
+                    + 2 * cfg.kv_dim)
+    return int((act + tokens * cfg.vocab_size) * 4)
+
+
+def admission_check(*, need_bytes: int, measured_bytes: dict[str, int],
+                    extra_bytes: int, what: str) -> tuple[bool, str]:
+    """The HBM admission guard's verdict for one would-be admission:
+    ``need_bytes`` (the staging-time shape-algebra estimate) is
+    cross-checked against the compile ledger's measured per-program bytes
+    (the estimate can only be RAISED by evidence, never lowered), plus
+    ``extra_bytes`` for programs the admission would compile fresh.
+    Returns ``(ok, reason)``; always ok when the device limit is unknown
+    or ``DLLAMA_SKIP_HBM_CHECK`` is set."""
+    if os.environ.get("DLLAMA_SKIP_HBM_CHECK"):
+        return True, ""
+    limit = device_memory_bytes()
+    if limit is None:
+        return True, ""
+    measured_peak = max(measured_bytes.values(), default=0)
+    need = max(need_bytes, measured_peak) + extra_bytes
+    if need <= limit:
+        return True, ""
+    gb = 1024 ** 3
+    src = ("measured per-program bytes"
+           if measured_peak > need_bytes else "estimate")
+    return False, (
+        f"HBM admission guard: {what} needs ~{need / gb:.2f} GB per device "
+        f"({src}"
+        + (f" + ~{extra_bytes / gb:.2f} GB for an uncompiled program"
+           if extra_bytes else "")
+        + f") but the device reports {limit / gb:.2f} GB — refusing the "
+        f"admission instead of risking an XLA OOM that can wedge the "
+        f"backend (shrink the prompt, lower --batch-slots/--max-seq-len, "
+        f"or set DLLAMA_SKIP_HBM_CHECK=1)")
+
+
 def check_budget(need_per_device: int, what: str) -> int | None:
     """Raise a clean, actionable error when the estimate exceeds the device
     limit. Returns the limit (None = unknown, check skipped). Bypass with

@@ -209,6 +209,22 @@ class CompileLedger:
         with self._lock:
             self._steady[scope] = True
 
+    def measured_hbm_bytes(self, scope: str) -> dict[str, int]:
+        """Measured per-program device bytes (argument + temp + output,
+        from ``memory_analysis()``) for every analyzed program in
+        ``scope`` — the HBM admission guard's cross-check against the
+        shape-algebra estimate. Empty when nothing was analyzed (analyze
+        off, or the backend has no memory_analysis)."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for (sc, program), entry in self._programs.items():
+                if sc != scope:
+                    continue
+                total = (entry["analysis"] or {}).get("hbm_total_bytes", 0)
+                if total:
+                    out[program] = int(total)
+        return out
+
     # -- miss/hit recording (ObservedJit) ------------------------------------
 
     def record(self, entry: dict, compile_s: float, signature: dict,

@@ -78,6 +78,40 @@ class RequestTimeoutError(SchedulerError):
     request with ``finish_reason="timeout"`` and partial output."""
 
 
+class HbmAdmissionError(SchedulerError):
+    """The HBM admission guard refused the request: estimated + measured
+    per-device bytes would exceed the HBM limit (HTTP 503 with the
+    reason; ``dllama_hbm_admission_rejects_total``)."""
+
+
+def check_hbm_admission(engine, n_prompt: int, need_bytes: int) -> None:
+    """HBM admission guard, shared by the batch scheduler's ``submit`` and
+    the single-sequence API path: before admitting a prompt, cross-check
+    the staging-time estimate against the compile ledger's measured
+    per-program bytes (PR 3's ``memory_analysis()`` data), plus a
+    workspace estimate for any prefill bucket the engine has not
+    dispatched yet — a fresh program means fresh XLA temporaries, which is
+    exactly where an over-budget admission would OOM the process. Raises
+    :class:`HbmAdmissionError` instead of letting that happen; a no-op
+    when the device limit is unknown or ``DLLAMA_SKIP_HBM_CHECK`` is
+    set."""
+    from . import introspection
+    from .hbm import admission_check, estimate_prefill_temp_bytes
+
+    scope = getattr(engine, "introspection_scope", None)
+    measured = (introspection.ledger().measured_hbm_bytes(scope)
+                if scope else {})
+    bucket = engine._prefill_chunk_size(max(1, n_prompt - 1))
+    extra = (0 if bucket in engine.seen_buckets
+             else estimate_prefill_temp_bytes(engine.cfg, bucket))
+    ok, reason = admission_check(
+        need_bytes=need_bytes, measured_bytes=measured, extra_bytes=extra,
+        what=f"admitting a {n_prompt}-token request")
+    if not ok:
+        telemetry.registry().counter(telemetry.HBM_ADMISSION_REJECTS).inc()
+        raise HbmAdmissionError(reason)
+
+
 def _replicated_ragged_step(params, cfg, tokens, pos, kv, temps, topps, coins):
     """Ragged sampled step with replicated picked tokens (multihost: every
     process reads the same [B] vector on host)."""
@@ -179,9 +213,53 @@ class BatchedGenerator:
         if engine.multihost and not engine._is_root and not _mirror:
             raise ValueError("on worker processes batched serving runs via "
                              "worker_serve's mirror, not directly")
+        # the engine's admission-time HBM check budgeted a batch-1 KV; the
+        # slot pool multiplies that by n_slots, so re-check before
+        # allocating (runtime.hbm — a staging OOM can wedge the TPU
+        # backend for hours). The check now DEGRADES instead of refusing:
+        # the largest dp-divisible pool that fits serves (with a loud
+        # warning), and only a pool where even dp slots don't fit still
+        # raises. KV per device: the slot pool is dp-sharded, so a device
+        # holds n_slots/dp columns — plus ONE more for the engine's
+        # still-resident batch-1 cache; weights and the layer-stacked KV
+        # shard over tp×pp (same n_shards as the engine's load-time
+        # check; dp replicates weights). Computed BEFORE the worker
+        # broadcast so every process builds the same (possibly degraded)
+        # pool; worker mirrors take the packet's count as-is.
+        from .hbm import check_budget, estimate_device_bytes, fit_batch_slots
+
+        dp = max(1, getattr(engine, "dp", 1))
+        if _mirror:
+            # a mirror takes the packet's (possibly root-degraded) slot
+            # count as-is — degrading independently would desync the
+            # replay — but still refuses a pool ITS device can't hold
+            est = estimate_device_bytes(
+                engine.cfg,
+                weight_repr=getattr(engine, "hbm_weight_repr", "q40"),
+                kv_dtype_bytes=engine.kv_dtype.itemsize,
+                batch=n_slots // dp + 1, n_shards=engine.tp * engine.pp,
+                offload=(engine.weight_mode == "offload"))
+            check_budget(est["need_per_device"],
+                         f"batched serving ({n_slots} slots)")
+        else:
+            n_fit, est = fit_batch_slots(
+                engine.cfg, n_slots,
+                weight_repr=getattr(engine, "hbm_weight_repr", "q40"),
+                kv_dtype_bytes=engine.kv_dtype.itemsize,
+                n_shards=engine.tp * engine.pp, dp=dp,
+                offload=(engine.weight_mode == "offload"))
+            if n_fit == 0:
+                check_budget(est["need_per_device"],
+                             f"batched serving ({n_slots} slots)")
+            if n_fit < n_slots:
+                print(f"⚠️ HBM admission guard: --batch-slots {n_slots} "
+                      f"does not fit the device budget — degrading to "
+                      f"{n_fit} slots instead of risking an OOM "
+                      f"(runtime/hbm.py)", flush=True)
+                n_slots = n_fit
         self._root_bcast = engine.multihost and engine._is_root
         if self._root_bcast:
-            # FIRST thing, before any device work: the slot-pool KV below is
+            # FIRST thing before any device work: the slot-pool KV below is
             # device_put onto a sharding that spans every process, which
             # blocks until all processes participate — the worker must be
             # building its mirror generator concurrently, not still waiting
@@ -191,25 +269,9 @@ class BatchedGenerator:
         self.eng = engine
         self.cfg = engine.cfg
         self.n_slots = n_slots
-        # the engine's admission-time HBM check budgeted a batch-1 KV; the
-        # slot pool multiplies that by n_slots, so re-check before allocating
-        # (runtime.hbm — a staging OOM can wedge the TPU backend for hours)
-        from .hbm import check_budget, estimate_device_bytes
-
-        # KV per device: the slot pool is dp-sharded (enforced above), so a
-        # device holds n_slots/dp columns — plus ONE more for the engine's
-        # still-resident batch-1 cache (engine.kv stays allocated alongside
-        # the pool); weights and the layer-stacked KV shard over tp×pp
-        # (same n_shards the engine's own load-time check uses; dp
-        # replicates weights)
-        est = estimate_device_bytes(
-            self.cfg, weight_repr=getattr(engine, "hbm_weight_repr", "q40"),
-            kv_dtype_bytes=engine.kv_dtype.itemsize,
-            batch=n_slots // max(1, getattr(engine, "dp", 1)) + 1,
-            n_shards=engine.tp * engine.pp,
-            offload=(engine.weight_mode == "offload"))
-        check_budget(est["need_per_device"],
-                     f"batched serving ({n_slots} slots)")
+        # the staging-time pool estimate the submit-time admission guard
+        # cross-checks against measured per-program bytes
+        self.hbm_need = est["need_per_device"]
         kv = KVCache.create(self.cfg, batch_size=n_slots,
                             dtype=engine.kv_dtype)
         if engine.plan is not None:
@@ -324,48 +386,56 @@ class BatchedGenerator:
         return self._take(self.kv, src)
 
     def _exec_prefill(self, col, padded, pos: int):
-        with self._plan_ctx():
-            _, col = self._prefill_fwd(
-                self.eng.params, self.cfg,
-                jnp.asarray(np.asarray(padded).reshape(1, -1), jnp.int32),
-                jnp.int32(pos), col)
-        return col
+        with self.eng.watchdog.guard("batch_prefill"):
+            failpoints.fire("step_hang")
+            with self._plan_ctx():
+                _, col = self._prefill_fwd(
+                    self.eng.params, self.cfg,
+                    jnp.asarray(np.asarray(padded).reshape(1, -1), jnp.int32),
+                    jnp.int32(pos), col)
+            return col
 
     def _exec_commit(self, slot: int, col) -> None:
         self.kv = self._put(self.kv, col, slot)
 
     def _exec_step(self, tokens, pos, temps, topps, coins):
-        with self._plan_ctx():
-            nxt, self.kv = self._step(
-                self.eng.params, self.cfg,
-                jnp.asarray(np.asarray(tokens, np.int32)[:, None]),
-                jnp.asarray(np.asarray(pos, np.int32)), self.kv,
-                jnp.asarray(np.asarray(temps, np.float32)),
-                jnp.asarray(np.asarray(topps, np.float32)),
-                jnp.asarray(np.asarray(coins, np.float32)))
-        return np.asarray(nxt)
+        with self.eng.watchdog.guard("batch_step"):
+            failpoints.fire("step_hang")
+            with self._plan_ctx():
+                nxt, self.kv = self._step(
+                    self.eng.params, self.cfg,
+                    jnp.asarray(np.asarray(tokens, np.int32)[:, None]),
+                    jnp.asarray(np.asarray(pos, np.int32)), self.kv,
+                    jnp.asarray(np.asarray(temps, np.float32)),
+                    jnp.asarray(np.asarray(topps, np.float32)),
+                    jnp.asarray(np.asarray(coins, np.float32)))
+            return np.asarray(nxt)
 
     def _exec_step_chunk(self, tokens, pos, temps, topps, coins, k: int):
-        with self._plan_ctx():
-            toks, self.kv = self._steps(
-                self.eng.params, self.cfg,
-                jnp.asarray(np.asarray(tokens, np.int32)),
-                jnp.asarray(np.asarray(pos, np.int32)), self.kv,
-                jnp.asarray(np.asarray(temps, np.float32)),
-                jnp.asarray(np.asarray(topps, np.float32)),
-                jnp.asarray(np.asarray(coins, np.float32)), k)
-        return np.asarray(toks)  # [B, k]
+        with self.eng.watchdog.guard("batch_chunk"):
+            failpoints.fire("step_hang")
+            with self._plan_ctx():
+                toks, self.kv = self._steps(
+                    self.eng.params, self.cfg,
+                    jnp.asarray(np.asarray(tokens, np.int32)),
+                    jnp.asarray(np.asarray(pos, np.int32)), self.kv,
+                    jnp.asarray(np.asarray(temps, np.float32)),
+                    jnp.asarray(np.asarray(topps, np.float32)),
+                    jnp.asarray(np.asarray(coins, np.float32)), k)
+            return np.asarray(toks)  # [B, k]
 
     def _exec_verify(self, toks_2d, pos, temps, topps, coins):
-        with self._plan_ctx():
-            n_acc, preds, self.kv = self._verify(
-                self.eng.params, self.cfg,
-                jnp.asarray(np.asarray(toks_2d, np.int32)),
-                jnp.asarray(np.asarray(pos, np.int32)), self.kv,
-                jnp.asarray(np.asarray(temps, np.float32)),
-                jnp.asarray(np.asarray(topps, np.float32)),
-                jnp.asarray(np.asarray(coins, np.float32)))
-        return np.asarray(n_acc), np.asarray(preds)
+        with self.eng.watchdog.guard("batch_verify"):
+            failpoints.fire("step_hang")
+            with self._plan_ctx():
+                n_acc, preds, self.kv = self._verify(
+                    self.eng.params, self.cfg,
+                    jnp.asarray(np.asarray(toks_2d, np.int32)),
+                    jnp.asarray(np.asarray(pos, np.int32)), self.kv,
+                    jnp.asarray(np.asarray(temps, np.float32)),
+                    jnp.asarray(np.asarray(topps, np.float32)),
+                    jnp.asarray(np.asarray(coins, np.float32)))
+            return np.asarray(n_acc), np.asarray(preds)
 
     # -- slot lifecycle -----------------------------------------------------
 
@@ -442,6 +512,7 @@ class BatchedGenerator:
             padded = chunk + [0] * (pad_to - len(chunk))
             self._bcast(CTRL_SRV_PREFILL, adm.slot, [adm.pos] + padded)
             adm.col = self._exec_prefill(adm.col, padded, adm.pos)
+            self.eng.seen_buckets.add(len(padded))  # the DISPATCHED width
             adm.pos += len(chunk)
             if adm.pos < len(rest):
                 return False
@@ -725,7 +796,7 @@ class BatchScheduler:
                  max_queue: int = 0, max_restarts: int = 3,
                  _start_thread: bool = True):
         self.gen = BatchedGenerator(engine, n_slots)
-        self.n_slots = n_slots
+        self.n_slots = self.gen.n_slots  # may be HBM-degraded below n_slots
         self.max_queue = max_queue
         self.max_restarts = max_restarts
         self._queue: list[Request] = []
@@ -743,6 +814,12 @@ class BatchScheduler:
         # an unexpected retrace (WARNed + dllama_retrace_unexpected_total)
         self._introspect_scope = getattr(engine, "introspection_scope", None)
         self._quiet_ticks = 0
+        # step watchdog (runtime.watchdog): a wedged dispatch blocks the
+        # loop thread inside step(), so supervision can't run there — the
+        # watchdog's monitor thread calls _on_stall instead
+        self._watchdog = getattr(engine, "watchdog", None)
+        if self._watchdog is not None:
+            self._watchdog.on_stall.append(self._on_stall)
         self._thread: threading.Thread | None = None
         if _start_thread:
             self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -765,6 +842,11 @@ class BatchScheduler:
                 raise QueueFullError(
                     f"queue full ({len(self._queue)} waiting, "
                     f"--max-queue {self.max_queue}); retry later")
+            # HBM admission guard: refuse a request that would push the
+            # device past its limit (measured-bytes cross-check +
+            # uncompiled-bucket workspace) instead of OOM-crashing later
+            check_hbm_admission(self.gen.eng, len(prompt_ids),
+                                self.gen.hbm_need)
             rid = self._next_rid
             self._next_rid += 1
             req = Request(rid=rid, prompt_ids=list(prompt_ids),
@@ -793,7 +875,9 @@ class BatchScheduler:
 
     def readiness(self) -> tuple[bool, str]:
         """(ready, reason) for ``GET /readyz``: scheduler alive ∧ not
-        draining ∧ queue below the shed threshold."""
+        draining ∧ queue below the shed threshold ∧ no watchdog stall."""
+        if self._watchdog is not None and self._watchdog.stalled:
+            return False, "step watchdog tripped (wedged device dispatch)"
         if not self._healthy:
             return False, "scheduler crashed (restart budget exhausted)"
         if self._thread is not None and not self._thread.is_alive():
@@ -891,6 +975,24 @@ class BatchScheduler:
                     and not s.timed_out:
                 self._timeout_request(s)
                 s.cancel.set()
+
+    def _on_stall(self, info: dict) -> None:
+        """Watchdog trip (runs on the MONITOR thread — the loop thread is
+        the one wedged inside a dispatch, so it cannot supervise itself):
+        flip unready first, under the lock, so no submit slips in after
+        the fail sweep; then fail every queued/admitting/in-flight
+        request explicitly (their handlers get 503s, never a hang). The
+        stall is permanent — even if the dispatch eventually returns, the
+        device just proved it can wedge, and restarting the pool on top
+        of a possibly half-executed program is exactly the implicit
+        failure mode this PR removes."""
+        with self._lock:
+            self._healthy = False
+            self._stop = True
+        self._fail_all(
+            f"step watchdog: device dispatch {info.get('label')!r} stalled "
+            f"past its {info.get('budget_s') or 0:.1f}s budget")
+        self._wake.set()
 
     def _on_crash(self, exc: BaseException) -> None:
         """Supervision: surface the crash to every pending request, then

@@ -73,6 +73,11 @@ SCHEDULER_CRASHES = "dllama_scheduler_crashes_total"
 SCHEDULER_RESTARTS = "dllama_scheduler_restarts_total"
 SERVER_DRAINING = "dllama_server_draining"
 FAILPOINTS_FIRED = "dllama_failpoints_fired_total"
+# runtime hardening (runtime/weights.py, runtime/watchdog.py, runtime/hbm.py)
+WEIGHT_IO_RETRIES = "dllama_weight_io_retries_total"
+LOAD_CORRUPTION = "dllama_load_corruption_total"
+WATCHDOG_STALLS = "dllama_watchdog_stalls_total"
+HBM_ADMISSION_REJECTS = "dllama_hbm_admission_rejects_total"
 
 # HTTP layer (serve/api.py)
 HTTP_REQUESTS = "dllama_http_requests_total"
@@ -174,6 +179,20 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "admissions), else 0"),
     _spec(FAILPOINTS_FIRED, "counter",
           "Fault-injection failpoint fires by name (runtime/failpoints)"),
+    _spec(WEIGHT_IO_RETRIES, "counter",
+          "Transient weight-read failures retried by the streaming loader "
+          "(bounded backoff; exhaustion fails the load atomically)"),
+    _spec(LOAD_CORRUPTION, "counter",
+          "Weight tensors whose bytes failed checksum verification against "
+          "the .m.sums manifest (each one fails the load, naming the "
+          "tensor)"),
+    _spec(WATCHDOG_STALLS, "counter",
+          "Step-watchdog deadline expiries: a device dispatch exceeded the "
+          "EWMA-derived budget (engine marked unhealthy, in-flight "
+          "requests failed)"),
+    _spec(HBM_ADMISSION_REJECTS, "counter",
+          "Admissions rejected by the HBM admission guard (estimated + "
+          "measured per-program bytes would exceed the device limit)"),
     _spec(COMPILE_TOTAL, "counter",
           "XLA trace+compile events by program and engine scope "
           "(runtime/introspection ledger)"),

@@ -31,6 +31,7 @@ of these same slice readers — see PARITY.md.
 from __future__ import annotations
 
 import os
+import time
 from typing import TYPE_CHECKING, Callable
 
 import jax
@@ -41,10 +42,139 @@ from ..formats.mfile import ArchType, ModelFile
 from ..formats.quants import Q40, Q80, QUANT_BLOCK_SIZE
 from ..ops.linear import QuantizedWeight
 from ..parallel.api import MeshPlan, make_tp_mesh
+from . import failpoints, telemetry
 
 if TYPE_CHECKING:
     from ..models.config import ModelConfig
     from ..models.llama import Params
+
+
+class WeightIntegrityError(RuntimeError):
+    """A weight tensor's bytes do not match the checksum manifest. The
+    message names the exact tensor — NOT retryable (the bytes are wrong,
+    not the read)."""
+
+
+class WeightLoadError(RuntimeError):
+    """A weight read kept failing past the bounded retry budget."""
+
+
+class ResilientReader:
+    """Integrity + transient-retry layer over :class:`ModelFile` reads —
+    the read-callback hardening the streaming loader threads every tensor
+    access through:
+
+    * **checksum verification** — when the model carries a ``.m.sums``
+      manifest, each tensor's full on-disk bytes are crc32-verified ONCE,
+      before its first slice is decoded; a mismatch raises
+      :class:`WeightIntegrityError` naming the tensor (and counts
+      ``dllama_load_corruption_total``). Verification is per tensor, not
+      per slice: slices don't have manifest entries, and one sequential
+      crc pass over pages the shard reads were about to touch anyway is
+      the cheapest point with an exact blame label.
+    * **bounded retry** — an ``OSError`` out of a read (NFS flake, EIO on
+      a cold page, the armed ``load_read`` failpoint) is retried up to
+      ``max_retries`` times with doubling backoff
+      (``dllama_weight_io_retries_total``); exhaustion raises
+      :class:`WeightLoadError` carrying the original error, which names
+      the failing site. Non-OSError failures propagate immediately —
+      corrupt bytes and injected hard failures are not transient.
+
+    Either terminal error propagates out of ``load_params`` → the engine
+    constructor, whose teardown guarantees the failure is atomic (no
+    half-initialized engine)."""
+
+    def __init__(self, mf: ModelFile, *, max_retries: int = 3,
+                 backoff_s: float = 0.05):
+        self.mf = mf
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self._verified: set[str] = set()
+
+    def _verify(self, key: str) -> None:
+        sums = self.mf.checksums
+        if sums is None or key in self._verified:
+            return
+        want = sums.get(key)
+        if want is None:
+            raise WeightIntegrityError(
+                f"weight tensor {key!r} has no entry in the checksum "
+                f"manifest ({self.mf.path}.sums) — the manifest does not "
+                f"belong to this file; regenerate it or delete it to "
+                f"load unverified")
+        got = self.mf.tensor_crc32(key)
+        if got != want:
+            telemetry.registry().counter(telemetry.LOAD_CORRUPTION).inc()
+            raise WeightIntegrityError(
+                f"weight tensor {key!r} is corrupt: crc32 {got:#010x} != "
+                f"manifest {want:#010x} ({self.mf.path}) — the file is "
+                f"damaged; re-download or reconvert it")
+        self._verified.add(key)
+
+    def _read(self, key: str, fn: Callable, *args):
+        delay = self.backoff_s
+        attempt = 0
+        while True:
+            try:
+                failpoints.fire("load_read")
+                self._verify(key)
+                return fn(key, *args)
+            except OSError as e:
+                if attempt >= self.max_retries:
+                    raise WeightLoadError(
+                        f"reading weight tensor {key!r} failed after "
+                        f"{attempt} retries: {type(e).__name__}: {e}"
+                    ) from e
+                attempt += 1
+                telemetry.registry().counter(
+                    telemetry.WEIGHT_IO_RETRIES).inc()
+                time.sleep(delay)
+                delay *= 2
+
+    # the ModelFile read surface the streaming loader uses, each routed
+    # through the verify+retry guard
+    def tensor_f32(self, key):
+        return self._read(key, self.mf.tensor_f32)
+
+    def tensor_f32_rows(self, key, lo, hi):
+        return self._read(key, self.mf.tensor_f32_rows, lo, hi)
+
+    def tensor_q40_kmajor_sub(self, key, out_lo, out_hi, in_lo, in_hi):
+        return self._read(key, self.mf.tensor_q40_kmajor_sub,
+                          out_lo, out_hi, in_lo, in_hi)
+
+    def tensor_q80_kmajor_sub(self, key, out_lo, out_hi, in_lo, in_hi):
+        return self._read(key, self.mf.tensor_q80_kmajor_sub,
+                          out_lo, out_hi, in_lo, in_hi)
+
+    def tensor_scales_kmajor_sub(self, key, out_lo, out_hi, in_lo, in_hi):
+        return self._read(key, self.mf.tensor_scales_kmajor_sub,
+                          out_lo, out_hi, in_lo, in_hi)
+
+
+def verify_weights(mf: ModelFile, emit=None) -> dict:
+    """Offline full-file verification (``python -m dllama_tpu verify``,
+    ``--verify-weights``): crc-check every tensor against the manifest.
+    Returns ``{"tensors": n, "corrupt": [keys...]}``; raises
+    :class:`WeightIntegrityError` when the model has no manifest."""
+    if mf.checksums is None:
+        raise WeightIntegrityError(
+            f"{mf.path} has no checksum manifest ({mf.path}.sums) — "
+            f"generate one with: python -m dllama_tpu verify --model "
+            f"{mf.path} --write")
+    corrupt: list[str] = []
+    for key in mf.tensors:
+        want = mf.checksums.get(key)
+        got = mf.tensor_crc32(key)
+        ok = want is not None and got == want
+        if not ok:
+            corrupt.append(key)
+            telemetry.registry().counter(telemetry.LOAD_CORRUPTION).inc()
+        if emit is not None:
+            emit(f"{'✅' if ok else '❌'} {key}: crc32 {got:#010x}"
+                 + ("" if ok else f" != manifest "
+                    f"{'-' if want is None else format(want, '#010x')}"))
+    return {"tensors": len(mf.tensors), "corrupt": corrupt}
 
 
 def _bounds(sl: slice, dim: int) -> tuple[int, int]:
@@ -119,6 +249,10 @@ class _StreamingLoader:
     def __init__(self, mf: ModelFile, cfg: "ModelConfig", plan: MeshPlan | None,
                  weight_mode: str):
         self.mf = mf
+        # every tensor read goes through the verify+retry guard; tensors
+        # are crc-checked against the .m.sums manifest (when present)
+        # before their first slice is decoded
+        self.rd = ResilientReader(mf)
         self.cfg = cfg
         self.h = mf.header
         # a trivial 1-device mesh gives single-chip loads the same code path
@@ -187,15 +321,21 @@ class _StreamingLoader:
                 n_lo, n_hi = _bounds(n_sl, out_dim)
                 k_lo, k_hi, k_al, k_ah = _quant_k_bounds(
                     k_sl, in_dim, want_scales)
-                sub = (self.mf.tensor_q40_kmajor_sub
+                sub = (self.rd.tensor_q40_kmajor_sub
                        if self.h.weight_type == Q40
-                       else self.mf.tensor_q80_kmajor_sub)
+                       else self.rd.tensor_q80_kmajor_sub)
                 out = None
                 for i, l in enumerate(layers):
                     k = key(l) if l is not None else name
-                    scales, codes = sub(k, n_lo, n_hi, k_al, k_ah)
-                    part = (scales if want_scales
-                            else codes[k_lo - k_al:k_hi - k_al])
+                    if want_scales:
+                        # scales-only reader: keeps this callback's host
+                        # allocation ~the scales slice instead of also
+                        # decoding the 16x larger codes plane it discards
+                        part = self.rd.tensor_scales_kmajor_sub(
+                            k, n_lo, n_hi, k_al, k_ah)
+                    else:
+                        _, codes = sub(k, n_lo, n_hi, k_al, k_ah)
+                        part = codes[k_lo - k_al:k_hi - k_al]
                     if not stacked:
                         return part
                     if out is None:  # fill in place: peak = slice + 1 layer
@@ -223,7 +363,7 @@ class _StreamingLoader:
                 o_sl, i_sl = idx
                 layers = [None]
             o_lo, o_hi = _bounds(o_sl, out_dim)
-            parts = [self.mf.tensor_f32_rows(key(l) if l is not None else name,
+            parts = [self.rd.tensor_f32_rows(key(l) if l is not None else name,
                                              o_lo, o_hi)[:, i_sl]
                      for l in layers]
             return np.stack(parts) if stacked else parts[0]
@@ -240,14 +380,14 @@ class _StreamingLoader:
         def read(idx):
             layers = _layer_range(idx[0], L)
             return np.stack([
-                self.mf.tensor_f32(f"{name}.{l}") for l in layers])
+                self.rd.tensor_f32(f"{name}.{l}") for l in layers])
 
         return _make(shape, jnp.float32, sh, read)
 
     def f32(self, name: str, *shape: int, dtype=jnp.float32) -> jax.Array:
         sh = self.plan.sharding_for(tuple(shape), *([None] * len(shape)))
         return _make(tuple(shape), dtype, sh,
-                     lambda idx: self.mf.tensor_f32(name)[idx])
+                     lambda idx: self.rd.tensor_f32(name)[idx])
 
     def expert_stack(self, name: str, out_dim: int, in_dim: int,
                      out_axis: str | None, in_axis: str | None):
@@ -269,8 +409,8 @@ class _StreamingLoader:
                                   in_axis, out_axis)
             s_sh = self._sharding(sshape, "layers", "experts",
                                   in_axis, out_axis)
-            sub = (self.mf.tensor_q40_kmajor_sub if self.h.weight_type == Q40
-                   else self.mf.tensor_q80_kmajor_sub)
+            sub = (self.rd.tensor_q40_kmajor_sub if self.h.weight_type == Q40
+                   else self.rd.tensor_q80_kmajor_sub)
 
             def read_q(idx, want_scales: bool):
                 l_sl, e_sl, k_sl, n_sl = idx
@@ -282,10 +422,13 @@ class _StreamingLoader:
                 out = None
                 for li, l in enumerate(layers):
                     for ei, e in enumerate(experts):
-                        scales, codes = sub(f"{name}.{l}.{e}",
-                                            n_lo, n_hi, k_al, k_ah)
-                        part = (scales if want_scales
-                                else codes[k_lo - k_al:k_hi - k_al])
+                        if want_scales:
+                            part = self.rd.tensor_scales_kmajor_sub(
+                                f"{name}.{l}.{e}", n_lo, n_hi, k_al, k_ah)
+                        else:
+                            _, codes = sub(f"{name}.{l}.{e}",
+                                           n_lo, n_hi, k_al, k_ah)
+                            part = codes[k_lo - k_al:k_hi - k_al]
                         if out is None:  # fill in place, one slice at a time
                             out = np.empty(
                                 (len(layers), len(experts)) + part.shape,
@@ -312,7 +455,7 @@ class _StreamingLoader:
             out = None
             for li, l in enumerate(_layer_range(l_sl, L)):
                 for ei, e in enumerate(_layer_range(e_sl, E)):
-                    part = self.mf.tensor_f32_rows(
+                    part = self.rd.tensor_f32_rows(
                         f"{name}.{l}.{e}", o_lo, o_hi)[:, i_sl].T  # -> [in, out]
                     if out is None:
                         out = np.empty(

@@ -35,8 +35,10 @@ from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
 from ..runtime import failpoints, introspection, profiling, telemetry
 from ..runtime.engine import InferenceEngine
-from ..runtime.serving import (QueueFullError, RequestTimeoutError,
-                               SchedulerUnavailableError)
+from ..runtime.serving import (HbmAdmissionError, QueueFullError,
+                               RequestTimeoutError,
+                               SchedulerUnavailableError,
+                               check_hbm_admission)
 from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
                               ChatTemplateType, EosDetector, EosResult)
 
@@ -222,8 +224,11 @@ class ApiState:
         self._rid = 0  # request counter for trace spans (single-threaded)
 
     def readiness(self) -> tuple[bool, str]:
-        """Single-sequence mode has no queue or supervisor: ready iff
-        the engine exists (liveness == readiness)."""
+        """Single-sequence mode has no queue or supervisor, but the step
+        watchdog still applies: a wedged dispatch must flip /readyz."""
+        wd = getattr(self.engine, "watchdog", None)
+        if wd is not None and wd.stalled:
+            return False, "step watchdog tripped (wedged device dispatch)"
         return True, "ok"
 
     def complete(self, body: dict, emit=None) -> dict:
@@ -267,6 +272,10 @@ class ApiState:
         prompt = self.template.generate(items, append_generation_prompt=True)
         ids = tok.encode(prompt.content, is_start=start_pos == 0,
                          add_special_tokens=True)
+        # HBM admission guard (single-sequence twin of the scheduler's
+        # submit-time check): refuse before prefill, not via an XLA OOM
+        check_hbm_admission(engine, len(ids),
+                            engine.hbm_estimate["need_per_device"])
 
         prompt_end = min(start_pos + len(ids) - 1, engine.cfg.seq_len)
         max_pred = min(engine.cfg.seq_len,
@@ -719,8 +728,11 @@ def make_handler(state: ApiState):
                                headers={"Retry-After": "1"})
                 else:
                     stream_abort("error")
-            except SchedulerUnavailableError as e:
-                status = 503  # draining or crashed-unready
+            except (SchedulerUnavailableError, HbmAdmissionError) as e:
+                # draining, crashed-unready, watchdog-stalled, or the HBM
+                # admission guard refused the request — all 503-shaped:
+                # the server cannot take this work right now
+                status = 503
                 if not headers_sent:
                     self._json(503, {"error": str(e)},
                                headers={"Retry-After": "5"})
@@ -794,7 +806,9 @@ def run_api_server(args) -> int:
             request_timeout=request_timeout)
         server = ThreadingHTTPServer((args.host, args.port),
                                      make_handler(state))
-        print(f"🕸️ continuous batching: {n_slots} slots"
+        print(f"🕸️ continuous batching: {state.sched.n_slots} slots"
+              + (f" (HBM-degraded from {n_slots})"
+                 if state.sched.n_slots != n_slots else "")
               + (f", queue bound {max_queue} (429 beyond)" if max_queue
                  else ""))
         if engine.spec_lookup:

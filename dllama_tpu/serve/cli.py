@@ -33,9 +33,21 @@ from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllama_tpu",
                                 description="TPU-native distributed-llama")
-    p.add_argument("mode", choices=["inference", "chat", "perplexity", "api", "worker"])
+    p.add_argument("mode", choices=["inference", "chat", "perplexity", "api",
+                                    "worker", "verify"])
     p.add_argument("--model", required=False, help=".m model file")
     p.add_argument("--tokenizer", required=False, help=".t tokenizer file")
+    p.add_argument("--verify-weights", action="store_true",
+                   help="crc-verify every weight tensor against the .m.sums "
+                        "checksum manifest before any device staging (the "
+                        "loader always verifies tensors it reads when a "
+                        "manifest exists; this forces the full offline "
+                        "sweep first). See also the 'verify' mode")
+    p.add_argument("--write", action="store_true",
+                   help="verify mode: (re)generate the .m.sums checksum "
+                        "manifest for --model instead of checking it — the "
+                        "migration path for models converted before "
+                        "manifests existed")
     p.add_argument("--prompt", default=None)
     p.add_argument("--file", default=None, help="text file (perplexity mode)")
     p.add_argument("--steps", type=int, default=0, help="max total positions")
@@ -376,6 +388,7 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         spec_lookup=getattr(args, "spec_lookup", 0),
         kv_dtype=getattr(args, "kv_dtype", "auto"),
         profile_split=getattr(args, "profile_split", False),
+        verify_weights=getattr(args, "verify_weights", False),
     )
     h = engine.model_file.header
     print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "
@@ -515,6 +528,43 @@ def run_chat(args) -> int:
             sys.stdout.flush()
         print()
     engine.close()
+    return 0
+
+
+def run_verify(args) -> int:
+    """``python -m dllama_tpu verify --model m.m [--write]`` — offline
+    weight-integrity check (or manifest generation with ``--write``)
+    against the .m.sums sidecar. Pure host-side: no jax, no device."""
+    from ..formats import mfile as _mfile
+    from ..runtime.weights import WeightIntegrityError, verify_weights
+
+    if not args.model:
+        raise SystemExit("--model is required for verify mode")
+    try:
+        if args.write:
+            out = _mfile.write_manifest(args.model)
+            with _mfile.ModelFile.open(args.model) as mf:
+                n = len(mf.tensors)
+            print(f"🔏 checksum manifest written: {out} ({n} tensors)")
+            return 0
+        with _mfile.ModelFile.open(args.model) as mf:
+            try:
+                res = verify_weights(mf, emit=print)
+            except WeightIntegrityError as e:
+                print(f"❌ {e}")
+                return 2
+    except (OSError, ValueError) as e:
+        # structurally broken file (bad magic, truncation, stale manifest):
+        # a clean diagnostic, not a traceback — this tool's whole job is
+        # reporting damage
+        print(f"❌ {args.model}: {e}")
+        return 1
+    if res["corrupt"]:
+        print(f"❌ {len(res['corrupt'])} of {res['tensors']} tensors "
+              f"corrupt: {', '.join(res['corrupt'])}")
+        return 1
+    print(f"✅ {res['tensors']} tensors verified against "
+          f"{_mfile.manifest_path(args.model)}")
     return 0
 
 
@@ -729,6 +779,9 @@ def main(argv=None) -> int:
     # programmatic argv (tests call cli.main([...])), not the host process's
     args._argv = list(argv) if argv is not None else sys.argv[1:]
     args._multihost = False
+    if args.mode == "verify":
+        # pure host-side integrity check: no jax backend, no compile cache
+        return run_verify(args)
     _setup_compile_cache(args)
     if args.mode != "worker":
         # Honor an explicit JAX_PLATFORMS (e.g. the virtual CPU mesh:

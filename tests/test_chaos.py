@@ -18,12 +18,14 @@ import urllib.request
 import numpy as np
 import pytest
 
-from dllama_tpu.formats import tfile
+from dllama_tpu.formats import mfile, tfile
 from dllama_tpu.runtime import failpoints as fp
 from dllama_tpu.runtime import telemetry as tm
 from dllama_tpu.runtime.engine import InferenceEngine
-from dllama_tpu.runtime.serving import (BatchScheduler, QueueFullError,
+from dllama_tpu.runtime.serving import (BatchScheduler, HbmAdmissionError,
+                                        QueueFullError,
                                         SchedulerUnavailableError)
+from dllama_tpu.runtime.weights import (WeightIntegrityError, WeightLoadError)
 
 from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
 
@@ -66,6 +68,21 @@ def test_failpoint_registry_arm_fire_times():
     assert reg.fired("chaos.x") == 2
     assert fired.total(name="chaos.x") == before + 2
     assert not reg.armed("chaos.x")
+
+
+def test_short_read_and_sleep_actions():
+    reg = fp.registry()
+    reg.arm("x", "short_read")
+    with pytest.raises(fp.ShortReadError) as e:
+        reg.fire("x")
+    assert isinstance(e.value, OSError)  # classified transient by the loader
+    reg.arm("y", "sleep", times=1, delay_s=0.15)
+    t0 = time.monotonic()
+    reg.fire("y")  # blocks, does NOT raise
+    assert time.monotonic() - t0 >= 0.14
+    assert reg.fired("y") == 1 and not reg.armed("y")
+    reg.fire("y")  # exhausted: no-op, no sleep
+    reg.clear()
 
 
 def test_failpoint_actions_and_spec_grammar(monkeypatch):
@@ -372,3 +389,186 @@ def test_sse_client_disconnect_counted_not_500(batched_server):
     with _post(url, {"messages": [{"role": "user", "content": "again"}],
                      "max_tokens": 3}) as r:
         assert json.loads(r.read())["usage"]["completion_tokens"] >= 1
+
+
+# -- runtime hardening (ISSUE 4): loader retries, corruption, watchdog, HBM --
+
+
+def _fresh_model(tmp_path, seed=21, manifest=False):
+    mpath, tpath = tmp_path / "m.m", tmp_path / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(seed))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    if manifest:
+        mfile.write_manifest(mpath)
+    return str(mpath), str(tpath)
+
+
+def test_loader_retries_transient_reads_then_succeeds(tmp_path):
+    """Armed load_read (transient, bounded times) → the loader retries at
+    the read-callback level and the load completes; both the retry counter
+    and the failpoint counter advance."""
+    retries = tm.registry().counter(tm.WEIGHT_IO_RETRIES)
+    fired = tm.registry().counter(tm.FAILPOINTS_FIRED)
+    r0, f0 = retries.total(), fired.total(name="load_read")
+    mpath, tpath = _fresh_model(tmp_path)
+    fp.arm("load_read", "short_read", times=2)
+    eng = InferenceEngine(mpath, tpath, temperature=0.0, seed=3)
+    try:
+        assert retries.total() == r0 + 2
+        assert fired.total(name="load_read") == f0 + 2
+        assert not fp.registry().armed("load_read")  # consumed, recovered
+        # the engine is fully usable after the retried load
+        logits, _ = eng.prefill(_enc(eng)[:2])
+        assert np.all(np.isfinite(np.asarray(logits)))
+    finally:
+        eng.close()
+
+
+def test_loader_retry_exhaustion_fails_atomically_naming_site(tmp_path,
+                                                              monkeypatch):
+    """Persistently armed load_read → bounded retries, then a clean,
+    ATOMIC load failure: the error names the site, the engine never comes
+    into existence, and its mmap/watchdog are torn down."""
+    retries = tm.registry().counter(tm.WEIGHT_IO_RETRIES)
+    r0 = retries.total()
+    mpath, tpath = _fresh_model(tmp_path)
+    opened = []
+    orig_open = mfile.ModelFile.open.__func__
+
+    def spy_open(cls, *a, **kw):
+        mf = orig_open(cls, *a, **kw)
+        opened.append(mf)
+        return mf
+
+    monkeypatch.setattr(mfile.ModelFile, "open", classmethod(spy_open))
+    fp.arm("load_read", "oserror")
+    with pytest.raises(WeightLoadError, match="load_read"):
+        InferenceEngine(mpath, tpath, temperature=0.0, seed=3)
+    fp.registry().clear()
+    assert retries.total() == r0 + 3  # the loader's bounded retry budget
+    assert opened and opened[-1]._mm is None  # teardown closed the mmap
+    # atomic: nothing half-initialized lingers — a fresh engine just works
+    eng = InferenceEngine(mpath, tpath, temperature=0.0, seed=3)
+    try:
+        assert len(eng.tokenizer.encode("ok")) > 0
+    finally:
+        eng.close()
+
+
+def test_bit_flipped_tensor_fails_load_naming_tensor(tmp_path, monkeypatch):
+    """A single flipped byte in one tensor of a manifested model → the
+    load fails with WeightIntegrityError naming exactly that tensor, the
+    corruption counter advances, and the failure is atomic."""
+    corrupt = tm.registry().counter(tm.LOAD_CORRUPTION)
+    c0 = corrupt.total()
+    mpath, tpath = _fresh_model(tmp_path, manifest=True)
+    with mfile.ModelFile.open(mpath) as mf:
+        rec = mf.tensors["block_matmul_w2.1"]
+    with open(mpath, "r+b") as f:
+        f.seek(rec.offset + 5)
+        b = f.read(1)
+        f.seek(rec.offset + 5)
+        f.write(bytes([b[0] ^ 0x10]))
+    opened = []
+    orig_open = mfile.ModelFile.open.__func__
+
+    def spy_open(cls, *a, **kw):
+        mf = orig_open(cls, *a, **kw)
+        opened.append(mf)
+        return mf
+
+    monkeypatch.setattr(mfile.ModelFile, "open", classmethod(spy_open))
+    with pytest.raises(WeightIntegrityError,
+                       match=r"block_matmul_w2\.1.*corrupt|corrupt.*block_matmul_w2\.1"):
+        InferenceEngine(mpath, tpath, temperature=0.0, seed=3)
+    assert corrupt.total() == c0 + 1
+    assert opened and opened[-1]._mm is None
+
+
+def test_watchdog_trips_within_budget_and_routes_to_supervision(tmp_path):
+    """Armed step_hang (sleep) → the watchdog trips within its budget
+    (well before the injected hang would end), the in-flight request
+    fails 503-shaped, /readyz-backing readiness flips, submits are
+    refused, and the stall counter advances."""
+    stalls = tm.registry().counter(tm.WATCHDOG_STALLS)
+    s0 = stalls.total()
+    mpath, tpath = _fresh_model(tmp_path)
+    eng = InferenceEngine(mpath, tpath, temperature=0.0, seed=3)
+    # tight test budget; production defaults are generous (floor 120s)
+    eng.watchdog.min_budget_s = 0.3
+    eng.watchdog.margin = 1.0
+    eng.watchdog.min_samples = 2
+    sched = BatchScheduler(eng, n_slots=2)
+    try:
+        warm = sched.submit(_enc(eng), 4, stop_on_eos=False)
+        assert warm.done.wait(timeout=120) and warm.error is None
+        assert eng.watchdog.budget_s() is not None  # EWMA trained, armed
+        hang_s = 8.0
+        fp.arm("step_hang", "sleep", times=1, delay_s=hang_s)
+        t0 = time.monotonic()
+        req = sched.submit(_enc(eng, "stall me"), 50, stop_on_eos=False)
+        assert req.done.wait(timeout=60)
+        elapsed = time.monotonic() - t0
+        # tripped within budget: the waiter was failed while the dispatch
+        # was still wedged, not after the hang resolved
+        assert elapsed < hang_s - 1.0, elapsed
+        assert req.error is not None and "watchdog" in req.error
+        assert req.server_error  # maps to HTTP 503
+        assert stalls.total() == s0 + 1
+        ready, reason = sched.readiness()
+        assert not ready and "watchdog" in reason
+        with pytest.raises(SchedulerUnavailableError):
+            sched.submit(_enc(eng), 4)
+    finally:
+        fp.registry().clear()
+        sched.close()
+        eng.close()
+
+
+def test_hbm_admission_guard_rejects_over_budget_submit(tmp_path,
+                                                        monkeypatch):
+    """A device limit below the pool's needs → submit is rejected with a
+    clear reason (503-shaped HbmAdmissionError) and the reject counter
+    advances; the guard stands down when the limit is unknown."""
+    rejects = tm.registry().counter(tm.HBM_ADMISSION_REJECTS)
+    r0 = rejects.total()
+    mpath, tpath = _fresh_model(tmp_path)
+    eng = InferenceEngine(mpath, tpath, temperature=0.0, seed=3)
+    sched = BatchScheduler(eng, n_slots=2, _start_thread=False)
+    try:
+        monkeypatch.setenv("DLLAMA_HBM_BYTES", "10000000")  # << pool need
+        with pytest.raises(HbmAdmissionError, match="HBM admission guard"):
+            sched.submit(_enc(eng), 4)
+        assert rejects.total() == r0 + 1
+        monkeypatch.delenv("DLLAMA_HBM_BYTES")
+        req = sched.submit(_enc(eng), 4)  # limit unknown again: admits
+        assert req in sched._queue
+    finally:
+        sched.close()
+        eng.close()
+
+
+def test_hbm_admission_guard_degrades_slot_pool(tmp_path, monkeypatch):
+    """A limit that fits a 2-slot pool but not 4 → the generator degrades
+    to 2 slots instead of refusing (and instead of OOM-crashing later)."""
+    from dllama_tpu.runtime.hbm import estimate_device_bytes
+    from dllama_tpu.runtime.serving import BatchedGenerator
+
+    mpath, tpath = _fresh_model(tmp_path)
+    # tp pinned to 1 so the estimate below (n_shards=1) matches the pool's
+    eng = InferenceEngine(mpath, tpath, tp=1, temperature=0.0, seed=3)
+
+    def need(batch):
+        return estimate_device_bytes(
+            eng.cfg, weight_repr=eng.hbm_weight_repr,
+            kv_dtype_bytes=eng.kv_dtype.itemsize, batch=batch,
+            n_shards=1)["need_per_device"]
+
+    # between the 2-slot pool's need (batch=2+1) and the 4-slot's (4+1)
+    monkeypatch.setenv("DLLAMA_HBM_BYTES", str((need(3) + need(5)) // 2))
+    gen = BatchedGenerator(eng, n_slots=4)
+    assert gen.n_slots == 2
+    assert gen.kv.k.shape[1] == 2  # the pool really is smaller
+    monkeypatch.delenv("DLLAMA_HBM_BYTES")
+    eng.close()

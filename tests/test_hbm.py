@@ -88,3 +88,68 @@ def test_engine_records_estimate(tmp_path):
     tfile.write_tfile(tpath, byte_vocab_tokenizer())
     e = InferenceEngine(str(mpath), str(tpath))
     assert e.hbm_estimate["need_per_device"] > 0
+
+
+# -- HBM admission guard (ISSUE 4) --------------------------------------------
+
+
+def test_fit_batch_slots_degrades_in_dp_steps(monkeypatch):
+    from dllama_tpu.runtime.hbm import fit_batch_slots
+
+    c = _cfg(dim=512, hidden_dim=1024, n_layers=4, vocab_size=2048,
+             n_heads=8, n_kv_heads=4, head_dim=64, seq_len=512)
+    # dp=2: n slots -> batch n/2+1, so 8->b5, 6->b4, 4->b3. A limit
+    # between need(b3) and need(b4) fits only the 4-slot pool.
+    mid = (estimate_device_bytes(c, weight_repr="q40", kv_dtype_bytes=4,
+                                 batch=3)["need_per_device"]
+           + estimate_device_bytes(c, weight_repr="q40", kv_dtype_bytes=4,
+                                   batch=4)["need_per_device"]) // 2
+    monkeypatch.setenv("DLLAMA_HBM_BYTES", str(mid))
+    n, est = fit_batch_slots(c, 8, weight_repr="q40", kv_dtype_bytes=4,
+                             dp=2)
+    assert n == 4 and n % 2 == 0
+    assert est["need_per_device"] <= mid
+    # nothing fits -> 0 (caller refuses)
+    monkeypatch.setenv("DLLAMA_HBM_BYTES", "1000")
+    n, _ = fit_batch_slots(c, 8, weight_repr="q40", kv_dtype_bytes=4, dp=2)
+    assert n == 0
+    # unknown limit / explicit skip -> untouched
+    monkeypatch.delenv("DLLAMA_HBM_BYTES")
+    n, _ = fit_batch_slots(c, 8, weight_repr="q40", kv_dtype_bytes=4, dp=2)
+    assert n == 8
+    monkeypatch.setenv("DLLAMA_HBM_BYTES", "1000")
+    monkeypatch.setenv("DLLAMA_SKIP_HBM_CHECK", "1")
+    n, _ = fit_batch_slots(c, 8, weight_repr="q40", kv_dtype_bytes=4, dp=2)
+    assert n == 8
+
+
+def test_admission_check_uses_measured_bytes_and_uncompiled_extra(monkeypatch):
+    from dllama_tpu.runtime.hbm import admission_check
+
+    monkeypatch.setenv("DLLAMA_HBM_BYTES", str(1_000_000))
+    ok, _ = admission_check(need_bytes=400_000, measured_bytes={},
+                            extra_bytes=0, what="x")
+    assert ok
+    # measured evidence RAISES the estimate past the limit
+    ok, reason = admission_check(need_bytes=400_000,
+                                 measured_bytes={"forward": 1_200_000},
+                                 extra_bytes=0, what="x")
+    assert not ok and "measured" in reason
+    # uncompiled-program workspace pushes a borderline admission over
+    ok, reason = admission_check(need_bytes=900_000, measured_bytes={},
+                                 extra_bytes=200_000, what="x")
+    assert not ok and "uncompiled" in reason
+    # the guard stands down when the limit is unknown
+    monkeypatch.delenv("DLLAMA_HBM_BYTES")
+    ok, _ = admission_check(need_bytes=10**15, measured_bytes={},
+                            extra_bytes=0, what="x")
+    assert ok
+
+
+def test_estimate_prefill_temp_bytes_scales_with_tokens():
+    from dllama_tpu.runtime.hbm import estimate_prefill_temp_bytes
+
+    c = _cfg()
+    small = estimate_prefill_temp_bytes(c, 32)
+    big = estimate_prefill_temp_bytes(c, 256)
+    assert big == small * 8 and small > 0

@@ -1,0 +1,69 @@
+"""Step watchdog unit tier (runtime/watchdog.py): budget shape, EWMA
+training, trip-once semantics, callbacks. The end-to-end trip through the
+scheduler (fail-all, /readyz, telemetry) is chaos-driven in
+test_chaos.py::test_watchdog_trips_within_budget_and_routes_to_supervision."""
+
+import time
+
+from dllama_tpu.runtime import telemetry as tm
+from dllama_tpu.runtime.watchdog import StepWatchdog
+
+
+def test_budget_trains_after_min_samples_with_floor_and_margin():
+    wd = StepWatchdog("t1", margin=10.0, min_budget_s=0.5, min_samples=3,
+                      enabled=True)
+    assert wd.budget_s() is None
+    for _ in range(3):
+        wd.observe(20.0)  # 20 ms steps
+    # 20ms * 10x = 0.2s, floored at 0.5s
+    assert wd.budget_s() == 0.5
+    for _ in range(50):
+        wd.observe(200.0)  # EWMA converges toward 200 ms
+    assert 1.5 < wd.budget_s() <= 2.0
+    wd.close()
+
+
+def test_disabled_watchdog_never_arms():
+    wd = StepWatchdog("t2", margin=1.0, min_budget_s=0.01, min_samples=1,
+                      enabled=False)
+    for _ in range(5):
+        wd.observe(1.0)
+    assert wd.budget_s() is None
+    with wd.guard("x"):
+        pass
+    assert wd._thread is None  # no monitor thread was ever needed
+    wd.close()
+
+
+def test_guard_trips_once_and_calls_callbacks():
+    stalls = tm.registry().counter(tm.WATCHDOG_STALLS)
+    s0 = stalls.total(name="t3")
+    wd = StepWatchdog("t3", margin=1.0, min_budget_s=0.05, min_samples=1,
+                      enabled=True)
+    wd.observe(1.0)
+    hits = []
+    wd.on_stall.append(lambda info: hits.append(info))
+    with wd.guard("slowpoke"):
+        time.sleep(0.4)  # well past the 50 ms budget
+    assert wd.stalled and wd.stall_count == 1
+    assert len(hits) == 1 and hits[0]["label"] == "slowpoke"
+    assert hits[0]["budget_s"] <= 0.06
+    assert stalls.total(name="t3") == s0 + 1
+    # a fast guarded step after the trip does not re-trip
+    with wd.guard("fine"):
+        pass
+    time.sleep(0.1)
+    assert wd.stall_count == 1
+    wd.close()
+
+
+def test_fast_guards_never_trip():
+    wd = StepWatchdog("t4", margin=50.0, min_budget_s=0.2, min_samples=1,
+                      enabled=True)
+    wd.observe(1.0)
+    for _ in range(10):
+        with wd.guard("fast"):
+            pass
+    time.sleep(0.05)
+    assert not wd.stalled and wd.stall_count == 0
+    wd.close()

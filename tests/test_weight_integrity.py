@@ -1,0 +1,219 @@
+"""Checksummed resilient weight loading (ISSUE 4 tentpole #1).
+
+The manifest layer (formats/mfile.py: ``<model>.m.sums``) + the loader's
+verify/retry guard (runtime/weights.py ResilientReader) + the offline
+surfaces (``python -m dllama_tpu verify``, ``--verify-weights``). The
+chaos-driven paths (failpoint retries, corruption mid-engine-load,
+atomicity) live in test_chaos.py; this file covers the format and the
+offline tooling.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import helpers
+from dllama_tpu.formats import mfile, quants
+from dllama_tpu.runtime import telemetry as tm
+from dllama_tpu.runtime.weights import (ResilientReader, WeightIntegrityError,
+                                        verify_weights)
+
+
+def _model(tmp_path, name="m.m", seed=5, manifest=True, **hdr):
+    p = tmp_path / name
+    helpers.write_tiny_model(p, helpers.tiny_header_params(**hdr),
+                             np.random.default_rng(seed))
+    if manifest:
+        mfile.write_manifest(p)
+    return p
+
+
+def _flip(path, key, byte_off=3):
+    with mfile.ModelFile.open(path) as mf:
+        rec = mf.tensors[key]
+    with open(path, "r+b") as f:
+        f.seek(rec.offset + byte_off)
+        b = f.read(1)
+        f.seek(rec.offset + byte_off)
+        f.write(bytes([b[0] ^ 0x40]))
+
+
+# -- manifest format ----------------------------------------------------------
+
+
+def test_manifest_roundtrip_and_open_picks_it_up(tmp_path):
+    p = _model(tmp_path)
+    with mfile.ModelFile.open(p) as mf:
+        assert mf.checksums is not None
+        assert set(mf.checksums) == set(mf.tensors)
+        assert mf.checksums == mfile.compute_checksums(mf)
+
+
+def test_missing_manifest_loads_unverified(tmp_path):
+    p = _model(tmp_path, manifest=False)
+    with mfile.ModelFile.open(p) as mf:
+        assert mf.checksums is None  # legacy files stay loadable
+
+
+def test_stale_manifest_rejected(tmp_path):
+    p = _model(tmp_path)
+    # the model is regenerated (self-consistent, different size) but the
+    # old manifest is left behind: verification must refuse, not silently
+    # check the wrong sums or skip
+    helpers.write_tiny_model(p, helpers.tiny_header_params(n_layers=3),
+                             np.random.default_rng(9))
+    with pytest.raises(ValueError, match="stale|truncated"):
+        mfile.ModelFile.open(p)
+
+
+def test_stale_manifest_is_regenerable(tmp_path):
+    """`verify --write` is what the stale-manifest error tells the user to
+    run — regeneration must bypass (not validate) the sidecar it
+    replaces, or the repair path is circular."""
+    from dllama_tpu.serve.cli import main
+
+    p = _model(tmp_path)
+    helpers.write_tiny_model(p, helpers.tiny_header_params(n_layers=3),
+                             np.random.default_rng(9))  # manifest now stale
+    assert main(["verify", "--model", str(p), "--write"]) == 0
+    assert main(["verify", "--model", str(p)]) == 0
+    with mfile.ModelFile.open(p) as mf:  # and normal opens verify again
+        assert mf.checksums is not None
+
+
+def test_malformed_manifest_rejected(tmp_path):
+    p = _model(tmp_path, manifest=False)
+    with open(mfile.manifest_path(p), "w") as f:
+        f.write("{not json")
+    with pytest.raises(ValueError, match="malformed"):
+        mfile.ModelFile.open(p)
+    # wrong SHAPE (tensors as a list) must get the same clean refusal,
+    # not an AttributeError traceback
+    with open(mfile.manifest_path(p), "w") as f:
+        json.dump({"version": 1, "algo": "crc32", "file_size": 1,
+                   "tensors": [1, 2]}, f)
+    with pytest.raises(ValueError, match="malformed"):
+        mfile.ModelFile.open(p)
+
+
+def test_wrong_algo_rejected(tmp_path):
+    p = _model(tmp_path)
+    mp = mfile.manifest_path(p)
+    doc = json.load(open(mp))
+    doc["algo"] = "md5"
+    json.dump(doc, open(mp, "w"))
+    with pytest.raises(ValueError, match="algo"):
+        mfile.ModelFile.open(p)
+
+
+# -- offline verification -----------------------------------------------------
+
+
+def test_verify_weights_reports_every_corrupt_tensor(tmp_path):
+    p = _model(tmp_path)
+    _flip(p, "block_matmul_q.0")
+    _flip(p, "block_norm_1.1")
+    with mfile.ModelFile.open(p) as mf:
+        res = verify_weights(mf)
+    assert sorted(res["corrupt"]) == ["block_matmul_q.0", "block_norm_1.1"]
+    assert res["tensors"] == len(mf.tensors)
+
+
+def test_verify_weights_requires_manifest(tmp_path):
+    p = _model(tmp_path, manifest=False)
+    with mfile.ModelFile.open(p) as mf:
+        with pytest.raises(WeightIntegrityError, match="no checksum"):
+            verify_weights(mf)
+
+
+def test_cli_verify_check_write_and_corrupt_rcs(tmp_path, capsys):
+    from dllama_tpu.serve.cli import main
+
+    p = str(_model(tmp_path, manifest=False))
+    assert main(["verify", "--model", p]) == 2      # no manifest yet
+    assert main(["verify", "--model", p, "--write"]) == 0
+    assert main(["verify", "--model", p]) == 0       # clean
+    _flip(p, "block_matmul_v.1")
+    rc = main(["verify", "--model", p])
+    assert rc == 1
+    assert "block_matmul_v.1" in capsys.readouterr().out
+
+
+def test_engine_verify_weights_flag_names_corrupt_tensor(tmp_path):
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    p = _model(tmp_path, vocab_size=268, seq_len=48)
+    _flip(p, "block_matmul_wo.0")
+    with pytest.raises(WeightIntegrityError, match=r"block_matmul_wo\.0"):
+        InferenceEngine(str(p), verify_weights=True)
+    # and the clean twin passes the full sweep then loads
+    p2 = _model(tmp_path, name="clean.m", vocab_size=268, seq_len=48)
+    eng = InferenceEngine(str(p2), verify_weights=True)
+    try:
+        logits, _ = eng.prefill([1, 2, 3])
+        assert np.all(np.isfinite(np.asarray(logits)))
+    finally:
+        eng.close()
+
+
+# -- resilient reader ---------------------------------------------------------
+
+
+def test_resilient_reader_retry_budget_is_bounded(tmp_path):
+    from dllama_tpu.runtime import failpoints as fp
+    from dllama_tpu.runtime.weights import WeightLoadError
+
+    p = _model(tmp_path)
+    retries = tm.registry().counter(tm.WEIGHT_IO_RETRIES)
+    r0 = retries.total()
+    with mfile.ModelFile.open(p) as mf:
+        rd = ResilientReader(mf, max_retries=2, backoff_s=0.001)
+        fp.arm("load_read", "oserror")
+        try:
+            with pytest.raises(WeightLoadError, match="after 2 retries"):
+                rd.tensor_f32("embedding")
+        finally:
+            fp.registry().clear()
+        assert retries.total() == r0 + 2
+        # non-transient failures are NOT retried: corrupt bytes raise once
+        _flip(p, "final_norm")
+        c0 = retries.total()
+        with pytest.raises(WeightIntegrityError, match="final_norm"):
+            rd.tensor_f32("final_norm")
+        assert retries.total() == c0
+
+
+def test_reader_verifies_each_tensor_once(tmp_path):
+    p = _model(tmp_path)
+    with mfile.ModelFile.open(p) as mf:
+        rd = ResilientReader(mf)
+        calls = []
+        orig = mf.tensor_crc32
+        mf.tensor_crc32 = lambda k: (calls.append(k), orig(k))[1]
+        rd.tensor_f32_rows("embedding", 0, 4)
+        rd.tensor_f32_rows("embedding", 4, 8)
+        assert calls == ["embedding"]  # verified once, not per slice
+
+
+# -- scales-only reader (the per-callback allocation bound fix) ---------------
+
+
+@pytest.mark.parametrize("weight_type", [quants.Q40, quants.Q80])
+def test_scales_only_reader_matches_pair_reader(tmp_path, weight_type):
+    p = _model(tmp_path, manifest=False, weight_type=weight_type,
+               dim=64, hidden_dim=96)
+    with mfile.ModelFile.open(p) as mf:
+        sub = (mf.tensor_q40_kmajor_sub if weight_type == quants.Q40
+               else mf.tensor_q80_kmajor_sub)
+        for key, (o_lo, o_hi, i_lo, i_hi) in [
+                ("block_matmul_q.0", (0, 64, 0, 64)),
+                ("block_matmul_q.0", (16, 48, 32, 64)),
+                ("block_matmul_w2.1", (8, 40, 32, 96)),
+        ]:
+            want, _ = sub(key, o_lo, o_hi, i_lo, i_hi)
+            got = mf.tensor_scales_kmajor_sub(key, o_lo, o_hi, i_lo, i_hi)
+            np.testing.assert_array_equal(got, want)
+            assert got.dtype == np.float32 and got.flags["C_CONTIGUOUS"]
