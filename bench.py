@@ -843,6 +843,14 @@ def main() -> None:
         if fb is not None:
             data, path = fb
             here = os.path.dirname(os.path.abspath(__file__))
+            # first-class skip marker: the LIVE measurement did not run —
+            # the numbers below are a re-emitted banked capture, so a
+            # comparison tool must read this as "no hardware", never as a
+            # regression or an improvement (tools/bench_compare.py)
+            data["skipped"] = True
+            data["skip_reason"] = (f"backend unavailable: {detail}; "
+                                   f"re-emitting banked capture "
+                                   f"{os.path.relpath(path, here)}")
             data["fallback"] = {
                 "source": os.path.relpath(path, here),
                 "live_probe_error": detail,
@@ -854,6 +862,8 @@ def main() -> None:
             data["elapsed_s"] = round(time.monotonic() - t_start, 1)
             emit(data)
             return
+        result["skipped"] = True
+        result["skip_reason"] = f"backend unavailable: {detail}"
         result["error"] = f"backend unavailable: {detail}"
         result["probe_attempts"] = attempts
         result["env"] = {

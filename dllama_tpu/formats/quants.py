@@ -19,6 +19,17 @@ model for kernel tests. A faster C++ implementation lives in
 
 All functions operate on flat 1-D arrays whose length is a multiple of the
 block size, mirroring the reference's row-major tensor walk.
+
+Scale saturation: block scales are stored as float16, whose largest finite
+value is 65504 — a block whose absmax exceeds ``8 * 65504`` (Q40) or
+``127 * 65504`` (Q80) would round its scale to +/-Inf and every dequantized
+element of the block to Inf/NaN. The quantizers therefore CLAMP the stored
+scale to the finite f16 range: finite input always dequantizes finite
+(asserted by tests/test_quants.py), at the cost of a large (but finite)
+reconstruction error for such absurd magnitudes — real model weights sit
+orders of magnitude below the cutoff, and in-range blocks are
+byte-identical to the unclamped encoding. Oversized inputs are routed to
+the portable numpy codec (the native codec does not clamp).
 """
 
 from __future__ import annotations
@@ -38,6 +49,8 @@ Q40 = 2
 Q80 = 3
 
 FLOAT_TYPE_NAMES = {F32: "f32", F16: "f16", Q40: "q40", Q80: "q80"}
+
+_F16_MAX = 65504.0  # largest finite float16 (scale saturation bound)
 
 
 def q40_bytes(n: int) -> int:
@@ -82,7 +95,11 @@ def quantize_q40(x: np.ndarray) -> bytes:
     assert x.ndim == 1 and x.size % Q40_BLOCK_SIZE == 0, x.shape
     from .. import native
 
-    nat = native.q40_quantize(x) if native.available() else None
+    # oversized magnitudes (scale would overflow f16) take the clamping
+    # numpy path — the native codec writes the overflowed Inf scale
+    in_range = x.size == 0 or float(np.max(np.abs(x))) < _F16_MAX * 8.0
+    nat = (native.q40_quantize(x)
+           if native.available() and in_range else None)
     if nat is not None:
         return nat
     return quantize_q40_np(x)
@@ -94,7 +111,9 @@ def quantize_q40_np(x: np.ndarray) -> bytes:
     gmax = g.max(axis=1)
     gmin = g.min(axis=1)
     d = np.where(-gmin > gmax, gmin, gmax) / -8.0
-    d16 = d.astype(np.float16)
+    # stored scale saturates at the largest finite f16 (module docstring:
+    # finite input must always dequantize finite)
+    d16 = np.clip(d, -_F16_MAX, _F16_MAX).astype(np.float16)
     inv = np.where(d != 0, np.divide(1.0, d, where=d != 0), 0.0).astype(np.float32)
     q = np.clip(np.floor(g * inv[:, None] + 8.5), 0, 15).astype(np.uint8)
     half = Q40_BLOCK_SIZE // 2
@@ -161,7 +180,10 @@ def quantize_q80(x: np.ndarray) -> bytes:
     assert x.ndim == 1 and x.size % Q80_BLOCK_SIZE == 0, x.shape
     from .. import native
 
-    nat = native.q80_quantize(x) if native.available() else None
+    # oversized magnitudes route to the clamping numpy path (see Q40)
+    in_range = x.size == 0 or float(np.max(np.abs(x))) < _F16_MAX * 127.0
+    nat = (native.q80_quantize(x)
+           if native.available() and in_range else None)
     if nat is not None:
         return nat
     return quantize_q80_np(x)
@@ -172,7 +194,8 @@ def quantize_q80_np(x: np.ndarray) -> bytes:
     g = x.reshape(-1, Q80_BLOCK_SIZE)
     amax = np.abs(g).max(axis=1)
     d = (amax / 127.0).astype(np.float32)
-    d16 = d.astype(np.float16)
+    # stored scale saturates at the largest finite f16 (module docstring)
+    d16 = np.clip(d, 0.0, _F16_MAX).astype(np.float16)
     inv = np.where(d != 0, np.divide(1.0, d, where=d != 0), 0.0).astype(np.float32)
     q = np.round(g * inv[:, None]).astype(np.int8)
 

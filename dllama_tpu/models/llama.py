@@ -46,6 +46,7 @@ from ..ops.linear import (
 from ..ops.norms import rms_norm, rms_norm_per_head
 from ..parallel.api import constrain, shard_map
 from ..parallel.api import current_plan as _current_plan
+from ..runtime import numerics as _numerics
 from ..runtime.kvcache import KVCache, update_layer
 from .config import ModelConfig
 from .rope import apply_rope, build_rope_cache
@@ -425,12 +426,36 @@ def _moe_ffn(cfg: ModelConfig, h: jax.Array, lp: LayerParams) -> jax.Array:
     return _moe_ffn_dense(cfg, h, lp)
 
 
+def _tap_stat(x: jax.Array) -> dict[str, jax.Array]:
+    """Activation stats for one numerics-observatory tap site (all f32/i32
+    scalars, cheap reductions XLA fuses into the producing op's epilogue):
+    rms and abs-max over FINITE lanes (a NaN must poison the non-finite
+    count, not the statistics), the non-finite lane count, and the Q80
+    roundtrip error the sync/wire quantization would apply at this
+    boundary (0 when the trailing axis isn't block-divisible)."""
+    from ..formats.quants import Q80_BLOCK_SIZE
+    from ..parallel.qcollectives import q80_roundtrip_error
+
+    xf = x.astype(jnp.float32)
+    finite = jnp.isfinite(xf)
+    nf = jnp.sum(jnp.logical_not(finite).astype(jnp.int32))
+    xz = jnp.where(finite, xf, 0.0)
+    rms = jnp.sqrt(jnp.mean(jnp.square(xz)))
+    absmax = jnp.max(jnp.abs(xz))
+    q80e = (q80_roundtrip_error(xz) if x.shape[-1] % Q80_BLOCK_SIZE == 0
+            else jnp.float32(0.0))
+    return {"rms": rms, "absmax": absmax, "nonfinite": nf, "q80_err": q80e}
+
+
 def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
                 k_cache: jax.Array, v_cache: jax.Array,
                 cos: jax.Array, sin: jax.Array, start_pos: jax.Array,
-                positions: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+                positions: jax.Array, taps: bool = False):
     """One transformer block. ``x: [B, T, dim]``; caches are head-major
-    ``[B, n_kv, S, hd]`` (see runtime.kvcache)."""
+    ``[B, n_kv, S, hd]`` (see runtime.kvcache). With ``taps`` (a
+    trace-time bool — the numerics observatory's activation taps) the
+    return gains a per-site stats dict: ``attn_out`` after the attention
+    residual, ``mlp_out`` after the ffn residual."""
     B, T, _ = x.shape
 
     # Q80 sync-parity: fake-quantize at the reference's cast points — matmul
@@ -487,6 +512,7 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     att = constrain(att, "batch", None, "heads", None)
     x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo, in_axis="heads"))
     x = constrain(x, "batch", None, None)
+    attn_stat = _tap_stat(x) if taps else None
 
     # -- ffn half (reference ff segment, llm.cpp:369-439; MoE is new) ------
     h = fq(rms_norm(x, lp.norm_ffn, cfg.norm_epsilon))
@@ -498,6 +524,9 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
         hidden = constrain(fq(gate * up), "batch", None, "hidden")
         x = x + fq(linear(hidden, lp.w2, in_axis="hidden"))
     x = constrain(x, "batch", None, None)
+    if taps:
+        return x, k_cache, v_cache, {"attn_out": attn_stat,
+                                     "mlp_out": _tap_stat(x)}
     return x, k_cache, v_cache
 
 
@@ -643,8 +672,17 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     """
     start_pos = jnp.asarray(start_pos, dtype=jnp.int32)
     ragged = start_pos.ndim > 0
+    # numerics observatory taps (runtime/numerics): a TRACE-TIME flag, so
+    # the default (off) trace is byte-identical — no tap code exists in it
+    collect = _numerics.taps_active()
     plan = _current_plan()
     if plan is not None and plan.axis_size("pp") > 1:
+        if collect:
+            # the manual pp schedule owns its own shard_map region; tap
+            # stats can't thread through it — fail at trace time rather
+            # than silently returning an empty pytree
+            raise ValueError("numerics taps are unsupported under "
+                             "pipeline parallelism (pp > 1)")
         # pipeline parallelism: layer stack sharded over pp, stages hand the
         # activation along the ring (parallel/pipeline.py — new capability).
         # Ragged [B] start_pos (batched serving) rides along: each stage's
@@ -674,6 +712,10 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             # weights stream host → device per layer; XLA prefetches the next
             # layer's transfer while this layer computes (cfg.offload docs)
             lp = jax.device_put(lp, jax.memory.Space.Device)
+        if collect:
+            x, k_l, v_l, st = _layer_step(cfg, x, lp, k_l, v_l, cos, sin,
+                                          start_pos, positions, taps=True)
+            return x, (k_l, v_l, st)
         x, k_l, v_l = _layer_step(cfg, x, lp, k_l, v_l, cos, sin,
                                   start_pos, positions)
         return x, (k_l, v_l)
@@ -684,15 +726,177 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     # per-step loop overhead beyond the matmuls on the 1b shape. Part of the
     # multihost cluster fingerprint (different unroll = different program).
     unroll = int(os.environ.get("DLLAMA_TPU_SCAN_UNROLL", "1"))
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params.layers, kv.k, kv.v),
-                                     unroll=max(1, unroll))
+    x, ys = jax.lax.scan(body, x, (params.layers, kv.k, kv.v),
+                         unroll=max(1, unroll))
+    if collect:
+        new_k, new_v, layer_taps = ys  # stacked [L] leaves per site
+    else:
+        new_k, new_v = ys
 
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
+    final_stat = _tap_stat(x) if collect else None
     if cfg.sync_q80:  # final cast before the logits matmul (llm.cpp:445-486)
         x = fake_quant_q80(x)
     logits = linear(x, params.logits, out_axis="vocab").astype(jnp.float32)
     logits = constrain(logits, "batch", None, "vocab")
+    if collect:
+        taps = dict(layer_taps)
+        taps["final_norm"] = final_stat
+        taps["logits"] = _tap_stat(logits)
+        return logits, KVCache(k=new_k, v=new_v), taps
     return logits, KVCache(k=new_k, v=new_v)
+
+
+def forward_with_taps(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                      start_pos: jax.Array, kv: KVCache):
+    """:func:`forward` with the numerics observatory's activation taps
+    collected: returns ``((logits, taps), kv)`` where ``taps`` is the
+    per-site stats pytree (``attn_out``/``mlp_out`` carry stacked ``[L]``
+    leaves from the layer scan; ``final_norm``/``logits`` scalars — see
+    :func:`_tap_stat`). A separate entry point (not a flag argument) so
+    the plain program's trace stays byte-identical and the tapped one is
+    only ever jitted when an engine opts in (``--numerics-taps``)."""
+    with _numerics.collecting_taps():
+        logits, kv, taps = forward(params, cfg, tokens, start_pos, kv)
+    return (logits, taps), kv
+
+
+# ---------------------------------------------------------------------------
+# Guarded decode steps — the non-finite tripwire (runtime/numerics)
+# ---------------------------------------------------------------------------
+#
+# Every engine/serving decode dispatch runs a *_guarded twin of the fused
+# step: same math, same program shape, plus (a) an in-graph poison selector
+# (a traced f32 scalar driven by the `logits` failpoint — 0.0 in
+# production, so arming chaos never recompiles) and (b) a fused per-row
+# count of non-finite decode-step logits returned alongside the picked
+# token. The raw steps above keep their signatures for bench.py and the
+# parity tests; the guarded ones are what the engine jits (under the same
+# program names, so the compile ledger's view is unchanged).
+
+
+def _poison_logits(logits: jax.Array, poison: jax.Array) -> jax.Array:
+    """Inject the failpoint's poison into the logits in-graph: 0 = clean
+    passthrough, 1 = NaN, >=2 = +Inf (numerics.POISON_CODES)."""
+    val = jnp.where(poison >= 2.0, jnp.float32(jnp.inf),
+                    jnp.float32(jnp.nan))
+    return jnp.where(poison > 0.0, val.astype(logits.dtype), logits)
+
+
+def _nonfinite_rows(logits: jax.Array) -> jax.Array:
+    """Per-row count of non-finite lanes: ``[B, ...] -> [B] int32``."""
+    bad = jnp.logical_not(jnp.isfinite(logits)).astype(jnp.int32)
+    return jnp.sum(bad, axis=tuple(range(1, logits.ndim)))
+
+
+def greedy_step_guarded(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                        start_pos: jax.Array, kv: KVCache,
+                        poison: jax.Array):
+    """:func:`greedy_step` + tripwire: returns ``((token, nonfinite), kv)``
+    where ``nonfinite [B]`` counts non-finite lanes of the decode-step
+    logits — the one row every emitted token is derived from."""
+    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    last = _poison_logits(logits[:, -1, :], poison)
+    tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    return (tok, _nonfinite_rows(last)), kv
+
+
+def sampled_step_guarded(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                         start_pos: jax.Array, kv: KVCache,
+                         temperature: jax.Array, topp: jax.Array,
+                         coin: jax.Array, poison: jax.Array):
+    """:func:`sampled_step` + tripwire (also the ragged batched-serving
+    step: everything broadcasts over rows, ``nonfinite [B]`` is per
+    slot so a poisoned request can be failed without touching the rest
+    of the batch)."""
+    from ..ops.sampling import sampled_token
+
+    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    last = _poison_logits(logits[:, -1, :], poison)
+    return (sampled_token(last, temperature, topp, coin),
+            _nonfinite_rows(last)), kv
+
+
+def _scan_decode_guarded(step1, token: jax.Array, start_pos: jax.Array,
+                         kv: KVCache, n_steps: int,
+                         coins: jax.Array | None = None):
+    """Guarded twin of :func:`scan_decode`: ``step1`` returns
+    ``((tok, nf), kv)`` and the per-row non-finite counts accumulate over
+    the chunk's scan carry — one fused count per dispatch, exactly like
+    the tokens themselves."""
+
+    def body(carry, xs):
+        token, kv, nf = carry
+        if coins is None:
+            (nxt, nf_i), kv = step1(token[:, None], start_pos + xs, kv)
+        else:
+            i, coin = xs
+            (nxt, nf_i), kv = step1(token[:, None], start_pos + i, kv, coin)
+        return (nxt, kv, nf + nf_i), nxt
+
+    xs = jnp.arange(n_steps, dtype=jnp.int32)
+    nf0 = jnp.zeros(token.shape, dtype=jnp.int32)
+    (_, kv, nf), toks = jax.lax.scan(
+        body, (token, kv, nf0), xs if coins is None else (xs, coins))
+    return (jnp.moveaxis(toks, 0, 1), nf), kv  # ([B, n_steps], [B])
+
+
+def greedy_steps_guarded(params: Params, cfg: ModelConfig, token: jax.Array,
+                         start_pos: jax.Array, kv: KVCache, n_steps: int,
+                         poison: jax.Array):
+    """:func:`greedy_steps` + tripwire: ``((tokens, nonfinite), kv)``."""
+    return _scan_decode_guarded(
+        lambda t, p, kv: greedy_step_guarded(params, cfg, t, p, kv, poison),
+        token, start_pos, kv, n_steps)
+
+
+def sampled_steps_guarded(params: Params, cfg: ModelConfig, token: jax.Array,
+                          start_pos: jax.Array, kv: KVCache,
+                          temperature: jax.Array, topp: jax.Array,
+                          coins: jax.Array, n_steps: int,
+                          poison: jax.Array):
+    """:func:`sampled_steps` + tripwire (also the ragged chunked step for
+    batched serving, like its unguarded twin)."""
+    return _scan_decode_guarded(
+        lambda t, p, kv, c: sampled_step_guarded(params, cfg, t, p, kv,
+                                                 temperature, topp, c,
+                                                 poison),
+        token, start_pos, kv, n_steps, coins=coins)
+
+
+def verify_step_guarded(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                        start_pos: jax.Array, kv: KVCache,
+                        poison: jax.Array):
+    """:func:`verify_step` + tripwire over all K+1 verify positions (every
+    one of them can become an emitted token): ``((n_acc, preds, nf), kv)``."""
+    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    logits = _poison_logits(logits, poison)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+    ok = (tokens[:, 1:] == preds[:, :-1]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(ok, axis=-1), axis=-1)
+    return (n_acc, preds, _nonfinite_rows(logits)), kv
+
+
+def ragged_verify_step_guarded(params: Params, cfg: ModelConfig,
+                               tokens: jax.Array, pos_vec: jax.Array,
+                               kv: KVCache, temps: jax.Array,
+                               topps: jax.Array, coins: jax.Array,
+                               poison: jax.Array):
+    """:func:`ragged_verify_step` + tripwire: ``((n_acc, preds, nf), kv)``
+    with per-row counts so batched serving fails only the poisoned
+    slot."""
+    from ..ops.sampling import sampled_token
+
+    logits, kv = forward(params, cfg, tokens, pos_vec, kv)
+    logits = _poison_logits(logits, poison)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+    ok = (tokens[:, 1:] == preds[:, :-1]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(ok, axis=-1), axis=-1)
+    greedy_row = jnp.asarray(temps) <= 0.0
+    n_acc = jnp.where(greedy_row, n_acc, 0)
+    first = sampled_token(logits[:, 0], temps, topps, coins)
+    preds = preds.at[:, 0].set(first)
+    return (n_acc, preds, _nonfinite_rows(logits)), kv
 
 
 # ---------------------------------------------------------------------------

@@ -413,6 +413,76 @@ def replicated_sampled_steps(params, cfg, token, start_pos, kv, temperature,
     return constrain(toks, None, None), kv
 
 
+def replicated_greedy_guarded(params, cfg, tokens, start_pos, kv, poison):
+    """Guarded (non-finite tripwire) twin of :func:`replicated_greedy`:
+    ``((token, nonfinite), kv)``, both replicated so every host reads the
+    same values. ``poison`` is always 0 under multihost (the failpoint
+    injection is single-host only — a root-only NaN would desync the
+    replicated pick), but the scalar stays in the program so root and
+    worker compile identical executables."""
+    import jax.numpy as jnp
+
+    from ..models.llama import _nonfinite_rows, _poison_logits
+    from .api import constrain
+
+    logits, kv = replicated_forward(params, cfg, tokens, start_pos, kv)
+    last = _poison_logits(logits[:, -1, :], poison)
+    tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    return (constrain(tok, None), constrain(_nonfinite_rows(last), None)), kv
+
+
+def replicated_sampled_guarded(params, cfg, tokens, start_pos, kv,
+                               temperature, topp, coin, poison):
+    from ..models.llama import _nonfinite_rows, _poison_logits
+    from ..ops.sampling import sampled_token
+    from .api import constrain
+
+    logits, kv = replicated_forward(params, cfg, tokens, start_pos, kv)
+    last = _poison_logits(logits[:, -1, :], poison)
+    tok = sampled_token(last, temperature, topp, coin)
+    return (constrain(tok, None), constrain(_nonfinite_rows(last), None)), kv
+
+
+def replicated_verify_guarded(params, cfg, tokens, start_pos, kv, poison):
+    import jax.numpy as jnp
+
+    from ..models.llama import _nonfinite_rows, _poison_logits
+    from .api import constrain
+
+    logits, kv = replicated_forward(params, cfg, tokens, start_pos, kv)
+    logits = _poison_logits(logits, poison)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    ok = (tokens[:, 1:] == preds[:, :-1]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(ok, axis=-1), axis=-1)
+    return (constrain(n_acc, None), constrain(preds, None, None),
+            constrain(_nonfinite_rows(logits), None)), kv
+
+
+def replicated_greedy_steps_guarded(params, cfg, token, start_pos, kv,
+                                    n_steps, poison):
+    from ..models.llama import _scan_decode_guarded
+    from .api import constrain
+
+    (toks, nf), kv = _scan_decode_guarded(
+        lambda t, p, kv: replicated_greedy_guarded(params, cfg, t, p, kv,
+                                                   poison),
+        token, start_pos, kv, n_steps)
+    return (constrain(toks, None, None), constrain(nf, None)), kv
+
+
+def replicated_sampled_steps_guarded(params, cfg, token, start_pos, kv,
+                                     temperature, topp, coins, n_steps,
+                                     poison):
+    from ..models.llama import _scan_decode_guarded
+    from .api import constrain
+
+    (toks, nf), kv = _scan_decode_guarded(
+        lambda t, p, kv, c: replicated_sampled_guarded(
+            params, cfg, t, p, kv, temperature, topp, c, poison),
+        token, start_pos, kv, n_steps, coins=coins)
+    return (constrain(toks, None, None), constrain(nf, None)), kv
+
+
 def worker_serve(engine: "InferenceEngine", *,
                  timeout_s: float | None = None) -> int:
     """Run the worker side: mirror every root dispatch until STOP.

@@ -53,6 +53,25 @@ def wire_q80() -> bool:
     return os.environ.get("DLLAMA_TPU_WIRE", "f32") == "q80"
 
 
+def q80_roundtrip_error(x: jax.Array) -> jax.Array:
+    """Relative RMS error of ONE Q80 quantize→dequantize roundtrip of
+    ``x`` — the per-hop quantization loss this module's wire collectives
+    (and the ``sync_q80`` cast emulation) apply to an activation.
+    In-graph (traceable) and built on the same
+    ``ops.linear.q80_quantize_planes``/``q80_dequant`` pair the wire
+    ships, so the measured loss can't drift from the shipped math.
+    Sampled at the sync boundary by the activation taps
+    (``models/llama.py``) into ``dllama_q80_roundtrip_error{site}``.
+    Trailing axis must be block-divisible (the same precondition as the
+    wire itself)."""
+    from ..ops.linear import fake_quant_q80
+
+    xf = x.astype(jnp.float32)
+    err = fake_quant_q80(xf) - xf
+    denom = jnp.sqrt(jnp.mean(jnp.square(xf))) + 1e-12
+    return jnp.sqrt(jnp.mean(jnp.square(err))) / denom
+
+
 def psum_q80_wire(x: jax.Array, axis_name) -> jax.Array:
     """All-reduce whose WIRE traffic is Q80: quantize the local partial,
     all-gather the planes, dequant-sum locally. Numerically identical to

@@ -19,6 +19,7 @@ mask (``s <= pos``), and every slot is rewritten by its real token's
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from contextlib import nullcontext
 from dataclasses import dataclass, field
@@ -33,18 +34,19 @@ from ..models.config import ModelConfig
 from ..models.llama import (
     Params,
     forward,
-    greedy_step,
-    greedy_steps,
+    forward_with_taps,
+    greedy_step_guarded,
+    greedy_steps_guarded,
     load_params_from_mfile,
-    sampled_step,
-    sampled_steps,
-    verify_step,
+    sampled_step_guarded,
+    sampled_steps_guarded,
+    verify_step_guarded,
 )
 from ..parallel.api import MeshPlan, make_mesh, plan_scoped_jit, use_plan
 from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.sampler import Sampler, xorshift_random_f32
-from . import failpoints, telemetry
+from . import failpoints, numerics, telemetry
 from .kvcache import KVCache
 from .watchdog import StepWatchdog
 
@@ -133,7 +135,9 @@ class InferenceEngine:
                  multihost: bool = False, host_sampling: bool = False,
                  decode_chunk: int = 1, spec_lookup: int = 0,
                  kv_dtype: str = "auto", profile_split: bool = False,
-                 verify_weights: bool = False):
+                 verify_weights: bool = False,
+                 numerics_taps: bool = False,
+                 numerics_failfast: bool | None = None):
         from ..ops.linear import turbo_mode
 
         if turbo_mode() is not None and weight_mode != "auto":
@@ -342,6 +346,31 @@ class InferenceEngine:
         # request id stamped onto trace spans by the serving layer (the
         # engine itself has no request concept; -1 = unattributed)
         self.trace_rid = -1
+        # numerics observatory (runtime/numerics): activation taps are an
+        # opt-in engine mode (the tapped program is only jitted when on, so
+        # the default engine stays compile-ledger-quiet); the non-finite
+        # tripwire is always on via the guarded step programs, and
+        # fail-fast decides whether a poisoned dispatch raises
+        # NumericsError or just counts and emits garbage
+        self.numerics_taps = (numerics_taps
+                              or os.environ.get("DLLAMA_NUMERICS_TAPS") == "1")
+        if self.numerics_taps and multihost:
+            raise ValueError(
+                "--numerics-taps is single-host only (the taps pytree is "
+                "host-read and would be non-addressable across processes)")
+        if self.numerics_taps and pp > 1:
+            # fail at STARTUP, not as a per-request trace-time ValueError
+            # the HTTP layer would misreport as a client 400
+            raise ValueError(
+                "--numerics-taps is unsupported under pipeline "
+                "parallelism (pp > 1): tap stats cannot thread through "
+                "the manual pp shard_map region")
+        self.nf_failfast = (numerics_failfast if numerics_failfast is not None
+                            else os.environ.get(
+                                "DLLAMA_NUMERICS_FAILFAST") == "1")
+        # golden canary drift sentinel (numerics.CanarySentinel), wired by
+        # the serving layer (run_api_server --canary-interval) or tests
+        self.canary = None
 
         try:
             if verify_weights:
@@ -413,10 +442,11 @@ class InferenceEngine:
         if multihost:
             from ..parallel.multihost import (
                 replicated_forward,
-                replicated_greedy,
-                replicated_greedy_steps,
-                replicated_sampled,
-                replicated_sampled_steps,
+                replicated_greedy_guarded,
+                replicated_greedy_steps_guarded,
+                replicated_sampled_guarded,
+                replicated_sampled_steps_guarded,
+                replicated_verify_guarded,
             )
 
             # plan_scoped_jit: the traced programs bake in THIS engine's
@@ -425,29 +455,33 @@ class InferenceEngine:
             # function — a second engine with a different plan would
             # otherwise dispatch the first engine's sharding constraints.
             # scope= files every program under this engine in the compile
-            # ledger (runtime.introspection).
+            # ledger (runtime.introspection). The decode-path programs are
+            # the *_guarded twins (non-finite tripwire fused in) but keep
+            # their historical program names — the ledger's view of "what
+            # does this engine compile" is unchanged.
             _sc = self.introspection_scope
             self._step = plan_scoped_jit(replicated_forward, scope=_sc,
                                          static_argnums=1,
                                          donate_argnums=(4,))
             self._greedy_step = plan_scoped_jit(
-                replicated_greedy, scope=_sc, static_argnums=1,
+                replicated_greedy_guarded, scope=_sc,
+                program="replicated_greedy", static_argnums=1,
                 donate_argnums=(4,))
             self._sampled_step = plan_scoped_jit(
-                replicated_sampled, scope=_sc, static_argnums=1,
+                replicated_sampled_guarded, scope=_sc,
+                program="replicated_sampled", static_argnums=1,
                 donate_argnums=(4,))
-            self._greedy_steps = plan_scoped_jit(replicated_greedy_steps,
-                                                 scope=_sc,
-                                                 static_argnums=(1, 5),
-                                                 donate_argnums=(4,))
-            self._sampled_steps = plan_scoped_jit(replicated_sampled_steps,
-                                                  scope=_sc,
-                                                  static_argnums=(1, 8),
-                                                  donate_argnums=(4,))
-            from ..parallel.multihost import replicated_verify
-
+            self._greedy_steps = plan_scoped_jit(
+                replicated_greedy_steps_guarded, scope=_sc,
+                program="replicated_greedy_steps", static_argnums=(1, 5),
+                donate_argnums=(4,))
+            self._sampled_steps = plan_scoped_jit(
+                replicated_sampled_steps_guarded, scope=_sc,
+                program="replicated_sampled_steps", static_argnums=(1, 8),
+                donate_argnums=(4,))
             self._verify_step = plan_scoped_jit(
-                replicated_verify, scope=_sc, static_argnums=1,
+                replicated_verify_guarded, scope=_sc,
+                program="replicated_verify", static_argnums=1,
                 donate_argnums=(4,))
         else:
             _sc = self.introspection_scope
@@ -457,19 +491,41 @@ class InferenceEngine:
             # token and a 4-byte host transfer instead of a full logits row;
             # used by next_token() when temperature == 0. The sampled twin
             # fuses temperature/top-p on device the same way (temp/topp/coin
-            # are traced scalars, so knob changes never recompile).
-            self._greedy_step = plan_scoped_jit(greedy_step, scope=_sc,
+            # are traced scalars, so knob changes never recompile). All
+            # decode programs are the *_guarded twins — the non-finite
+            # tripwire rides every dispatch, the poison scalar is traced so
+            # chaos arming never recompiles — under the historical program
+            # names (compile-ledger view unchanged).
+            self._greedy_step = plan_scoped_jit(greedy_step_guarded,
+                                                scope=_sc,
+                                                program="greedy_step",
                                                 static_argnums=1,
                                                 donate_argnums=(4,))
             self._sampled_step = plan_scoped_jit(
-                sampled_step, scope=_sc, static_argnums=1, donate_argnums=(4,))
-            self._greedy_steps = plan_scoped_jit(greedy_steps, scope=_sc,
+                sampled_step_guarded, scope=_sc, program="sampled_step",
+                static_argnums=1, donate_argnums=(4,))
+            self._greedy_steps = plan_scoped_jit(greedy_steps_guarded,
+                                                 scope=_sc,
+                                                 program="greedy_steps",
                                                  static_argnums=(1, 5),
                                                  donate_argnums=(4,))
-            self._sampled_steps = plan_scoped_jit(sampled_steps, scope=_sc,
+            self._sampled_steps = plan_scoped_jit(sampled_steps_guarded,
+                                                  scope=_sc,
+                                                  program="sampled_steps",
                                                   static_argnums=(1, 8),
                                                   donate_argnums=(4,))
-            self._verify_step = plan_scoped_jit(verify_step, scope=_sc,
+            self._verify_step = plan_scoped_jit(verify_step_guarded,
+                                                scope=_sc,
+                                                program="verify_step",
+                                                static_argnums=1,
+                                                donate_argnums=(4,))
+        # activation taps (numerics observatory): the tapped forward is
+        # only jitted when the engine opted in — a taps-off engine never
+        # registers the program, keeping the default compile ledger
+        # byte-identical to a taps-never-imported baseline
+        self._step_tapped = None
+        if self.numerics_taps:
+            self._step_tapped = plan_scoped_jit(forward_with_taps, scope=_sc,
                                                 static_argnums=1,
                                                 donate_argnums=(4,))
 
@@ -550,6 +606,16 @@ class InferenceEngine:
             self._ctrl.send(self._ctrl.encode(
                 kind, tokens_2d, start_pos,
                 scalars=extras if kind == CTRL_SAMPLED else None))
+        trailing: tuple = ()
+        if step_fn is not self._step and step_fn is not self._step_tapped:
+            # guarded decode programs take the tripwire's poison selector
+            # as a trailing traced scalar (0.0 = clean; the `logits`
+            # failpoint drives it). Multihost pins it to 0 on every
+            # process — a root-only injection would desync the replicated
+            # outputs — while keeping the scalar in the program so root
+            # and workers compile identical executables.
+            poison = 0.0 if self.multihost else numerics.poison_code()
+            trailing = (jnp.float32(poison),)
         with self.watchdog.guard("dispatch"):
             failpoints.fire("step_hang")
             with (use_plan(self.plan) if self.plan is not None
@@ -558,7 +624,7 @@ class InferenceEngine:
                     self.params, self.cfg,
                     jnp.asarray(tokens_2d, dtype=jnp.int32),
                     jnp.int32(start_pos), self.kv,
-                    *(jnp.float32(e) for e in extras))
+                    *(jnp.float32(e) for e in extras), *trailing)
         return out
 
     def _forward(self, tokens_2d: np.ndarray, start_pos: int) -> jax.Array:
@@ -599,8 +665,25 @@ class InferenceEngine:
             pad_to = min(size, self.cfg.seq_len - self.pos)
             padded = chunk + [0] * (pad_to - valid)
             t0 = time.perf_counter()
-            logits = self._forward(np.asarray([padded]), self.pos)
+            if self._step_tapped is not None:
+                # numerics taps (opt-in): the tapped forward returns the
+                # per-layer stats pytree alongside the logits; publish it
+                # (gauges + /debug/numerics) per chunk
+                logits, taps = self._dispatch(
+                    self._step_tapped, np.asarray([padded]), self.pos)
+                numerics.record_taps(
+                    jax.tree_util.tree_map(np.asarray, taps))
+            else:
+                logits = self._forward(np.asarray([padded]), self.pos)
             logits_np = np.asarray(logits[0, valid - 1])
+            # host-side tripwire on the one row the next token derives
+            # from (it is already fetched; the fused in-graph check is
+            # decode's — prefill materializes its logits anyway)
+            bad = int(logits_np.size
+                      - np.count_nonzero(np.isfinite(logits_np)))
+            if bad:
+                numerics.check_nonfinite(bad, "prefill",
+                                         failfast=self.nf_failfast)
             # pad_to, not size: at the context tail the dispatched (and
             # compiled) program is pad_to wide — the admission guard must
             # not see a full-width bucket as compiled when only the
@@ -624,7 +707,12 @@ class InferenceEngine:
             raise ValueError(f"position {self.pos} reached seq_len {self.cfg.seq_len}")
         logits = self._forward(np.asarray([[token]]), self.pos)
         self.pos += 1
-        return np.asarray(logits[0, 0])
+        row = np.asarray(logits[0, 0])
+        bad = int(row.size - np.count_nonzero(np.isfinite(row)))
+        if bad:
+            numerics.check_nonfinite(bad, "decode",
+                                     failfast=self.nf_failfast)
+        return row
 
     def next_token(self, token: int) -> int:
         """The engine's next-token primitive — always ONE fused dispatch and a
@@ -638,16 +726,19 @@ class InferenceEngine:
             raise ValueError(f"position {self.pos} reached seq_len {self.cfg.seq_len}")
         t0 = time.perf_counter()
         if self.sampler.temperature == 0.0:
-            nxt = self._dispatch(self._greedy_step, np.asarray([[token]]), self.pos)
+            nxt, nf = self._dispatch(self._greedy_step,
+                                     np.asarray([[token]]), self.pos)
             self.pos += 1
+            numerics.check_nonfinite(nf, "decode", failfast=self.nf_failfast)
         elif self.host_sampling:
             nxt = (self.sampler.sample(self.decode_step(token)),)
         else:
             coin, self.sampler.rng_state = xorshift_random_f32(self.sampler.rng_state)
-            nxt = self._dispatch(
+            nxt, nf = self._dispatch(
                 self._sampled_step, np.asarray([[token]]), self.pos,
                 extras=(self.sampler.temperature, self.sampler.topp, coin))
             self.pos += 1
+            numerics.check_nonfinite(nf, "decode", failfast=self.nf_failfast)
         self._m_step_ms.record((time.perf_counter() - t0) * 1000.0)
         self._m_decode_tok.inc()
         self._m_kv.set(self.pos / self.cfg.seq_len)
@@ -690,20 +781,29 @@ class InferenceEngine:
                    temp: float, topp: float, coins) -> np.ndarray:
         """Dispatch one fused K-step decode (root and worker replay path)."""
         tok0 = jnp.asarray([token], dtype=jnp.int32)
+        poison = jnp.float32(0.0 if self.multihost
+                             else numerics.poison_code())
         with self.watchdog.guard("chunk"):
             failpoints.fire("step_hang")
             with (use_plan(self.plan) if self.plan is not None
                     else nullcontext()):
                 if greedy:
-                    toks, self.kv = self._greedy_steps(
+                    (toks, nf), self.kv = self._greedy_steps(
                         self.params, self.cfg, tok0, jnp.int32(start_pos),
-                        self.kv, k)
+                        self.kv, k, poison)
                 else:
-                    toks, self.kv = self._sampled_steps(
+                    (toks, nf), self.kv = self._sampled_steps(
                         self.params, self.cfg, tok0, jnp.int32(start_pos),
                         self.kv, jnp.float32(temp), jnp.float32(topp),
-                        jnp.asarray(coins, dtype=jnp.float32), k)
-            return np.asarray(toks)
+                        jnp.asarray(coins, dtype=jnp.float32), k, poison)
+            toks_np = np.asarray(toks)
+        # fail-fast only on the root: this is also the multihost worker
+        # replay path, and a NumericsError propagating out of worker_serve
+        # would kill the mirror while the root recovers — the next root
+        # dispatch would then hang in a collective against dead peers
+        numerics.check_nonfinite(nf, "decode",
+                                 failfast=self.nf_failfast and self._is_root)
+        return toks_np
 
     @property
     def spec_active(self) -> bool:
@@ -742,14 +842,20 @@ class InferenceEngine:
 
     def _run_verify(self, tokens_2d, start_pos: int):
         """Dispatch one verify step (root and worker replay path)."""
+        poison = jnp.float32(0.0 if self.multihost
+                             else numerics.poison_code())
         with self.watchdog.guard("verify"):
             failpoints.fire("step_hang")
             with (use_plan(self.plan) if self.plan is not None
                     else nullcontext()):
-                n_acc, preds, self.kv = self._verify_step(
+                (n_acc, preds, nf), self.kv = self._verify_step(
                     self.params, self.cfg, jnp.asarray(tokens_2d, jnp.int32),
-                    jnp.int32(start_pos), self.kv)
-            return int(np.asarray(n_acc)[0]), np.asarray(preds)
+                    jnp.int32(start_pos), self.kv, poison)
+            out = int(np.asarray(n_acc)[0]), np.asarray(preds)
+        # root-only fail-fast: see _run_chunk (worker replay path)
+        numerics.check_nonfinite(nf, "verify",
+                                 failfast=self.nf_failfast and self._is_root)
+        return out
 
     def commit_chunk(self, n_keep: int) -> None:
         """Advance position and sampler RNG by the kept prefix of a chunk."""
@@ -780,7 +886,7 @@ class InferenceEngine:
                 fn = self._greedy_step
                 compiled = fn.lower(
                     self.params, self.cfg, jnp.zeros((1, 1), jnp.int32),
-                    jnp.int32(pos), self.kv).compile()
+                    jnp.int32(pos), self.kv, jnp.float32(0)).compile()
             elif kind == "prefill":
                 fn = self._step
                 chunk = next((b for b in self.prefill_buckets

@@ -21,7 +21,13 @@ exception types (``raise`` → :class:`FailpointError`, ``broken_pipe`` →
 ``OSError`` so the loader's transient-retry path treats it as such) —
 except ``sleep``, which does not raise at all: the armed site blocks for
 ``delay_s`` seconds (default 30; programmatic ``arm(..., delay_s=...)``
-overrides), simulating a wedged device dispatch for the step watchdog.
+overrides), simulating a wedged device dispatch for the step watchdog —
+and ``nonfinite``, which neither raises nor blocks: :func:`fire` RETURNS
+the poison mode (``"nan"`` default; programmatic ``arm(..., mode="inf")``
+selects Inf) and the call site injects it into the dispatch (the
+``logits`` site ships it as a traced scalar that poisons the decode-step
+logits in-graph, driving the numerics tripwire end to end —
+runtime/numerics.py).
 ``times`` bounds how often the point fires (default: every hit). Every
 fire increments ``dllama_failpoints_fired_total{name=...}`` so chaos
 tests assert injection *and* recovery through the same telemetry
@@ -43,6 +49,11 @@ have at least one call site:
 * ``step_hang`` — inside every watchdog-guarded device dispatch (engine
   and batched generator; the ``sleep`` action simulates a wedged XLA
   dispatch and exercises the step-watchdog trip).
+* ``logits`` — the decode-step logits poison selector
+  (``runtime/numerics.poison_code``, read by every guarded decode
+  dispatch): the ``nonfinite`` action injects NaN/Inf into the
+  decode-step logits in-graph, exercising the non-finite tripwire and
+  its opt-in fail-fast.
 """
 
 from __future__ import annotations
@@ -71,7 +82,10 @@ _ACTIONS = {
     "oserror": OSError,
     "short_read": ShortReadError,
     "sleep": None,  # blocks instead of raising (step-hang injection)
+    "nonfinite": None,  # returns the poison mode instead of raising
 }
+
+_POISON_MODES = ("nan", "inf")
 
 
 @dataclass
@@ -79,6 +93,7 @@ class _Armed:
     action: str
     times: int | None  # None = fire on every hit
     delay_s: float = DEFAULT_SLEEP_S  # sleep action only
+    mode: str = "nan"  # nonfinite action only: which poison to inject
 
 
 class FailpointRegistry:
@@ -91,14 +106,18 @@ class FailpointRegistry:
 
     def arm(self, name: str, action: str = "raise",
             times: int | None = None,
-            delay_s: float = DEFAULT_SLEEP_S) -> None:
+            delay_s: float = DEFAULT_SLEEP_S,
+            mode: str = "nan") -> None:
         if action not in _ACTIONS:
             raise ValueError(f"unknown failpoint action {action!r} "
                              f"(known: {sorted(_ACTIONS)})")
         if times is not None and times <= 0:
             raise ValueError("times must be positive (or None for always)")
+        if mode not in _POISON_MODES:
+            raise ValueError(f"nonfinite mode must be one of "
+                             f"{_POISON_MODES}, got {mode!r}")
         with self._lock:
-            self._armed[name] = _Armed(action, times, delay_s)
+            self._armed[name] = _Armed(action, times, delay_s, mode)
 
     def disarm(self, name: str) -> None:
         with self._lock:
@@ -118,19 +137,22 @@ class FailpointRegistry:
         with self._lock:
             return self._fired.get(name, 0)
 
-    def fire(self, name: str) -> None:
+    def fire(self, name: str) -> str | None:
         """Raise the armed exception for ``name``; no-op when disarmed.
 
-        The disarmed fast path takes no lock: ``_armed`` is read as a
-        plain attribute and arming between the check and the locked
-        re-check only delays the injection by one hit — fine for a test
-        hook, and it keeps per-step cost negligible."""
+        Non-raising actions return instead: ``nonfinite`` returns its
+        poison mode (``"nan"``/``"inf"``) for the call site to inject,
+        ``sleep`` blocks then returns None. The disarmed fast path takes
+        no lock: ``_armed`` is read as a plain attribute and arming
+        between the check and the locked re-check only delays the
+        injection by one hit — fine for a test hook, and it keeps
+        per-step cost negligible."""
         if not self._armed:
-            return
+            return None
         with self._lock:
             fp = self._armed.get(name)
             if fp is None:
-                return
+                return None
             if fp.times is not None:
                 fp.times -= 1
                 if fp.times <= 0:
@@ -143,7 +165,9 @@ class FailpointRegistry:
             # simulate a wedged dispatch: block the calling thread, then
             # return normally — the step watchdog must notice, not this code
             time.sleep(fp.delay_s)
-            return
+            return None
+        if fp.action == "nonfinite":
+            return fp.mode
         raise _ACTIONS[fp.action](f"failpoint {name!r} fired")
 
     def configure(self, spec: str | None) -> None:
@@ -172,13 +196,13 @@ def registry() -> FailpointRegistry:
     return _registry
 
 
-def fire(name: str) -> None:
-    _registry.fire(name)
+def fire(name: str) -> str | None:
+    return _registry.fire(name)
 
 
 def arm(name: str, action: str = "raise", times: int | None = None,
-        delay_s: float = DEFAULT_SLEEP_S) -> None:
-    _registry.arm(name, action, times, delay_s)
+        delay_s: float = DEFAULT_SLEEP_S, mode: str = "nan") -> None:
+    _registry.arm(name, action, times, delay_s, mode)
 
 
 def configure_from_env() -> bool:

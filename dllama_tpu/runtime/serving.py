@@ -36,9 +36,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import failpoints, introspection, telemetry
+from . import failpoints, introspection, numerics, telemetry
 
-from ..models.llama import forward, sampled_step
+from ..models.llama import forward, sampled_step_guarded
 from ..parallel.api import plan_scoped_jit, use_plan
 from ..parallel.multihost import (
     CTRL_SRV_COMMIT,
@@ -112,33 +112,38 @@ def check_hbm_admission(engine, n_prompt: int, need_bytes: int) -> None:
         raise HbmAdmissionError(reason)
 
 
-def _replicated_ragged_step(params, cfg, tokens, pos, kv, temps, topps, coins):
+def _replicated_ragged_step(params, cfg, tokens, pos, kv, temps, topps,
+                            coins, poison):
     """Ragged sampled step with replicated picked tokens (multihost: every
-    process reads the same [B] vector on host)."""
+    process reads the same [B] vector on host). Guarded: the non-finite
+    tripwire's per-row count rides along, replicated too."""
     from ..parallel.api import constrain
 
-    tok, kv = sampled_step(params, cfg, tokens, pos, kv, temps, topps, coins)
-    return constrain(tok, None), kv
+    (tok, nf), kv = sampled_step_guarded(params, cfg, tokens, pos, kv,
+                                         temps, topps, coins, poison)
+    return (constrain(tok, None), constrain(nf, None)), kv
 
 
 def _replicated_ragged_steps(params, cfg, token, pos, kv, temps, topps,
-                             coins, n_steps):
-    from ..models.llama import sampled_steps
+                             coins, n_steps, poison):
+    from ..models.llama import sampled_steps_guarded
     from ..parallel.api import constrain
 
-    toks, kv = sampled_steps(params, cfg, token, pos, kv, temps, topps,
-                             coins, n_steps)
-    return constrain(toks, None, None), kv
+    (toks, nf), kv = sampled_steps_guarded(params, cfg, token, pos, kv,
+                                           temps, topps, coins, n_steps,
+                                           poison)
+    return (constrain(toks, None, None), constrain(nf, None)), kv
 
 
 def _replicated_ragged_verify(params, cfg, tokens, pos, kv, temps, topps,
-                              coins):
-    from ..models.llama import ragged_verify_step
+                              coins, poison):
+    from ..models.llama import ragged_verify_step_guarded
     from ..parallel.api import constrain
 
-    n_acc, preds, kv = ragged_verify_step(params, cfg, tokens, pos, kv,
-                                          temps, topps, coins)
-    return constrain(n_acc, None), constrain(preds, None, None), kv
+    (n_acc, preds, nf), kv = ragged_verify_step_guarded(
+        params, cfg, tokens, pos, kv, temps, topps, coins, poison)
+    return (constrain(n_acc, None), constrain(preds, None, None),
+            constrain(nf, None)), kv
 
 
 @dataclass
@@ -336,12 +341,15 @@ class BatchedGenerator:
         self.spec = max(0, getattr(engine, "spec_lookup", 0))
         self._proposers: list = [None] * n_slots
         if self.spec:
-            from ..models.llama import ragged_verify_step
+            from ..models.llama import ragged_verify_step_guarded
 
             self._verify = plan_scoped_jit(
                 _replicated_ragged_verify if engine.multihost
-                else ragged_verify_step,
-                scope=_sc, static_argnums=1, donate_argnums=(4,))
+                else ragged_verify_step_guarded,
+                scope=_sc, program=("_replicated_ragged_verify"
+                                    if engine.multihost
+                                    else "ragged_verify_step"),
+                static_argnums=1, donate_argnums=(4,))
         # non-multihost engine._step IS jit(forward) with these options;
         # multihost needs plain forward (the engine's replicated_forward
         # constrains logits this path discards, but matching the seed's
@@ -398,44 +406,55 @@ class BatchedGenerator:
     def _exec_commit(self, slot: int, col) -> None:
         self.kv = self._put(self.kv, col, slot)
 
+    def _poison(self) -> jnp.ndarray:
+        """The tripwire's poison selector for one ragged dispatch: always
+        0 under multihost (root AND mirrors — a one-sided injection would
+        desync the replicated outputs), else driven by the `logits`
+        failpoint (runtime/numerics)."""
+        return jnp.float32(0.0 if self.eng.multihost
+                           else numerics.poison_code())
+
     def _exec_step(self, tokens, pos, temps, topps, coins):
         with self.eng.watchdog.guard("batch_step"):
             failpoints.fire("step_hang")
             with self._plan_ctx():
-                nxt, self.kv = self._step(
+                (nxt, nf), self.kv = self._step(
                     self.eng.params, self.cfg,
                     jnp.asarray(np.asarray(tokens, np.int32)[:, None]),
                     jnp.asarray(np.asarray(pos, np.int32)), self.kv,
                     jnp.asarray(np.asarray(temps, np.float32)),
                     jnp.asarray(np.asarray(topps, np.float32)),
-                    jnp.asarray(np.asarray(coins, np.float32)))
-            return np.asarray(nxt)
+                    jnp.asarray(np.asarray(coins, np.float32)),
+                    self._poison())
+            return np.asarray(nxt), np.asarray(nf)
 
     def _exec_step_chunk(self, tokens, pos, temps, topps, coins, k: int):
         with self.eng.watchdog.guard("batch_chunk"):
             failpoints.fire("step_hang")
             with self._plan_ctx():
-                toks, self.kv = self._steps(
+                (toks, nf), self.kv = self._steps(
                     self.eng.params, self.cfg,
                     jnp.asarray(np.asarray(tokens, np.int32)),
                     jnp.asarray(np.asarray(pos, np.int32)), self.kv,
                     jnp.asarray(np.asarray(temps, np.float32)),
                     jnp.asarray(np.asarray(topps, np.float32)),
-                    jnp.asarray(np.asarray(coins, np.float32)), k)
-            return np.asarray(toks)  # [B, k]
+                    jnp.asarray(np.asarray(coins, np.float32)), k,
+                    self._poison())
+            return np.asarray(toks), np.asarray(nf)  # [B, k], [B]
 
     def _exec_verify(self, toks_2d, pos, temps, topps, coins):
         with self.eng.watchdog.guard("batch_verify"):
             failpoints.fire("step_hang")
             with self._plan_ctx():
-                n_acc, preds, self.kv = self._verify(
+                (n_acc, preds, nf), self.kv = self._verify(
                     self.eng.params, self.cfg,
                     jnp.asarray(np.asarray(toks_2d, np.int32)),
                     jnp.asarray(np.asarray(pos, np.int32)), self.kv,
                     jnp.asarray(np.asarray(temps, np.float32)),
                     jnp.asarray(np.asarray(topps, np.float32)),
-                    jnp.asarray(np.asarray(coins, np.float32)))
-            return np.asarray(n_acc), np.asarray(preds)
+                    jnp.asarray(np.asarray(coins, np.float32)),
+                    self._poison())
+            return np.asarray(n_acc), np.asarray(preds), np.asarray(nf)
 
     # -- slot lifecycle -----------------------------------------------------
 
@@ -624,11 +643,15 @@ class BatchedGenerator:
                 self.next_token.astype(np.int32), self.pos.astype(np.int32),
                 self._f32bits(temps, topps, coins)]))
         t0 = time.perf_counter()
-        nxt = self._exec_step(self.next_token, self.pos, temps, topps, coins)
+        nxt, nf = self._exec_step(self.next_token, self.pos, temps, topps,
+                                  coins)
         ms = (time.perf_counter() - t0) * 1000.0
 
+        poisoned = self._handle_nonfinite(active, nf)
         emitted = 0
         for i in active:
+            if i in poisoned:
+                continue
             emitted += self._emit_run(i, [int(nxt[i])])
         self._record_step(len(active), ms, emitted)
         return emitted
@@ -674,11 +697,14 @@ class BatchedGenerator:
                 self.next_token.astype(np.int32), self.pos.astype(np.int32),
                 self._f32bits(temps, topps, coins.reshape(-1))]))
         t0 = time.perf_counter()
-        toks = self._exec_step_chunk(self.next_token, self.pos, temps,
-                                     topps, coins, k)
+        toks, nf = self._exec_step_chunk(self.next_token, self.pos, temps,
+                                         topps, coins, k)
         step_ms = (time.perf_counter() - t0) * 1000.0
+        poisoned = self._handle_nonfinite(active, nf)
         emitted = 0
         for i in active:
+            if i in poisoned:
+                continue
             req = self.slots[i]
             sampled = req.temperature > 0.0
             n = self._emit_run(i, [int(t) for t in toks[i]])
@@ -690,6 +716,27 @@ class BatchedGenerator:
                 req.rng_state = st
         self._record_step(len(active), step_ms, emitted)
         return emitted
+
+    def _handle_nonfinite(self, active: list[int], nf) -> set[int]:
+        """Non-finite tripwire tail for one ragged dispatch: count each
+        poisoned row's event (``dllama_nonfinite_total{site="batch"}``);
+        with fail-fast armed, fail THAT request explicitly (503-shaped —
+        an explicit numerics error instead of garbage tokens) and retire
+        its slot, leaving the rest of the batch untouched. Returns the
+        retired rows."""
+        failed: set[int] = set()
+        for i in active:
+            n = int(nf[i])
+            if n <= 0:
+                continue
+            numerics.record_nonfinite(n, "batch")
+            if getattr(self.eng, "nf_failfast", False):
+                req = self.slots[i]
+                req.error = str(numerics.nonfinite_error("batch", n))
+                req.server_error = True
+                self._retire(i)
+                failed.add(i)
+        return failed
 
     def _record_step(self, n_active: int, ms: float, emitted: int) -> None:
         """Per-dispatch telemetry: occupancy, step latency, emitted tokens,
@@ -750,12 +797,16 @@ class BatchedGenerator:
                 toks.reshape(-1), self.pos.astype(np.int32),
                 self._f32bits(temps, topps, coins)]))
         t0 = time.perf_counter()
-        n_acc, preds = self._exec_verify(toks, self.pos, temps, topps, coins)
+        n_acc, preds, nf = self._exec_verify(toks, self.pos, temps, topps,
+                                             coins)
         ms = (time.perf_counter() - t0) * 1000.0
         n_greedy = sum(1 for i in active if self.slots[i].temperature <= 0.0)
         self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(n_greedy * self.spec)
+        poisoned = self._handle_nonfinite(active, nf)
         emitted = 0
         for i in active:
+            if i in poisoned:
+                continue
             acc = int(n_acc[i])
             if self.slots[i].temperature <= 0.0 and acc:
                 self._tm.counter(telemetry.SPEC_ACCEPTED_TOKENS).inc(acc)
@@ -1097,6 +1148,14 @@ class BatchScheduler:
                 telemetry.registry().counter(telemetry.RETIRES).inc()
                 adm.req.error = f"{type(e).__name__}: {e}"
                 adm.req.done.set()
+        # golden canary drift sentinel (runtime/numerics): time-gated
+        # fixed-seed replay on this thread — the same thread that owns
+        # every device dispatch, so it can never race a batch step. Its
+        # golden was recorded at startup (run_api_server), so replays are
+        # compile-cache hits and cannot trip the retrace sentinel.
+        canary = getattr(self.gen.eng, "canary", None)
+        if canary is not None:
+            canary.maybe_run()
         if self.gen.n_active == 0 and not self._admissions:
             self._wake.wait(timeout=0.05)
             self._wake.clear()

@@ -86,6 +86,15 @@ TTFT_MS = "dllama_ttft_ms"
 ITL_MS = "dllama_itl_ms"
 PROMPT_TOKENS = "dllama_prompt_tokens_total"
 COMPLETION_TOKENS = "dllama_completion_tokens_total"
+# numerics observatory (runtime/numerics.py, models/llama.py taps)
+NONFINITE = "dllama_nonfinite_total"
+CANARY_RUNS = "dllama_canary_runs_total"
+CANARY_DRIFT = "dllama_canary_drift_total"
+Q80_ROUNDTRIP_ERROR = "dllama_q80_roundtrip_error"
+ACTIVATION_RMS = "dllama_activation_rms"
+ACTIVATION_ABSMAX = "dllama_activation_absmax"
+QUANT_AUDIT_MIN_SNR = "dllama_quant_audit_min_snr_db"
+QUANT_AUDIT_NONFINITE = "dllama_quant_audit_nonfinite_total"
 # XLA compile introspection (runtime/introspection.py)
 COMPILE_TOTAL = "dllama_compile_total"
 COMPILE_SECONDS = "dllama_compile_seconds"
@@ -193,6 +202,35 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
     _spec(HBM_ADMISSION_REJECTS, "counter",
           "Admissions rejected by the HBM admission guard (estimated + "
           "measured per-program bytes would exceed the device limit)"),
+    _spec(NONFINITE, "counter",
+          "Non-finite tripwire events by site (decode/batch/verify/"
+          "prefill/canary/taps): a dispatch whose logits — or a tapped "
+          "activation — contained NaN/Inf. One increment per event, not "
+          "per lane"),
+    _spec(CANARY_RUNS, "counter",
+          "Golden-canary replays (fixed-seed prompt through the live "
+          "weights; runtime/numerics.CanarySentinel)"),
+    _spec(CANARY_DRIFT, "counter",
+          "Canary replays whose token ids or logit fingerprint diverged "
+          "from the recorded golden — a silent numerics regression; the "
+          "WARN names the first divergent layer when taps are on"),
+    _spec(Q80_ROUNDTRIP_ERROR, "gauge",
+          "Relative RMS error of one Q80 quantize→dequantize roundtrip "
+          "of the tapped activation, by site — the quantization loss the "
+          "Q80 sync/wire collectives apply (parallel/qcollectives)"),
+    _spec(ACTIVATION_RMS, "gauge",
+          "Tapped activation rms by site (last layer for the stacked "
+          "sites; --numerics-taps)"),
+    _spec(ACTIVATION_ABSMAX, "gauge",
+          "Tapped activation abs-max by site (max over layers)"),
+    _spec(QUANT_AUDIT_MIN_SNR, "gauge",
+          "Worst per-tensor Q40 roundtrip SNR (dB) from the last "
+          "`dllama_tpu audit` sweep (0 until one ran; exact roundtrips "
+          "excluded)"),
+    _spec(QUANT_AUDIT_NONFINITE, "counter",
+          "Non-finite values found in model tensors by audit sweeps "
+          "(any growth means a damaged or mis-scaled tensor; the audit "
+          "table names it)"),
     _spec(COMPILE_TOTAL, "counter",
           "XLA trace+compile events by program and engine scope "
           "(runtime/introspection ledger)"),
@@ -571,4 +609,12 @@ def stats_line(reg: Registry | None = None, *,
     n_retrace = reg.counter(RETRACE_UNEXPECTED).total()
     if n_retrace:
         parts.append(f"retrace={int(n_retrace)}!")
+    # numerics alarms (runtime/numerics): same `=N!` convention as retrace —
+    # a steady healthy server never shows either marker
+    n_nonfinite = reg.counter(NONFINITE).total()
+    if n_nonfinite:
+        parts.append(f"nonfinite={int(n_nonfinite)}!")
+    n_drift = reg.counter(CANARY_DRIFT).total()
+    if n_drift:
+        parts.append(f"drift={int(n_drift)}!")
     return "📈 " + " ".join(parts)

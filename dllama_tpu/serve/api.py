@@ -33,7 +33,7 @@ import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
-from ..runtime import failpoints, introspection, profiling, telemetry
+from ..runtime import failpoints, introspection, numerics, profiling, telemetry
 from ..runtime.engine import InferenceEngine
 from ..runtime.serving import (HbmAdmissionError, QueueFullError,
                                RequestTimeoutError,
@@ -48,7 +48,8 @@ from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
 # (tools/check_route_labels.py enforces it in `make lint`).
 _ROUTES = ("/v1/chat/completions", "/v1/models", "/metrics",
            "/health", "/healthz", "/readyz",
-           "/debug/compiles", "/debug/requests", "/debug/profile")
+           "/debug/compiles", "/debug/requests", "/debug/profile",
+           "/debug/numerics")
 
 # POST /debug/profile capture-window bounds (ms): long enough to catch a few
 # decode steps, short enough that a handler thread never parks for minutes
@@ -354,6 +355,16 @@ class ApiState:
                 engine.pos)
         if scope and led.compile_count(scope) == compiles_before:
             led.mark_steady(scope)
+        # canary piggyback (single-sequence mode has no scheduler loop):
+        # the handler thread owns every dispatch, so replaying the canary
+        # between completions can never race a request's decode. Known
+        # trade-off: once per interval, one request's response write
+        # waits out the canary forward — acceptable for the low-traffic
+        # single-sequence mode (batched mode replays on the scheduler
+        # tick instead)
+        can = getattr(engine, "canary", None)
+        if can is not None:
+            can.maybe_run()
         return {
             "text": "".join(gate.parts),
             "finish_reason": finish_reason,
@@ -594,6 +605,11 @@ def make_handler(state: ApiState):
                 # timelines (SpanTracer; no --trace-out needed)
                 self._json(200,
                            {"requests": telemetry.tracer().recent_requests()})
+            elif path == "/debug/numerics":
+                # the numerics observatory: tripwire totals per site, the
+                # last tapped dispatch's per-layer stats, canary status
+                self._json(200, numerics.debug_snapshot(
+                    getattr(state, "engine", None)))
             else:
                 self._not_found()
 
@@ -744,6 +760,15 @@ def make_handler(state: ApiState):
                     self._json(408, {"error": str(e)})
                 else:
                     stream_abort("timeout")
+            except numerics.NumericsError as e:
+                # fail-fast tripwire: the model produced non-finite
+                # decode-step logits — an explicit 5xx naming the site,
+                # never garbage tokens (runtime/numerics)
+                status = 500
+                if not headers_sent:
+                    self._json(500, {"error": str(e)})
+                else:
+                    stream_abort("error")
             except (ClientDisconnect, BrokenPipeError,
                     ConnectionResetError):
                 # the peer hung up: nothing left to write, and this is
@@ -795,6 +820,25 @@ def run_api_server(args) -> int:
         print(f"🚧 HBM startup report unavailable: {type(e).__name__}: {e}")
     if getattr(args, "stats", 0):
         start_stats_reporter(float(args.stats))
+    # golden canary drift sentinel (--canary-interval SEC): record the
+    # golden NOW — before serving reaches steady state, so the canary's
+    # programs compile while compiles are still expected; every later
+    # replay is a compile-cache hit (ledger-quiet by construction)
+    canary_s = float(getattr(args, "canary_interval", 0.0) or 0.0)
+    if canary_s > 0:
+        if engine.multihost:
+            print("🚧 --canary-interval ignored under multihost (the "
+                  "canary's scratch dispatches are not broadcast to "
+                  "worker mirrors)")
+        else:
+            engine.canary = numerics.CanarySentinel(engine,
+                                                    interval_s=canary_s)
+            engine.canary.ensure_golden()
+            print(f"🐤 canary sentinel: fixed-seed replay every "
+                  f"{canary_s:g}s (drift → dllama_canary_drift_total, "
+                  f"WARN names the divergent layer"
+                  + (")" if engine.numerics_taps
+                     else " with --numerics-taps)"))
     n_slots = getattr(args, "batch_slots", 0) or 0
     max_queue = getattr(args, "max_queue", 0) or 0
     request_timeout = getattr(args, "request_timeout", 0.0) or 0.0

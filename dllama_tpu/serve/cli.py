@@ -34,7 +34,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllama_tpu",
                                 description="TPU-native distributed-llama")
     p.add_argument("mode", choices=["inference", "chat", "perplexity", "api",
-                                    "worker", "verify"])
+                                    "worker", "verify", "audit"])
     p.add_argument("--model", required=False, help=".m model file")
     p.add_argument("--tokenizer", required=False, help=".t tokenizer file")
     p.add_argument("--verify-weights", action="store_true",
@@ -153,6 +153,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "device time; traffic comes from the compiled HLO "
                         "(costs one extra XLA compile, absorbed by the "
                         "persistent compile cache)")
+    p.add_argument("--numerics-taps", action="store_true",
+                   help="collect per-layer activation stats (rms/abs-max/"
+                        "non-finite count/Q80 roundtrip error per block "
+                        "site) on prefill and canary forwards "
+                        "(runtime/numerics; surfaced via /debug/numerics "
+                        "and dllama_activation_* gauges). Off by default: "
+                        "the untapped trace is byte-identical and "
+                        "compile-ledger-quiet")
+    p.add_argument("--numerics-failfast", action="store_true",
+                   help="turn the always-on non-finite logits tripwire "
+                        "into fail-fast: a poisoned request dies with an "
+                        "explicit numerics error (HTTP 5xx naming the "
+                        "site) instead of emitting garbage tokens; "
+                        "default counts dllama_nonfinite_total only")
+    p.add_argument("--canary-interval", type=float, default=0.0,
+                   metavar="SEC",
+                   help="api mode: replay a fixed-seed canary prompt "
+                        "every SEC seconds and compare token ids + a "
+                        "logit fingerprint against the golden recorded "
+                        "at startup (drift → dllama_canary_drift_total, "
+                        "--stats drift=N!, WARN names the first "
+                        "divergent layer when --numerics-taps is on); "
+                        "0 = off")
+    p.add_argument("--audit-json", action="store_true",
+                   help="audit mode: print the per-tensor table as one "
+                        "JSON object instead of text")
     p.add_argument("--trace-out", default=None, metavar="FILE",
                    help="append per-request phase spans (queue/prefill/"
                         "decode/verify) as JSONL trace events to FILE "
@@ -389,6 +415,9 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         kv_dtype=getattr(args, "kv_dtype", "auto"),
         profile_split=getattr(args, "profile_split", False),
         verify_weights=getattr(args, "verify_weights", False),
+        numerics_taps=getattr(args, "numerics_taps", False),
+        numerics_failfast=(True if getattr(args, "numerics_failfast", False)
+                           else None),
     )
     h = engine.model_file.header
     print(f"💡 Arch: {h.arch_type.name}  Dim: {h.dim}  Layers: {h.n_layers}  "
@@ -566,6 +595,27 @@ def run_verify(args) -> int:
     print(f"✅ {res['tensors']} tensors verified against "
           f"{_mfile.manifest_path(args.model)}")
     return 0
+
+
+def run_audit(args) -> int:
+    """``python -m dllama_tpu audit --model m.m [--audit-json]`` — offline
+    per-tensor quant-error audit (runtime/numerics.audit_model): Q40/Q80
+    reconstruction health (non-finite values, scale range, roundtrip
+    SNR/MSE via the formats/quants reference codecs). Pure host-side: no
+    jax, no device. Exit 1 when any tensor carries non-finite values."""
+    from ..runtime.numerics import audit_model
+
+    if not args.model:
+        raise SystemExit("--model is required for audit mode")
+    try:
+        res = audit_model(args.model,
+                          emit=None if args.audit_json else print)
+    except (OSError, ValueError) as e:
+        print(f"❌ {args.model}: {e}")
+        return 1
+    if args.audit_json:
+        print(json.dumps(res))
+    return 1 if res["nonfinite_tensors"] else 0
 
 
 def run_perplexity(args) -> int:
@@ -782,6 +832,9 @@ def main(argv=None) -> int:
     if args.mode == "verify":
         # pure host-side integrity check: no jax backend, no compile cache
         return run_verify(args)
+    if args.mode == "audit":
+        # host-side quant-error audit (runtime/numerics): no jax either
+        return run_audit(args)
     _setup_compile_cache(args)
     if args.mode != "worker":
         # Honor an explicit JAX_PLATFORMS (e.g. the virtual CPU mesh:

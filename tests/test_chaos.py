@@ -391,6 +391,62 @@ def test_sse_client_disconnect_counted_not_500(batched_server):
         assert json.loads(r.read())["usage"]["completion_tokens"] >= 1
 
 
+# -- numerics tripwire (ISSUE 5): logits failpoint → count / fail-fast -------
+
+
+def test_logits_failpoint_counts_without_failfast(engine):
+    """Armed `logits:nonfinite` → one batched dispatch's logits are
+    poisoned in-graph; default mode counts the tripwire event
+    (site=batch) and still emits the (garbage) tokens — observable, not
+    behavior-changing."""
+    nf = tm.registry().counter(tm.NONFINITE)
+    fired = tm.registry().counter(tm.FAILPOINTS_FIRED)
+    b0, f0 = nf.total(site="batch"), fired.total(name="logits")
+    sched = BatchScheduler(engine, n_slots=2)
+    try:
+        fp.arm("logits", "nonfinite", times=1)
+        req = sched.submit(_enc(engine), 4, stop_on_eos=False)
+        assert req.done.wait(timeout=60)
+        assert req.error is None and len(req.tokens) == 4
+        assert nf.total(site="batch") == b0 + 1
+        assert fired.total(name="logits") == f0 + 1
+    finally:
+        fp.registry().clear()
+        sched.close()
+
+
+def test_logits_failfast_fails_poisoned_request_503_shaped(tmp_path):
+    """Fail-fast armed → the poisoned request dies with an explicit
+    numerics error (server_error ⇒ HTTP 503-shaped) instead of garbage
+    tokens, the slot is reclaimed, and the next clean request serves."""
+    from dllama_tpu.runtime import numerics
+
+    nf = tm.registry().counter(tm.NONFINITE)
+    b0 = nf.total(site="batch")
+    mpath, tpath = _fresh_model(tmp_path)
+    eng = InferenceEngine(mpath, tpath, tp=1, temperature=0.0, seed=3,
+                          numerics_failfast=True)
+    sched = BatchScheduler(eng, n_slots=2)
+    try:
+        fp.arm("logits", "nonfinite", times=1)
+        req = sched.submit(_enc(eng), 8, stop_on_eos=False)
+        assert req.done.wait(timeout=60)
+        assert req.error is not None and "non-finite" in req.error
+        assert "site=batch" in req.error
+        assert req.server_error  # maps to HTTP 503, not 400
+        assert nf.total(site="batch") == b0 + 1
+        # mid-request tripwires fail ONE request, not the scheduler
+        ok = sched.submit(_enc(eng), 4, stop_on_eos=False)
+        assert ok.done.wait(timeout=60)
+        assert ok.error is None and len(ok.tokens) == 4
+        assert isinstance(numerics.nonfinite_error("batch", 1),
+                          numerics.NumericsError)
+    finally:
+        fp.registry().clear()
+        sched.close()
+        eng.close()
+
+
 # -- runtime hardening (ISSUE 4): loader retries, corruption, watchdog, HBM --
 
 

@@ -103,11 +103,12 @@ def test_metrics_endpoint_after_one_completion(server):
 
 
 def test_metrics_names_all_match_convention(server):
-    """Every sample name on the wire derives from a dllama_[a-z_]+ metric
-    (the contract tools/check_metrics_names.py lints at the source level)."""
+    """Every sample name on the wire derives from a dllama_[a-z0-9_]+
+    metric (the contract tools/check_metrics_names.py lints at the source
+    level; digits admitted for format names like q80)."""
     import re
 
-    pat = re.compile(r"^dllama_[a-z_]+(_bucket|_sum|_count)?(\{.*\})?$")
+    pat = re.compile(r"^dllama_[a-z0-9_]+(_bucket|_sum|_count)?(\{.*\})?$")
     for name in _scrape(server):
         assert pat.match(name), name
 

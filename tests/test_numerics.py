@@ -1,0 +1,378 @@
+"""Numerics observatory tier (runtime/numerics.py): activation taps,
+the non-finite tripwire + fail-fast, the golden canary drift sentinel
+(including the ISSUE-5 acceptance criteria: a patched weight trips
+``dllama_canary_drift_total`` with the divergent layer named, and a
+taps-off canary adds ZERO compiles after steady state — ledger-asserted),
+the offline quant-error audit, and the ``/debug/numerics`` endpoint."""
+
+import json
+import math
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import mfile, tfile
+from dllama_tpu.runtime import failpoints as fp
+from dllama_tpu.runtime import introspection, numerics
+from dllama_tpu.runtime import telemetry as tm
+from dllama_tpu.runtime.engine import InferenceEngine
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.registry().clear()
+    yield
+    fp.registry().clear()
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("numerics")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(17))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    return str(mpath), str(tpath)
+
+
+def _engine(model_files, **kw):
+    kw.setdefault("tp", 1)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("seed", 3)
+    return InferenceEngine(*model_files, **kw)
+
+
+# -- activation taps ----------------------------------------------------------
+
+
+def test_tapped_forward_is_bit_identical_and_stats_shaped(model_files):
+    """forward_with_taps returns the SAME logits as the plain forward
+    (the taps are observers, never participants) plus a stats pytree
+    with every documented site, per-layer leaves, zero non-finite
+    counts on a healthy model, and a nonzero Q80 roundtrip error."""
+    plain = _engine(model_files)
+    tapped = _engine(model_files, numerics_taps=True)
+    try:
+        ids = plain.tokenizer.encode("hello world", is_start=True)
+        lp, _ = plain.prefill(ids)
+        lt, _ = tapped.prefill(ids)
+        np.testing.assert_array_equal(np.asarray(lp), np.asarray(lt))
+
+        snap = numerics.debug_snapshot(tapped)
+        taps = snap["taps"]
+        assert sorted(taps) == sorted(numerics.TAP_SITES)
+        n_layers = tapped.cfg.n_layers
+        for site in ("attn_out", "mlp_out"):
+            assert len(taps[site]["rms"]) == n_layers
+            assert taps[site]["nonfinite"] == 0
+            assert all(v > 0 for v in taps[site]["rms"])
+            assert all(v > 0 for v in taps[site]["q80_err"])
+        assert taps["logits"]["nonfinite"] == 0
+        reg = tm.registry()
+        assert reg.gauge(tm.ACTIVATION_RMS).value(site="mlp_out") > 0
+        assert reg.gauge(tm.ACTIVATION_ABSMAX).value(site="logits") > 0
+        assert reg.gauge(tm.Q80_ROUNDTRIP_ERROR).value(site="attn_out") > 0
+    finally:
+        plain.close()
+        tapped.close()
+
+
+def test_taps_flag_rejected_under_multihost(model_files):
+    with pytest.raises(ValueError, match="numerics-taps"):
+        _engine(model_files, numerics_taps=True, multihost=True)
+
+
+# -- non-finite tripwire ------------------------------------------------------
+
+
+def test_logits_failpoint_poisons_decode_and_counts(model_files):
+    """Armed `logits:nonfinite` → the fused in-graph tripwire counts the
+    poisoned decode dispatch (site=decode) while the default mode still
+    emits a (garbage) token — count, don't alter behavior."""
+    eng = _engine(model_files)
+    nf = tm.registry().counter(tm.NONFINITE)
+    fired = tm.registry().counter(tm.FAILPOINTS_FIRED)
+    before, f0 = nf.total(site="decode"), fired.total(name="logits")
+    try:
+        ids = eng.tokenizer.encode("hello", is_start=True)
+        eng.prefill(ids[:-1])
+        fp.arm("logits", "nonfinite", times=1)
+        tok = eng.next_token(ids[-1])
+        assert 0 <= tok < eng.cfg.vocab_size  # a token WAS emitted
+        assert nf.total(site="decode") == before + 1
+        assert fired.total(name="logits") == f0 + 1
+        # disarmed again: clean steps don't count
+        eng.next_token(tok)
+        assert nf.total(site="decode") == before + 1
+    finally:
+        eng.close()
+
+
+def test_failfast_raises_numerics_error_naming_site(model_files):
+    eng = _engine(model_files, numerics_failfast=True)
+    try:
+        ids = eng.tokenizer.encode("hello", is_start=True)
+        eng.prefill(ids[:-1])
+        fp.arm("logits", "nonfinite", times=1)
+        with pytest.raises(numerics.NumericsError, match="site=decode"):
+            eng.next_token(ids[-1])
+        # the failpoint consumed itself: the engine still serves
+        tok = eng.next_token(ids[-1])
+        assert 0 <= tok < eng.cfg.vocab_size
+    finally:
+        eng.close()
+
+
+def test_tripwire_covers_chunked_and_verify_dispatches(model_files):
+    """The guarded chunk and speculative-verify programs carry the same
+    fused count (site=decode / site=verify)."""
+    nf = tm.registry().counter(tm.NONFINITE)
+    eng = _engine(model_files, decode_chunk=4)
+    try:
+        ids = eng.tokenizer.encode("hello", is_start=True)
+        eng.prefill(ids[:-1])
+        d0 = nf.total(site="decode")
+        fp.arm("logits", "nonfinite", times=1)
+        toks = eng.decode_chunk_tokens(ids[-1], 4)
+        assert len(toks) == 4
+        assert nf.total(site="decode") == d0 + 1
+    finally:
+        eng.close()
+    eng = _engine(model_files, spec_lookup=2)
+    try:
+        ids = eng.tokenizer.encode("hello", is_start=True)
+        eng.prefill(ids[:-1])
+        v0 = nf.total(site="verify")
+        fp.arm("logits", "nonfinite", times=1)
+        run = eng.speculative_tokens(ids[-1], [1, 2])
+        assert 1 <= len(run) <= 3
+        assert nf.total(site="verify") == v0 + 1
+    finally:
+        eng.close()
+
+
+def test_poison_inf_mode(model_files):
+    """`arm(..., mode="inf")` injects Inf instead of NaN — both are
+    non-finite, both trip."""
+    eng = _engine(model_files)
+    nf = tm.registry().counter(tm.NONFINITE)
+    before = nf.total(site="decode")
+    try:
+        ids = eng.tokenizer.encode("hi", is_start=True)
+        eng.prefill(ids[:-1])
+        fp.arm("logits", "nonfinite", times=1, mode="inf")
+        eng.next_token(ids[-1])
+        assert nf.total(site="decode") == before + 1
+    finally:
+        eng.close()
+
+
+# -- golden canary drift sentinel --------------------------------------------
+
+
+def test_canary_clean_replay_does_not_drift(model_files):
+    eng = _engine(model_files, numerics_taps=True)
+    try:
+        c = numerics.CanarySentinel(eng, interval_s=0.0)
+        c.ensure_golden()
+        drift0 = tm.registry().counter(tm.CANARY_DRIFT).total()
+        for _ in range(2):
+            res = c.run()
+            assert res["drift"] is False
+        assert tm.registry().counter(tm.CANARY_DRIFT).total() == drift0
+        st = c.status()
+        assert st["golden_recorded"] and st["runs"] >= 2
+        assert st["drifts"] == 0
+    finally:
+        eng.close()
+
+
+def test_canary_detects_patched_weight_and_names_layer(model_files, capsys):
+    """ISSUE-5 acceptance: a deliberately perturbed forward (patched
+    weight) trips dllama_canary_drift_total and the WARN names the first
+    divergent layer via the taps."""
+    eng = _engine(model_files, numerics_taps=True)
+    try:
+        c = numerics.CanarySentinel(eng, interval_s=0.0)
+        c.ensure_golden()
+        assert c.run()["drift"] is False
+        layers = eng.params.layers
+        eng.params = eng.params._replace(layers=layers._replace(
+            norm_ffn=layers.norm_ffn.at[1].multiply(3.0)))
+        drift0 = tm.registry().counter(tm.CANARY_DRIFT).total()
+        res = c.run()
+        assert res["drift"] is True
+        assert res["divergent_layer"] == "layer 1 (mlp_out)"
+        assert tm.registry().counter(tm.CANARY_DRIFT).total() == drift0 + 1
+        out = capsys.readouterr().out
+        assert "canary drift" in out and "layer 1 (mlp_out)" in out
+    finally:
+        eng.close()
+
+
+def test_canary_is_compile_ledger_quiet_without_taps(model_files):
+    """ISSUE-5 acceptance: with taps disabled the canary adds ZERO
+    compiles to the engine's scope after steady state (every replay is a
+    cache hit on the prefill-width forward program), and the retrace
+    sentinel stays silent — asserted through the compile ledger."""
+    led = introspection.ledger()
+    eng = _engine(model_files)
+    try:
+        assert getattr(eng, "_step_tapped", None) is None  # taps off
+        eng.generate("hello there friend", 3, stop_on_eos=False)
+        scope = eng.introspection_scope
+        compiles0 = led.compile_count(scope)
+        led.mark_steady(scope)
+        retrace0 = tm.registry().counter(tm.RETRACE_UNEXPECTED).total()
+        c = numerics.CanarySentinel(eng, interval_s=0.0)
+        c.ensure_golden()
+        c.run()
+        c.run()
+        assert led.compile_count(scope) == compiles0
+        assert tm.registry().counter(tm.RETRACE_UNEXPECTED).total() \
+            == retrace0
+        assert led.steady(scope)
+    finally:
+        eng.close()
+
+
+def test_canary_maybe_run_respects_interval(model_files):
+    eng = _engine(model_files)
+    try:
+        c = numerics.CanarySentinel(eng, interval_s=3600.0)
+        c.ensure_golden()
+        runs0 = c.runs
+        assert c.maybe_run() is None  # inside the interval: no-op
+        assert c.runs == runs0
+    finally:
+        eng.close()
+
+
+def test_canary_rejected_under_multihost(model_files):
+    eng = _engine(model_files)
+    try:
+        eng.multihost = True
+        with pytest.raises(ValueError, match="single-host"):
+            numerics.CanarySentinel(eng)
+    finally:
+        eng.multihost = False
+        eng.close()
+
+
+# -- offline quant-error audit ------------------------------------------------
+
+
+def test_audit_scores_healthy_model(model_files, tmp_path):
+    res = numerics.audit_model(model_files[0], emit=None)
+    assert res["tensors"] > 0
+    assert res["nonfinite_tensors"] == []
+    by_name = {r["tensor"]: r for r in res["rows"]}
+    # quantized matmul tensors carry scale stats; healthy blocks
+    # re-encode exactly (self-consistency — the signal a mis-scaled
+    # block would break)
+    w1 = by_name["block_matmul_w1.0"]
+    assert w1["type"] == "q40" and w1["scale_nonfinite"] == 0
+    assert w1["q40_exact"] is True and w1["q40_mse"] == 0.0
+    # dense tensors report what Q40 quantization WOULD cost
+    emb = by_name["embedding"]
+    assert emb["type"] == "f32" and emb["q40_snr_db"] > 0
+    assert res["min_snr_db"] is not None and res["min_snr_db"] > 0
+    assert tm.registry().gauge(tm.QUANT_AUDIT_MIN_SNR).value() \
+        == pytest.approx(res["min_snr_db"])
+
+
+def test_audit_flags_nonfinite_scale_naming_tensor(model_files, tmp_path):
+    """A Q40 block scale flipped to f16 Inf — the mis-scaled-block defect
+    the audit exists to catch — is reported against the exact tensor and
+    advances the audit counter."""
+    import shutil
+
+    broken = tmp_path / "broken.m"
+    shutil.copy(model_files[0], broken)
+    with mfile.ModelFile.open(str(broken)) as mf:
+        rec = mf.tensors["block_matmul_w2.1"]
+    with open(broken, "r+b") as f:
+        f.seek(rec.offset)  # first block's f16 scale → +Inf (0x7C00)
+        f.write(bytes([0x00, 0x7C]))
+    audit_nf = tm.registry().counter(tm.QUANT_AUDIT_NONFINITE)
+    before = audit_nf.total()
+    res = numerics.audit_model(str(broken), emit=None)
+    assert "block_matmul_w2.1" in res["nonfinite_tensors"]
+    row = {r["tensor"]: r for r in res["rows"]}["block_matmul_w2.1"]
+    assert row["nonfinite"] > 0 and row["scale_nonfinite"] == 1
+    assert audit_nf.total() > before
+
+
+def test_audit_cli_mode(model_files, capsys):
+    from dllama_tpu.serve.cli import main
+
+    rc = main(["audit", "--model", model_files[0], "--audit-json"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    data = json.loads(out)
+    assert data["tensors"] > 0 and data["nonfinite_tensors"] == []
+
+
+# -- /debug/numerics + --stats markers ----------------------------------------
+
+
+def test_debug_numerics_endpoint_and_stats_markers(model_files, tmp_path):
+    from http.server import HTTPServer
+
+    from dllama_tpu.serve.api import ApiState, make_handler
+
+    # ApiState needs a chat template; build a templated tokenizer twin
+    td = byte_vocab_tokenizer()
+    td.chat_template = "<|start_header_id|>"  # detected as llama3
+    tfile.write_tfile(tmp_path / "t.t", td)
+    eng = _engine((model_files[0], str(tmp_path / "t.t")))
+    eng.canary = numerics.CanarySentinel(eng, interval_s=3600.0)
+    eng.canary.ensure_golden()
+    state = ApiState(eng)
+    httpd = HTTPServer(("127.0.0.1", 0), make_handler(state))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/debug/numerics"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            assert r.status == 200
+            snap = json.loads(r.read())
+        assert snap["canary"]["golden_recorded"] is True
+        assert "nonfinite_total" in snap and "taps" in snap
+        # the route is a first-class label, not "other"
+        http = tm.registry().counter(tm.HTTP_REQUESTS)
+        assert http.total(route="/debug/numerics", status="200") >= 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.close()
+
+    # --stats alarm markers ride the same counters (satellite: the
+    # nonfinite=N!/drift=N! convention, like retrace=N!)
+    reg = tm.registry()
+    reg.counter(tm.NONFINITE).inc(site="decode")
+    reg.counter(tm.CANARY_DRIFT).inc()
+    line = tm.stats_line(reg)
+    assert "nonfinite=" in line and line.split("nonfinite=")[1][0].isdigit()
+    assert "drift=" in line
+    assert "!" in line.split("drift=")[1][:4]
+
+
+def test_first_divergent_layer_ordering():
+    mk = lambda rms: {"rms": list(rms), "absmax": [0.0] * len(rms),
+                      "nonfinite": 0, "q80_err": [0.0] * len(rms)}
+    golden = {"attn_out": mk([1.0, 1.0]), "mlp_out": mk([2.0, 2.0]),
+              "final_norm": mk([3.0]), "logits": mk([4.0])}
+    drifted = {"attn_out": mk([1.0, 1.5]), "mlp_out": mk([2.0, 9.0]),
+               "final_norm": mk([3.0]), "logits": mk([4.0])}
+    assert numerics.first_divergent_layer(drifted, golden) \
+        == "layer 1 (attn_out)"
+    assert numerics.first_divergent_layer(golden, golden) is None
+    head_only = {k: (mk([3.0]) if k == "final_norm" else golden[k])
+                 for k in golden}
+    head_only["logits"] = mk([9.0])
+    assert numerics.first_divergent_layer(head_only, golden) == "logits"

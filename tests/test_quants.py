@@ -75,3 +75,94 @@ def test_zero_block():
     x = np.zeros(32, dtype=np.float32)
     np.testing.assert_array_equal(quants.dequantize_q40(quants.quantize_q40(x), 32), x)
     np.testing.assert_array_equal(quants.dequantize_q80(quants.quantize_q80(x), 32), x)
+
+
+# -- adversarial roundtrip bounds (ISSUE-5 satellite) -------------------------
+#
+# Property-style blocks: all-zero, colmax at the clamp edge (x/d in
+# (7.5, 8) clips to code 15 — the worst Q40 case), denormal magnitudes
+# (the f16 scale rounds to 0), and ±large magnitudes. Documented per-block
+# bound (in-range blocks): |err| <= absmax/8 (clip asymmetry) + absmax/16
+# (half a rounding step) + 8·2^-24 (f16 scale subnormal quantum, which
+# dominates once the scale itself denormalizes). Finite input must NEVER
+# dequantize non-finite (the stored scale saturates at the f16 max —
+# quants.py module docstring).
+
+
+def _adversarial_blocks(rng):
+    blocks = [
+        np.zeros(32, np.float32),                       # all-zero
+        np.full(32, 7.9, np.float32),                   # clamp edge...
+        np.linspace(-8.0, 7.9, 32).astype(np.float32),  # ...with -absmax
+        np.full(32, 1e-40, np.float32),                 # denormal block
+        (rng.standard_normal(32) * 1e-39).astype(np.float32),
+        (rng.standard_normal(32) * 1e4).astype(np.float32),   # ±large
+        (rng.standard_normal(32) * 5e4).astype(np.float32),
+        np.array([5e4] + [0.0] * 31, np.float32),       # lone spike
+        -np.array([5e4] + [0.0] * 31, np.float32),
+    ]
+    # clamp-edge block where x/d lands in (7.5, 8): gmin = -8 wins the
+    # signed max, d = 1, so +7.9 clips from code 16 to 15 (error 0.9 < 1)
+    blocks[1][0] = -8.0
+    return np.concatenate(blocks)
+
+
+def _q40_bound(x):
+    absmax = np.abs(x.reshape(-1, 32)).max(axis=1, keepdims=True)
+    return absmax / 8.0 + absmax / 16.0 + 8.0 * 2.0 ** -24 + 1e-30
+
+
+def test_q40_adversarial_blocks_within_documented_bound():
+    x = _adversarial_blocks(np.random.default_rng(0))
+    y = quants.dequantize_q40(quants.quantize_q40(x), x.size)
+    assert np.all(np.isfinite(y))
+    err = np.abs(x - y).reshape(-1, 32)
+    assert (err <= _q40_bound(x)).all(), \
+        (err - _q40_bound(x)).max()
+
+
+def test_q80_adversarial_blocks_within_documented_bound():
+    x = _adversarial_blocks(np.random.default_rng(1))
+    y = quants.dequantize_q80(quants.quantize_q80(x), x.size)
+    assert np.all(np.isfinite(y))
+    err = np.abs(x - y).reshape(-1, 32)
+    absmax = np.abs(x.reshape(-1, 32)).max(axis=1, keepdims=True)
+    # half a step of round-to-nearest + the f16 rounding of the stored
+    # scale over up to 127 code steps (+ subnormal quantum for denormals)
+    bound = absmax / 127.0 * 0.51 + absmax * 2.0 ** -11 \
+        + 127.0 * 2.0 ** -24 + 1e-30
+    assert (err <= bound).all(), (err - bound).max()
+
+
+def test_finite_input_never_dequantizes_nonfinite():
+    """Scale saturation: magnitudes whose block scale would overflow f16
+    (absmax > 8·65504 for Q40, 127·65504 for Q80) used to dequantize to
+    Inf/NaN; the stored scale now clamps to the finite f16 range."""
+    for mag in (6e5, 1e20, 3e38):
+        x = np.linspace(-mag, mag, 64).astype(np.float32)
+        y40 = quants.dequantize_q40(quants.quantize_q40(x), 64)
+        assert np.all(np.isfinite(y40)), mag
+        y80 = quants.dequantize_q80(quants.quantize_q80(x), 64)
+        assert np.all(np.isfinite(y80)), mag
+    # in-range blocks are byte-identical to the unclamped encoding: the
+    # stored f16 scales must equal the plain (clip-free) f16 rounding of
+    # the reference scale formula d = signed_absmax / -8
+    rng = np.random.default_rng(2)
+    x = (rng.standard_normal(256) * 3.0).astype(np.float32)
+    g = x.reshape(-1, 32)
+    d = np.where(-g.min(axis=1) > g.max(axis=1),
+                 g.min(axis=1), g.max(axis=1)) / -8.0
+    stored = np.frombuffer(quants.quantize_q40_np(x), np.uint8) \
+        .reshape(-1, quants.Q40_BLOCK_BYTES)[:, :2].copy() \
+        .view(np.float16).reshape(-1)
+    np.testing.assert_array_equal(stored, d.astype(np.float16))
+
+
+def test_denormal_block_roundtrip_is_finite_and_bounded():
+    """A block of denormal values rounds its f16 scale to 0: the
+    reconstruction collapses to 0 (error <= absmax, trivially inside the
+    f16-quantum term of the documented bound) and stays finite."""
+    x = np.full(32, 1e-40, np.float32)
+    y = quants.dequantize_q40(quants.quantize_q40(x), 32)
+    assert np.all(np.isfinite(y))
+    assert np.abs(x - y).max() <= np.abs(x).max() + 8.0 * 2.0 ** -24

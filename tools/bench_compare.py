@@ -56,6 +56,17 @@ def main() -> None:
     a, b = _load(sys.argv[1]), _load(sys.argv[2])
     print(f"A: {sys.argv[1]}  (git {a.get('git')}, {a.get('device_kind')})")
     print(f"B: {sys.argv[2]}  (git {b.get('git')}, {b.get('device_kind')})")
+    # a skipped run never measured live hardware (bench.py emits
+    # `skipped: true` + the reason when the backend was down, possibly
+    # re-emitting an older banked capture): say so loudly — its deltas
+    # are "no hardware", not a regression signal
+    for tag, d in (("A", a), ("B", b)):
+        if d.get("skipped"):
+            print(f"⚠️ {tag} SKIPPED (no live measurement): "
+                  f"{d.get('skip_reason') or d.get('error') or 'backend unavailable'}")
+    if a.get("skipped") or b.get("skipped"):
+        print("⚠️ deltas below compare non-live data — not a regression "
+              "signal\n")
     hv_a, hv_b = a.get("value") or 0, b.get("value") or 0
     if hv_a and hv_b:
         print(f"headline {a.get('metric')}: {hv_a} -> {hv_b} "
