@@ -328,7 +328,10 @@ def stage_child(spec: str) -> None:
               dict(seq_len=8192) if mod == "s8k" else {})
     st = _PhaseDict()
     try:
-        bench_preset(preset, deadline, out=st, **kwargs)
+        if preset in SCENARIOS:
+            bench_continuous(deadline, out=st)
+        else:
+            bench_preset(preset, deadline, out=st, **kwargs)
     except Exception as e:  # noqa: BLE001 — the parent needs the line
         st["error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps({"stage_result": dict(st)}), flush=True)
@@ -736,6 +739,246 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
     return out
 
 
+def _scn_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def _pctl(sorted_vals: list, q: float):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def bench_continuous(deadline: float, *, out: dict | None = None) -> dict:
+    """``--scenario continuous``: a mixed short/long staggered-arrival
+    request stream through the paged continuous-batching scheduler
+    (``--kv-block-size``, runtime/serving.PagedGenerator). The dense
+    ``@b16`` stage measures raw batched dispatch rate on a full batch;
+    this scenario measures what serving actually delivers under churn —
+    sequences admit and retire mid-batch, chunked prefill interleaves
+    with decode, and a third of the prompts share a 2-block prefix so
+    block-level sharing is exercised. Reported fields (the ones
+    tools/bench_compare.py diffs): aggregate ``agg_tok_per_s`` over the
+    whole stream, TTFT percentiles (queue wait included — that IS the
+    continuous-batching win), and block-pool occupancy/sharing peaks.
+
+    Workload knobs (env): DLLAMA_BENCH_SCN_REQUESTS (24),
+    DLLAMA_BENCH_SCN_SLOTS (4), DLLAMA_BENCH_KV_BLOCK (16),
+    DLLAMA_BENCH_SCN_STAGGER (0.05 s), DLLAMA_BENCH_SCN_MAXTOK (16)."""
+    import shutil
+    import tempfile
+    import threading
+
+    out = {} if out is None else out
+    out["phase"] = "scenario_setup"
+    here = os.path.dirname(os.path.abspath(__file__))
+    # the scenario drives the REAL engine/scheduler stack, so it needs a
+    # real .m/.t pair: synthesize the same tiny fixture the test tier uses
+    sys.path.insert(0, os.path.join(here, "tests"))
+    import numpy as np
+
+    from helpers import (byte_vocab_tokenizer, tiny_header_params,
+                         write_tiny_model)
+
+    from dllama_tpu.formats import tfile
+    from dllama_tpu.runtime import telemetry as tm
+    from dllama_tpu.runtime.engine import InferenceEngine
+    from dllama_tpu.runtime.serving import BatchScheduler
+
+    n_reqs = _scn_int("DLLAMA_BENCH_SCN_REQUESTS", 24)
+    n_slots = _scn_int("DLLAMA_BENCH_SCN_SLOTS", 4)
+    block = _scn_int("DLLAMA_BENCH_KV_BLOCK", 16)
+    max_tok = _scn_int("DLLAMA_BENCH_SCN_MAXTOK", 16)
+    stagger_s = float(os.environ.get("DLLAMA_BENCH_SCN_STAGGER", "0.05"))
+    out.update(n_requests=n_reqs, n_slots=n_slots, kv_block_size=block)
+
+    d = tempfile.mkdtemp(prefix="dllama-bench-scn-")
+    try:
+        mpath, tpath = os.path.join(d, "m.m"), os.path.join(d, "t.t")
+        rng = np.random.default_rng(0xC0)
+        write_tiny_model(mpath, tiny_header_params(
+            dim=256, hidden_dim=512, n_layers=2, n_heads=4, n_kv_heads=2,
+            head_dim=64, vocab_size=268, seq_len=256), rng)
+        tfile.write_tfile(tpath, byte_vocab_tokenizer())
+
+        # mixed workload: 1/3 long shared-prefix (RAG/system-prompt shape,
+        # exercises block sharing + CoW), 1/3 short interactive, 1/3 long
+        # distinct — arrivals staggered so admissions land mid-batch
+        shared = [int(x) for x in rng.integers(1, 200, 2 * block)]
+        prompts = []
+        for i in range(n_reqs):
+            if i % 3 == 0:
+                prompts.append(shared
+                               + [int(x) for x in rng.integers(1, 200, 48)])
+            elif i % 3 == 1:
+                prompts.append([int(x) for x in rng.integers(1, 200, 8)])
+            else:
+                prompts.append([int(x) for x in rng.integers(1, 200, 96)])
+
+        out["phase"] = "scenario_engine"
+        eng = InferenceEngine(mpath, tpath, tp=1, kv_block_size=block)
+        sched = BatchScheduler(eng, n_slots=n_slots)
+        reg = tm.registry()
+        g_total = reg.gauge(tm.KV_BLOCKS_TOTAL)
+        g_used = reg.gauge(tm.KV_BLOCKS_USED)
+        g_shared = reg.gauge(tm.KV_BLOCKS_SHARED)
+        reuse = reg.counter(tm.PREFIX_REUSE_TOKENS)
+        r0 = reuse.total()
+
+        occ: list = []
+        peaks = {"shared": 0.0}
+        stop_sampling = threading.Event()
+
+        def sample():
+            while not stop_sampling.wait(0.05):
+                total = g_total.value() or 1
+                occ.append(g_used.value() / total)
+                peaks["shared"] = max(peaks["shared"], g_shared.value())
+
+        sampler = threading.Thread(target=sample, daemon=True)
+        sampler.start()
+
+        out["phase"] = "scenario_run"
+        t_sub: dict = {}
+        t_first: dict = {}
+
+        def mk_cb(i):
+            def cb(tok, piece):
+                if i not in t_first:
+                    t_first[i] = time.perf_counter()
+            return cb
+
+        try:
+            t0 = time.perf_counter()
+            reqs = []
+            for i, ids in enumerate(prompts):
+                t_sub[i] = time.perf_counter()
+                reqs.append(sched.submit(ids, max_tok, stop_on_eos=False,
+                                         on_token=mk_cb(i)))
+                time.sleep(stagger_s)
+            for r in reqs:
+                if not r.done.wait(timeout=max(5.0,
+                                               deadline - time.monotonic())):
+                    out["error"] = "deadline inside scenario wave"
+                    break
+            t_end = time.perf_counter()
+        finally:
+            stop_sampling.set()
+            sampler.join(timeout=5)
+            sched.close()
+            eng.close()
+
+        done = [r for r in reqs if r.done.is_set() and r.error is None]
+        out["n_completed"] = len(done)
+        out["n_tokens"] = sum(len(r.tokens) for r in done)
+        errs = [r.error for r in reqs if r.error]
+        if errs:
+            out["request_errors"] = len(errs)
+            out.setdefault("error", errs[0][:200])
+        dt = t_end - t0
+        if dt > 0 and out["n_tokens"]:
+            out["agg_tok_per_s"] = round(out["n_tokens"] / dt, 2)
+        ttfts = sorted(1e3 * (t_first[i] - t_sub[i]) for i in t_first)
+        out["ttft_ms_p50"] = (round(_pctl(ttfts, 0.5), 1)
+                              if ttfts else None)
+        out["ttft_ms_p95"] = (round(_pctl(ttfts, 0.95), 1)
+                              if ttfts else None)
+        if occ:
+            out["block_occupancy_peak"] = round(max(occ), 4)
+            out["block_occupancy_mean"] = round(sum(occ) / len(occ), 4)
+        out["kv_blocks_total"] = int(g_total.value())
+        out["kv_blocks_shared_peak"] = int(peaks["shared"])
+        out["prefix_reuse_tokens"] = int(reuse.total() - r0)
+        out["phase"] = "done"
+        return out
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+SCENARIOS = ("continuous",)
+
+
+def _result_skeleton(metric: str) -> dict:
+    """The one-line emit contract's required fields + the git stamp —
+    shared by main() and scenario_main so the shape cannot drift."""
+    result: dict = {
+        "metric": metric,
+        "value": 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "error": None,
+    }
+    try:
+        result["git"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or None
+    except Exception:  # noqa: BLE001 — traceability only
+        result["git"] = None
+    return result
+
+
+def _mark_skipped(result: dict, detail: str, attempts: list,
+                  t_start: float) -> None:
+    """Stamp the first-class skip contract (no live measurement ran —
+    tools/bench_compare.py must read this as 'no hardware', never as a
+    regression) — shared by every no-backend emit path."""
+    result["skipped"] = True
+    result["skip_reason"] = f"backend unavailable: {detail}"
+    result["error"] = f"backend unavailable: {detail}"
+    result["probe_attempts"] = attempts
+    result["elapsed_s"] = round(time.monotonic() - t_start, 1)
+
+
+def _stage_cache_env() -> None:
+    """Persistent XLA compile cache for the measurement children —
+    amortizes compiles across stages and across bench runs."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/dllama-xla-cache-bench")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+
+def scenario_main(name: str) -> None:
+    """``bench.py --scenario <name>`` entry: probe the backend, run the
+    serving scenario in an isolated stage child (same wedge containment as
+    the preset stages), and print exactly ONE JSON line whose per-stage
+    fields tools/bench_compare.py knows how to diff."""
+    t_start = time.monotonic()
+    result = _result_skeleton(f"{name}_agg_tok_per_s")
+    if name not in SCENARIOS:
+        result["error"] = f"unknown scenario {name!r} (have {SCENARIOS})"
+        emit(result)
+        return
+
+    force_platform = os.environ.get("DLLAMA_BENCH_PLATFORM")
+    if force_platform:
+        os.environ["JAX_PLATFORMS"] = force_platform
+    attempts: list = []
+    ok, detail = probe_backend(force_platform, attempts)
+    if not ok:
+        _mark_skipped(result, detail, attempts, t_start)
+        emit(result)
+        return
+    try:
+        info = json.loads(detail)
+    except (ValueError, IndexError):
+        info = {"platform": "unknown", "kind": "unknown", "n": 0}
+    result["platform"] = info.get("platform")
+    result["device_kind"] = info.get("kind")
+    _stage_cache_env()
+
+    res = run_stage(name, STAGE_DEADLINE_S)
+    result["stages"] = {name: res}
+    if res.get("agg_tok_per_s"):
+        result["value"] = res["agg_tok_per_s"]
+    else:
+        result["error"] = res.get("error", "scenario did not measure")
+    result["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    emit(result)
+
+
 def _find_fallback_capture():
     """Newest VALID banked capture, for emitting when the live chip is down.
 
@@ -808,21 +1051,7 @@ def _find_fallback_capture():
 
 def main() -> None:
     t_start = time.monotonic()
-    result: dict = {
-        "metric": "decode_tok_per_s_llama8b_q40_1chip",
-        "value": 0.0,
-        "unit": "tok/s",
-        "vs_baseline": 0.0,
-        "error": None,
-    }
-    try:
-        result["git"] = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=10,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        ).stdout.strip() or None
-    except Exception:  # noqa: BLE001 — traceability only
-        result["git"] = None
+    result = _result_skeleton("decode_tok_per_s_llama8b_q40_1chip")
 
     force_platform = os.environ.get("DLLAMA_BENCH_PLATFORM")  # e.g. "cpu" self-test
     if force_platform:
@@ -862,17 +1091,13 @@ def main() -> None:
             data["elapsed_s"] = round(time.monotonic() - t_start, 1)
             emit(data)
             return
-        result["skipped"] = True
-        result["skip_reason"] = f"backend unavailable: {detail}"
-        result["error"] = f"backend unavailable: {detail}"
-        result["probe_attempts"] = attempts
+        _mark_skipped(result, detail, attempts, t_start)
         result["env"] = {
             "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS"),
             "accel_devices": sorted(
                 f for f in os.listdir("/dev") if f.startswith(("accel", "vfio"))
             ) if os.path.isdir("/dev") else [],
         }
-        result["elapsed_s"] = round(time.monotonic() - t_start, 1)
         emit(result)
         return
 
@@ -887,11 +1112,8 @@ def main() -> None:
 
     # the parent stays jax-free: every measurement runs in a --stage child
     # (stage_child re-pins jax_platforms there; sitecustomize would clobber
-    # a bare env var). A persistent compile cache amortizes child compiles
-    # across stages and across bench runs in the same image.
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                          "/tmp/dllama-xla-cache-bench")
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    # a bare env var)
+    _stage_cache_env()
 
     # promoted serving config (tools/promote_config.py, written when an
     # on-chip A/B showed a combo beating `auto` by >=10%): apply its env
@@ -1058,5 +1280,7 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--stage":
         stage_child(sys.argv[2])
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--scenario":
+        scenario_main(sys.argv[2])
     else:
         main()

@@ -447,6 +447,53 @@ def _tap_stat(x: jax.Array) -> dict[str, jax.Array]:
     return {"rms": rms, "absmax": absmax, "nonfinite": nf, "q80_err": q80e}
 
 
+def _attn_qkv(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
+              cos: jax.Array, sin: jax.Array, positions: jax.Array, fq):
+    """Attention prologue shared by the dense and paged layer steps:
+    pre-norm, QKV projections, optional qk-norm, rope. Returns post-rope
+    ``q [B, T, n_heads, hd]`` and ``k/v [B, T, n_kv, hd]``."""
+    B, T, _ = x.shape
+    h = fq(rms_norm(x, lp.norm_att, cfg.norm_epsilon))
+    q = linear(h, lp.wq, out_axis="heads").reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = linear(h, lp.wk, out_axis="kv_heads").reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = linear(h, lp.wv, out_axis="kv_heads").reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q = constrain(q, "batch", None, "heads", None)
+    k = constrain(k, "batch", None, "kv_heads", None)
+    v = constrain(v, "batch", None, "kv_heads", None)
+
+    if cfg.uses_qk_norm:
+        q = rms_norm_per_head(q, lp.norm_q, cfg.norm_epsilon)
+        k = rms_norm_per_head(k, lp.norm_k, cfg.norm_epsilon)
+
+    q = apply_rope(q, cos, sin, positions, cfg.rope_type)
+    k = apply_rope(k, cos, sin, positions, cfg.rope_type)
+    return q, k, v
+
+
+def _attn_out_and_ffn(cfg: ModelConfig, x: jax.Array, att: jax.Array,
+                      lp: LayerParams, fq, taps: bool):
+    """Layer epilogue shared by the dense and paged layer steps: output
+    projection + residual, then the ffn half. Returns ``(x, stats|None)``."""
+    B, T, _ = x.shape
+    x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo, in_axis="heads"))
+    x = constrain(x, "batch", None, None)
+    attn_stat = _tap_stat(x) if taps else None
+
+    # -- ffn half (reference ff segment, llm.cpp:369-439; MoE is new) ------
+    h = fq(rms_norm(x, lp.norm_ffn, cfg.norm_epsilon))
+    if cfg.is_moe:
+        x = x + fq(_moe_ffn(cfg, h, lp))
+    else:
+        gate = _hidden_act(cfg, linear(h, lp.w1, out_axis="hidden"))
+        up = linear(h, lp.w3, out_axis="hidden")
+        hidden = constrain(fq(gate * up), "batch", None, "hidden")
+        x = x + fq(linear(hidden, lp.w2, in_axis="hidden"))
+    x = constrain(x, "batch", None, None)
+    if taps:
+        return x, {"attn_out": attn_stat, "mlp_out": _tap_stat(x)}
+    return x, None
+
+
 def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
                 k_cache: jax.Array, v_cache: jax.Array,
                 cos: jax.Array, sin: jax.Array, start_pos: jax.Array,
@@ -464,20 +511,7 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     fq = fake_quant_q80 if cfg.sync_q80 else (lambda a: a)
 
     # -- attention half (reference att segment, llm.cpp:226-366) -----------
-    h = fq(rms_norm(x, lp.norm_att, cfg.norm_epsilon))
-    q = linear(h, lp.wq, out_axis="heads").reshape(B, T, cfg.n_heads, cfg.head_dim)
-    k = linear(h, lp.wk, out_axis="kv_heads").reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-    v = linear(h, lp.wv, out_axis="kv_heads").reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-    q = constrain(q, "batch", None, "heads", None)
-    k = constrain(k, "batch", None, "kv_heads", None)
-    v = constrain(v, "batch", None, "kv_heads", None)
-
-    if cfg.uses_qk_norm:
-        q = rms_norm_per_head(q, lp.norm_q, cfg.norm_epsilon)
-        k = rms_norm_per_head(k, lp.norm_k, cfg.norm_epsilon)
-
-    q = apply_rope(q, cos, sin, positions, cfg.rope_type)
-    k = apply_rope(k, cos, sin, positions, cfg.rope_type)
+    q, k, v = _attn_qkv(cfg, x, lp, cos, sin, positions, fq)
 
     sp_res = None
     plan = _current_plan()
@@ -510,24 +544,53 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
             else:
                 att = attention(q, k_cache, v_cache, positions, cfg.head_dim)
     att = constrain(att, "batch", None, "heads", None)
-    x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo, in_axis="heads"))
-    x = constrain(x, "batch", None, None)
-    attn_stat = _tap_stat(x) if taps else None
-
-    # -- ffn half (reference ff segment, llm.cpp:369-439; MoE is new) ------
-    h = fq(rms_norm(x, lp.norm_ffn, cfg.norm_epsilon))
-    if cfg.is_moe:
-        x = x + fq(_moe_ffn(cfg, h, lp))
-    else:
-        gate = _hidden_act(cfg, linear(h, lp.w1, out_axis="hidden"))
-        up = linear(h, lp.w3, out_axis="hidden")
-        hidden = constrain(fq(gate * up), "batch", None, "hidden")
-        x = x + fq(linear(hidden, lp.w2, in_axis="hidden"))
-    x = constrain(x, "batch", None, None)
+    x, stats = _attn_out_and_ffn(cfg, x, att, lp, fq, taps)
     if taps:
-        return x, k_cache, v_cache, {"attn_out": attn_stat,
-                                     "mlp_out": _tap_stat(x)}
+        return x, k_cache, v_cache, stats
     return x, k_cache, v_cache
+
+
+def _paged_layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
+                      k_pool: jax.Array, v_pool: jax.Array,
+                      cos: jax.Array, sin: jax.Array,
+                      positions: jax.Array, tables: jax.Array):
+    """One transformer block over the PAGED cache (runtime/kvblocks.py).
+
+    ``k_pool/v_pool: [n_blocks, n_kv, block_size, hd]`` is this layer's
+    slice of the block pool; ``tables [B, max_blocks]`` maps each row's
+    logical block index to a physical block (0 = the null block). New K/V
+    rows scatter into their physical (block, offset) cell, then the row's
+    logical cache is gathered back to the dense head-major view and
+    attended by the XLA oracle — value-identical to the dense slot-pool
+    layer step on the same context (the gather materializes exactly the
+    rows ``update_layer`` would have produced; rows behind unallocated
+    table entries read the null block and are position-masked). The
+    TPU-native ragged-paged-attention kernel (PAPERS.md) can later replace
+    the gather+oracle pair without touching this program's callers."""
+    B, T, _ = x.shape
+    fq = fake_quant_q80 if cfg.sync_q80 else (lambda a: a)
+    q, k, v = _attn_qkv(cfg, x, lp, cos, sin, positions, fq)
+
+    bs = k_pool.shape[2]
+    n_blocks_seq = tables.shape[1]
+    brow = jnp.arange(B, dtype=jnp.int32)[:, None]
+    blk = tables[brow, positions // bs]                      # [B, T]
+    off = positions % bs
+    # scatter the new rows: advanced (blk, off) indices around the head
+    # slice address each row's [n_kv, hd] cell; inactive rows carry
+    # all-null tables, so their ride-along writes land in the null block
+    k_pool = k_pool.at[blk, :, off, :].set(k.astype(k_pool.dtype))
+    v_pool = v_pool.at[blk, :, off, :].set(v.astype(v_pool.dtype))
+
+    def view(pool):
+        gathered = pool[tables]                  # [B, M, n_kv, bs, hd]
+        return jnp.moveaxis(gathered, 2, 1).reshape(
+            B, cfg.n_kv_heads, n_blocks_seq * bs, cfg.head_dim)
+
+    att = attention(q, view(k_pool), view(v_pool), positions, cfg.head_dim)
+    att = constrain(att, "batch", None, "heads", None)
+    x, _ = _attn_out_and_ffn(cfg, x, att, lp, fq, taps=False)
+    return x, k_pool, v_pool
 
 
 def greedy_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
@@ -897,6 +960,81 @@ def ragged_verify_step_guarded(params: Params, cfg: ModelConfig,
     first = sampled_token(logits[:, 0], temps, topps, coins)
     preds = preds.at[:, 0].set(first)
     return (n_acc, preds, _nonfinite_rows(logits)), kv
+
+
+# ---------------------------------------------------------------------------
+# Paged program family — block-table KV (runtime/kvblocks.py)
+# ---------------------------------------------------------------------------
+#
+# The paged twins of the ragged serving programs: KV lives in a block pool
+# [L, n_blocks, n_kv, block_size, hd] and every row of the batch addresses
+# its context through a block table. Shapes are static per pool geometry
+# (n_blocks, block_size, batch width, table width), so the whole family
+# jits once per geometry and the compile ledger stays quiet across
+# admissions/retirements — the continuous-batching requirement.
+
+
+def paged_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                  pos_vec: jax.Array, pkv, tables: jax.Array):
+    """Full forward over the paged pool: ``tokens [B, T]`` at per-row
+    ``pos_vec [B]`` with block ``tables [B, max_blocks]``. Returns float32
+    logits ``[B, T, vocab]`` and the updated pool (a
+    :class:`~dllama_tpu.runtime.kvblocks.PagedKVCache`). Always ragged —
+    the paged path exists for continuous batching only."""
+    from ..runtime.kvblocks import PagedKVCache
+
+    if _numerics.taps_active():
+        raise ValueError("numerics taps are unsupported on the paged KV "
+                         "path (use the dense slot pool for tap sessions)")
+    plan = _current_plan()
+    if plan is not None and plan.axis_size("pp") > 1:
+        raise ValueError("paged KV is unsupported under pipeline "
+                         "parallelism (pp > 1)")
+    pos_vec = jnp.asarray(pos_vec, dtype=jnp.int32)
+    B, T = tokens.shape
+    x = params.embedding[tokens].astype(cfg.compute_dtype)
+    x = constrain(x, "batch", None, None)
+
+    cos, sin = build_rope_cache(cfg)
+    arange = jnp.arange(T, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(pos_vec[:, None] + arange, (B, T))
+
+    def body(carry, xs):
+        x = carry
+        lp, k_l, v_l = xs
+        if cfg.offload:
+            lp = jax.device_put(lp, jax.memory.Space.Device)
+        x, k_l, v_l = _paged_layer_step(cfg, x, lp, k_l, v_l, cos, sin,
+                                        positions, tables)
+        return x, (k_l, v_l)
+
+    unroll = int(os.environ.get("DLLAMA_TPU_SCAN_UNROLL", "1"))
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params.layers, pkv.k, pkv.v),
+                                     unroll=max(1, unroll))
+    x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
+    if cfg.sync_q80:
+        x = fake_quant_q80(x)
+    logits = linear(x, params.logits, out_axis="vocab").astype(jnp.float32)
+    logits = constrain(logits, "batch", None, "vocab")
+    return logits, PagedKVCache(k=new_k, v=new_v)
+
+
+def paged_sampled_step_guarded(params: Params, cfg: ModelConfig,
+                               tokens: jax.Array, pos_vec: jax.Array,
+                               pkv, tables: jax.Array, temps: jax.Array,
+                               topps: jax.Array, coins: jax.Array,
+                               poison: jax.Array):
+    """The paged ragged decode step + non-finite tripwire — the block-table
+    twin of :func:`sampled_step_guarded`: one dispatch samples every row
+    (temp <= 0 rows take argmax), ``nonfinite [B]`` is per row so a
+    poisoned request fails without touching the rest of the batch.
+    Returns ``((token, nonfinite), pkv)``."""
+    from ..ops.sampling import sampled_token
+
+    logits, pkv = paged_forward(params, cfg, tokens, pos_vec, pkv, tables)
+    last = _poison_logits(logits[:, -1, :], poison)
+    return (sampled_token(last, temps, topps, coins),
+            _nonfinite_rows(last)), pkv
 
 
 # ---------------------------------------------------------------------------

@@ -137,6 +137,17 @@ def kv_cache_sharding(plan: MeshPlan, kv: "KVCache") -> "KVCache":
     return KVCache(k=s, v=s)
 
 
+def paged_kv_sharding(plan: MeshPlan, pkv):
+    """Paged block pool ``[L, n_blocks, n_kv, block_size, hd]`` — kv-heads
+    over tp like the dense cache; the block and row axes stay replicated
+    (block-table gathers index the unsharded block axis)."""
+    from ..runtime.kvblocks import PagedKVCache
+
+    s = plan.sharding_for(tuple(pkv.k.shape), "layers", None, "kv_heads",
+                          None, None)
+    return PagedKVCache(k=s, v=s)
+
+
 def shard_params(plan: MeshPlan, params: "Params") -> "Params":
     """Place params on the mesh with the TP shardings."""
     shardings = param_shardings(plan, params)

@@ -134,7 +134,8 @@ class InferenceEngine:
                  temperature: float = 0.0, topp: float = 0.9, seed: int = 0xB1A5,
                  multihost: bool = False, host_sampling: bool = False,
                  decode_chunk: int = 1, spec_lookup: int = 0,
-                 kv_dtype: str = "auto", profile_split: bool = False,
+                 kv_dtype: str = "auto", kv_block_size: int = 0,
+                 profile_split: bool = False,
                  verify_weights: bool = False,
                  numerics_taps: bool = False,
                  numerics_failfast: bool | None = None):
@@ -216,6 +217,33 @@ class InferenceEngine:
             raise ValueError(
                 f"spec_lookup {self.spec_lookup} exceeds the control packet's "
                 f"{self.packet_slots} token slots (raise --nbatches)")
+
+        # paged KV serving (--kv-block-size, runtime/kvblocks.py): validate
+        # the block geometry AND the feature combos up front — the paged
+        # program family covers plain + tp ragged decode only, and a combo
+        # it can't serve must fail at startup with the reason, not as a
+        # per-request trace-time error
+        self.kv_block_size = max(0, int(kv_block_size or 0))
+        if self.kv_block_size:
+            from .kvblocks import validate_block_size
+
+            validate_block_size(self.cfg.seq_len, self.kv_block_size)
+            unsupported = [
+                ("--spec-lookup", self.spec_lookup > 0),
+                ("--decode-chunk > 1", self.decode_chunk > 1),
+                ("multihost workers", multihost),
+                ("--sp > 1", sp > 1),
+                ("--pp > 1", pp > 1),
+                ("--dp > 1", dp > 1),
+                ("attn_impl='flash' (forced)",
+                 self.cfg.attn_impl == "flash"),
+            ]
+            bad = [name for name, hit in unsupported if hit]
+            if bad:
+                raise ValueError(
+                    f"--kv-block-size (paged KV serving) does not support "
+                    f"{', '.join(bad)} yet — drop those flags or drop "
+                    f"--kv-block-size to use the dense slot pool")
 
         n_dev = len(jax.devices())
         for name, n in (("dp", dp), ("sp", sp), ("pp", pp)):

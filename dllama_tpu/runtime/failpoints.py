@@ -54,6 +54,10 @@ have at least one call site:
   dispatch): the ``nonfinite`` action injects NaN/Inf into the
   decode-step logits in-graph, exercising the non-finite tripwire and
   its opt-in fail-fast.
+* ``kv_alloc`` — the paged KV block allocator (``runtime/kvblocks.py
+  BlockPool.alloc``): a ``raise`` here simulates block-pool exhaustion,
+  which must degrade to queueing (admission) or an explicit per-request
+  failure (mid-decode growth), never a crash.
 """
 
 from __future__ import annotations

@@ -143,6 +143,57 @@ def fit_batch_slots(cfg, n_slots: int, *, weight_repr: str,
     return 0, est
 
 
+def estimate_block_pool_bytes(cfg, n_blocks: int, block_size: int,
+                              kv_dtype_bytes: int) -> int:
+    """Device bytes of a paged KV block pool
+    ``[L, n_blocks, n_kv, block_size, hd]`` ×2 (K and V)."""
+    return 2 * cfg.n_layers * n_blocks * cfg.kv_dim * block_size \
+        * kv_dtype_bytes
+
+
+def fit_block_pool(cfg, n_blocks: int, *, block_size: int, min_blocks: int,
+                   weight_repr: str, kv_dtype_bytes: int, n_shards: int = 1,
+                   offload: bool = False) -> tuple[int, dict]:
+    """Largest paged block-pool size ``<= n_blocks`` whose estimate fits
+    the device limit — the paged twin of :func:`fit_batch_slots`: blocks
+    are the admission currency, so the pool shrinks block-granularly
+    instead of by whole max-context slots. The base charge keeps the
+    engine's batch-1 cache (still resident beside the pool). Returns
+    ``(n_fit, estimate)``; ``n_fit == 0`` when even ``min_blocks`` (one
+    full sequence + the null block) doesn't fit."""
+    limit = (None if os.environ.get("DLLAMA_SKIP_HBM_CHECK")
+             else device_memory_bytes())
+    base = estimate_device_bytes(
+        cfg, weight_repr=weight_repr, kv_dtype_bytes=kv_dtype_bytes,
+        batch=1, n_shards=n_shards, offload=offload)
+
+    def est_for(k: int) -> dict:
+        pool = estimate_block_pool_bytes(cfg, k, block_size, kv_dtype_bytes)
+        est = dict(base)
+        est["kv_pool_bytes"] = pool
+        est["need_per_device"] = (base["need_per_device"]
+                                  + int(pool / max(1, n_shards) * _MARGIN))
+        return est
+
+    n = max(min_blocks, n_blocks)
+    est = est_for(n)
+    if limit is None or est["need_per_device"] <= limit:
+        return n, est
+    est = est_for(min_blocks)
+    if est["need_per_device"] > limit:  # even the floor doesn't fit
+        return 0, est
+    # the estimate is monotone in the block count: bisect for the exact
+    # largest fitting size (lo always fits, hi never does)
+    lo, hi = min_blocks, n
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if est_for(mid)["need_per_device"] <= limit:
+            lo = mid
+        else:
+            hi = mid
+    return lo, est_for(lo)
+
+
 def estimate_prefill_temp_bytes(cfg, tokens: int) -> int:
     """Coarse XLA-temporary estimate for a ``tokens``-wide prefill chunk
     the engine has NOT compiled yet: per-layer activations (residual

@@ -50,6 +50,7 @@ from ..parallel.multihost import (
     CTRL_SRV_VERIFY,
 )
 from ..tokenizer.sampler import xorshift_random_f32
+from .kvblocks import BlockPoolExhausted
 from .kvcache import KVCache
 
 if TYPE_CHECKING:
@@ -200,7 +201,207 @@ class _Admission:
     reused: int = 0  # prefix tokens skipped via cross-slot KV reuse
 
 
-class BatchedGenerator:
+class _GeneratorCore:
+    """Slot-lifecycle machinery shared by the dense slot-pool generator
+    (:class:`BatchedGenerator`) and the paged block-pool generator
+    (:class:`PagedGenerator`): request emit/retire rules, the non-finite
+    tripwire tail, and per-dispatch telemetry. Subclasses own the KV
+    storage and the admit/step programs."""
+
+    def _init_core(self, engine: "InferenceEngine", n_slots: int) -> None:
+        self.eng = engine
+        self.cfg = engine.cfg
+        self.n_slots = n_slots
+        self.pos = np.zeros(n_slots, dtype=np.int32)
+        self.next_token = np.zeros(n_slots, dtype=np.int32)
+        self.slots: list[Request | None] = [None] * n_slots
+        self.spec = 0
+        self._proposers: list = [None] * n_slots
+        # telemetry: cached handles (no registry lookups per step)
+        self._tm = telemetry.registry()
+        self._tm.gauge(telemetry.BATCH_SLOTS).set(n_slots)
+        self._m_step_ms = self._tm.histogram(telemetry.BATCH_STEP_MS)
+        self._m_occupancy = self._tm.gauge(telemetry.BATCH_OCCUPANCY)
+        self._m_tokens = self._tm.counter(telemetry.BATCH_TOKENS)
+        self._m_kv = self._tm.gauge(telemetry.KV_OCCUPANCY)
+
+    # -- slot lifecycle -----------------------------------------------------
+
+    def free_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+    @property
+    def n_active(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    def can_admit(self, req: Request) -> bool:
+        """Whether admission-side capacity exists for ``req`` right now
+        (beyond a free slot). The dense pool always says yes; the paged
+        pool prices the request in blocks."""
+        return True
+
+    def abort_admit(self, adm: "_Admission") -> None:
+        """Roll back an admission that will never commit (client cancel
+        mid-prefill, or a prefill dispatch raised). The dense pool has
+        nothing to undo — the slot column is pool-owned; the paged pool
+        releases the blocks taken in ``begin_admit``."""
+
+    def _plan_ctx(self):
+        return (use_plan(self.eng.plan) if self.eng.plan is not None
+                else nullcontext())
+
+    def _poison(self) -> jnp.ndarray:
+        """The tripwire's poison selector for one ragged dispatch: always
+        0 under multihost (root AND mirrors — a one-sided injection would
+        desync the replicated outputs), else driven by the `logits`
+        failpoint (runtime/numerics)."""
+        return jnp.float32(0.0 if self.eng.multihost
+                           else numerics.poison_code())
+
+    def _retire(self, slot: int) -> None:
+        req = self.slots[slot]
+        self.slots[slot] = None
+        self._proposers[slot] = None
+        self._tm.counter(telemetry.RETIRES).inc()
+        if req.t_decode:
+            telemetry.tracer().emit(req.rid, "decode", req.t_decode,
+                                    telemetry.now_ns(), slot=slot,
+                                    n_tokens=len(req.tokens))
+        req.done.set()
+
+    def _arm_decode(self, adm: "_Admission") -> None:
+        """Shared commit tail: arm ``adm``'s slot for decode (position,
+        seed token, per-request streaming decoder, telemetry span)."""
+        req = adm.req
+        self.pos[adm.slot] = adm.pos
+        self.next_token[adm.slot] = req.prompt_ids[-1]
+        if self.eng.tokenizer is not None:
+            # per-request streaming decoder: a shallow copy shares the vocab
+            # tables but owns its UTF-8 carry-over, so interleaved slots
+            # can't corrupt each other's multi-byte sequences
+            import copy
+
+            req.decoder = copy.copy(self.eng.tokenizer)
+            req.decoder._pending = bytearray()
+        req.t_decode = telemetry.now_ns()
+        if req.t_admit:
+            # n_tokens = positions actually prefilled (after prefix reuse),
+            # so span counts cross-check dllama_prefix_reuse_tokens_total
+            telemetry.tracer().emit(req.rid, "prefill", req.t_admit,
+                                    req.t_decode, slot=adm.slot,
+                                    n_tokens=adm.pos - adm.reused)
+        self.slots[adm.slot] = req
+
+    def _note_admitted(self, req: Request, slot: int, reused: int) -> None:
+        """Shared admission telemetry, called AFTER the last failable call
+        of begin_admit so a reject never skews admissions - retires."""
+        req.t_admit = telemetry.now_ns()
+        self._tm.counter(telemetry.ADMISSIONS).inc()
+        if reused:
+            self._tm.counter(telemetry.PREFIX_REUSE_TOKENS).inc(reused)
+        if req.t_submit:
+            self._tm.histogram(telemetry.QUEUE_WAIT_MS).record(
+                (req.t_admit - req.t_submit) / 1e6)
+            telemetry.tracer().emit(req.rid, "queue", req.t_submit,
+                                    req.t_admit, slot=slot)
+
+    # -- emit/tripwire tails shared by every dispatch kind ------------------
+
+    def _handle_nonfinite(self, active: list[int], nf) -> set[int]:
+        """Non-finite tripwire tail for one ragged dispatch: count each
+        poisoned row's event (``dllama_nonfinite_total{site="batch"}``);
+        with fail-fast armed, fail THAT request explicitly (503-shaped —
+        an explicit numerics error instead of garbage tokens) and retire
+        its slot, leaving the rest of the batch untouched. Returns the
+        retired rows."""
+        failed: set[int] = set()
+        for i in active:
+            n = int(nf[i])
+            if n <= 0:
+                continue
+            numerics.record_nonfinite(n, "batch")
+            if getattr(self.eng, "nf_failfast", False):
+                req = self.slots[i]
+                req.error = str(numerics.nonfinite_error("batch", n))
+                req.server_error = True
+                self._retire(i)
+                failed.add(i)
+        return failed
+
+    def _kv_fraction(self) -> float:
+        """Live-context share of the KV storage for the occupancy gauge —
+        subclass-specific (rows over the slot pool, blocks over the block
+        pool)."""
+        raise NotImplementedError
+
+    def _sweep_cancelled(self) -> list[int]:
+        """Retire client-cancelled slots; return the active row list."""
+        for i, s in enumerate(self.slots):
+            if s is not None and s.cancel.is_set():
+                self._retire(i)
+        return [i for i, s in enumerate(self.slots) if s is not None]
+
+    def _sampling_rows(self, active: list[int]):
+        """Per-row sampling knobs for ONE ragged dispatch (single-step
+        form: one xorshift coin drawn and committed per temperature>0
+        row — multi-step dispatches pre-draw from a COPY instead, see
+        step_chunk). Shared so the coin-stream rules can never diverge
+        between the dense and paged paths."""
+        temps = np.zeros(self.n_slots, dtype=np.float32)
+        topps = np.zeros(self.n_slots, dtype=np.float32)
+        coins = np.zeros(self.n_slots, dtype=np.float32)
+        for i in active:
+            req = self.slots[i]
+            temps[i] = req.temperature
+            topps[i] = req.topp
+            if req.temperature > 0.0:
+                coins[i], req.rng_state = xorshift_random_f32(req.rng_state)
+        return temps, topps, coins
+
+    def _record_step(self, n_active: int, ms: float, emitted: int) -> None:
+        """Per-dispatch telemetry: occupancy, step latency, emitted tokens,
+        KV occupancy (see :meth:`_kv_fraction`)."""
+        self._m_occupancy.set(n_active)
+        self._m_step_ms.record(ms)
+        if emitted:
+            self._m_tokens.inc(emitted)
+        self._m_kv.set(self._kv_fraction())
+
+    def _emit_run(self, i: int, run: list[int]) -> int:
+        """Deliver a run of tokens to slot ``i``'s request: append, stream,
+        advance position, retire on EOS / limits. Returns tokens emitted.
+        The run is pre-truncated to the ACCEPTED prefix; EOS/max_tokens
+        truncation happens here so both step paths share the exact rules."""
+        req = self.slots[i]
+        tok = self.eng.tokenizer
+        n_keep = min(len(run), req.max_tokens - len(req.tokens))
+        if n_keep <= 0:  # belt: the scheduler retires at max_tokens
+            self._retire(i)
+            return 0
+        retire = n_keep < len(run)
+        for j in range(n_keep):
+            t = run[j]
+            eos = (req.stop_on_eos and tok is not None and tok.is_eos(t))
+            if eos:
+                n_keep, retire = j + 1, True
+                break
+        run = run[:n_keep]
+        self.pos[i] += len(run)
+        self.next_token[i] = run[-1]
+        req.tokens.extend(run)
+        if self._proposers[i] is not None:
+            self._proposers[i].extend(run)
+        for t in run:
+            piece = req.decoder.decode(t) if req.decoder is not None else None
+            if req.on_token is not None:
+                req.on_token(t, piece)
+        if (retire or len(req.tokens) >= req.max_tokens
+                or self.pos[i] >= self.cfg.seq_len):
+            self._retire(i)
+        return len(run)
+
+
+class BatchedGenerator(_GeneratorCore):
     """Slot pool + the ragged batched decode step. Not thread-safe by itself
     (the scheduler serializes access)."""
 
@@ -271,9 +472,7 @@ class BatchedGenerator:
             # in its packet loop
             engine._ctrl.send(engine._ctrl.encode_raw(CTRL_SRV_INIT,
                                                       n_slots, ()))
-        self.eng = engine
-        self.cfg = engine.cfg
-        self.n_slots = n_slots
+        self._init_core(engine, n_slots)
         # the staging-time pool estimate the submit-time admission guard
         # cross-checks against measured per-program bytes
         self.hbm_need = est["need_per_device"]
@@ -284,9 +483,6 @@ class BatchedGenerator:
 
             kv = jax.device_put(kv, kv_cache_sharding(engine.plan, kv))
         self.kv = kv
-        self.pos = np.zeros(n_slots, dtype=np.int32)
-        self.next_token = np.zeros(n_slots, dtype=np.int32)
-        self.slots: list[Request | None] = [None] * n_slots
         # per-slot PREFILL context: _ctx[s][p] is the prompt token whose KV
         # row sits at position p of slot s, for the prefill-built region
         # only. Survives retirement: retired slots DO keep riding every
@@ -358,13 +554,6 @@ class BatchedGenerator:
                                              static_argnums=1,
                                              donate_argnums=(4,))
                              if engine.multihost else engine._step)
-        # telemetry: cached handles (no registry lookups per step)
-        self._tm = telemetry.registry()
-        self._tm.gauge(telemetry.BATCH_SLOTS).set(n_slots)
-        self._m_step_ms = self._tm.histogram(telemetry.BATCH_STEP_MS)
-        self._m_occupancy = self._tm.gauge(telemetry.BATCH_OCCUPANCY)
-        self._m_tokens = self._tm.counter(telemetry.BATCH_TOKENS)
-        self._m_kv = self._tm.gauge(telemetry.KV_OCCUPANCY)
         # slot-column gather/scatter for per-slot prefill
         self._take = jax.jit(
             lambda kv, b: KVCache(
@@ -405,14 +594,6 @@ class BatchedGenerator:
 
     def _exec_commit(self, slot: int, col) -> None:
         self.kv = self._put(self.kv, col, slot)
-
-    def _poison(self) -> jnp.ndarray:
-        """The tripwire's poison selector for one ragged dispatch: always
-        0 under multihost (root AND mirrors — a one-sided injection would
-        desync the replicated outputs), else driven by the `logits`
-        failpoint (runtime/numerics)."""
-        return jnp.float32(0.0 if self.eng.multihost
-                           else numerics.poison_code())
 
     def _exec_step(self, tokens, pos, temps, topps, coins):
         with self.eng.watchdog.guard("batch_step"):
@@ -458,13 +639,6 @@ class BatchedGenerator:
 
     # -- slot lifecycle -----------------------------------------------------
 
-    def free_slots(self) -> list[int]:
-        return [i for i, s in enumerate(self.slots) if s is None]
-
-    @property
-    def n_active(self) -> int:
-        return sum(s is not None for s in self.slots)
-
     def begin_admit(self, req: Request, slot: int) -> "_Admission":
         """Start admitting a request into ``slot``: the slot's cache column
         is gathered to a [L, 1, ...] view and prefilled INCREMENTALLY — one
@@ -489,15 +663,7 @@ class BatchedGenerator:
         # telemetry AFTER the last failable call: a raise anywhere above
         # (prompt too long, device error) leaves ADMISSIONS untouched, so
         # the scheduler's reject path never skews admissions - retires
-        req.t_admit = telemetry.now_ns()
-        self._tm.counter(telemetry.ADMISSIONS).inc()
-        if k:
-            self._tm.counter(telemetry.PREFIX_REUSE_TOKENS).inc(k)
-        if req.t_submit:
-            self._tm.histogram(telemetry.QUEUE_WAIT_MS).record(
-                (req.t_admit - req.t_submit) / 1e6)
-            telemetry.tracer().emit(req.rid, "queue", req.t_submit,
-                                    req.t_admit, slot=slot)
+        self._note_admitted(req, slot, k)
         return adm
 
     def _best_prefix(self, rest: list[int]) -> tuple[int, int]:
@@ -514,10 +680,6 @@ class BatchedGenerator:
             if k > best_k:
                 best, best_k = s, k
         return best, best_k
-
-    def _plan_ctx(self):
-        return (use_plan(self.eng.plan) if self.eng.plan is not None
-                else nullcontext())
 
     def continue_admit(self, adm: "_Admission") -> bool:
         """Run one prefill chunk; True when the slot is armed for decode."""
@@ -537,31 +699,13 @@ class BatchedGenerator:
                 return False
         self._bcast(CTRL_SRV_COMMIT, adm.slot)
         self._exec_commit(adm.slot, adm.col)
-        self.pos[adm.slot] = adm.pos
-        self.next_token[adm.slot] = adm.req.prompt_ids[-1]
         self._ctx[adm.slot] = list(adm.req.prompt_ids[:-1])
-        req = adm.req
-        if self.eng.tokenizer is not None:
-            # per-request streaming decoder: a shallow copy shares the vocab
-            # tables but owns its UTF-8 carry-over, so interleaved slots
-            # can't corrupt each other's multi-byte sequences
-            import copy
-
-            req.decoder = copy.copy(self.eng.tokenizer)
-            req.decoder._pending = bytearray()
         if self.spec:
             from .speculative import NgramProposer
 
             self._proposers[adm.slot] = NgramProposer(self.spec)
-            self._proposers[adm.slot].extend(req.prompt_ids)
-        req.t_decode = telemetry.now_ns()
-        if req.t_admit:
-            # n_tokens = positions actually prefilled (after prefix reuse),
-            # so span counts cross-check dllama_prefix_reuse_tokens_total
-            telemetry.tracer().emit(req.rid, "prefill", req.t_admit,
-                                    req.t_decode, slot=adm.slot,
-                                    n_tokens=adm.pos - adm.reused)
-        self.slots[adm.slot] = req
+            self._proposers[adm.slot].extend(adm.req.prompt_ids)
+        self._arm_decode(adm)
         return True
 
     def admit(self, req: Request, slot: int) -> None:
@@ -569,17 +713,6 @@ class BatchedGenerator:
         adm = self.begin_admit(req, slot)
         while not self.continue_admit(adm):
             pass
-
-    def _retire(self, slot: int) -> None:
-        req = self.slots[slot]
-        self.slots[slot] = None
-        self._proposers[slot] = None
-        self._tm.counter(telemetry.RETIRES).inc()
-        if req.t_decode:
-            telemetry.tracer().emit(req.rid, "decode", req.t_decode,
-                                    telemetry.now_ns(), slot=slot,
-                                    n_tokens=len(req.tokens))
-        req.done.set()
 
     def reset_state(self) -> None:
         """Forget every slot, cached prefix, and proposer — crash
@@ -604,19 +737,16 @@ class BatchedGenerator:
         of tokens emitted. Inactive slots ride along as temp-0 rows writing
         into their own (unused) cache positions — static shapes, one
         compiled program regardless of occupancy."""
-        for i, s in enumerate(self.slots):  # client-cancelled slots retire
-            if s is not None and s.cancel.is_set():
-                self._retire(i)
+        active = self._sweep_cancelled()
         if self.spec:
             # the K+1-wide cache write would CLAMP (and corrupt earlier
             # rows) past seq_len - spec - 1: retire slots that close to the
             # cap before dispatching (non-spec mode retires at seq_len; spec
             # trades the last few positions of capacity for run dispatches)
-            for i, s in enumerate(self.slots):
-                if s is not None and \
-                        self.pos[i] + self.spec + 1 > self.cfg.seq_len:
+            for i in list(active):
+                if self.pos[i] + self.spec + 1 > self.cfg.seq_len:
                     self._retire(i)
-        active = [i for i, s in enumerate(self.slots) if s is not None]
+                    active.remove(i)
         if not active:
             return 0
         if __debug__:
@@ -626,15 +756,7 @@ class BatchedGenerator:
             for i, ctx in enumerate(self._ctx):
                 assert ctx is None or self.pos[i] >= len(ctx), (
                     i, int(self.pos[i]), len(ctx))
-        temps = np.zeros(self.n_slots, dtype=np.float32)
-        topps = np.zeros(self.n_slots, dtype=np.float32)
-        coins = np.zeros(self.n_slots, dtype=np.float32)
-        for i in active:
-            req = self.slots[i]
-            temps[i] = req.temperature
-            topps[i] = req.topp
-            if req.temperature > 0.0:
-                coins[i], req.rng_state = xorshift_random_f32(req.rng_state)
+        temps, topps, coins = self._sampling_rows(active)
 
         if self.spec:
             return self._spec_step(active, temps, topps, coins)
@@ -669,10 +791,7 @@ class BatchedGenerator:
         to its solo run."""
         if k <= 1 or self.spec:
             return self.step()
-        for i, s in enumerate(self.slots):
-            if s is not None and s.cancel.is_set():
-                self._retire(i)
-        active = [i for i, s in enumerate(self.slots) if s is not None]
+        active = self._sweep_cancelled()
         if not active:
             return 0
         if any(self.pos[i] + k > self.cfg.seq_len for i in active) or \
@@ -717,72 +836,13 @@ class BatchedGenerator:
         self._record_step(len(active), step_ms, emitted)
         return emitted
 
-    def _handle_nonfinite(self, active: list[int], nf) -> set[int]:
-        """Non-finite tripwire tail for one ragged dispatch: count each
-        poisoned row's event (``dllama_nonfinite_total{site="batch"}``);
-        with fail-fast armed, fail THAT request explicitly (503-shaped —
-        an explicit numerics error instead of garbage tokens) and retire
-        its slot, leaving the rest of the batch untouched. Returns the
-        retired rows."""
-        failed: set[int] = set()
-        for i in active:
-            n = int(nf[i])
-            if n <= 0:
-                continue
-            numerics.record_nonfinite(n, "batch")
-            if getattr(self.eng, "nf_failfast", False):
-                req = self.slots[i]
-                req.error = str(numerics.nonfinite_error("batch", n))
-                req.server_error = True
-                self._retire(i)
-                failed.add(i)
-        return failed
-
-    def _record_step(self, n_active: int, ms: float, emitted: int) -> None:
-        """Per-dispatch telemetry: occupancy, step latency, emitted tokens,
-        pooled KV occupancy (rows holding LIVE requests' context / total
+    def _kv_fraction(self) -> float:
+        """Pooled KV occupancy: rows holding LIVE requests' context / total
         rows — retired slots keep stale pos for prefix reuse but their rows
-        are reclaimable, so they must not count as occupied)."""
-        self._m_occupancy.set(n_active)
-        self._m_step_ms.record(ms)
-        if emitted:
-            self._m_tokens.inc(emitted)
+        are reclaimable, so they must not count as occupied."""
         live = sum(int(self.pos[i]) for i, s in enumerate(self.slots)
                    if s is not None)
-        self._m_kv.set(live / (self.n_slots * self.cfg.seq_len))
-
-    def _emit_run(self, i: int, run: list[int]) -> int:
-        """Deliver a run of tokens to slot ``i``'s request: append, stream,
-        advance position, retire on EOS / limits. Returns tokens emitted.
-        The run is pre-truncated to the ACCEPTED prefix; EOS/max_tokens
-        truncation happens here so both step paths share the exact rules."""
-        req = self.slots[i]
-        tok = self.eng.tokenizer
-        n_keep = min(len(run), req.max_tokens - len(req.tokens))
-        if n_keep <= 0:  # belt: the scheduler retires at max_tokens
-            self._retire(i)
-            return 0
-        retire = n_keep < len(run)
-        for j in range(n_keep):
-            t = run[j]
-            eos = (req.stop_on_eos and tok is not None and tok.is_eos(t))
-            if eos:
-                n_keep, retire = j + 1, True
-                break
-        run = run[:n_keep]
-        self.pos[i] += len(run)
-        self.next_token[i] = run[-1]
-        req.tokens.extend(run)
-        if self._proposers[i] is not None:
-            self._proposers[i].extend(run)
-        for t in run:
-            piece = req.decoder.decode(t) if req.decoder is not None else None
-            if req.on_token is not None:
-                req.on_token(t, piece)
-        if (retire or len(req.tokens) >= req.max_tokens
-                or self.pos[i] >= self.cfg.seq_len):
-            self._retire(i)
-        return len(run)
+        return live / (self.n_slots * self.cfg.seq_len)
 
     def _spec_step(self, active: list[int], temps, topps, coins) -> int:
         """One ragged speculative verify dispatch (models.ragged_verify_step):
@@ -816,6 +876,431 @@ class BatchedGenerator:
         return emitted
 
 
+class PagedGenerator(_GeneratorCore):
+    """Block-granular paged KV + the paged ragged decode step
+    (runtime/kvblocks.py, models.llama.paged_forward) — the continuous
+    batching engine room behind ``--kv-block-size``.
+
+    Differences from the dense slot pool:
+
+    * KV lives in a block pool ``[L, n_blocks, n_kv, block_size, hd]``; a
+      sequence holds exactly the blocks its context needs (lazy growth at
+      decode time), not a max-context column — admission is priced in
+      BLOCKS, so many short requests fit where the dense pool would hold
+      worst-case HBM for each.
+    * Prefix reuse is block-level sharing: full prompt blocks are shared
+      physically (refcount, zero prefill work, zero copy), the partial
+      tail is copy-on-write (one block copy). Retired sequences' blocks
+      stay shareable in an LRU cache until allocation pressure evicts
+      them — reuse now survives pool churn instead of riding retired
+      slots' leftover columns.
+    * Prefill reuses the ENGINE's own prefill program over the sequence's
+      gathered dense column (take → chunked forward → scatter back), so
+      the paged path adds exactly one full-model program — the paged
+      decode step, jitted once per pool geometry.
+
+    Unsupported combinations (validated at engine construction): spec
+    lookup, fused decode chunks, multihost, sp/pp/dp meshes, forced
+    Pallas attention (the paged gather runs the XLA oracle).
+    """
+
+    def __init__(self, engine: "InferenceEngine", n_slots: int = 4):
+        from ..runtime.kvblocks import (BlockPool, PagedKVCache,
+                                        blocks_per_seq)
+        from .hbm import check_budget, fit_block_pool
+
+        block_size = int(getattr(engine, "kv_block_size", 0) or 0)
+        if block_size <= 0:
+            raise ValueError("PagedGenerator needs an engine built with "
+                             "kv_block_size (--kv-block-size N)")
+        if engine.multihost:
+            raise ValueError("--kv-block-size is single-host only (the "
+                             "worker mirror protocol has no paged ops yet)")
+        self._init_core(engine, n_slots)
+        self.block_size = block_size
+        self.table_width = blocks_per_seq(self.cfg.seq_len, block_size)
+        # pool sizing through the HBM guard: want the dense pool's worst
+        # case (every slot at max context) + the null block; degrade to the
+        # largest pool that fits the device budget (>= one full sequence)
+        want = n_slots * self.table_width + 1
+        n_blocks, est = fit_block_pool(
+            self.cfg, want, block_size=block_size,
+            min_blocks=self.table_width + 1,
+            weight_repr=getattr(engine, "hbm_weight_repr", "q40"),
+            kv_dtype_bytes=engine.kv_dtype.itemsize,
+            n_shards=engine.tp * engine.pp,
+            offload=(engine.weight_mode == "offload"))
+        if n_blocks == 0:
+            check_budget(est["need_per_device"],
+                         f"paged serving ({want} blocks of {block_size})")
+        if n_blocks < want:
+            print(f"⚠️ HBM admission guard: {want} KV blocks do not fit the "
+                  f"device budget — degrading to {n_blocks} blocks "
+                  f"({(n_blocks - 1) * block_size} cache rows) instead of "
+                  f"risking an OOM (runtime/hbm.py)", flush=True)
+        self.hbm_need = est["need_per_device"]
+        self.pool = BlockPool(n_blocks, block_size)
+        pkv = PagedKVCache.create(self.cfg, n_blocks, block_size,
+                                  dtype=engine.kv_dtype)
+        if engine.plan is not None:
+            from ..parallel.sharding import paged_kv_sharding
+
+            pkv = jax.device_put(pkv, paged_kv_sharding(engine.plan, pkv))
+        self.pkv = pkv
+        # per-slot block tables (host truth; shipped per dispatch as a
+        # traced [n_slots, table_width] int32 — values never recompile)
+        self.tables = np.zeros((n_slots, self.table_width), dtype=np.int32)
+        self._seq_bids: list[list[int]] = [[] for _ in range(n_slots)]
+        # shared-prefix length (in blocks) per slot: the commit scatter
+        # redirects those entries to the null block so a shared block is
+        # never written, even with identical bytes
+        self._n_shared = [0] * n_slots
+        # per-slot RESERVATION: worst-case blocks the slot's request may
+        # still allocate at decode boundaries. can_admit subtracts the
+        # outstanding total so concurrent sequences can't double-spend
+        # the same free blocks and hit mid-decode exhaustion — the
+        # block-priced admission guarantee holds across the whole batch,
+        # not just per request
+        self._reserve = [0] * n_slots
+
+        _sc = getattr(engine, "introspection_scope", None) or "default"
+        from ..models.llama import paged_sampled_step_guarded
+
+        self._step = plan_scoped_jit(paged_sampled_step_guarded, scope=_sc,
+                                     program="paged_sampled_step",
+                                     static_argnums=1, donate_argnums=(4,))
+        # prefill rides the ENGINE's jitted forward over the gathered
+        # column (same program its solo path compiles — shared cache)
+        self._prefill_fwd = engine._step
+        M, bs = self.table_width, block_size
+
+        def _take_fn(pkv, table):
+            def view(pool):
+                g = pool[:, table]                    # [L, M, n_kv, bs, hd]
+                g = jnp.moveaxis(g, 1, 2)             # [L, n_kv, M, bs, hd]
+                return g.reshape(g.shape[0], 1, self.cfg.n_kv_heads,
+                                 M * bs, self.cfg.head_dim)
+            return KVCache(k=view(pkv.k), v=view(pkv.v))
+
+        def _put_fn(pkv, col, table):
+            def back(pool, c):
+                L = c.shape[0]
+                c = c[:, 0].reshape(L, self.cfg.n_kv_heads, M, bs,
+                                    self.cfg.head_dim)
+                c = jnp.moveaxis(c, 2, 1)             # [L, M, n_kv, bs, hd]
+                return pool.at[:, table].set(c.astype(pool.dtype))
+            return PagedKVCache(k=back(pkv.k, col.k), v=back(pkv.v, col.v))
+
+        def _copy_fn(pkv, src, dst):
+            def cp(pool):
+                blk = jax.lax.dynamic_slice_in_dim(pool, src, 1, axis=1)
+                return jax.lax.dynamic_update_slice_in_dim(pool, blk, dst,
+                                                           axis=1)
+            return PagedKVCache(k=cp(pkv.k), v=cp(pkv.v))
+
+        self._take = jax.jit(_take_fn)
+        self._put = jax.jit(_put_fn, donate_argnums=(0,))
+        self._copy_block = jax.jit(_copy_fn, donate_argnums=(0,))
+        # warm-up normalization: pass the freshly created (committed) pool
+        # through one no-op jitted copy (null block onto itself). Two birds:
+        # the copy-on-write program is compiled BEFORE serving reaches
+        # steady state (a first CoW admission must not be a latency cliff),
+        # and every program only ever sees jit-OUTPUT sharding on the pool
+        # — a committed input would key a second executable for the same
+        # shapes on the first post-decode admission (the donated-output
+        # recompile the canary docs measured)
+        self.pkv = self._copy_block(self.pkv, jnp.int32(0), jnp.int32(0))
+        self._m_blocks_total = self._tm.gauge(telemetry.KV_BLOCKS_TOTAL)
+        self._m_blocks_used = self._tm.gauge(telemetry.KV_BLOCKS_USED)
+        self._m_blocks_shared = self._tm.gauge(telemetry.KV_BLOCKS_SHARED)
+        self._m_blocks_total.set(n_blocks - 1)
+        self._update_block_gauges()
+
+    # -- pool bookkeeping ---------------------------------------------------
+
+    def _update_block_gauges(self) -> None:
+        self._m_blocks_used.set(self.pool.used_blocks())
+        self._m_blocks_shared.set(self.pool.shared_blocks())
+
+    def _kv_fraction(self) -> float:
+        return self.pool.used_blocks() / max(1, self.pool.n_blocks - 1)
+
+    def _worst_case_blocks(self, prompt_len: int, max_tokens: int) -> int:
+        """Admission price in blocks: every position the request could
+        ever write (prompt prefill + decode growth, capped at seq_len) —
+        conservative (sharing only reduces the real need)."""
+        rows = min(prompt_len - 1 + max_tokens, self.cfg.seq_len)
+        return max(1, -(-rows // self.block_size))
+
+    def can_admit(self, req: Request) -> bool:
+        """Free (+ evictable) blocks minus every live sequence's
+        outstanding worst-case growth must cover this request's own
+        worst case — admission never over-commits the pool, so organic
+        mid-decode exhaustion cannot happen (only injected exhaustion
+        and early-retire slack remain)."""
+        return (self.pool.free_blocks() - sum(self._reserve)
+                >= self._worst_case_blocks(len(req.prompt_ids),
+                                           req.max_tokens))
+
+    # -- admission ----------------------------------------------------------
+
+    def begin_admit(self, req: Request, slot: int) -> "_Admission":
+        """Start admitting into ``slot``: match the prompt against the
+        block-level prefix index (share full blocks, copy-on-write the
+        partial tail), allocate the remaining prompt blocks, and gather
+        the sequence's column for incremental chunked prefill. Allocation
+        is atomic: any exhaustion mid-way releases everything taken and
+        raises :class:`~dllama_tpu.runtime.kvblocks.BlockPoolExhausted`
+        (the scheduler keeps the request QUEUED)."""
+        ids = req.prompt_ids
+        assert ids, "empty prompt"
+        if len(ids) >= self.cfg.seq_len:
+            raise ValueError(
+                f"prompt of {len(ids)} tokens exceeds the usable context "
+                f"(seq_len {self.cfg.seq_len})")
+        rest = ids[:-1]
+        shared, n_tok, cow_src, cow_r = self.pool.match_prefix(rest)
+        bids: list[int] = []
+        try:
+            for b in shared:
+                self.pool.share(b)
+                bids.append(b)
+            reused = n_tok
+            if cow_src is not None and cow_r > 0:
+                # copy-on-write: the partially-matching block cannot be
+                # shared (this sequence will overwrite rows >= cow_r), so
+                # copy it physically and reuse its first cow_r rows
+                self.pool.share(cow_src)  # pin across the alloc/eviction
+                try:
+                    dst = self.pool.alloc()
+                finally:
+                    self.pool.release(cow_src)
+                bids.append(dst)
+                self.pkv = self._copy_block(self.pkv, jnp.int32(cow_src),
+                                            jnp.int32(dst))
+                reused += cow_r
+            while len(bids) < -(-len(rest) // self.block_size):
+                bids.append(self.pool.alloc())
+            # a fully-reused prompt (shared blocks + CoW tail cover every
+            # prefill position) has no rows to build: skip the column
+            # gather/scatter round-trip entirely — THE hot path of
+            # repeated system prompts, where reuse must mean zero device
+            # work beyond the one CoW copy
+            col = self._exec_take(bids) if reused < len(rest) else None
+        except Exception as e:  # noqa: BLE001 — atomic rollback, re-raised
+            # ANY failure before the slot owns the blocks (exhaustion, a
+            # device error in the CoW copy or the column gather) releases
+            # everything taken — a leaked refcount would shrink the pool
+            # forever
+            for b in bids:
+                self.pool.release(b)
+            if isinstance(e, BlockPoolExhausted):
+                telemetry.registry().counter(
+                    telemetry.KV_BLOCK_EXHAUSTION).inc()
+            raise
+        self._seq_bids[slot] = bids
+        self._n_shared[slot] = len(shared)
+        self._reserve[slot] = max(
+            0, self._worst_case_blocks(len(ids), req.max_tokens) - len(bids))
+        # the slot's table is NOT published yet: until the commit in
+        # continue_admit the slot still rides along decode dispatches as
+        # an INACTIVE row (with whatever stale pos the previous occupant
+        # left), and its ride-along writes must keep landing in the null
+        # block — publishing shared bids here would let a stale-pos
+        # ride-along write corrupt a shared block other live sequences
+        # attend to. Prefill runs over a locally-built table instead.
+        self.tables[slot, :] = self.pool.NULL
+        adm = _Admission(req=req, slot=slot, col=col, reused=reused)
+        adm.pos = reused  # prefill resumes after the reused prefix
+        self._note_admitted(req, slot, reused)
+        self._update_block_gauges()
+        return adm
+
+    def _exec_take(self, bids: list[int]):
+        table = np.full(self.table_width, self.pool.NULL, dtype=np.int32)
+        table[:len(bids)] = bids
+        col = self._take(self.pkv, jnp.asarray(table))
+        # pin ONE canonical sharding on the gathered column: the prefill
+        # executable is keyed on its input shardings, and the pool cycles
+        # through jit outputs whose resolved sharding/commitment varies
+        # with the ops that produced them (copy-on-write vs step vs
+        # create) — without this, an identical-shape column could key a
+        # second forward executable AFTER steady state (a post-steady
+        # retrace = a latency cliff on TPU). device_put on a matching
+        # layout is a no-copy alias.
+        if self.eng.plan is not None:
+            from ..parallel.sharding import kv_cache_sharding
+
+            return jax.device_put(col, kv_cache_sharding(self.eng.plan, col))
+        s = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+        return jax.device_put(col, KVCache(k=s, v=s))
+
+    def _exec_prefill(self, col, padded, pos: int):
+        with self.eng.watchdog.guard("batch_prefill"):
+            failpoints.fire("step_hang")
+            with self._plan_ctx():
+                _, col = self._prefill_fwd(
+                    self.eng.params, self.cfg,
+                    jnp.asarray(np.asarray(padded).reshape(1, -1), jnp.int32),
+                    jnp.int32(pos), col)
+            return col
+
+    def continue_admit(self, adm: "_Admission") -> bool:
+        """One prefill chunk over the gathered column; commit scatters it
+        back through the block table (shared-prefix entries redirected to
+        the null block — a shared block is never a write target) and
+        registers the prompt's blocks for future sharing."""
+        rest = adm.req.prompt_ids[:-1]
+        if adm.pos < len(rest):
+            n_b = self.eng._prefill_chunk_size(len(rest) - adm.pos)
+            chunk = rest[adm.pos:adm.pos + n_b]
+            pad_to = min(n_b, self.cfg.seq_len - adm.pos)
+            padded = chunk + [0] * (pad_to - len(chunk))
+            adm.col = self._exec_prefill(adm.col, padded, adm.pos)
+            self.eng.seen_buckets.add(len(padded))
+            adm.pos += len(chunk)
+            if adm.pos < len(rest):
+                return False
+        slot = adm.slot
+        bids = self._seq_bids[slot]
+        if adm.col is not None:
+            # scatter only the slot's OWN blocks back: shared-prefix
+            # entries stay null — a shared block is never a write target
+            put_table = np.full(self.table_width, self.pool.NULL,
+                                dtype=np.int32)
+            n_sh = self._n_shared[slot]
+            put_table[n_sh:len(bids)] = bids[n_sh:]
+            self.pkv = self._put(self.pkv, adm.col,
+                                 jnp.asarray(put_table))
+        self.pool.register_prompt(bids, rest)
+        # the table goes live only NOW, with the committed pos riding in
+        # _arm_decode — no dispatch ever sees this slot's real table
+        # paired with a stale position
+        self.tables[slot, :len(bids)] = bids
+        adm.pos = len(rest)
+        self._arm_decode(adm)
+        return True
+
+    def admit(self, req: Request, slot: int) -> None:
+        """Admit in one go (tests / non-interleaved callers)."""
+        adm = self.begin_admit(req, slot)
+        while not self.continue_admit(adm):
+            pass
+
+    def _release_blocks(self, slot: int) -> None:
+        """Drop every block reference ``slot`` holds and forget its
+        bookkeeping (shared count, growth reservation, table row — the
+        all-null row sends ride-along writes to the null block)."""
+        for b in self._seq_bids[slot]:
+            self.pool.release(b)
+        self._seq_bids[slot] = []
+        self._n_shared[slot] = 0
+        self._reserve[slot] = 0
+        self.tables[slot, :] = self.pool.NULL
+        self._update_block_gauges()
+
+    def _retire(self, slot: int) -> None:
+        super()._retire(slot)
+        self._release_blocks(slot)
+
+    def abort_admit(self, adm: "_Admission") -> None:
+        """Release everything ``begin_admit`` took for an admission that
+        will never commit. Safe in every abort window: blocks this
+        admission allocated fresh are unregistered (they free outright),
+        shared/CoW sources just drop the extra reference — registered
+        contents stay valid for other sequences."""
+        self._release_blocks(adm.slot)
+
+    def reset_state(self) -> None:
+        """Crash recovery: every slot forgotten, the whole pool (refcounts
+        AND the prefix index) reset — nothing can match blocks a
+        half-finished dispatch may have corrupted."""
+        self.slots = [None] * self.n_slots
+        self._proposers = [None] * self.n_slots
+        self._seq_bids = [[] for _ in range(self.n_slots)]
+        self._n_shared = [0] * self.n_slots
+        self._reserve = [0] * self.n_slots
+        self.pool.reset()
+        self.tables[:, :] = self.pool.NULL
+        self.pos[:] = 0
+        self.next_token[:] = 0
+        self._m_occupancy.set(0)
+        self._m_kv.set(0.0)
+        self._update_block_gauges()
+
+    # -- decode -------------------------------------------------------------
+
+    def _ensure_block(self, i: int) -> None:
+        """Lazy block growth: guarantee slot ``i``'s write position has a
+        physical block before the dispatch (the continuous-batching
+        memory win — a sequence only ever holds the blocks its live
+        context spans)."""
+        idx = int(self.pos[i]) // self.block_size
+        if self.tables[i, idx] == self.pool.NULL:
+            bid = self.pool.alloc()
+            self._seq_bids[i].append(bid)
+            self._reserve[i] = max(0, self._reserve[i] - 1)
+            self.tables[i, idx] = bid
+
+    def step(self) -> int:
+        """One paged ragged decode step for every active slot. Inactive
+        slots ride along with all-null tables (their writes land in the
+        null block) — static shapes, one compiled program regardless of
+        occupancy or block-table contents."""
+        active = self._sweep_cancelled()
+        if not active:
+            return 0
+        for i in list(active):
+            try:
+                self._ensure_block(i)
+            except BlockPoolExhausted as e:
+                # mid-decode growth found no block: fail THIS request
+                # explicitly (503-shaped), keep the rest of the batch
+                telemetry.registry().counter(
+                    telemetry.KV_BLOCK_EXHAUSTION).inc()
+                req = self.slots[i]
+                req.error = str(e)
+                req.server_error = True
+                self._retire(i)
+                active.remove(i)
+        if not active:
+            return 0
+        if __debug__:
+            # copy-on-write safety: a write target is never a shared block
+            for i in active:
+                bid = int(self.tables[i, int(self.pos[i]) // self.block_size])
+                assert self.pool.refcount(bid) == 1, (i, bid)
+        temps, topps, coins = self._sampling_rows(active)
+        t0 = time.perf_counter()
+        with self.eng.watchdog.guard("batch_step"):
+            failpoints.fire("step_hang")
+            with self._plan_ctx():
+                (nxt, nf), self.pkv = self._step(
+                    self.eng.params, self.cfg,
+                    jnp.asarray(self.next_token.astype(np.int32)[:, None]),
+                    jnp.asarray(self.pos.astype(np.int32)), self.pkv,
+                    jnp.asarray(self.tables),
+                    jnp.asarray(temps), jnp.asarray(topps),
+                    jnp.asarray(coins), self._poison())
+            nxt, nf = np.asarray(nxt), np.asarray(nf)
+        ms = (time.perf_counter() - t0) * 1000.0
+        poisoned = self._handle_nonfinite(active, nf)
+        emitted = 0
+        for i in active:
+            if i in poisoned:
+                continue
+            emitted += self._emit_run(i, [int(nxt[i])])
+        self._record_step(len(active), ms, emitted)
+        self._update_block_gauges()
+        return emitted
+
+    def step_chunk(self, k: int) -> int:
+        """Fused multi-step decode is not built for the paged path yet
+        (engine validation rejects --decode-chunk with --kv-block-size);
+        direct callers degrade to single steps."""
+        return self.step()
+
+
 class BatchScheduler:
     """Thread-safe front end: queue beyond the slot pool + a step loop.
 
@@ -846,8 +1331,20 @@ class BatchScheduler:
     def __init__(self, engine: "InferenceEngine", n_slots: int = 4, *,
                  max_queue: int = 0, max_restarts: int = 3,
                  _start_thread: bool = True):
-        self.gen = BatchedGenerator(engine, n_slots)
+        # --kv-block-size selects the paged block-pool generator; the
+        # scheduler's queue/deadline/supervision machinery is identical
+        # over both (they share _GeneratorCore's lifecycle contract)
+        if getattr(engine, "kv_block_size", 0):
+            self.gen: _GeneratorCore = PagedGenerator(engine, n_slots)
+        else:
+            self.gen = BatchedGenerator(engine, n_slots)
         self.n_slots = self.gen.n_slots  # may be HBM-degraded below n_slots
+        # token-budget policy for interleaved chunked prefill: per loop
+        # tick, at least one admission advances one chunk, and further
+        # admissions only run while the tick's prefill-token budget lasts
+        # — decode latency for active slots stays bounded no matter how
+        # many long prompts are admitting
+        self.prefill_budget = max(engine.prefill_buckets)
         self.max_queue = max_queue
         self.max_restarts = max_restarts
         self._queue: list[Request] = []
@@ -991,6 +1488,12 @@ class BatchScheduler:
         with self._lock:
             victims = list(self._queue)
             self._queue.clear()
+            # NOT abort_admit'ed here: _fail_all runs on foreign threads
+            # (close(), the watchdog monitor) that must not touch the
+            # loop-thread-owned BlockPool; every _fail_all path either
+            # resets the pool right after (crash restart) or stops
+            # serving for good (stall, drain), so nothing is leaked to a
+            # live pool
             victims += [a.req for a in self._admissions]
             self._admissions.clear()
             telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(0)
@@ -1112,16 +1615,29 @@ class BatchScheduler:
         self._check_deadlines()
         reserved = {a.slot for a in self._admissions}
         with self._lock:
-            # start admissions into free, unreserved slots
+            # start admissions into free, unreserved slots; on the paged
+            # pool each request is priced in BLOCKS first (worst-case
+            # need vs free+evictable blocks) — an unaffordable request
+            # stays queued, preserving FIFO order
             while self._queue:
                 free = [s for s in self.gen.free_slots()
                         if s not in reserved]
                 if not free:
                     break
+                if not self.gen.can_admit(self._queue[0]):
+                    break
                 req = self._queue.pop(0)
                 try:
                     failpoints.fire("admit")
                     adm = self.gen.begin_admit(req, free[0])
+                except BlockPoolExhausted:
+                    # block-pool exhaustion (organic or kv_alloc-injected)
+                    # DEGRADES TO QUEUEING: the request goes back to the
+                    # head and waits for retirements to free blocks —
+                    # back-pressure surfaces as 429s (queue full) or 408s
+                    # (deadline), never a crash or a silent drop
+                    self._queue.insert(0, req)
+                    break
                 except Exception as e:  # noqa: BLE001 — reject, don't wedge
                     req.error = f"{type(e).__name__}: {e}"
                     req.done.set()
@@ -1130,21 +1646,33 @@ class BatchScheduler:
                 reserved.add(adm.slot)
             telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
                 len(self._queue))
-        # ONE prefill chunk per in-flight admission per loop tick, so a
-        # long prompt interleaves with (not stalls) active decode steps
+        # interleaved chunked prefill under the token-budget policy: the
+        # FIRST admission always advances one chunk (progress guarantee);
+        # further admissions run only while the tick's budget lasts, so a
+        # pile-up of long prompts can't starve active decode steps
+        # cancel sweep over EVERY admission first — a cancelled client
+        # behind the budget cutoff must not keep blocks/reservation/slot
+        # for the remaining ticks of the admissions ahead of it
         for adm in list(self._admissions):
             if adm.req.cancel.is_set():
                 self._admissions.remove(adm)
+                self.gen.abort_admit(adm)  # paged: release the blocks
                 # counted as admitted in begin_admit: balance the pair so
                 # admissions_total - retires_total stays "live requests"
                 telemetry.registry().counter(telemetry.RETIRES).inc()
                 adm.req.done.set()
-                continue
+        spent = 0
+        for adm in list(self._admissions):
+            if spent >= self.prefill_budget:
+                break  # over budget: the rest prefill on later ticks
+            remaining = len(adm.req.prompt_ids) - 1 - adm.pos
+            spent += self.gen.eng._prefill_chunk_size(max(1, remaining))
             try:
                 if self.gen.continue_admit(adm):
                     self._admissions.remove(adm)
             except Exception as e:  # noqa: BLE001 — reject, don't wedge
                 self._admissions.remove(adm)
+                self.gen.abort_admit(adm)
                 telemetry.registry().counter(telemetry.RETIRES).inc()
                 adm.req.error = f"{type(e).__name__}: {e}"
                 adm.req.done.set()

@@ -66,6 +66,11 @@ BATCH_TOKENS = "dllama_batch_tokens_total"
 ADMISSIONS = "dllama_admissions_total"
 RETIRES = "dllama_retires_total"
 PREFIX_REUSE_TOKENS = "dllama_prefix_reuse_tokens_total"
+# paged KV block pool (runtime/kvblocks.py via runtime/serving.py)
+KV_BLOCKS_TOTAL = "dllama_kv_blocks_total"
+KV_BLOCKS_USED = "dllama_kv_blocks_used"
+KV_BLOCKS_SHARED = "dllama_kv_blocks_shared"
+KV_BLOCK_EXHAUSTION = "dllama_kv_block_exhaustion_total"
 # fault tolerance (runtime/serving.py, runtime/failpoints.py)
 REQUESTS_SHED = "dllama_requests_shed_total"
 REQUEST_TIMEOUTS = "dllama_request_timeouts_total"
@@ -170,7 +175,22 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
     _spec(ADMISSIONS, "counter", "Requests admitted into a slot"),
     _spec(RETIRES, "counter", "Slots retired (EOS, limits, or cancel)"),
     _spec(PREFIX_REUSE_TOKENS, "counter",
-          "Prompt tokens skipped via cross-slot KV prefix reuse"),
+          "Prompt tokens skipped via KV prefix reuse (cross-slot on the "
+          "dense pool; block-level sharing + copy-on-write on the paged "
+          "pool)"),
+    _spec(KV_BLOCKS_TOTAL, "gauge",
+          "Usable physical blocks in the paged KV pool (excludes the "
+          "null block; 0 when serving runs the dense slot pool)"),
+    _spec(KV_BLOCKS_USED, "gauge",
+          "Paged KV blocks held by live sequences (refcount >= 1)"),
+    _spec(KV_BLOCKS_SHARED, "gauge",
+          "Paged KV blocks referenced by more than one live sequence "
+          "(block-level prefix sharing in effect)"),
+    _spec(KV_BLOCK_EXHAUSTION, "counter",
+          "Block-pool exhaustion events: an admission or decode step "
+          "found no free/evictable block and degraded to queueing (or "
+          "failed that one request 503-shaped mid-decode), never a "
+          "crash"),
     _spec(REQUESTS_SHED, "counter",
           "Requests rejected at admission because the queue was full "
           "(HTTP 429 load shedding)"),

@@ -800,6 +800,11 @@ def run_api_server(args) -> int:
         raise SystemExit("--dp shards the --batch-slots pool; without "
                          "batched serving it only replicates batch-1 work "
                          "(set --batch-slots N with N % dp == 0, or drop --dp)")
+    if (getattr(args, "kv_block_size", 0) or 0) > 0 \
+            and (getattr(args, "batch_slots", 0) or 0) <= 1:
+        raise SystemExit("--kv-block-size is the paged BATCHED serving "
+                         "cache; it needs --batch-slots N (N > 1) to serve "
+                         "through the continuous-batching scheduler")
     if getattr(args, "trace_out", None):
         telemetry.tracer().configure(args.trace_out)
         print(f"🔬 request trace (JSONL spans) → {args.trace_out}")
@@ -855,6 +860,11 @@ def run_api_server(args) -> int:
                  if state.sched.n_slots != n_slots else "")
               + (f", queue bound {max_queue} (429 beyond)" if max_queue
                  else ""))
+        if getattr(engine, "kv_block_size", 0):
+            pool = state.sched.gen.pool
+            print(f"🕸️ paged KV: {pool.n_blocks - 1} blocks × "
+                  f"{pool.block_size} rows (block-priced admission, "
+                  f"block-level prefix sharing)")
         if engine.spec_lookup:
             print(f"🕸️ speculative serving: verify K={engine.spec_lookup} "
                   f"per slot (greedy requests)")

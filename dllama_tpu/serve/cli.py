@@ -100,6 +100,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(float8_e4m3) halves bf16's cache footprint and "
                         "read bandwidth — long-context decode is "
                         "KV-bandwidth-bound")
+    p.add_argument("--kv-block-size", type=int, default=0, metavar="N",
+                   help="api mode with --batch-slots: paged KV serving — "
+                        "the cache becomes a pool of N-row blocks with "
+                        "per-sequence block tables (runtime/kvblocks.py). "
+                        "Admission is priced in blocks, prefix reuse is "
+                        "block-level sharing + copy-on-write. N must be a "
+                        "power of two tiling the padded context; 0 (the "
+                        "default) keeps the dense slot pool")
     p.add_argument("--nbatches", type=int, default=None,
                    help="pin a fixed prefill chunk size (reference default "
                         "32, app.cpp:28); unset = TPU-sized adaptive "
@@ -413,6 +421,7 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         decode_chunk=args.decode_chunk,
         spec_lookup=getattr(args, "spec_lookup", 0),
         kv_dtype=getattr(args, "kv_dtype", "auto"),
+        kv_block_size=getattr(args, "kv_block_size", 0),
         profile_split=getattr(args, "profile_split", False),
         verify_weights=getattr(args, "verify_weights", False),
         numerics_taps=getattr(args, "numerics_taps", False),

@@ -125,3 +125,40 @@ def byte_vocab_tokenizer() -> tfile.TokenizerData:
         chat_template=None,
         max_token_length=max(len(t) for t in vocab),
     )
+
+
+def pinned_host_probe():
+    """Probe (once per process) whether this jaxlib can actually place
+    arrays in ``pinned_host`` memory — the capability the offload weight
+    path requires. Some jaxlib/CPU builds expose only ``unpinned_host``
+    and fail at sharding construction; offload tests skip with the
+    probe's reason instead of failing (the path itself is untouched)."""
+    global _PINNED_HOST_PROBE
+    if _PINNED_HOST_PROBE is None:
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            dev = jax.local_devices()[0]
+            s = jax.sharding.SingleDeviceSharding(dev,
+                                                  memory_kind="pinned_host")
+            x = jax.device_put(jnp.zeros((8,), jnp.float32), s)
+            jax.block_until_ready(x)
+            _PINNED_HOST_PROBE = (True, "")
+        except Exception as e:  # noqa: BLE001 — any failure means "unsupported here"
+            _PINNED_HOST_PROBE = (False, f"{type(e).__name__}: {e}")
+    return _PINNED_HOST_PROBE
+
+
+_PINNED_HOST_PROBE = None
+
+
+def require_pinned_host():
+    """``pytest.skip`` (with the probe's reason) when this jaxlib cannot
+    place arrays in pinned_host memory."""
+    import pytest
+
+    ok, reason = pinned_host_probe()
+    if not ok:
+        pytest.skip(f"jaxlib pinned_host unsupported on this backend: "
+                    f"{reason}")

@@ -628,3 +628,109 @@ def test_hbm_admission_guard_degrades_slot_pool(tmp_path, monkeypatch):
     assert gen.kv.k.shape[1] == 2  # the pool really is smaller
     monkeypatch.delenv("DLLAMA_HBM_BYTES")
     eng.close()
+
+
+# -- kv_alloc: paged block-pool exhaustion (ISSUE 6) -------------------------
+
+
+@pytest.fixture(scope="module")
+def paged_chaos_engine(tmp_path_factory):
+    d = tmp_path_factory.mktemp("chaos_paged")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(23)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    return InferenceEngine(str(mpath), str(tpath), tp=1, temperature=0.0,
+                           seed=3, kv_block_size=16)
+
+
+def test_kv_alloc_exhaustion_degrades_to_queueing_then_recovers(
+        paged_chaos_engine):
+    """The ISSUE-6 chaos acceptance: injected block-pool exhaustion at
+    admission DEGRADES TO QUEUEING — the request stays queued (not failed,
+    not crashed), ``dllama_kv_block_exhaustion_total`` counts the event,
+    and once blocks are allocatable again the same request admits and
+    completes normally."""
+    exhaustion = tm.registry().counter(tm.KV_BLOCK_EXHAUSTION)
+    crashes = tm.registry().counter(tm.SCHEDULER_CRASHES)
+    fired = tm.registry().counter(tm.FAILPOINTS_FIRED)
+    e0, c0, f0 = exhaustion.total(), crashes.total(), fired.total(
+        name="kv_alloc")
+    fp.arm("kv_alloc", "raise", times=1)
+    sched = BatchScheduler(paged_chaos_engine, n_slots=2,
+                           _start_thread=False)
+    try:
+        req = sched.submit(_enc(paged_chaos_engine), 4, stop_on_eos=False)
+        sched._tick()  # alloc raises: back-pressure, never a crash
+        assert not req.done.is_set()
+        assert req in sched._queue  # requeued at the head, FIFO preserved
+        assert exhaustion.total() == e0 + 1
+        assert fired.total(name="kv_alloc") == f0 + 1
+        for _ in range(200):  # failpoint exhausted: admits + completes
+            sched._tick()
+            if req.done.is_set():
+                break
+        assert req.done.is_set()
+        assert req.error is None and len(req.tokens) == 4
+        assert crashes.total() == c0  # exhaustion is not a crash
+    finally:
+        sched.close()
+
+
+def test_kv_alloc_sustained_exhaustion_sheds_429_shaped(paged_chaos_engine):
+    """Sustained exhaustion back-pressures the queue until load shedding
+    takes over: with the pool dry, queued work stays queued and the
+    requests beyond ``max_queue`` are shed 429-shaped (QueueFullError +
+    ``dllama_requests_shed_total``) — the crash-free degradation chain the
+    README promises."""
+    shed = tm.registry().counter(tm.REQUESTS_SHED)
+    exhaustion = tm.registry().counter(tm.KV_BLOCK_EXHAUSTION)
+    s0, e0 = shed.total(), exhaustion.total()
+    fp.arm("kv_alloc", "raise")  # every alloc fails until cleared
+    sched = BatchScheduler(paged_chaos_engine, n_slots=2, max_queue=1,
+                           _start_thread=False)
+    try:
+        req = sched.submit(_enc(paged_chaos_engine), 4, stop_on_eos=False)
+        for _ in range(3):
+            sched._tick()  # pool dry: req keeps its place in the queue
+        assert not req.done.is_set() and req in sched._queue
+        assert exhaustion.total() > e0
+        with pytest.raises(QueueFullError, match="queue full"):
+            sched.submit(_enc(paged_chaos_engine), 4)
+        assert shed.total() == s0 + 1
+        fp.registry().clear()  # blocks allocatable again: queue drains
+        for _ in range(200):
+            sched._tick()
+            if req.done.is_set():
+                break
+        assert req.error is None and len(req.tokens) == 4
+    finally:
+        sched.close()
+
+
+def test_kv_alloc_mid_decode_exhaustion_fails_one_request_503_shaped(
+        paged_chaos_engine):
+    """Exhaustion at mid-decode block growth fails THAT request explicitly
+    (503-shaped: ``server_error`` + an error naming the exhaustion) and
+    leaves the rest of the batch untouched — degraded service, never a
+    crash or silent truncation."""
+    from dllama_tpu.runtime.serving import PagedGenerator, Request
+
+    exhaustion = tm.registry().counter(tm.KV_BLOCK_EXHAUSTION)
+    e0 = exhaustion.total()
+    gen = PagedGenerator(paged_chaos_engine, n_slots=2)
+    # rest = 9 ids -> one 16-row block; decode must grow at position 16
+    grower = Request(rid=0, prompt_ids=_enc(paged_chaos_engine, "hello w"),
+                     max_tokens=24, stop_on_eos=False)
+    bystander = Request(rid=1, prompt_ids=_enc(paged_chaos_engine, "abc"),
+                        max_tokens=4, stop_on_eos=False)
+    gen.admit(grower, 0)
+    gen.admit(bystander, 1)
+    fp.arm("kv_alloc", "raise", times=1)
+    while gen.n_active:
+        gen.step()
+    assert grower.server_error and "exhaustion" in grower.error
+    assert len(grower.tokens) < 24  # failed at the block boundary
+    assert exhaustion.total() == e0 + 1
+    assert bystander.error is None and len(bystander.tokens) == 4

@@ -1,0 +1,782 @@
+"""Paged KV cache + continuous batching (runtime/kvblocks.py, the paged
+program family in models/llama.py, and PagedGenerator/BatchScheduler in
+runtime/serving.py).
+
+Three tiers:
+
+1. **Allocator properties** — pure host bookkeeping, no jax: thousands of
+   alloc/free/share/copy-on-write cycles asserting the refcount invariants
+   (no double free, freed blocks reusable, shared blocks never a write
+   target, cached LRU eviction unregisters).
+2. **Gather parity** — ``paged_forward`` through a deliberately scrambled
+   block table is bit-identical to the dense slot-pool ``forward`` on the
+   same inputs: the block-table indirection must be value-invisible.
+3. **Serving acceptance** — the ISSUE-6 criteria: a request stream larger
+   than the slot capacity completes under continuous batching token-exact
+   vs fresh solo oracles; chunked prefill interleaves with decode; a
+   shared-prefix workload shows ``dllama_kv_blocks_shared > 0`` with
+   block-level reuse >= the dense pool's longest-prefix accounting, and
+   zero post-steady compiles (ledger-asserted).
+"""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime import introspection
+from dllama_tpu.runtime import telemetry as tm
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.runtime.kvblocks import (BlockPool, BlockPoolExhausted,
+                                         PagedKVCache, blocks_per_seq,
+                                         validate_block_size)
+from dllama_tpu.runtime.kvcache import padded_cache_len
+from dllama_tpu.runtime.serving import BatchScheduler, PagedGenerator, Request
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+# ---------------------------------------------------------------------------
+# 1. BlockPool allocator properties (pure host, no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_refcount_free_roundtrip():
+    pool = BlockPool(8, 16)
+    assert pool.free_blocks() == 7  # block 0 is the null block
+    a = pool.alloc()
+    b = pool.alloc()
+    assert a != b and a != pool.NULL and b != pool.NULL
+    assert pool.refcount(a) == 1 and pool.refcount(b) == 1
+    assert pool.used_blocks() == 2
+    pool.release(a)
+    assert pool.refcount(a) == 0
+    assert pool.free_blocks() == 6  # unregistered: straight back to free
+    assert pool.used_blocks() == 1
+
+
+def test_double_free_raises():
+    pool = BlockPool(4, 8)
+    a = pool.alloc()
+    pool.release(a)
+    with pytest.raises(ValueError, match="double free"):
+        pool.release(a)
+
+
+def test_null_block_is_never_sharable_or_releasable():
+    pool = BlockPool(4, 8)
+    with pytest.raises(ValueError):
+        pool.share(pool.NULL)
+    with pytest.raises(ValueError):
+        pool.release(pool.NULL)
+
+
+def test_share_free_block_raises():
+    pool = BlockPool(4, 8)
+    a = pool.alloc()
+    pool.release(a)  # unregistered -> free, not cached
+    with pytest.raises(ValueError, match="not shareable"):
+        pool.share(a)
+
+
+def test_exhaustion_raises_then_recovers_after_release():
+    pool = BlockPool(4, 8)
+    got = [pool.alloc() for _ in range(3)]
+    with pytest.raises(BlockPoolExhausted):
+        pool.alloc()
+    pool.release(got[1])
+    again = pool.alloc()  # freed block is reusable
+    assert again == got[1]
+    assert pool.used_blocks() == 3
+
+
+def test_shared_blocks_counts_refcount_above_one():
+    pool = BlockPool(8, 4)
+    bids = [pool.alloc(), pool.alloc()]
+    pool.register_prompt(bids, list(range(8)))  # two full blocks
+    assert pool.shared_blocks() == 0
+    shared, n, cow, cow_r = pool.match_prefix(list(range(8)))
+    assert shared == bids and n == 8 and cow is None and cow_r == 0
+    for b in shared:
+        pool.share(b)
+    assert pool.shared_blocks() == 2
+    for b in shared:
+        pool.release(b)
+    assert pool.shared_blocks() == 0
+
+
+def test_released_registered_blocks_park_in_cache_and_still_match():
+    pool = BlockPool(8, 4)
+    bids = [pool.alloc()]
+    pool.register_prompt(bids, list(range(4)))
+    pool.release(bids[0])
+    assert pool.refcount(bids[0]) == 0
+    assert pool.free_blocks() == 7  # cached blocks stay allocatable
+    shared, n, _, _ = pool.match_prefix(list(range(4)))
+    assert shared == bids and n == 4  # retired prompt still shareable
+    pool.share(bids[0])  # resurrect from the cache
+    assert pool.refcount(bids[0]) == 1
+
+
+def test_lru_eviction_recycles_cached_blocks_and_unregisters():
+    pool = BlockPool(4, 4)  # 3 usable blocks
+    # register three single-block prompts, release all -> all cached
+    prompts = [[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12]]
+    bids = []
+    for p in prompts:
+        b = pool.alloc()
+        pool.register_prompt([b], p)
+        pool.release(b)
+        bids.append(b)
+    assert pool.free_blocks() == 3
+    # allocation pressure: the OLDEST cached block (prompts[0]) is evicted
+    fresh = pool.alloc()
+    assert fresh == bids[0]
+    shared, n, cow, cow_r = pool.match_prefix(prompts[0])
+    assert shared == [] and n == 0 and cow is None  # evicted = unregistered
+    shared, n, _, _ = pool.match_prefix(prompts[1])
+    assert shared == [bids[1]] and n == 4  # younger entries survive
+
+
+def test_match_prefix_cow_tail():
+    pool = BlockPool(8, 4)
+    bids = [pool.alloc(), pool.alloc()]
+    # one full block [1,2,3,4] + a partial tail [5,6]
+    pool.register_prompt(bids, [1, 2, 3, 4, 5, 6])
+    pool.release(bids[0])
+    pool.release(bids[1])
+    # a new prompt sharing the full block and 1 token of the tail
+    shared, n, cow, cow_r = pool.match_prefix([1, 2, 3, 4, 5, 99, 100])
+    assert shared == [bids[0]] and n == 4
+    assert cow == bids[1] and cow_r == 1
+    # divergence inside the first block: nothing shared, CoW from pos 0
+    shared, n, cow, cow_r = pool.match_prefix([1, 2, 99, 100])
+    assert shared == [] and n == 0
+    assert cow == bids[0] and cow_r == 2
+
+
+def test_register_prompt_skips_already_indexed_blocks():
+    pool = BlockPool(8, 4)
+    a = pool.alloc()
+    pool.register_prompt([a], [1, 2, 3, 4])
+    # a second sequence SHARING block `a` re-registers the same chain
+    pool.share(a)
+    b = pool.alloc()
+    pool.register_prompt([a, b], [1, 2, 3, 4, 5, 6, 7, 8])
+    shared, n, _, _ = pool.match_prefix([1, 2, 3, 4, 5, 6, 7, 8])
+    assert shared == [a, b] and n == 8
+
+
+def test_reset_clears_refcounts_and_prefix_index():
+    pool = BlockPool(8, 4)
+    a = pool.alloc()
+    pool.register_prompt([a], [1, 2, 3, 4])
+    pool.reset()
+    assert pool.free_blocks() == 7 and pool.used_blocks() == 0
+    shared, n, cow, _ = pool.match_prefix([1, 2, 3, 4])
+    assert shared == [] and n == 0 and cow is None
+
+
+def test_validate_block_size():
+    validate_block_size(96, 16)
+    validate_block_size(96, 128)  # padded_cache_len(96) == 128
+    with pytest.raises(ValueError, match="power of two"):
+        validate_block_size(96, 24)
+    with pytest.raises(ValueError, match="power of two"):
+        validate_block_size(96, 0)
+    with pytest.raises(ValueError, match="tile the padded context"):
+        validate_block_size(96, 256)
+    assert blocks_per_seq(96, 16) == padded_cache_len(96) // 16
+
+
+def test_randomized_refcount_invariants():
+    """Thousands of random alloc/share/release/register cycles against a
+    model of the refcount state: no double allocation, conservation of
+    blocks, free/cached/live partitions stay disjoint."""
+    rng = np.random.default_rng(0xB10C)
+    pool = BlockPool(16, 4)
+    live: dict[int, int] = {}  # bid -> model refcount
+    registered: set[int] = set()
+    next_tok = [1000]
+
+    for step in range(4000):
+        op = rng.integers(0, 4)
+        if op == 0:  # alloc
+            try:
+                b = pool.alloc()
+            except BlockPoolExhausted:
+                assert sum(live.values()) > 0  # only when everything is live
+                continue
+            assert b != pool.NULL
+            assert b not in live, "double allocation of a live block"
+            live[b] = 1
+            registered.discard(b)  # eviction/recycle forgets the index
+        elif op == 1 and live:  # share a live block
+            b = int(rng.choice(list(live)))
+            pool.share(b)
+            live[b] += 1
+        elif op == 2 and live:  # release
+            b = int(rng.choice(list(live)))
+            pool.release(b)
+            live[b] -= 1
+            if not live[b]:
+                del live[b]
+        elif op == 3 and live:  # register a fresh 1-block prompt
+            b = int(rng.choice(list(live)))
+            if b not in registered and pool.refcount(b) == 1:
+                toks = [next_tok[0] + i for i in range(4)]
+                next_tok[0] += 4
+                pool.register_prompt([b], toks)
+                registered.add(b)
+        # invariants
+        for b, r in live.items():
+            assert pool.refcount(b) == r
+        assert pool.used_blocks() == len(live)
+        assert pool.free_blocks() == pool.n_blocks - 1 - len(live)
+        assert pool.shared_blocks() == sum(1 for r in live.values() if r > 1)
+    # drain: everything releasable exactly its refcount times, no more
+    for b, r in list(live.items()):
+        for _ in range(r):
+            pool.release(b)
+        with pytest.raises(ValueError):
+            pool.release(b)
+    assert pool.used_blocks() == 0 and pool.free_blocks() == pool.n_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# 2. Gather parity: paged_forward ≡ dense forward through a scrambled table
+# ---------------------------------------------------------------------------
+
+
+def test_paged_forward_matches_dense_forward_bitwise():
+    """The block-table indirection is value-invisible: a prefill-width
+    ``paged_forward`` through a deliberately out-of-order block table
+    produces bit-identical logits to the dense ``forward``, and the rows it
+    scatters into the pool equal the dense cache's rows."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.formats.mfile import ArchType, RopeType
+    from dllama_tpu.models import ModelConfig
+    from dllama_tpu.models.llama import forward, init_random_params, paged_forward
+    from dllama_tpu.runtime.kvcache import KVCache
+
+    cfg = ModelConfig(arch=ArchType.LLAMA, dim=32, hidden_dim=64, n_layers=2,
+                      n_heads=4, n_kv_heads=2, head_dim=8, vocab_size=64,
+                      seq_len=64, norm_epsilon=1e-5, rope_theta=10000.0,
+                      rope_type=RopeType.LLAMA)
+    params = init_random_params(cfg, seed=7)
+    T = 24
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, cfg.vocab_size, (1, T)),
+        jnp.int32)
+
+    logits_d, kv = forward(params, cfg, tokens, jnp.int32(0),
+                           KVCache.create(cfg))
+
+    bs = 16
+    M = blocks_per_seq(cfg.seq_len, bs)
+    # scrambled physical placement: logical block j -> physical block
+    # (descending from the top of the pool), so any row-order dependence
+    # in the gather/scatter would break parity
+    n_blocks = 2 * M + 1
+    table = np.zeros((1, M), dtype=np.int32)
+    table[0, :] = np.arange(n_blocks - 1, n_blocks - 1 - M, -1)
+    pkv = PagedKVCache.create(cfg, n_blocks, bs)
+    logits_p, pkv = paged_forward(params, cfg, tokens,
+                                  jnp.asarray([0], jnp.int32), pkv,
+                                  jnp.asarray(table))
+    np.testing.assert_array_equal(np.asarray(logits_d), np.asarray(logits_p))
+
+    # the scattered rows, gathered back through the table, equal the dense
+    # cache rows the slot-pool forward produced
+    k_p = np.asarray(pkv.k)[:, table[0]]       # [L, M, n_kv, bs, hd]
+    k_p = np.moveaxis(k_p, 2, 1).reshape(cfg.n_layers, cfg.n_kv_heads,
+                                         M * bs, cfg.head_dim)
+    k_d = np.asarray(kv.k)[:, 0]               # [L, n_kv, S, hd]
+    np.testing.assert_array_equal(k_p[:, :, :T], k_d[:, :, :T])
+
+
+# ---------------------------------------------------------------------------
+# 3. Serving acceptance (PagedGenerator / BatchScheduler)
+# ---------------------------------------------------------------------------
+
+PATHS = {}
+
+
+@pytest.fixture(scope="module")
+def paged_engine(tmp_path_factory):
+    d = tmp_path_factory.mktemp("kvblocks")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(41)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    PATHS["m"], PATHS["t"] = str(mpath), str(tpath)
+    return InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=16)
+
+
+def solo(temperature=0.0, seed=7, **kw):
+    """Fresh single-sequence engine on the same files — the oracle."""
+    return InferenceEngine(PATHS["m"], PATHS["t"], tp=1,
+                           temperature=temperature, seed=seed, **kw)
+
+
+def _enc(engine, text):
+    return engine.tokenizer.encode(text, is_start=True)
+
+
+def test_engine_validates_block_size_and_combos(tmp_path_factory):
+    d = tmp_path_factory.mktemp("kvblocks_val")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(1))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    with pytest.raises(ValueError, match="power of two"):
+        InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=24)
+    with pytest.raises(ValueError, match="tile the padded context"):
+        InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=512)
+    with pytest.raises(ValueError, match="--spec-lookup"):
+        InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=16,
+                        spec_lookup=3)
+    with pytest.raises(ValueError, match="--decode-chunk"):
+        InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=16,
+                        decode_chunk=4)
+    with pytest.raises(ValueError, match="--dp"):
+        InferenceEngine(str(mpath), str(tpath), tp=1, dp=2, kv_block_size=16)
+
+
+def test_continuous_stream_exceeds_slot_capacity_token_exact(paged_engine):
+    """THE tentpole acceptance: a stream of 6 mixed requests through 2
+    slots completes under continuous batching — sequences admit and retire
+    mid-batch — and every transcript equals a fresh solo run."""
+    prompts = ["hello world", "hello there", "abc",
+               "hello world how are you", "xyzzy", "hello hello hello"]
+    specs = [dict(temperature=0.0, seed=1), dict(temperature=0.8, seed=2),
+             dict(temperature=0.0, seed=3), dict(temperature=1.2, seed=4),
+             dict(temperature=0.0, seed=5), dict(temperature=0.6, seed=6)]
+    want = []
+    for p, s in zip(prompts, specs):
+        e = solo(**s)
+        want.append(e.generate(p, 8, stop_on_eos=False).tokens)
+        e.close()
+
+    admissions = tm.registry().counter(tm.ADMISSIONS)
+    retires = tm.registry().counter(tm.RETIRES)
+    a0, r0 = admissions.total(), retires.total()
+    sched = BatchScheduler(paged_engine, n_slots=2)
+    assert isinstance(sched.gen, PagedGenerator)
+    try:
+        reqs = [sched.submit(_enc(paged_engine, p), 8, stop_on_eos=False,
+                             temperature=s["temperature"], seed=s["seed"])
+                for p, s in zip(prompts, specs)]
+        for r in reqs:
+            assert r.done.wait(timeout=300)
+            assert r.error is None, r.error
+        for r, w, p in zip(reqs, want, prompts):
+            assert r.tokens == w, p
+    finally:
+        sched.close()
+    assert admissions.total() - a0 == len(prompts)
+    assert retires.total() - r0 >= len(prompts)
+
+
+def test_block_sharing_live_and_cow_write_isolation(paged_engine):
+    """Block-level prefix sharing: a second live sequence with a >= 1-block
+    common prefix SHARES physical blocks (``dllama_kv_blocks_shared`` > 0
+    while both run; reuse counted at block granularity), the shared bytes
+    are never rewritten, and both transcripts stay solo-exact."""
+    # 26 distinct chars -> BOS + 26 ids; rest = 26 >= one full 16-block
+    base = "abcdefghijklmnopqrstuvwxy "
+    e1 = solo()
+    want_a = e1.generate(base + "111", 6, stop_on_eos=False).tokens
+    e1.close()
+    e2 = solo()
+    want_b = e2.generate(base + "222", 6, stop_on_eos=False).tokens
+    e2.close()
+
+    gen = PagedGenerator(paged_engine, n_slots=2)
+    reuse = tm.registry().counter(tm.PREFIX_REUSE_TOKENS)
+    shared_gauge = tm.registry().gauge(tm.KV_BLOCKS_SHARED)
+
+    r_a = Request(rid=0, prompt_ids=_enc(paged_engine, base + "111"),
+                  max_tokens=6, stop_on_eos=False)
+    gen.admit(r_a, 0)
+    gen.step()  # r_a live and decoding; its prompt blocks are registered
+
+    ids_b = _enc(paged_engine, base + "222")
+    n_common = 0
+    for x, y in zip(ids_b[:-1], r_a.prompt_ids[:-1]):
+        if x != y:
+            break
+        n_common += 1
+    assert n_common >= gen.block_size, "workload must share a full block"
+
+    c0 = reuse.total()
+    shared_before = gen.pool.shared_blocks()
+    r_b = Request(rid=1, prompt_ids=ids_b, max_tokens=6, stop_on_eos=False)
+    gen.admit(r_b, 1)
+
+    # both sequences live: physical sharing is visible in pool + telemetry
+    assert gen.pool.shared_blocks() > shared_before
+    assert shared_gauge.value() > 0
+    # block-level reuse >= the dense pool's longest-prefix token accounting
+    # (full shared blocks + the copy-on-write tail cover the whole prefix)
+    assert reuse.total() - c0 >= n_common
+
+    # copy-on-write safety: the shared block's device bytes never change
+    shared_bids = [b for b in gen._seq_bids[1] if gen.pool.refcount(b) > 1]
+    assert shared_bids
+    before = np.asarray(gen.pkv.k[:, shared_bids[0]]).copy()
+    while gen.n_active:
+        gen.step()
+    np.testing.assert_array_equal(
+        before, np.asarray(gen.pkv.k[:, shared_bids[0]]))
+
+    assert r_a.tokens == want_a
+    assert r_b.tokens == want_b
+
+
+def test_paged_prefill_interleaves_with_decode(tmp_path_factory):
+    """Chunked prefill interleaves with decode on the paged pool: an active
+    slot keeps emitting between a newcomer's prefill chunks, and both
+    match their solo runs."""
+    d = tmp_path_factory.mktemp("kvblocks_inc")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(41)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1, n_batches=4,
+                          kv_block_size=16)
+    long_ids = [int(x) for x in np.random.default_rng(3).integers(1, 200, 40)]
+
+    solo_a = InferenceEngine(str(mpath), str(tpath), tp=1, n_batches=4)
+    want_a = solo_a.generate("hello world", 16, stop_on_eos=False).tokens
+    solo_a.close()
+    solo_b = InferenceEngine(str(mpath), str(tpath), tp=1, n_batches=4)
+    want_b = solo_b.generate(long_ids, 4, stop_on_eos=False).tokens
+    solo_b.close()
+
+    gen = PagedGenerator(eng, n_slots=2)
+    r_a = Request(rid=0, prompt_ids=_enc(eng, "hello world"),
+                  max_tokens=16, stop_on_eos=False)
+    gen.admit(r_a, 0)
+    gen.step()
+    a_before = len(r_a.tokens)
+
+    r_b = Request(rid=1, prompt_ids=long_ids, max_tokens=4,
+                  stop_on_eos=False)
+    adm = gen.begin_admit(r_b, 1)
+    interleaved = 0
+    while not gen.continue_admit(adm):
+        gen.step()  # active slot decodes between the newcomer's chunks
+        interleaved += 1
+    assert interleaved >= 5  # 39 prompt tokens / 4-token chunks
+    assert len(r_a.tokens) > a_before
+    while gen.n_active:
+        gen.step()
+    assert r_a.tokens == want_a
+    assert r_b.tokens == want_b
+
+
+def test_shared_prefix_workload_is_ledger_quiet_post_steady(paged_engine):
+    """Zero post-steady compiles across a CoW + sharing + admit/retire
+    wave: the paged program family is jitted once per pool geometry, so
+    block-table contents, occupancy, and sharing must never retrace."""
+    sched = BatchScheduler(paged_engine, n_slots=2)
+    scope = paged_engine.introspection_scope
+    try:
+        # steady-state warmup: the full program family (prefill buckets,
+        # paged step, CoW copy) compiles here
+        warm = [sched.submit(_enc(paged_engine, p), 4, stop_on_eos=False)
+                for p in ["abcdefghijklmnopqrstuvwxy 0",
+                          "abcdefghijklmnopqrstuvwxy 1", "hello"]]
+        for r in warm:
+            assert r.done.wait(timeout=300) and r.error is None
+        c0 = introspection.ledger().compile_count(scope)
+        wave = [sched.submit(_enc(paged_engine, p), 4, stop_on_eos=False)
+                for p in ["abcdefghijklmnopqrstuvwxy 2",
+                          "abcdefghijklmnopqrstuvwxy 3",
+                          "abcdefghijklmnopqrstuvwxy 4", "hello there"]]
+        for r in wave:
+            assert r.done.wait(timeout=300) and r.error is None
+        assert introspection.ledger().compile_count(scope) == c0, \
+            "post-steady recompile on the paged path"
+    finally:
+        sched.close()
+
+
+def test_paged_under_tp_matches_solo(tmp_path_factory):
+    """The paged pool composes with tensor parallelism: kv-heads shard over
+    tp (parallel/sharding.paged_kv_sharding), transcripts equal solo tp
+    runs."""
+    d = tmp_path_factory.mktemp("kvblocks_tp")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(41)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+
+    s1 = InferenceEngine(str(mpath), str(tpath), tp=2)
+    want_a = s1.generate("hello world", 8, stop_on_eos=False).tokens
+    s1.close()
+    s2 = InferenceEngine(str(mpath), str(tpath), tp=2, temperature=0.8,
+                         seed=6)
+    want_b = s2.generate("hello", 8, stop_on_eos=False).tokens
+    s2.close()
+
+    eng = InferenceEngine(str(mpath), str(tpath), tp=2, kv_block_size=16)
+    gen = PagedGenerator(eng, n_slots=2)
+    r_a = Request(rid=0, prompt_ids=_enc(eng, "hello world"), max_tokens=8,
+                  stop_on_eos=False)
+    r_b = Request(rid=1, prompt_ids=_enc(eng, "hello"), max_tokens=8,
+                  stop_on_eos=False, temperature=0.8, seed=6)
+    gen.admit(r_a, 0)
+    gen.admit(r_b, 1)
+    while gen.n_active:
+        gen.step()
+    assert r_a.tokens == want_a
+    assert r_b.tokens == want_b
+
+
+def test_mid_decode_block_growth_is_lazy(paged_engine):
+    """A sequence only holds the blocks its live context spans: decoding
+    across a block boundary allocates exactly one more block, at the
+    boundary — the continuous-batching memory win."""
+    gen = PagedGenerator(paged_engine, n_slots=2)
+    # prompt of 10 ids -> rest 9 -> 1 block; decode grows past row 16
+    r = Request(rid=0, prompt_ids=_enc(paged_engine, "hello w"),
+                max_tokens=24, stop_on_eos=False)
+    gen.admit(r, 0)
+    assert len(gen._seq_bids[0]) == 1
+    grew_at = None
+    while gen.n_active:
+        pos_before = int(gen.pos[0])
+        blocks_before = len(gen._seq_bids[0])
+        gen.step()
+        if gen.n_active and len(gen._seq_bids[0]) > blocks_before:
+            assert grew_at is None, "grew more than once before row 32"
+            grew_at = pos_before
+    assert grew_at is not None and grew_at % gen.block_size == 0
+
+
+def test_fit_block_pool_tests_the_min_blocks_floor(monkeypatch):
+    """The degrade loop must test min_blocks itself even when the step
+    sequence would skip past it (want - min not divisible by the step):
+    a budget that fits exactly the floor returns the floor, not 0."""
+    from dllama_tpu.formats.mfile import ArchType, RopeType
+    from dllama_tpu.models import ModelConfig
+    from dllama_tpu.runtime.hbm import (estimate_block_pool_bytes,
+                                        estimate_device_bytes,
+                                        fit_block_pool)
+
+    cfg = ModelConfig(arch=ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2,
+                      n_heads=4, n_kv_heads=2, head_dim=16, vocab_size=128,
+                      seq_len=64, norm_epsilon=1e-5, rope_theta=10000.0,
+                      rope_type=RopeType.LLAMA)
+    want, mn, bs = 53, 14, 16  # step = (53-14)//16 = 2: 53, 51, ... 15, SKIPS 14
+    base = estimate_device_bytes(cfg, weight_repr="q40", kv_dtype_bytes=4,
+                                 batch=1, n_shards=1)["need_per_device"]
+    floor_pool = estimate_block_pool_bytes(cfg, mn, bs, 4)
+    above_pool = estimate_block_pool_bytes(cfg, mn + 1, bs, 4)
+    # a limit between the floor pool's need and one-block-more's need
+    monkeypatch.setenv("DLLAMA_HBM_BYTES",
+                       str(base + (int(floor_pool * 1.15)
+                                   + int(above_pool * 1.15)) // 2))
+    n_fit, est = fit_block_pool(cfg, want, block_size=bs, min_blocks=mn,
+                                weight_repr="q40", kv_dtype_bytes=4)
+    assert n_fit == mn, (n_fit, est)
+    # and a limit below even the floor still refuses with 0
+    monkeypatch.setenv("DLLAMA_HBM_BYTES", str(base))
+    n_fit, _ = fit_block_pool(cfg, want, block_size=bs, min_blocks=mn,
+                              weight_repr="q40", kv_dtype_bytes=4)
+    assert n_fit == 0
+
+
+def test_fully_shared_prompt_skips_prefill_and_stays_token_exact(
+        paged_engine):
+    """Resubmitting an identical prompt (the repeated-system-prompt hot
+    path) reuses EVERY prefill position — no prefill dispatch, no column
+    gather/scatter (adm.col is None) — and still decodes token-exactly."""
+    gen = PagedGenerator(paged_engine, n_slots=2)
+    ids = _enc(paged_engine, "abcdefghijklmnopqrstuvwxy!")
+    r_a = Request(rid=0, prompt_ids=ids, max_tokens=6, stop_on_eos=False)
+    gen.admit(r_a, 0)
+    while gen.n_active:
+        gen.step()
+
+    reuse = tm.registry().counter(tm.PREFIX_REUSE_TOKENS)
+    c0 = reuse.total()
+    r_b = Request(rid=1, prompt_ids=list(ids), max_tokens=6,
+                  stop_on_eos=False)
+    adm = gen.begin_admit(r_b, 1)
+    assert adm.col is None  # zero device work beyond the one CoW copy
+    assert adm.pos == len(ids) - 1  # nothing left to prefill
+    assert reuse.total() - c0 == len(ids) - 1
+    assert gen.continue_admit(adm)
+    while gen.n_active:
+        gen.step()
+    assert r_b.tokens == r_a.tokens
+
+
+def test_mid_admission_ride_along_never_writes_shared_blocks(paged_engine):
+    """The slot table must stay all-null until the admission COMMITS: a
+    slot mid-admission still rides along decode dispatches with whatever
+    stale ``pos`` its previous occupant left, and that ride-along write
+    must land in the null block — publishing shared bids early would let
+    it corrupt prefix KV other live sequences attend to."""
+    base = "abcdefghijklmnopqrstuvwxy "  # rest >= one full 16-block
+    e1 = solo()
+    want_a = e1.generate(base + "111", 8, stop_on_eos=False).tokens
+    e1.close()
+    e2 = solo()
+    want_b = e2.generate(base + "222", 6, stop_on_eos=False).tokens
+    e2.close()
+
+    gen = PagedGenerator(paged_engine, n_slots=2)
+    # previous occupant of slot 1: retires with stale pos INSIDE block 0,
+    # so a published shared bids[0] would be the ride-along write target
+    r0 = Request(rid=0, prompt_ids=_enc(paged_engine, "hi"),
+                 max_tokens=12, stop_on_eos=False)
+    gen.admit(r0, 1)
+    while gen.n_active:
+        gen.step()
+    stale = int(gen.pos[1])
+    assert 0 < stale < gen.block_size
+
+    r_a = Request(rid=1, prompt_ids=_enc(paged_engine, base + "111"),
+                  max_tokens=8, stop_on_eos=False)
+    gen.admit(r_a, 0)
+    gen.step()  # r_a live; its prompt blocks registered for sharing
+
+    r_b = Request(rid=2, prompt_ids=_enc(paged_engine, base + "222"),
+                  max_tokens=6, stop_on_eos=False)
+    adm = gen.begin_admit(r_b, 1)
+    shared_bids = [b for b in gen._seq_bids[1] if gen.pool.refcount(b) > 1]
+    assert shared_bids  # the base prefix really is physically shared
+    assert (gen.tables[1] == gen.pool.NULL).all()  # not published yet
+    before = np.asarray(gen.pkv.k[:, shared_bids[0]]).copy()
+    gen.step()  # slot 1 rides along with its stale pos mid-admission
+    np.testing.assert_array_equal(
+        before, np.asarray(gen.pkv.k[:, shared_bids[0]]))
+    while not gen.continue_admit(adm):
+        gen.step()
+    while gen.n_active:
+        gen.step()
+    assert r_a.tokens == want_a
+    assert r_b.tokens == want_b
+
+
+def test_admission_reserves_decode_growth_no_organic_exhaustion(
+        paged_engine):
+    """Block-priced admission holds across the BATCH: every live
+    sequence's worst-case decode growth stays reserved, so a second
+    request that would double-spend the same free blocks queues instead
+    of admitting — and nobody ever hits organic mid-decode exhaustion
+    (503) on a pool the admission gate said was affordable."""
+    from dllama_tpu.runtime.kvblocks import BlockPool
+    from dllama_tpu.runtime.serving import BatchScheduler
+
+    exhaustion = tm.registry().counter(tm.KV_BLOCK_EXHAUSTION)
+    e0 = exhaustion.total()
+    sched = BatchScheduler(paged_engine, n_slots=2, _start_thread=False)
+    try:
+        # shrink the allocatable pool to 9 blocks (< two 6-block worst
+        # cases); bids 1..9 stay valid indices into the larger device pool
+        sched.gen.pool = BlockPool(10, sched.gen.block_size)
+        ids = _enc(paged_engine, "hello wor")  # rest 9 -> 1 block held
+        # worst case: 9 + 85 = 94 rows -> 6 blocks per request
+        r1 = sched.submit(ids, 85, stop_on_eos=False)
+        r2 = sched.submit(list(ids), 85, stop_on_eos=False)
+        max_active = 0
+        for _ in range(500):
+            sched._tick()
+            max_active = max(max_active, sched.gen.n_active)
+            if r1.done.is_set() and r2.done.is_set():
+                break
+        assert r1.done.is_set() and r2.done.is_set()
+        assert r1.error is None and r2.error is None
+        assert len(r1.tokens) == 85 and len(r2.tokens) == 85
+        assert max_active == 1  # the second request QUEUED, not gambled
+        assert exhaustion.total() == e0  # and nothing ever ran dry
+    finally:
+        sched.close()
+
+
+def test_begin_admit_rolls_back_blocks_on_any_failure(paged_engine):
+    """A device error mid-admission (not just exhaustion) must release
+    every block taken — a leaked refcount would shrink the allocatable
+    pool forever on a healthy server."""
+    gen = PagedGenerator(paged_engine, n_slots=2)
+    free0 = gen.pool.free_blocks()
+    orig = gen._take
+
+    def boom(*a):
+        raise RuntimeError("device boom")
+
+    gen._take = boom
+    r = Request(rid=0, prompt_ids=_enc(paged_engine, "hello world"),
+                max_tokens=4, stop_on_eos=False)
+    with pytest.raises(RuntimeError, match="device boom"):
+        gen.begin_admit(r, 0)
+    assert gen.pool.free_blocks() == free0  # atomic rollback
+    gen._take = orig
+    gen.admit(r, 0)  # the pool is intact: the same request admits fine
+    while gen.n_active:
+        gen.step()
+    assert len(r.tokens) == 4
+
+
+def test_cancelled_mid_admission_releases_blocks(paged_engine):
+    """A client cancel between prefill chunks aborts the admission AND
+    returns its blocks to the pool (dense slots had nothing to release;
+    paged refcounts would leak without abort_admit)."""
+    from dllama_tpu.runtime.serving import BatchScheduler
+
+    sched = BatchScheduler(paged_engine, n_slots=2, _start_thread=False)
+    try:
+        free0 = sched.gen.pool.free_blocks()
+        # rest of 79 ids needs 2 chunks (64-bucket + tail) -> the cancel
+        # window between ticks exists
+        ids = [int(x) for x in np.random.default_rng(9).integers(1, 200, 80)]
+        req = sched.submit(ids, 8, stop_on_eos=False)
+        sched._tick()
+        assert sched._admissions  # still prefilling
+        assert sched.gen.pool.free_blocks() < free0
+        req.cancel.set()
+        sched._tick()
+        assert req.done.is_set()
+        assert not sched._admissions
+        assert sched.gen.pool.free_blocks() == free0  # all blocks back
+    finally:
+        sched.close()
+
+
+def test_cancel_behind_prefill_budget_releases_immediately(paged_engine):
+    """The cancel sweep runs over EVERY in-flight admission before the
+    budgeted prefill loop: a cancelled client queued behind the budget
+    cutoff must not keep blocks/reservation/slot for the remaining ticks
+    of the admissions ahead of it."""
+    from dllama_tpu.runtime.serving import BatchScheduler
+
+    sched = BatchScheduler(paged_engine, n_slots=2, _start_thread=False)
+    sched.prefill_budget = 1  # only the FIRST admission advances per tick
+    try:
+        free0 = sched.gen.pool.free_blocks()
+        rng = np.random.default_rng(11)
+        a = sched.submit([int(x) for x in rng.integers(1, 200, 80)], 4,
+                         stop_on_eos=False)
+        b = sched.submit([int(x) for x in rng.integers(1, 200, 80)], 4,
+                         stop_on_eos=False)
+        sched._tick()  # both begin; only A's prefill advances
+        assert len(sched._admissions) == 2
+        held = free0 - sched.gen.pool.free_blocks()
+        b.cancel.set()
+        sched._tick()  # cancel sweep precedes the budget break
+        assert b.done.is_set()
+        assert all(adm.req is not b for adm in sched._admissions)
+        assert free0 - sched.gen.pool.free_blocks() < held  # B's came back
+        while not a.done.is_set():
+            sched._tick()
+        assert a.error is None and len(a.tokens) == 4
+    finally:
+        sched.close()

@@ -20,7 +20,8 @@ from dllama_tpu.parallel.sharding import kv_cache_sharding, shard_params
 from dllama_tpu.runtime import KVCache
 from dllama_tpu.runtime.engine import InferenceEngine
 
-from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+from helpers import (byte_vocab_tokenizer, require_pinned_host,
+                     tiny_header_params, write_tiny_model)
 
 
 def _cfg(**kw):
@@ -248,6 +249,7 @@ def test_engine_pp_offload_matches_single(model_files):
     """--pp 2 composes with --weight-mode offload: each stage's layer shard
     stays in pinned host memory (placement asserted) and streams per layer
     inside the stage scan; generation matches the resident tp=1 engine."""
+    require_pinned_host()
     import jax
 
     base = InferenceEngine(*model_files, tp=1)

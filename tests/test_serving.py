@@ -12,7 +12,8 @@ from dllama_tpu.formats import tfile
 from dllama_tpu.runtime.engine import InferenceEngine
 from dllama_tpu.runtime.serving import BatchedGenerator, BatchScheduler, Request
 
-from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+from helpers import (byte_vocab_tokenizer, require_pinned_host,
+                     tiny_header_params, write_tiny_model)
 
 
 PATHS = {}
@@ -591,6 +592,7 @@ def test_batched_serving_with_offload_matches_solo(tmp_path_factory):
     """--weight-mode offload (host-DRAM layer streaming) composes with the
     slot pool: the ragged programs pull the same pinned-host stacks the solo
     forward does, so transcripts must match solo offload runs."""
+    require_pinned_host()
     d = tmp_path_factory.mktemp("serving-off")
     mpath, tpath = d / "m.m", d / "t.t"
     rng = np.random.default_rng(61)

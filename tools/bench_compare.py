@@ -17,7 +17,14 @@ import os
 import sys
 
 _RATES = ("decode_tok_per_s", "prefill_tok_per_s", "sampled_decode_tok_per_s",
-          "chunked_decode_tok_per_s")
+          "chunked_decode_tok_per_s", "agg_tok_per_s")
+# lower-is-better latencies (--scenario continuous TTFT): the printed pct
+# is still "improvement-positive", so the sign is flipped before ranking
+_LATENCIES = ("ttft_ms_p50", "ttft_ms_p95")
+# context-only scenario fields: printed for both sides, never ranked (a
+# higher occupancy or sharing count is workload-dependent, not a win/loss)
+_GAUGES = ("block_occupancy_peak", "block_occupancy_mean",
+           "kv_blocks_shared_peak", "prefix_reuse_tokens")
 
 
 def _load(path: str) -> dict:
@@ -79,11 +86,25 @@ def main() -> None:
             va, vb = sa[stage].get(k), sb[stage].get(k)
             if va and vb:
                 rows.append((100 * (vb - va) / va, stage, k, va, vb))
+        for k in _LATENCIES:  # lower is better: +% means B got FASTER
+            va, vb = sa[stage].get(k), sb[stage].get(k)
+            if va and vb:
+                rows.append((100 * (va - vb) / va, stage, k, va, vb))
     if not rows:
         print("no overlapping measured rates")
         return
     for pct, stage, k, va, vb in sorted(rows, reverse=True):
         print(f"  {stage:10s} {k:28s} {va:>10} -> {vb:>10}  ({pct:+.1f}%)")
+    gauges = []
+    for stage in sorted(set(sa) & set(sb)):
+        for k in _GAUGES:
+            va, vb = sa[stage].get(k), sb[stage].get(k)
+            if va is not None and vb is not None:
+                gauges.append((stage, k, va, vb))
+    if gauges:
+        print("  -- context (not ranked) --")
+        for stage, k, va, vb in gauges:
+            print(f"  {stage:10s} {k:28s} {va:>10} -> {vb:>10}")
 
 
 if __name__ == "__main__":
