@@ -44,6 +44,7 @@ lint:
 	$(PY) tools/check_exception_hygiene.py
 	$(PY) tools/check_route_labels.py
 	$(PY) tools/check_failpoint_sites.py
+	$(PY) tools/check_span_phases.py
 
 bench:
 	$(PY) bench.py

@@ -46,7 +46,7 @@ from ..parallel.api import MeshPlan, make_mesh, plan_scoped_jit, use_plan
 from ..parallel.sharding import kv_cache_sharding, shard_params, validate_tp
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.sampler import Sampler, xorshift_random_f32
-from . import failpoints, numerics, telemetry
+from . import failpoints, flightrec, numerics, telemetry
 from .kvcache import KVCache
 from .watchdog import StepWatchdog
 
@@ -374,6 +374,10 @@ class InferenceEngine:
         # request id stamped onto trace spans by the serving layer (the
         # engine itself has no request concept; -1 = unattributed)
         self.trace_rid = -1
+        # flight recorder (runtime/flightrec): the single-sequence path
+        # records per-chunk lifecycle events into the same ring the batch
+        # scheduler's ticks land in
+        self._flight = flightrec.recorder()
         # numerics observatory (runtime/numerics): activation taps are an
         # opt-in engine mode (the tapped program is only jitted when on, so
         # the default engine stays compile-ledger-quiet); the non-finite
@@ -720,6 +724,8 @@ class InferenceEngine:
             ms = (time.perf_counter() - t0) * 1000.0
             metrics.append(StepMetrics("eval", ms, valid))
             self._m_prefill_ms.record(ms)
+            self._flight.note("prefill_chunk", self.trace_rid,
+                              ms=round(ms, 3), n_tokens=valid, pos=self.pos)
             last_logits = logits_np
             self.pos += valid
             i += valid

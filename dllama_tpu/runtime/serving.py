@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import failpoints, introspection, numerics, telemetry
+from . import failpoints, flightrec, introspection, numerics, telemetry
 
 from ..models.llama import forward, sampled_step_guarded
 from ..parallel.api import plan_scoped_jit, use_plan
@@ -182,9 +182,30 @@ class Request:
     t_submit: int = 0
     t_admit: int = 0
     t_decode: int = 0
+    # latency attribution (runtime/flightrec): first-token stamp plus
+    # per-phase wall accumulators (ms) the generator fills — queue/
+    # admission/prefill/first_decode are derived from these at the first
+    # emitted token and must sum to wall TTFT by construction
+    t_first_token: int = 0
+    ms_prefill: float = 0.0       # own prefill chunk dispatch wall
+    ms_decode_steps: float = 0.0  # decode dispatch wall while slot active
+    ms_preempt: float = 0.0       # others' interleaved prefill wall while
+    #                               this slot was decode-armed (tick-budget
+    #                               preemption share of inter-token stalls)
 
     def __post_init__(self):
         self.rng_state = self.seed & _MASK64
+
+    def ttft_breakdown(self) -> dict | None:
+        """This request's TTFT decomposition (ms) via the one shared
+        phase formula (:func:`flightrec.ttft_phases`), or None until the
+        first token (or for direct-generator use with no submit stamp)."""
+        if not (self.t_first_token and self.t_submit and self.t_admit
+                and self.t_decode):
+            return None
+        return flightrec.ttft_phases(self.t_submit, self.t_admit,
+                                     self.t_decode, self.t_first_token,
+                                     self.ms_prefill)
 
 
 @dataclass
@@ -224,6 +245,12 @@ class _GeneratorCore:
         self._m_occupancy = self._tm.gauge(telemetry.BATCH_OCCUPANCY)
         self._m_tokens = self._tm.counter(telemetry.BATCH_TOKENS)
         self._m_kv = self._tm.gauge(telemetry.KV_OCCUPANCY)
+        # flight recorder (runtime/flightrec): the scheduler opens/closes
+        # ticks; the generator records decisions and dispatch/prefill wall
+        # into the open tick — pure host bookkeeping, trace-invisible
+        self.flight = flightrec.recorder()
+        self._m_ttft_attrib = self._tm.histogram(telemetry.TTFT_ATTRIB_MS)
+        self._m_itl_attrib = self._tm.histogram(telemetry.ITL_ATTRIB_MS)
 
     # -- slot lifecycle -----------------------------------------------------
 
@@ -258,7 +285,7 @@ class _GeneratorCore:
         return jnp.float32(0.0 if self.eng.multihost
                            else numerics.poison_code())
 
-    def _retire(self, slot: int) -> None:
+    def _retire(self, slot: int, reason: str = "done") -> None:
         req = self.slots[slot]
         self.slots[slot] = None
         self._proposers[slot] = None
@@ -267,6 +294,14 @@ class _GeneratorCore:
             telemetry.tracer().emit(req.rid, "decode", req.t_decode,
                                     telemetry.now_ns(), slot=slot,
                                     n_tokens=len(req.tokens))
+        self.flight.note("retire", req.rid, reason=reason, slot=slot,
+                         n_tokens=len(req.tokens))
+        # ITL attribution (once per request, at retire): total decode
+        # dispatch wall vs the tick-budget preemption stall other
+        # admissions' prefill chunks imposed while this slot waited
+        if req.t_first_token and len(req.tokens) > 1:
+            self._m_itl_attrib.record(req.ms_decode_steps, cause="step")
+            self._m_itl_attrib.record(req.ms_preempt, cause="preempt")
         req.done.set()
 
     def _arm_decode(self, adm: "_Admission") -> None:
@@ -290,6 +325,8 @@ class _GeneratorCore:
             telemetry.tracer().emit(req.rid, "prefill", req.t_admit,
                                     req.t_decode, slot=adm.slot,
                                     n_tokens=adm.pos - adm.reused)
+        self.flight.note("decode_armed", req.rid, slot=adm.slot,
+                         pos=adm.pos, reused=adm.reused)
         self.slots[adm.slot] = req
 
     def _note_admitted(self, req: Request, slot: int, reused: int) -> None:
@@ -297,6 +334,8 @@ class _GeneratorCore:
         of begin_admit so a reject never skews admissions - retires."""
         req.t_admit = telemetry.now_ns()
         self._tm.counter(telemetry.ADMISSIONS).inc()
+        self.flight.note("admit", req.rid, slot=slot, reused=reused,
+                         n_prompt=len(req.prompt_ids))
         if reused:
             self._tm.counter(telemetry.PREFIX_REUSE_TOKENS).inc(reused)
         if req.t_submit:
@@ -324,7 +363,7 @@ class _GeneratorCore:
                 req = self.slots[i]
                 req.error = str(numerics.nonfinite_error("batch", n))
                 req.server_error = True
-                self._retire(i)
+                self._retire(i, "nonfinite")
                 failed.add(i)
         return failed
 
@@ -338,7 +377,7 @@ class _GeneratorCore:
         """Retire client-cancelled slots; return the active row list."""
         for i, s in enumerate(self.slots):
             if s is not None and s.cancel.is_set():
-                self._retire(i)
+                self._retire(i, "cancel")
         return [i for i, s in enumerate(self.slots) if s is not None]
 
     def _sampling_rows(self, active: list[int]):
@@ -360,12 +399,52 @@ class _GeneratorCore:
 
     def _record_step(self, n_active: int, ms: float, emitted: int) -> None:
         """Per-dispatch telemetry: occupancy, step latency, emitted tokens,
-        KV occupancy (see :meth:`_kv_fraction`)."""
+        KV occupancy (see :meth:`_kv_fraction`), the tick's dispatch
+        record."""
         self._m_occupancy.set(n_active)
         self._m_step_ms.record(ms)
         if emitted:
             self._m_tokens.inc(emitted)
         self._m_kv.set(self._kv_fraction())
+        self.flight.note_dispatch(ms, n_active, emitted)
+
+    def _attrib_decode(self, active: list[int], ms: float) -> None:
+        """Charge one decode dispatch's wall to every active request
+        (called BEFORE tripwire/emit retires can clear slots)."""
+        for i in active:
+            req = self.slots[i]
+            if req is not None:
+                req.ms_decode_steps += ms
+
+    def _prefill_chunk(self, adm: "_Admission", padded, n_valid: int) -> None:
+        """One timed prefill chunk dispatch for ``adm``, with attribution:
+        the admission's own ``prefill`` wall, every decode-armed slot's
+        preempt stall (this chunk ran INSTEAD of their next decode step —
+        the tick-budget interleave cost), the tick's prefill-token spend,
+        and a ``prefill_chunk`` span."""
+        t0 = telemetry.now_ns()
+        adm.col = self._exec_prefill(adm.col, padded, adm.pos)
+        t1 = telemetry.now_ns()
+        ms = (t1 - t0) / 1e6
+        adm.req.ms_prefill += ms
+        for s in self.slots:
+            if s is not None:
+                s.ms_preempt += ms
+        self.flight.note_prefill(adm.req.rid, ms, n_valid)
+        telemetry.tracer().emit(adm.req.rid, "prefill_chunk", t0, t1,
+                                slot=adm.slot, n_tokens=n_valid)
+
+    def _record_ttft_attrib(self, req: Request) -> None:
+        """Publish the TTFT decomposition (:meth:`Request.ttft_breakdown`)
+        at the first emitted token."""
+        bd = req.ttft_breakdown()
+        if bd is None:
+            return  # direct-generator use (tests) has no submit stamp
+        flightrec.record_ttft(self._m_ttft_attrib, bd)
+
+    def flight_blocks(self) -> dict | None:
+        """Block-pool occupancy for the tick record (paged pool only)."""
+        return None
 
     def _emit_run(self, i: int, run: list[int]) -> int:
         """Deliver a run of tokens to slot ``i``'s request: append, stream,
@@ -376,18 +455,23 @@ class _GeneratorCore:
         tok = self.eng.tokenizer
         n_keep = min(len(run), req.max_tokens - len(req.tokens))
         if n_keep <= 0:  # belt: the scheduler retires at max_tokens
-            self._retire(i)
+            self._retire(i, "max_tokens")
             return 0
         retire = n_keep < len(run)
+        hit_eos = False
         for j in range(n_keep):
             t = run[j]
-            eos = (req.stop_on_eos and tok is not None and tok.is_eos(t))
-            if eos:
-                n_keep, retire = j + 1, True
+            if req.stop_on_eos and tok is not None and tok.is_eos(t):
+                n_keep, retire, hit_eos = j + 1, True, True
                 break
         run = run[:n_keep]
         self.pos[i] += len(run)
         self.next_token[i] = run[-1]
+        if req.t_first_token == 0:
+            # first emitted token: stamp + publish the TTFT decomposition
+            req.t_first_token = telemetry.now_ns()
+            self.flight.note("first_token", req.rid, slot=i)
+            self._record_ttft_attrib(req)
         req.tokens.extend(run)
         if self._proposers[i] is not None:
             self._proposers[i].extend(run)
@@ -397,7 +481,9 @@ class _GeneratorCore:
                 req.on_token(t, piece)
         if (retire or len(req.tokens) >= req.max_tokens
                 or self.pos[i] >= self.cfg.seq_len):
-            self._retire(i)
+            self._retire(i, "eos" if hit_eos
+                         else "max_tokens" if len(req.tokens) >= req.max_tokens
+                         else "ctx_full")
         return len(run)
 
 
@@ -692,7 +778,7 @@ class BatchedGenerator(_GeneratorCore):
             pad_to = min(n_b, self.cfg.seq_len - adm.pos)
             padded = chunk + [0] * (pad_to - len(chunk))
             self._bcast(CTRL_SRV_PREFILL, adm.slot, [adm.pos] + padded)
-            adm.col = self._exec_prefill(adm.col, padded, adm.pos)
+            self._prefill_chunk(adm, padded, len(chunk))
             self.eng.seen_buckets.add(len(padded))  # the DISPATCHED width
             adm.pos += len(chunk)
             if adm.pos < len(rest):
@@ -745,7 +831,7 @@ class BatchedGenerator(_GeneratorCore):
             # trades the last few positions of capacity for run dispatches)
             for i in list(active):
                 if self.pos[i] + self.spec + 1 > self.cfg.seq_len:
-                    self._retire(i)
+                    self._retire(i, "ctx_full")
                     active.remove(i)
         if not active:
             return 0
@@ -768,7 +854,7 @@ class BatchedGenerator(_GeneratorCore):
         nxt, nf = self._exec_step(self.next_token, self.pos, temps, topps,
                                   coins)
         ms = (time.perf_counter() - t0) * 1000.0
-
+        self._attrib_decode(active, ms)
         poisoned = self._handle_nonfinite(active, nf)
         emitted = 0
         for i in active:
@@ -819,6 +905,7 @@ class BatchedGenerator(_GeneratorCore):
         toks, nf = self._exec_step_chunk(self.next_token, self.pos, temps,
                                          topps, coins, k)
         step_ms = (time.perf_counter() - t0) * 1000.0
+        self._attrib_decode(active, step_ms)
         poisoned = self._handle_nonfinite(active, nf)
         emitted = 0
         for i in active:
@@ -860,6 +947,7 @@ class BatchedGenerator(_GeneratorCore):
         n_acc, preds, nf = self._exec_verify(toks, self.pos, temps, topps,
                                              coins)
         ms = (time.perf_counter() - t0) * 1000.0
+        self._attrib_decode(active, ms)
         n_greedy = sum(1 for i in active if self.slots[i].temperature <= 0.0)
         self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(n_greedy * self.spec)
         poisoned = self._handle_nonfinite(active, nf)
@@ -1025,6 +1113,12 @@ class PagedGenerator(_GeneratorCore):
     def _kv_fraction(self) -> float:
         return self.pool.used_blocks() / max(1, self.pool.n_blocks - 1)
 
+    def flight_blocks(self) -> dict | None:
+        return {"total": self.pool.n_blocks - 1,
+                "used": self.pool.used_blocks(),
+                "shared": self.pool.shared_blocks(),
+                "reserved": sum(self._reserve)}
+
     def _worst_case_blocks(self, prompt_len: int, max_tokens: int) -> int:
         """Admission price in blocks: every position the request could
         ever write (prompt prefill + decode growth, capped at seq_len) —
@@ -1058,6 +1152,7 @@ class PagedGenerator(_GeneratorCore):
             raise ValueError(
                 f"prompt of {len(ids)} tokens exceeds the usable context "
                 f"(seq_len {self.cfg.seq_len})")
+        t_begin = telemetry.now_ns()  # the "admit" span: block bookkeeping
         rest = ids[:-1]
         shared, n_tok, cow_src, cow_r = self.pool.match_prefix(rest)
         bids: list[int] = []
@@ -1112,6 +1207,11 @@ class PagedGenerator(_GeneratorCore):
         self.tables[slot, :] = self.pool.NULL
         adm = _Admission(req=req, slot=slot, col=col, reused=reused)
         adm.pos = reused  # prefill resumes after the reused prefix
+        # paged-lifecycle span: the admission's block match/share/alloc +
+        # column gather work (n_tokens = prefix positions reused)
+        telemetry.tracer().emit(req.rid, "admit", t_begin,
+                                telemetry.now_ns(), slot=slot,
+                                n_tokens=reused)
         self._note_admitted(req, slot, reused)
         self._update_block_gauges()
         return adm
@@ -1156,7 +1256,7 @@ class PagedGenerator(_GeneratorCore):
             chunk = rest[adm.pos:adm.pos + n_b]
             pad_to = min(n_b, self.cfg.seq_len - adm.pos)
             padded = chunk + [0] * (pad_to - len(chunk))
-            adm.col = self._exec_prefill(adm.col, padded, adm.pos)
+            self._prefill_chunk(adm, padded, len(chunk))
             self.eng.seen_buckets.add(len(padded))
             adm.pos += len(chunk)
             if adm.pos < len(rest):
@@ -1199,8 +1299,8 @@ class PagedGenerator(_GeneratorCore):
         self.tables[slot, :] = self.pool.NULL
         self._update_block_gauges()
 
-    def _retire(self, slot: int) -> None:
-        super()._retire(slot)
+    def _retire(self, slot: int, reason: str = "done") -> None:
+        super()._retire(slot, reason)
         self._release_blocks(slot)
 
     def abort_admit(self, adm: "_Admission") -> None:
@@ -1255,14 +1355,18 @@ class PagedGenerator(_GeneratorCore):
                 self._ensure_block(i)
             except BlockPoolExhausted as e:
                 # mid-decode growth found no block: fail THIS request
-                # explicitly (503-shaped), keep the rest of the batch
+                # explicitly (503-shaped), keep the rest of the batch —
+                # and leave a black-box postmortem naming the victim and
+                # the tick decisions leading in
                 telemetry.registry().counter(
                     telemetry.KV_BLOCK_EXHAUSTION).inc()
                 req = self.slots[i]
                 req.error = str(e)
                 req.server_error = True
-                self._retire(i)
+                self._retire(i, "kv_block_exhaustion")
                 active.remove(i)
+                self.flight.dump("kv_block_exhaustion", victims=[req.rid],
+                                 info={"error": str(e), "slot": i})
         if not active:
             return 0
         if __debug__:
@@ -1284,6 +1388,7 @@ class PagedGenerator(_GeneratorCore):
                     jnp.asarray(coins), self._poison())
             nxt, nf = np.asarray(nxt), np.asarray(nf)
         ms = (time.perf_counter() - t0) * 1000.0
+        self._attrib_decode(active, ms)
         poisoned = self._handle_nonfinite(active, nf)
         emitted = 0
         for i in active:
@@ -1345,6 +1450,9 @@ class BatchScheduler:
         # — decode latency for active slots stays bounded no matter how
         # many long prompts are admitting
         self.prefill_budget = max(engine.prefill_buckets)
+        # flight recorder (runtime/flightrec): the scheduler owns the tick
+        # framing; every decision in _tick lands in the open tick record
+        self.flight = self.gen.flight
         self.max_queue = max_queue
         self.max_restarts = max_restarts
         self._queue: list[Request] = []
@@ -1407,6 +1515,8 @@ class BatchScheduler:
             self._queue.append(req)
             telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
                 len(self._queue))
+            self.flight.note("submit", rid, n_prompt=len(prompt_ids),
+                             max_tokens=max_tokens)
         self._wake.set()
         return req
 
@@ -1518,16 +1628,19 @@ class BatchScheduler:
                     len(self._queue))
         for req in expired:
             self._timeout_request(req)
+            self.flight.note("timeout", req.rid, reason="queued")
             req.done.set()
         for holder in (a.req for a in self._admissions):
             if holder.deadline_ns and now >= holder.deadline_ns \
                     and not holder.timed_out:
                 self._timeout_request(holder)
+                self.flight.note("timeout", holder.rid, reason="admitting")
                 holder.cancel.set()
         for s in self.gen.slots:
             if s is not None and s.deadline_ns and now >= s.deadline_ns \
                     and not s.timed_out:
                 self._timeout_request(s)
+                self.flight.note("timeout", s.rid, reason="in_flight")
                 s.cancel.set()
 
     def _on_stall(self, info: dict) -> None:
@@ -1543,9 +1656,18 @@ class BatchScheduler:
         with self._lock:
             self._healthy = False
             self._stop = True
+            victims = ([r.rid for r in self._queue]
+                       + [a.req.rid for a in self._admissions])
+        victims += [s.rid for s in self.gen.slots if s is not None]
         self._fail_all(
             f"step watchdog: device dispatch {info.get('label')!r} stalled "
             f"past its {info.get('budget_s') or 0:.1f}s budget")
+        # black-box postmortem: the wedged dispatch plus the last N ticks
+        # of scheduler decisions that led into it
+        self.flight.dump("watchdog_stall", victims=victims,
+                         info={"label": info.get("label"),
+                               "budget_s": info.get("budget_s"),
+                               "waited_s": info.get("waited_s")})
         self._wake.set()
 
     def _on_crash(self, exc: BaseException) -> None:
@@ -1558,6 +1680,12 @@ class BatchScheduler:
         msg = f"scheduler crashed: {type(exc).__name__}: {exc}"
         print(f"🛑 {msg} (crash {self._crashes}/{self.max_restarts})",
               flush=True)
+        with self._lock:
+            victims = ([r.rid for r in self._queue]
+                       + [a.req.rid for a in self._admissions])
+        victims += [s.rid for s in self.gen.slots if s is not None]
+        self.flight.dump("scheduler_crash", victims=victims,
+                         info={"error": msg, "crash_n": self._crashes})
         dead = self._crashes > self.max_restarts or self.gen.eng.multihost
 
         def _go_unready() -> None:
@@ -1609,6 +1737,29 @@ class BatchScheduler:
             self._quiet_ticks = 0
 
     def _tick(self) -> None:
+        """One loop tick under flight-recorder framing: the tick record
+        (runtime/flightrec) captures every decision, dispatch, and the
+        block-pool state — idle ticks are dropped by ``end_tick``, so the
+        ring stays signal-dense. The finally also closes the tick on a
+        crash, so the postmortem dump includes the dying tick."""
+        self.flight.begin_tick(queue_depth=len(self._queue),
+                               n_admissions=len(self._admissions))
+        try:
+            self._tick_body()
+        except BaseException as e:
+            # a crash before any decision/dispatch would otherwise read as
+            # an idle tick and be dropped — note it so the dying tick
+            # survives into the postmortem, named
+            self.flight.note("crash", reason=type(e).__name__)
+            raise
+        finally:
+            self.flight.end_tick(
+                blocks=self.gen.flight_blocks(),
+                slots=[s.rid if s is not None else None
+                       for s in self.gen.slots],
+                prefill_budget=self.prefill_budget)
+
+    def _tick_body(self) -> None:
         compiles_before = (
             introspection.ledger().compile_count(self._introspect_scope)
             if self._introspect_scope else 0)
@@ -1625,6 +1776,10 @@ class BatchScheduler:
                 if not free:
                     break
                 if not self.gen.can_admit(self._queue[0]):
+                    # blocks unaffordable: the head stays queued (FIFO) —
+                    # the tick record says WHY nothing admitted this tick
+                    self.flight.note("defer", self._queue[0].rid,
+                                     reason="blocks_unaffordable")
                     break
                 req = self._queue.pop(0)
                 try:
@@ -1637,9 +1792,15 @@ class BatchScheduler:
                     # back-pressure surfaces as 429s (queue full) or 408s
                     # (deadline), never a crash or a silent drop
                     self._queue.insert(0, req)
+                    now = telemetry.now_ns()
+                    telemetry.tracer().emit(req.rid, "requeue", now, now)
+                    self.flight.note("requeue", req.rid,
+                                     reason="kv_block_exhaustion")
                     break
                 except Exception as e:  # noqa: BLE001 — reject, don't wedge
                     req.error = f"{type(e).__name__}: {e}"
+                    self.flight.note("reject", req.rid,
+                                     reason=type(e).__name__)
                     req.done.set()
                     continue
                 self._admissions.append(adm)
@@ -1660,11 +1821,17 @@ class BatchScheduler:
                 # counted as admitted in begin_admit: balance the pair so
                 # admissions_total - retires_total stays "live requests"
                 telemetry.registry().counter(telemetry.RETIRES).inc()
+                self.flight.note("cancel", adm.req.rid, reason="admitting")
                 adm.req.done.set()
         spent = 0
         for adm in list(self._admissions):
             if spent >= self.prefill_budget:
-                break  # over budget: the rest prefill on later ticks
+                # over budget: this admission prefills on later ticks —
+                # the preempt decision is what ITL attribution's
+                # tick-budget story is built from
+                self.flight.note("preempt", adm.req.rid,
+                                 reason="prefill_budget")
+                continue
             remaining = len(adm.req.prompt_ids) - 1 - adm.pos
             spent += self.gen.eng._prefill_chunk_size(max(1, remaining))
             try:
@@ -1675,6 +1842,8 @@ class BatchScheduler:
                 self.gen.abort_admit(adm)
                 telemetry.registry().counter(telemetry.RETIRES).inc()
                 adm.req.error = f"{type(e).__name__}: {e}"
+                self.flight.note("reject", adm.req.rid,
+                                 reason=type(e).__name__)
                 adm.req.done.set()
         # golden canary drift sentinel (runtime/numerics): time-gated
         # fixed-seed replay on this thread — the same thread that owns

@@ -84,6 +84,13 @@ LOAD_CORRUPTION = "dllama_load_corruption_total"
 WATCHDOG_STALLS = "dllama_watchdog_stalls_total"
 HBM_ADMISSION_REJECTS = "dllama_hbm_admission_rejects_total"
 
+# flight recorder + latency attribution (runtime/flightrec.py, wired in
+# runtime/serving.py and serve/api.py)
+TTFT_ATTRIB_MS = "dllama_ttft_attrib_ms"
+ITL_ATTRIB_MS = "dllama_itl_attrib_ms"
+FLIGHT_TICKS = "dllama_flight_ticks_total"
+FLIGHT_DUMPS = "dllama_flight_dumps_total"
+
 # HTTP layer (serve/api.py)
 HTTP_REQUESTS = "dllama_http_requests_total"
 REQUESTS_IN_FLIGHT = "dllama_requests_in_flight"
@@ -267,6 +274,29 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "Recompiles observed AFTER an engine scope reached serving "
           "steady state (each is a latency cliff; the shape/plan diff is "
           "WARN-logged and kept in the /debug/compiles ledger)"),
+    _spec(TTFT_ATTRIB_MS, "histogram",
+          "Per-request TTFT decomposition by phase (queue: submit to "
+          "admission start; admission: admission start to decode-armed "
+          "minus own prefill dispatch wall; prefill: own prefill chunk "
+          "dispatch wall; first_decode: decode-armed to first emitted "
+          "token). The four phases sum to wall TTFT by construction "
+          "(runtime/flightrec, recorded by the generators and the "
+          "single-sequence API path)"),
+    _spec(ITL_ATTRIB_MS, "histogram",
+          "Per-request decode-phase wall attribution by cause (step: "
+          "total decode dispatch wall while the request's slot was "
+          "active; preempt: other admissions' interleaved prefill-chunk "
+          "wall charged to the waiting decode slots — the tick-budget "
+          "preemption share of inter-token stalls). Recorded once per "
+          "request at retire"),
+    _spec(FLIGHT_TICKS, "counter",
+          "Work-carrying scheduler ticks recorded by the flight recorder "
+          "(idle ticks are dropped; gaps in the dump's tick numbering "
+          "mark idle stretches)"),
+    _spec(FLIGHT_DUMPS, "counter",
+          "Flight-recorder postmortem dumps written, by reason "
+          "(watchdog_stall / scheduler_crash / kv_block_exhaustion; "
+          "rate-limited per reason)"),
     _spec(HTTP_REQUESTS, "counter",
           "HTTP requests by route and status code"),
     _spec(REQUESTS_IN_FLIGHT, "gauge", "Completions currently executing"),
@@ -478,14 +508,33 @@ def registry() -> Registry:
 
 # -- per-request span tracing -------------------------------------------------
 
-PHASES = ("queue", "prefill", "decode", "verify")
+# The documented span-phase vocabulary — the closed world
+# tools/check_span_phases.py lints against (both directions: every
+# tracer().emit call site uses a name listed here, and every name here
+# has a call site and a PERF.md mention):
+#
+# * ``queue`` — submit → admission start (batched serving).
+# * ``admit`` — the paged pool's admission bookkeeping (block
+#   match/share/alloc + column gather) inside ``begin_admit``.
+# * ``prefill`` — admission start → decode-armed (the whole prompt
+#   build, including interleave gaps).
+# * ``prefill_chunk`` — one prefill chunk dispatch (nested inside
+#   ``prefill``; the single-sequence engine records the same chunks as
+#   flight-recorder events instead).
+# * ``decode`` — decode-armed → retire (batched) or the decode loop of
+#   one single-sequence completion.
+# * ``verify`` — one speculative verify dispatch.
+# * ``requeue`` — an instant marker: admission found no KV blocks and
+#   the request went back to the queue head.
+PHASES = ("queue", "admit", "prefill", "prefill_chunk", "decode", "verify",
+          "requeue")
 
 
 class SpanTracer:
     """JSONL span sink + bounded in-memory span ring. One record per
     completed span:
 
-    ``{"request_id": int, "phase": "queue|prefill|decode|verify",
+    ``{"request_id": int, "phase": <one of PHASES>,
        "start_ns": int, "end_ns": int, "slot": int, "n_tokens": int}``
 
     Timestamps are ``time.monotonic_ns`` (durations, not wall clock).
@@ -523,6 +572,14 @@ class SpanTracer:
             if self._f is not None:
                 self._f.write(json.dumps(rec) + "\n")
                 self._f.flush()
+
+    def raw_spans(self) -> list[dict]:
+        """The span ring's raw records, oldest first — absolute
+        ``start_ns``/``end_ns`` preserved so the flight recorder's
+        Chrome-trace export can place them against tick timestamps
+        (``recent_requests`` rebases to per-request ms and loses that)."""
+        with self._lock:
+            return [dict(s) for s in self._ring]
 
     def recent_requests(self, limit: int = 64) -> list[dict]:
         """Most-recent per-request phase timelines from the span ring
@@ -578,10 +635,14 @@ class RequestTimer:
         self._reg = reg or registry()
         self._t0 = time.monotonic_ns()
         self._last: int | None = None
+        # first-token stamp (monotonic ns; None until one arrived) — the
+        # single-sequence TTFT-attribution path reads it
+        self.first_ns: int | None = None
 
     def token(self) -> None:
         now = time.monotonic_ns()
         if self._last is None:
+            self.first_ns = now
             self._reg.histogram(TTFT_MS).record((now - self._t0) / 1e6)
         else:
             self._reg.histogram(ITL_MS).record((now - self._last) / 1e6)
@@ -611,10 +672,25 @@ def stats_line(reg: Registry | None = None, *,
         f"/{int(reg.gauge(BATCH_SLOTS).value())}",
         f"kv={reg.gauge(KV_OCCUPANCY).value():.2f}",
     ]
+    # paged block pool (--kv-block-size): used/total + shared — otherwise
+    # the paged path is invisible between Prometheus scrapes
+    n_blocks = reg.gauge(KV_BLOCKS_TOTAL).value()
+    if n_blocks:
+        parts.append(f"blocks={int(reg.gauge(KV_BLOCKS_USED).value())}"
+                     f"/{int(n_blocks)}")
+        parts.append(f"shared={int(reg.gauge(KV_BLOCKS_SHARED).value())}")
     if window_tokens is not None and window_s:
         parts.append(f"tok/s={window_tokens / window_s:.1f}")
     parts.append(f"ttft_p50={ttft.quantile(0.5):.0f}ms")
     parts.append(f"itl_p50={itl.quantile(0.5):.0f}ms")
+    # TTFT attribution p50s (runtime/flightrec): where first-token time
+    # actually went — queue / admission / prefill / first decode
+    attrib = reg.histogram(TTFT_ATTRIB_MS)
+    if attrib.count(phase="first_decode"):
+        parts.append("ttft[q/a/p/d]=" + "/".join(
+            f"{attrib.quantile(0.5, phase=ph):.0f}"
+            for ph in ("queue", "admission", "prefill", "first_decode"))
+            + "ms")
     sync = reg.gauge(SYNC_FRACTION).value()
     sent = reg.gauge(COLLECTIVE_SENT_KB).value()
     if sync or sent:

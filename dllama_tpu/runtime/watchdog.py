@@ -222,6 +222,23 @@ class StepWatchdog:
             print(f"🛑 watchdog: compile ledger unavailable "
                   f"({type(e).__name__}: {e})", flush=True)
         try:
+            from . import flightrec
+
+            ticks = flightrec.recorder().snapshot()["ticks"][-8:]
+            if ticks:
+                lines = [
+                    f"    tick {t['tick']}: q={t.get('queue_depth', 0)} "
+                    f"active={t.get('n_active', 0)} "
+                    f"dispatch={t.get('dispatch_ms', 0.0):.1f}ms "
+                    f"prefill={t.get('prefill_ms', 0.0):.1f}ms "
+                    f"decisions={[d.get('event') for d in t.get('decisions', [])]}"
+                    for t in ticks]
+                print("🛑 watchdog: last flight-recorder ticks\n"
+                      + "\n".join(lines), flush=True)
+        except Exception as e:  # noqa: BLE001 — diagnostics are advisory; the stall itself is already reported
+            print(f"🛑 watchdog: flight recorder unavailable "
+                  f"({type(e).__name__}: {e})", flush=True)
+        try:
             frames = sys._current_frames()
             out = []
             for tid, frame in frames.items():

@@ -33,7 +33,8 @@ import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
-from ..runtime import failpoints, introspection, numerics, profiling, telemetry
+from ..runtime import (failpoints, flightrec, introspection, numerics,
+                       profiling, telemetry)
 from ..runtime.engine import InferenceEngine
 from ..runtime.serving import (HbmAdmissionError, QueueFullError,
                                RequestTimeoutError,
@@ -49,7 +50,7 @@ from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
 _ROUTES = ("/v1/chat/completions", "/v1/models", "/metrics",
            "/health", "/healthz", "/readyz",
            "/debug/compiles", "/debug/requests", "/debug/profile",
-           "/debug/numerics")
+           "/debug/numerics", "/debug/flight", "/debug/timeline")
 
 # POST /debug/profile capture-window bounds (ms): long enough to catch a few
 # decode steps, short enough that a handler thread never parks for minutes
@@ -119,6 +120,9 @@ def _validate_body(body: dict) -> None:
         if not (0 < float(timeout) <= _MAX_TIMEOUT_S):
             raise ValueError(
                 f"timeout must be in (0, {_MAX_TIMEOUT_S:.0f}] seconds")
+    timing = body.get("timing")
+    if timing is not None and not isinstance(timing, bool):
+        raise ValueError("timing must be a boolean")
     stop = body.get("stop")
     if stop is not None and not isinstance(stop, (str, list)):
         raise ValueError("stop must be a string or a list of strings")
@@ -254,6 +258,8 @@ class ApiState:
                     if timeout_s > 0 else 0)
         self._rid += 1
         engine.trace_rid = self._rid  # stamps the engine's prefill span
+        t_req0 = telemetry.now_ns()  # TTFT attribution origin (queue = 0:
+        # the single-threaded server has no scheduler queue)
         rt = telemetry.RequestTimer()
         if "temperature" in body:
             engine.sampler.set_temp(float(body["temperature"]))
@@ -289,8 +295,10 @@ class ApiState:
         if prompt.public_prompt:
             gate._out(prompt.public_prompt)
 
+        prefill_ms = 0.0
         if len(ids) > 1:
-            engine.prefill(ids[: prompt_end - start_pos])
+            _, pf_metrics = engine.prefill(ids[: prompt_end - start_pos])
+            prefill_ms = sum(s.ms for s in pf_metrics)
         token = ids[prompt_end - start_pos] if prompt_end - start_pos < len(ids) else ids[-1]
         tok.reset_decoder()
 
@@ -343,6 +351,18 @@ class ApiState:
         rt.done(len(ids), n_completion)
         telemetry.tracer().emit(self._rid, "decode", t_decode,
                                 telemetry.now_ns(), n_tokens=n_completion)
+        # TTFT attribution, single-sequence shape: t_admit == t_submit
+        # (no scheduler queue → queue = 0); admission = template/encode/
+        # cache work, prefill = the measured chunk dispatch wall — the
+        # phase formula itself is flightrec.ttft_phases, shared with the
+        # batched path so the two surfaces can never drift apart.
+        timing = None
+        if rt.first_ns is not None:
+            bd = flightrec.ttft_phases(t_req0, t_req0, t_decode,
+                                       rt.first_ns, prefill_ms)
+            flightrec.record_ttft(
+                telemetry.registry().histogram(telemetry.TTFT_ATTRIB_MS), bd)
+            timing = {k: round(v, 3) for k, v in bd.items()}
 
         if not (custom_stops and finish_reason == "stop"):
             # a custom-stop finish leaves the hidden stop text and an
@@ -365,12 +385,15 @@ class ApiState:
         can = getattr(engine, "canary", None)
         if can is not None:
             can.maybe_run()
-        return {
+        out = {
             "text": "".join(gate.parts),
             "finish_reason": finish_reason,
             "prompt_tokens": len(ids),
             "completion_tokens": n_completion,
         }
+        if body.get("timing") and timing is not None:
+            out["timing"] = timing  # opt-in latency attribution block
+        return out
 
 
 class BatchedApiState:
@@ -482,16 +505,26 @@ class BatchedApiState:
         if finish_reason in ("length", "timeout"):
             gate.flush_tail()
         rt.done(len(ids), n_completion)
-        return {
+        out = {
             "text": "".join(gate.parts),
             "finish_reason": finish_reason,
             "prompt_tokens": len(ids),
             "completion_tokens": n_completion,
         }
+        bd = req.ttft_breakdown() if body.get("timing") else None
+        if bd is not None:
+            # opt-in latency attribution (scheduler-side stamps; the phase
+            # formula lives in Request.ttft_breakdown — the histogram
+            # twins land in dllama_ttft_attrib_ms / dllama_itl_attrib_ms
+            # at first-token / retire)
+            out["timing"] = {k: round(v, 3) for k, v in bd.items()}
+            out["timing"]["decode_step_ms"] = round(req.ms_decode_steps, 3)
+            out["timing"]["preempt_ms"] = round(req.ms_preempt, 3)
+        return out
 
 
 def _completion_json(state, out: dict) -> dict:
-    return {
+    resp = {
         "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
         "object": "chat.completion",
         "created": int(time.time()),
@@ -507,6 +540,11 @@ def _completion_json(state, out: dict) -> dict:
             "total_tokens": out["prompt_tokens"] + out["completion_tokens"],
         },
     }
+    if "timing" in out:
+        # opt-in (body "timing": true) TTFT/ITL attribution block —
+        # non-streaming responses only (SSE chunks stay OpenAI-shaped)
+        resp["timing"] = out["timing"]
+    return resp
 
 
 def _chunk_json(state: ApiState, delta: dict, finish_reason=None) -> dict:
@@ -605,6 +643,16 @@ def make_handler(state: ApiState):
                 # timelines (SpanTracer; no --trace-out needed)
                 self._json(200,
                            {"requests": telemetry.tracer().recent_requests()})
+            elif path == "/debug/flight":
+                # the flight recorder's live rings: per-tick scheduler
+                # decisions + request lifecycle events (runtime/flightrec)
+                self._json(200, flightrec.recorder().snapshot())
+            elif path == "/debug/timeline":
+                # Perfetto-loadable Chrome trace of the live rings + the
+                # span ring (save the body, load in ui.perfetto.dev)
+                data = flightrec.recorder().snapshot()
+                data["spans"] = telemetry.tracer().raw_spans()
+                self._json(200, flightrec.to_chrome_trace(data))
             elif path == "/debug/numerics":
                 # the numerics observatory: tripwire totals per site, the
                 # last tapped dispatch's per-layer stats, canary status

@@ -34,7 +34,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllama_tpu",
                                 description="TPU-native distributed-llama")
     p.add_argument("mode", choices=["inference", "chat", "perplexity", "api",
-                                    "worker", "verify", "audit"])
+                                    "worker", "verify", "audit", "timeline"])
     p.add_argument("--model", required=False, help=".m model file")
     p.add_argument("--tokenizer", required=False, help=".t tokenizer file")
     p.add_argument("--verify-weights", action="store_true",
@@ -184,6 +184,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "--stats drift=N!, WARN names the first "
                         "divergent layer when --numerics-taps is on); "
                         "0 = off")
+    p.add_argument("--dump", default=None, metavar="FILE",
+                   help="timeline mode: the flight-recorder JSON to "
+                        "convert — a crash postmortem "
+                        "(dllama-flight-*.json) or a saved GET "
+                        "/debug/flight body (runtime/flightrec.py)")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help="timeline mode: write the Chrome trace-event JSON "
+                        "here (default: stdout); load the file in "
+                        "ui.perfetto.dev or chrome://tracing")
     p.add_argument("--audit-json", action="store_true",
                    help="audit mode: print the per-tensor table as one "
                         "JSON object instead of text")
@@ -627,6 +636,52 @@ def run_audit(args) -> int:
     return 1 if res["nonfinite_tensors"] else 0
 
 
+def run_timeline(args) -> int:
+    """``python -m dllama_tpu timeline --dump flight.json [--out t.json]``
+    — offline converter from a flight-recorder dump (crash postmortem or
+    a saved ``GET /debug/flight`` body) to Perfetto-loadable Chrome
+    trace-event JSON, with structural validation (per-track monotonic
+    timestamps, complete request flows). Pure host-side: no jax."""
+    from ..runtime import flightrec
+
+    if not args.dump:
+        raise SystemExit("--dump FILE (a flight-recorder dump, or a saved "
+                         "GET /debug/flight body) is required for timeline "
+                         "mode")
+    try:
+        with open(args.dump, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"❌ {args.dump}: {e}")
+        return 1
+    if not isinstance(data, dict):
+        print(f"❌ {args.dump}: not a flight-recorder dump (expected a "
+              f"JSON object, got {type(data).__name__})")
+        return 1
+    try:
+        trace = flightrec.to_chrome_trace(data)
+        problems = flightrec.validate_chrome_trace(trace)
+    except (KeyError, TypeError, AttributeError) as e:
+        # a truncated / hand-edited dump missing structural fields must
+        # fail with a name, not a traceback
+        print(f"❌ {args.dump}: malformed flight dump "
+              f"({type(e).__name__}: {e})")
+        return 1
+    payload = json.dumps(trace)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload)
+        print(f"🧾 {len(trace['traceEvents'])} trace events "
+              f"({len(data.get('ticks') or [])} ticks, "
+              f"{len(data.get('spans') or [])} spans) → {args.out} — load "
+              f"in ui.perfetto.dev or chrome://tracing")
+    else:
+        print(payload)
+    for prob in problems:
+        print(f"⚠️ {prob}", file=sys.stderr)
+    return 1 if problems else 0
+
+
 def run_perplexity(args) -> int:
     engine = make_engine(args)
     if args.file:
@@ -844,6 +899,9 @@ def main(argv=None) -> int:
     if args.mode == "audit":
         # host-side quant-error audit (runtime/numerics): no jax either
         return run_audit(args)
+    if args.mode == "timeline":
+        # offline flight-dump → Chrome trace converter: no jax either
+        return run_timeline(args)
     _setup_compile_cache(args)
     if args.mode != "worker":
         # Honor an explicit JAX_PLATFORMS (e.g. the virtual CPU mesh:

@@ -709,6 +709,108 @@ def test_kv_alloc_sustained_exhaustion_sheds_429_shaped(paged_chaos_engine):
         sched.close()
 
 
+def test_kv_alloc_exhaustion_dump_names_victim_and_tick_decisions(
+        paged_chaos_engine, tmp_path, monkeypatch):
+    """ISSUE-7 satellite: a kv_alloc-failpoint mid-decode exhaustion
+    leaves a readable flight-recorder postmortem — the dump file names
+    the victim request and carries the scheduler tick decisions leading
+    in (telemetry- AND file-asserted)."""
+    from dllama_tpu.runtime import flightrec
+
+    monkeypatch.setenv("DLLAMA_FLIGHT_DIR", str(tmp_path))
+    flightrec.recorder().reset()
+    dumps = tm.registry().counter(tm.FLIGHT_DUMPS)
+    d0 = dumps.total(reason="kv_block_exhaustion")
+    sched = BatchScheduler(paged_chaos_engine, n_slots=2,
+                           _start_thread=False)
+    try:
+        # rest = 9 ids -> one 16-row block; decode must grow at pos 16
+        grower = sched.submit(_enc(paged_chaos_engine, "hello w"), 24,
+                              stop_on_eos=False)
+        bystander = sched.submit(_enc(paged_chaos_engine, "abc"), 4,
+                                 stop_on_eos=False)
+        for _ in range(20):  # admit + arm both
+            sched._tick()
+            if grower.t_decode and bystander.t_decode:
+                break
+        assert grower.t_decode and bystander.t_decode
+        fp.arm("kv_alloc", "raise", times=1)
+        for _ in range(200):
+            sched._tick()
+            if grower.done.is_set():
+                break
+        assert grower.server_error and "exhaustion" in grower.error
+        assert bystander.done.is_set() and bystander.error is None
+        assert dumps.total(reason="kv_block_exhaustion") == d0 + 1
+        files = sorted(tmp_path.glob("dllama-flight-*kv_block_exhaustion*"))
+        assert len(files) == 1
+        doc = json.loads(files[0].read_text())
+        assert doc["victims"] == [grower.rid]
+        assert "exhaustion" in doc["info"]["error"]
+        # the tick history leading in: the victim's admit decision and
+        # its exhaustion retire are both on record
+        decisions = [d for t in doc["ticks"] for d in t["decisions"]]
+        assert any(d["event"] == "admit" and d["rid"] == grower.rid
+                   for d in decisions)
+        assert any(d["event"] == "retire" and d["rid"] == grower.rid
+                   and d["reason"] == "kv_block_exhaustion"
+                   for d in decisions)
+        # block-pool occupancy rides every tick record
+        assert any(t.get("blocks") for t in doc["ticks"])
+    finally:
+        fp.registry().clear()
+        sched.close()
+        flightrec.recorder().reset()
+
+
+def test_step_hang_watchdog_trip_dumps_flight_recorder(tmp_path,
+                                                       monkeypatch):
+    """ISSUE-7 satellite: a step_hang watchdog trip writes the black-box
+    postmortem (reason watchdog_stall) naming every in-flight victim,
+    with the tick decisions leading into the wedged dispatch."""
+    from dllama_tpu.runtime import flightrec
+
+    monkeypatch.setenv("DLLAMA_FLIGHT_DIR", str(tmp_path))
+    flightrec.recorder().reset()
+    dumps = tm.registry().counter(tm.FLIGHT_DUMPS)
+    d0 = dumps.total(reason="watchdog_stall")
+    mpath, tpath = _fresh_model(tmp_path)
+    eng = InferenceEngine(mpath, tpath, temperature=0.0, seed=3)
+    eng.watchdog.min_budget_s = 0.3
+    eng.watchdog.margin = 1.0
+    eng.watchdog.min_samples = 2
+    sched = BatchScheduler(eng, n_slots=2)
+    try:
+        warm = sched.submit(_enc(eng), 4, stop_on_eos=False)
+        assert warm.done.wait(timeout=120) and warm.error is None
+        assert eng.watchdog.budget_s() is not None
+        fp.arm("step_hang", "sleep", times=1, delay_s=8.0)
+        req = sched.submit(_enc(eng, "stall me"), 50, stop_on_eos=False)
+        assert req.done.wait(timeout=60)
+        assert req.error is not None and "watchdog" in req.error
+        # the dump is written on the MONITOR thread after the fail-all
+        # that set req.done — give it a moment
+        for _ in range(100):
+            if dumps.total(reason="watchdog_stall") == d0 + 1:
+                break
+            time.sleep(0.1)
+        assert dumps.total(reason="watchdog_stall") == d0 + 1
+        files = sorted(tmp_path.glob("dllama-flight-*watchdog_stall*"))
+        assert len(files) == 1
+        doc = json.loads(files[0].read_text())
+        assert req.rid in doc["victims"]
+        assert doc["info"]["label"] is not None  # the wedged dispatch
+        assert doc["ticks"], "no tick history in the postmortem"
+        decisions = [d for t in doc["ticks"] for d in t["decisions"]]
+        assert any(d["event"] == "admit" and d["rid"] == req.rid
+                   for d in decisions)
+    finally:
+        fp.registry().clear()
+        sched.close()
+        eng.close()
+        flightrec.recorder().reset()
+
+
 def test_kv_alloc_mid_decode_exhaustion_fails_one_request_503_shaped(
         paged_chaos_engine):
     """Exhaustion at mid-decode block growth fails THAT request explicitly

@@ -464,6 +464,54 @@ def test_cross_slot_prefix_reuse_exact_and_skips_prefill(engine):
     assert adm_c.req.tokens == want_c
 
 
+def test_paged_lifecycle_emits_spans_and_debug_requests_timeline(
+        tmp_path_factory):
+    """ISSUE-7 satellite: the paged lifecycle speaks the span vocabulary —
+    admit / prefill_chunk spans per admission (on top of the shared
+    queue/prefill/decode spans) — and the /debug/requests timeline payload
+    shows them under a continuous-batching run."""
+    from dllama_tpu.runtime import telemetry as tm
+
+    d = tmp_path_factory.mktemp("serving_paged_spans")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(43)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=16)
+    sched = BatchScheduler(eng, n_slots=2)
+    t0 = tm.now_ns()
+    try:
+        prompts = ["hello world hello", "hello", " world hello world"]
+        reqs = [sched.submit(eng.tokenizer.encode(p, is_start=True), 4,
+                             stop_on_eos=False) for p in prompts]
+        for r in reqs:
+            assert r.done.wait(timeout=300) and r.error is None
+    finally:
+        sched.close()
+        eng.close()
+    # raw ring, filtered to this run (the ring is process-global and
+    # request ids restart per scheduler)
+    spans = [s for s in tm.tracer().raw_spans() if s["start_ns"] >= t0]
+    by_rid = {}
+    for s in spans:
+        by_rid.setdefault(s["request_id"], set()).add(s["phase"])
+    for r in reqs:
+        assert {"queue", "admit", "prefill_chunk", "prefill",
+                "decode"} <= by_rid[r.rid], (r.rid, by_rid.get(r.rid))
+    # every emitted phase is in the documented vocabulary (the lint's
+    # runtime twin)
+    assert {p for ps in by_rid.values() for p in ps} <= set(tm.PHASES)
+    # and the /debug/requests payload (recent_requests) carries the paged
+    # phases (the ring is shared process-wide, so assert our rids are
+    # present with the new vocabulary rather than exact-matching)
+    timelines = {t["request_id"]: t for t in tm.tracer().recent_requests()}
+    for r in reqs:
+        phases = [p["phase"] for p in timelines[r.rid]["phases"]]
+        assert "admit" in phases and "prefill_chunk" in phases
+        assert timelines[r.rid]["total_ms"] > 0
+
+
 def test_batched_serving_on_moe_model(tmp_path_factory):
     """Continuous batching over a Mixture-of-Experts model: the ragged decode
     program rides the sparse MoE ffn (expert dispatch is positionwise, so
