@@ -45,6 +45,7 @@ lint:
 	$(PY) tools/check_route_labels.py
 	$(PY) tools/check_failpoint_sites.py
 	$(PY) tools/check_span_phases.py
+	$(PY) tools/check_shard_map_shim.py
 
 bench:
 	$(PY) bench.py

@@ -329,7 +329,7 @@ def stage_child(spec: str) -> None:
     st = _PhaseDict()
     try:
         if preset in SCENARIOS:
-            bench_continuous(deadline, out=st)
+            SCENARIO_FNS[preset](deadline, out=st)
         else:
             bench_preset(preset, deadline, out=st, **kwargs)
     except Exception as e:  # noqa: BLE001 — the parent needs the line
@@ -937,7 +937,151 @@ def bench_continuous(deadline: float, *, out: dict | None = None) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
-SCENARIOS = ("continuous",)
+def bench_multichip(deadline: float, *, out: dict | None = None) -> dict:
+    """``--scenario multichip``: the overlap/wire A/B on a ≥2-device mesh.
+
+    Four engine configs over the same tiny fixture model — the cross of
+    ``--comm-overlap {off,auto}`` × ``--wire {f32,q80}`` — each measured
+    for greedy decode step time and then profiled for the Eval/Sync split
+    and the EXPOSED collective wall (``dllama_comm_exposed_ms``: sync lane
+    time not covered by concurrent compute — the quantity the overlapped
+    ring merges exist to shrink; runtime/profiling.EvalSyncSplit). The
+    per-config analytic wire bytes (qcollectives.wire_traffic_model) show
+    the q80 wire's byte shrink next to the time numbers.
+
+    Skip contract: fewer than 2 visible devices emits ``skipped: true`` +
+    ``skip_reason`` (tools/bench_compare.py reads that as "no hardware",
+    never a regression), the same first-class skip as a dead backend.
+
+    Workload knobs (env): DLLAMA_BENCH_MC_STEPS (24 decode steps per
+    config), DLLAMA_BENCH_MC_TP (tp width; default: largest power of two
+    ≤ min(n_devices, 4) — the fixture has 4 heads)."""
+    import shutil
+    import tempfile
+
+    out = {} if out is None else out
+    out["phase"] = "scenario_setup"
+    import jax
+
+    n_dev = len(jax.devices())
+    out["n_devices"] = n_dev
+    if n_dev < 2:
+        out["skipped"] = True
+        out["skip_reason"] = (f"multichip scenario needs >= 2 devices, "
+                              f"found {n_dev} (CPU mesh: XLA_FLAGS="
+                              f"--xla_force_host_platform_device_count=8)")
+        out["phase"] = "done"
+        return out
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tests"))
+    import numpy as np
+
+    from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+    from dllama_tpu.formats import tfile
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    tp = _scn_int("DLLAMA_BENCH_MC_TP", 0)
+    if tp <= 0:
+        tp = 1
+        while tp * 2 <= min(n_dev, 4):
+            tp *= 2
+    steps = _scn_int("DLLAMA_BENCH_MC_STEPS", 24)
+    out.update(tp=tp, decode_steps=steps)
+
+    d = tempfile.mkdtemp(prefix="dllama-bench-mc-")
+    prev_wire = os.environ.get("DLLAMA_TPU_WIRE")
+    try:
+        mpath, tpath = os.path.join(d, "m.m"), os.path.join(d, "t.t")
+        rng = np.random.default_rng(0xAB)
+        write_tiny_model(mpath, tiny_header_params(
+            dim=256, hidden_dim=512, n_layers=2, n_heads=4, n_kv_heads=2,
+            head_dim=64, vocab_size=268, seq_len=256), rng)
+        tfile.write_tfile(tpath, byte_vocab_tokenizer())
+
+        ab: dict = {}
+        tokens_by_cfg: dict = {}
+        for overlap, wire in (("off", "f32"), ("auto", "f32"),
+                              ("off", "q80"), ("auto", "q80")):
+            key = f"overlap_{overlap}_{wire}"
+            if time.monotonic() > deadline:
+                ab[key] = {"error": "deadline before config ran"}
+                continue
+            out["phase"] = f"config_{key}"
+            os.environ["DLLAMA_TPU_WIRE"] = wire
+            eng = InferenceEngine(mpath, tpath, tp=tp,
+                                  comm_overlap=overlap, temperature=0.0)
+            try:
+                res = eng.generate([1, 5, 9, 13], steps, stop_on_eos=False)
+                n_pred = sum(s.n_tokens for s in res.steps
+                             if s.kind == "pred")
+                rec: dict = {
+                    "n_chunks": eng.cfg.comm_overlap,
+                    "decode_tok_per_s": round(res.pred_tok_per_s, 2),
+                    "decode_ms_per_step": (round(res.pred_ms / n_pred, 3)
+                                           if n_pred else None),
+                    "wire_kb_per_token": round(sum(
+                        b for _, _, b in eng._wire_traffic) / 1024.0, 3),
+                    "wire_ops": sorted({f"{op}/{w}" for op, w, _
+                                        in eng._wire_traffic}),
+                }
+                tokens_by_cfg[key] = res.tokens
+                try:
+                    split = eng.measure_split()
+                    rec["sync_ms"] = round(split.sync_ms, 4)
+                    rec["eval_ms"] = round(split.eval_ms, 4)
+                    rec["comm_exposed_ms"] = round(split.exposed_ms, 4)
+                except Exception as e:  # noqa: BLE001 — keep the rates
+                    rec["split_error"] = f"{type(e).__name__}: {e}"[:200]
+                ab[key] = rec
+            finally:
+                eng.close()
+        out["ab"] = ab
+
+        # the acceptance invariant, checked where the data is: the f32
+        # wire's tokens must be identical overlap-on vs overlap-off
+        if ("overlap_off_f32" in tokens_by_cfg
+                and "overlap_auto_f32" in tokens_by_cfg):
+            out["f32_tokens_identical"] = (
+                tokens_by_cfg["overlap_off_f32"]
+                == tokens_by_cfg["overlap_auto_f32"])
+
+        # flat fields tools/bench_compare.py ranks
+        auto_f32 = ab.get("overlap_auto_f32", {})
+        off_f32 = ab.get("overlap_off_f32", {})
+        auto_q80 = ab.get("overlap_auto_q80", {})
+        if auto_f32.get("decode_tok_per_s"):
+            out["decode_tok_per_s"] = auto_f32["decode_tok_per_s"]
+        if auto_q80.get("decode_tok_per_s"):
+            out["decode_tok_per_s_q80"] = auto_q80["decode_tok_per_s"]
+        rates = [c.get("decode_tok_per_s") for c in ab.values()
+                 if isinstance(c, dict) and c.get("decode_tok_per_s")]
+        if rates:
+            out["agg_tok_per_s"] = max(rates)
+        if auto_f32.get("comm_exposed_ms") is not None:
+            out["comm_exposed_ms"] = auto_f32["comm_exposed_ms"]
+        if off_f32.get("comm_exposed_ms") is not None:
+            out["comm_exposed_ms_off"] = off_f32["comm_exposed_ms"]
+        if ("comm_exposed_ms" in out and "comm_exposed_ms_off" in out):
+            out["exposed_overlap_lower"] = (
+                out["comm_exposed_ms"] < out["comm_exposed_ms_off"])
+        if (auto_q80.get("wire_kb_per_token") is not None
+                and auto_f32.get("wire_kb_per_token")):
+            out["wire_q80_shrink"] = round(
+                auto_f32["wire_kb_per_token"]
+                / max(1e-9, auto_q80["wire_kb_per_token"]), 2)
+        out["phase"] = "done"
+        return out
+    finally:
+        if prev_wire is None:
+            os.environ.pop("DLLAMA_TPU_WIRE", None)
+        else:
+            os.environ["DLLAMA_TPU_WIRE"] = prev_wire
+        shutil.rmtree(d, ignore_errors=True)
+
+
+SCENARIOS = ("continuous", "multichip")
+SCENARIO_FNS = {"continuous": bench_continuous, "multichip": bench_multichip}
 
 
 def _result_skeleton(metric: str) -> dict:
@@ -1009,10 +1153,25 @@ def scenario_main(name: str) -> None:
     result["platform"] = info.get("platform")
     result["device_kind"] = info.get("kind")
     _stage_cache_env()
+    if (name == "multichip" and info.get("platform") == "cpu"
+            and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        # the CPU backend exposes ONE device by default; the multichip A/B
+        # needs a mesh — give the stage child the 8-device virtual mesh
+        # the test tier uses (a real TPU slice is unaffected)
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_"
+                                     "count=8").strip()
 
     res = run_stage(name, STAGE_DEADLINE_S)
     result["stages"] = {name: res}
-    if res.get("agg_tok_per_s"):
+    if res.get("skipped"):
+        # the scenario itself declared a first-class skip (e.g. a single
+        # device): propagate it so comparisons read "no hardware"
+        result["skipped"] = True
+        result["skip_reason"] = res.get("skip_reason")
+        result["error"] = res.get("skip_reason")
+    elif res.get("agg_tok_per_s"):
         result["value"] = res["agg_tok_per_s"]
     else:
         result["error"] = res.get("error", "scenario did not measure")

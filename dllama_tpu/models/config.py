@@ -61,6 +61,15 @@ class ModelConfig:
     # places the layer stack host-side to match. No reference equivalent —
     # the reference keeps shards resident (SURVEY.md §7.4).
     offload: bool = False
+    # Compute/communication overlap for the two per-layer tp partial merges
+    # (wo and w2 — the reference's SYNC steps): > 0 splits each merge's
+    # model-dim into this many chunks reduced by independent ppermute ring
+    # chains (parallel/qcollectives.overlapped_wire_psum) so chunk i's hops
+    # overlap chunk i+1's compute under XLA's latency-hiding scheduler
+    # (TokenWeave shape, PAPERS.md). 0 keeps the monolithic GSPMD psum.
+    # Resolved by the engine from --comm-overlap {off,auto,N}; static trace
+    # config, so it is part of the multihost cluster fingerprint.
+    comm_overlap: int = 0
 
     @property
     def q_dim(self) -> int:

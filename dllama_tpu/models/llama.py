@@ -447,6 +447,113 @@ def _tap_stat(x: jax.Array) -> dict[str, jax.Array]:
     return {"rms": rms, "absmax": absmax, "nonfinite": nf, "q80_err": q80e}
 
 
+# Widest dispatch that still counts as the decode regime for the overlapped
+# merges: single steps (T=1), fused-chunk scan bodies (T=1), and speculative
+# verifies (T=K+1, small) ride the ring; prefill chunks (T >= 32) keep the
+# monolithic GSPMD psum — they are MXU-bound, so chunking their merge would
+# add launch overhead where there is no exposed collective wall to hide.
+_OVERLAP_MAX_WIDTH = 16
+
+
+def _overlapped_col_linear(cfg: ModelConfig, x: jax.Array, w,
+                           in_logical: str):
+    """TokenWeave-shaped col-split projection: the local partial matmul and
+    a CHUNKED ring merge inside one shard_map, so XLA can schedule chunk
+    i's ``ppermute`` hops concurrently with chunk j's dequant/accumulate
+    compute (parallel/qcollectives.overlapped_wire_psum; the q80 wire rides
+    the same hops when ``--wire q80``). Returns None when this geometry
+    keeps the monolithic GSPMD path: no plan / no tp resolution for
+    ``in_logical`` / non-divisible shapes / sp-pp meshes (their manual
+    regions can't nest another shard_map) / turbo weights (their integer
+    dot is fused per shard in ops.turbo) / prefill-wide dispatches."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..formats.quants import Q40_BLOCK_SIZE
+    from ..ops.linear import _fast_mode, dequantize_weight
+    from ..ops.turbo import TurboWeight
+    from ..parallel.qcollectives import overlapped_wire_psum
+
+    plan = _current_plan()
+    if (cfg.comm_overlap <= 1 or plan is None or x.ndim != 3
+            or isinstance(w, TurboWeight)
+            or x.shape[1] > _OVERLAP_MAX_WIDTH
+            or any(plan.axis_size(a) > 1 for a in ("sp", "pp"))):
+        return None
+    B, T, K = x.shape
+    k_ax = plan.resolve(in_logical)
+    if k_ax is None or K % plan._axis_size(k_ax) != 0:
+        return None
+    n = plan._axis_size(k_ax)
+    if n <= 1 or cfg.dim % cfg.comm_overlap != 0:
+        return None
+    dp_ax = plan.resolve("batch")
+    if dp_ax is not None and B % plan._axis_size(dp_ax) != 0:
+        dp_ax = None
+    quant = isinstance(w, QuantizedWeight)
+    if quant and (K // n) % Q40_BLOCK_SIZE != 0:
+        return None  # the scale plane's block rows can't split with codes
+    fast = quant and (_fast_mode(x) or w.scales.dtype == jnp.bfloat16)
+    out_dtype = x.dtype
+
+    def local(xl, *wl):
+        # f32 partials so the cross-device reduction doesn't round in bf16
+        # (same rule as quant_matmul_sharded's col-split merge)
+        if quant:
+            from ..ops.quant_matmul import pallas_local_choice, quant_matmul
+
+            sc, cd = wl
+            lw = QuantizedWeight(scales=sc, codes=cd)
+            # the ONE shared kernel rule (quant_matmul.pallas_local_choice)
+            # — flipping --comm-overlap never silently swaps the local
+            # matmul's numerics
+            kernel = pallas_local_choice(tuple(xl.shape), lw, fast)
+            if kernel is not None:
+                part = quant_matmul(xl.astype(jnp.float32), lw,
+                                    fast=fast, **kernel)
+            else:
+                wd = dequantize_weight(
+                    lw, dtype=jnp.bfloat16 if fast else xl.dtype)
+                part = jax.lax.dot_general(
+                    xl.astype(wd.dtype), wd,
+                    dimension_numbers=(((2,), (0,)), ((), ())),  # [K, D]
+                    preferred_element_type=jnp.float32)
+        else:
+            wd = wl[0].astype(xl.dtype)
+            part = jax.lax.dot_general(
+                xl, wd,
+                dimension_numbers=(((2,), (1,)), ((), ())),  # dense [D, K]
+                preferred_element_type=jnp.float32)
+        merged = overlapped_wire_psum(part, k_ax, n, cfg.comm_overlap)
+        return merged.astype(out_dtype)
+
+    if quant:
+        w_specs = (P(k_ax, None), P(k_ax, None))  # scales, codes shard K
+        w_leaves = (w.scales, w.codes)
+    else:
+        w_specs = (P(None, k_ax),)  # dense [out, in] shards the in dim
+        w_leaves = (w,)
+    fn = shard_map(
+        local, mesh=plan.mesh,
+        in_specs=(P(dp_ax, None, k_ax), *w_specs),
+        out_specs=P(dp_ax, None, None), check_vma=False)
+    from ..parallel.qcollectives import wire_poison_dp_scope
+
+    # under dp the shard-local "row 0" exists per dp group: name the axis
+    # so the wire poison site can pin the GLOBAL row 0 (one request)
+    with wire_poison_dp_scope(dp_ax):
+        return fn(x, *w_leaves)
+
+
+def _merge_linear(cfg: ModelConfig, x: jax.Array, w, in_logical: str):
+    """One col-split partial merge (wo or w2): the overlapped ring path
+    when ``--comm-overlap`` resolved chunks for this geometry, else the
+    plain :func:`linear` col-split (GSPMD psum / sharded Pallas kernel)."""
+    y = _overlapped_col_linear(cfg, x, w, in_logical)
+    if y is not None:
+        return y
+    return linear(x, w, in_axis=in_logical)
+
+
 def _attn_qkv(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
               cos: jax.Array, sin: jax.Array, positions: jax.Array, fq):
     """Attention prologue shared by the dense and paged layer steps:
@@ -475,7 +582,8 @@ def _attn_out_and_ffn(cfg: ModelConfig, x: jax.Array, att: jax.Array,
     """Layer epilogue shared by the dense and paged layer steps: output
     projection + residual, then the ffn half. Returns ``(x, stats|None)``."""
     B, T, _ = x.shape
-    x = x + fq(linear(fq(att.reshape(B, T, cfg.q_dim)), lp.wo, in_axis="heads"))
+    x = x + fq(_merge_linear(cfg, fq(att.reshape(B, T, cfg.q_dim)), lp.wo,
+                             "heads"))
     x = constrain(x, "batch", None, None)
     attn_stat = _tap_stat(x) if taps else None
 
@@ -487,7 +595,7 @@ def _attn_out_and_ffn(cfg: ModelConfig, x: jax.Array, att: jax.Array,
         gate = _hidden_act(cfg, linear(h, lp.w1, out_axis="hidden"))
         up = linear(h, lp.w3, out_axis="hidden")
         hidden = constrain(fq(gate * up), "batch", None, "hidden")
-        x = x + fq(linear(hidden, lp.w2, in_axis="hidden"))
+        x = x + fq(_merge_linear(cfg, hidden, lp.w2, "hidden"))
     x = constrain(x, "batch", None, None)
     if taps:
         return x, {"attn_out": attn_stat, "mlp_out": _tap_stat(x)}
@@ -840,10 +948,30 @@ def forward_with_taps(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 def _poison_logits(logits: jax.Array, poison: jax.Array) -> jax.Array:
     """Inject the failpoint's poison into the logits in-graph: 0 = clean
-    passthrough, 1 = NaN, >=2 = +Inf (numerics.POISON_CODES)."""
+    passthrough, 1 = NaN, 2 = +Inf (numerics.POISON_CODES). Codes >= 3
+    belong to the ``wire`` failpoint site (numerics.WIRE_POISON_CODES,
+    injected into the ring collectives' shipped partials by
+    parallel/qcollectives) and pass through clean here."""
     val = jnp.where(poison >= 2.0, jnp.float32(jnp.inf),
                     jnp.float32(jnp.nan))
-    return jnp.where(poison > 0.0, val.astype(logits.dtype), logits)
+    hit = jnp.logical_and(poison > 0.0, poison < 3.0)
+    return jnp.where(hit, val.astype(logits.dtype), logits)
+
+
+def _guarded_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                     start_pos: jax.Array, kv, poison: jax.Array,
+                     fwd=None):
+    """The guarded decode programs' forward: runs under
+    ``wire_poison_scope`` so the overlapped wire collectives (when the
+    trace contains them) carry the SAME traced poison scalar the logits
+    site uses — codes 1-2 poison logits, 3-4 poison this device's shipped
+    ring partial (batch row 0 only). One traced selector, so arming either
+    chaos site never recompiles. Unguarded programs (prefill, bench paths)
+    never enter the scope and trace no injection code at all."""
+    from ..parallel.qcollectives import wire_poison_scope
+
+    with wire_poison_scope(poison):
+        return (fwd or forward)(params, cfg, tokens, start_pos, kv)
 
 
 def _nonfinite_rows(logits: jax.Array) -> jax.Array:
@@ -858,7 +986,7 @@ def greedy_step_guarded(params: Params, cfg: ModelConfig, tokens: jax.Array,
     """:func:`greedy_step` + tripwire: returns ``((token, nonfinite), kv)``
     where ``nonfinite [B]`` counts non-finite lanes of the decode-step
     logits — the one row every emitted token is derived from."""
-    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    logits, kv = _guarded_forward(params, cfg, tokens, start_pos, kv, poison)
     last = _poison_logits(logits[:, -1, :], poison)
     tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
     return (tok, _nonfinite_rows(last)), kv
@@ -874,7 +1002,7 @@ def sampled_step_guarded(params: Params, cfg: ModelConfig, tokens: jax.Array,
     of the batch)."""
     from ..ops.sampling import sampled_token
 
-    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    logits, kv = _guarded_forward(params, cfg, tokens, start_pos, kv, poison)
     last = _poison_logits(logits[:, -1, :], poison)
     return (sampled_token(last, temperature, topp, coin),
             _nonfinite_rows(last)), kv
@@ -932,7 +1060,7 @@ def verify_step_guarded(params: Params, cfg: ModelConfig, tokens: jax.Array,
                         poison: jax.Array):
     """:func:`verify_step` + tripwire over all K+1 verify positions (every
     one of them can become an emitted token): ``((n_acc, preds, nf), kv)``."""
-    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    logits, kv = _guarded_forward(params, cfg, tokens, start_pos, kv, poison)
     logits = _poison_logits(logits, poison)
     preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
     ok = (tokens[:, 1:] == preds[:, :-1]).astype(jnp.int32)
@@ -950,7 +1078,7 @@ def ragged_verify_step_guarded(params: Params, cfg: ModelConfig,
     slot."""
     from ..ops.sampling import sampled_token
 
-    logits, kv = forward(params, cfg, tokens, pos_vec, kv)
+    logits, kv = _guarded_forward(params, cfg, tokens, pos_vec, kv, poison)
     logits = _poison_logits(logits, poison)
     preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
     ok = (tokens[:, 1:] == preds[:, :-1]).astype(jnp.int32)
@@ -1030,8 +1158,10 @@ def paged_sampled_step_guarded(params: Params, cfg: ModelConfig,
     poisoned request fails without touching the rest of the batch.
     Returns ``((token, nonfinite), pkv)``."""
     from ..ops.sampling import sampled_token
+    from ..parallel.qcollectives import wire_poison_scope
 
-    logits, pkv = paged_forward(params, cfg, tokens, pos_vec, pkv, tables)
+    with wire_poison_scope(poison):
+        logits, pkv = paged_forward(params, cfg, tokens, pos_vec, pkv, tables)
     last = _poison_logits(logits[:, -1, :], poison)
     return (sampled_token(last, temps, topps, coins),
             _nonfinite_rows(last)), pkv

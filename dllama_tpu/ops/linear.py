@@ -167,20 +167,22 @@ def _pallas_wanted(x: jax.Array, w: QuantizedWeight, fast: bool) -> bool:
 def _pallas_sharded(x: jax.Array, w: QuantizedWeight, out_axis: str | None,
                     in_axis: str | None, fast: bool):
     """Try the shard_map-wrapped kernel under the active plan; None → caller
-    falls back to XLA dequant+dot (auto-sharded via constraints)."""
-    mode = _kernel_mode()
-    if mode == "xla":
+    falls back to XLA dequant+dot (auto-sharded via constraints). The
+    mode/numerics gate is quant_matmul.pallas_mode_gate — the ONE rule
+    this, the overlapped merge, and the engine's wire pricing share
+    (fast mode: XLA fused dequant wins, see _pallas_wanted)."""
+    from .quant_matmul import pallas_mode_gate, quant_matmul_sharded
+
+    kw = pallas_mode_gate(fast)
+    if kw is None:
         return None
-    if mode != "pallas" and (fast or not _on_tpu()):
-        return None  # fast mode: XLA fused dequant wins (see _pallas_wanted)
     if x.ndim != 3 or w.codes.ndim != 2:
         return None  # stacked (scan-external) or 2-D activations: XLA path
     from ..parallel.api import current_plan
-    from .quant_matmul import quant_matmul_sharded
 
     return quant_matmul_sharded(
         current_plan(), x, w, out_axis=out_axis, in_axis=in_axis,
-        interpret=mode == "pallas" and not _on_tpu(), fast=fast)
+        interpret=kw["interpret"], fast=fast)
 
 
 def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,

@@ -255,6 +255,37 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
     return fn(x, w.scales, w.codes)
 
 
+def pallas_mode_gate(fast: bool) -> dict | None:
+    """The ONE mode/numerics gate for the sharded Pallas kernel: Pallas
+    only for exact mode on TPU, or when forced
+    (``DLLAMA_TPU_QUANT_KERNEL=pallas`` — interpret mode off-TPU, the
+    test path). Returns the :func:`quant_matmul` kwargs (currently just
+    ``interpret``) or None (XLA fused dequant+dot). Consulted by
+    ops.linear._pallas_sharded, the overlapped merge's
+    :func:`pallas_local_choice`, and the engine's wire pricing — one
+    rule, so none of them can drift from what linear() dispatches."""
+    from .linear import _kernel_mode, _on_tpu  # lazy: linear imports us
+
+    mode = _kernel_mode()
+    if mode == "xla":
+        return None
+    if mode != "pallas" and (fast or not _on_tpu()):
+        return None
+    return {"interpret": mode == "pallas" and not _on_tpu()}
+
+
+def pallas_local_choice(x_shape: tuple[int, ...], w: QuantizedWeight,
+                        fast: bool) -> dict | None:
+    """:func:`pallas_mode_gate` + the shard-shape ``supports`` check —
+    the per-shard kernel rule for the overlapped col-split merge
+    (models.llama._overlapped_col_linear) and host-side pricing probes.
+    ``w`` may carry ShapeDtypeStruct leaves."""
+    kw = pallas_mode_gate(fast)
+    if kw is None or not supports(tuple(x_shape), w):
+        return None
+    return kw
+
+
 # Largest M the un-tiled batch axis may take: x block + out block + dequant
 # scratch must fit VMEM (~16MB) alongside double-buffered weight tiles.
 MAX_M = 512

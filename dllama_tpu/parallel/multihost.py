@@ -319,6 +319,9 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
         # dense-bf16 vs quantized logits head compile different programs;
         # fingerprint the resolved decision (knob + numerics mode)
         1 if _dense_logits(engine.cfg.compute_dtype) else 0,
+        # overlapped-collective chunk count (--comm-overlap): the chunked
+        # ring merges are a different traced program than the GSPMD psum
+        engine.cfg.comm_overlap,
     ], dtype=np.int32)
     root_fp = np.asarray(multihost_utils.broadcast_one_to_all(
         fp, is_source=jax.process_index() == 0))
@@ -332,7 +335,8 @@ def validate_cluster_config(engine: "InferenceEngine") -> None:
             f"multihost config mismatch on process {jax.process_index()}: "
             f"local [n_batches, tp, sp, pp, dp, seq_len, n_layers, dim, vocab, "
             f"sync_q80, dtype, weight_mode, attn_impl, moe_impl, kv_dtype, "
-            f"spec_lookup, quant_mode, wire, scan_unroll, dense_logits] = "
+            f"spec_lookup, quant_mode, wire, scan_unroll, dense_logits, "
+            f"comm_overlap] = "
             f"{fp.tolist()} vs root {root_fp.tolist()} — start every process "
             f"with identical model files and flags")
     if any_bad.sum() > 0:

@@ -35,7 +35,9 @@ ops/quant_matmul.quant_matmul_sharded; GSPMD-inserted psums (the XLA
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -114,7 +116,11 @@ def psum_q80_ring(x: jax.Array, axis_name, n: int) -> jax.Array:
 
     perm = [(i, (i + 1) % n) for i in range(n)]
     idx = jax.lax.axis_index(axis_name)
-    chunks = x.astype(jnp.float32).reshape(*lead, n, d // n)
+    # the `wire` failpoint covers THIS formulation too (the past-crossover
+    # route of both wire_psum and ring_wire_psum): poison the local
+    # partial before it is chunked/quantized, same row-0 blast radius
+    vf = _maybe_poison_partial(x.astype(jnp.float32))
+    chunks = vf.reshape(*lead, n, d // n)
 
     def take(i):
         # device-dependent chunk selection: a one-hot contraction instead
@@ -193,3 +199,242 @@ def wire_psum(x: jax.Array, axis_name,
     if x.shape[-1] % (total * _BLOCK) == 0:
         return psum_q80_ring(x, axis_name, total)
     return jax.lax.psum(x, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Overlapped (TokenWeave-shaped) ring reductions — the --comm-overlap path
+# ---------------------------------------------------------------------------
+#
+# One monolithic all-reduce serializes against everything: XLA cannot start
+# the layer's next matmul until the collective's bytes land. Splitting the
+# per-layer partial merge into chunks and reducing each chunk with its own
+# chain of ``ppermute`` hops (collective-permute lowers to async start/done
+# pairs) gives the latency-hiding scheduler independent DAGs: chunk i's
+# in-flight hops overlap chunk i+1's local compute — the matmul slice that
+# produces it, the dequant-sum that consumes it (TokenWeave's
+# compute/communication overlap, PAPERS.md, at the granularity XLA can
+# schedule without a custom runtime). The q80 wire rides the same hops
+# (EQuARX direction): each device quantizes its partial ONCE, the int8/f16
+# planes forward around the ring unchanged, and every contribution is
+# dequantized and accumulated in f32 — numerics bit-identical to
+# :func:`psum_q80_wire`'s all-gather merge (same one-quantization-per-
+# partial rule, same rank-order sum), so goldens and error bounds transfer.
+
+
+class _WirePoison(threading.local):
+    poison = None
+    dp_axis = None
+
+
+_wire_poison_state = _WirePoison()
+
+
+@contextlib.contextmanager
+def wire_poison_scope(poison):
+    """Make the guarded decode programs' traced poison scalar visible to the
+    wire collectives below (the ``wire`` failpoint's in-graph injection
+    site). ``poison`` is a TRACER during trace — the scope is trace-time
+    plumbing, exactly like ``use_plan``; outside any scope the injection
+    code is never traced, so prefill and unguarded programs stay
+    byte-identical."""
+    prev = _wire_poison_state.poison
+    _wire_poison_state.poison = poison
+    try:
+        yield
+    finally:
+        _wire_poison_state.poison = prev
+
+
+@contextlib.contextmanager
+def wire_poison_dp_scope(dp_axis):
+    """Name the batch-sharding mesh axis for the poison site below: under
+    ``dp`` the shard_map-local "row 0" exists once PER dp shard, so the
+    injection additionally gates on ``axis_index(dp_axis) == 0`` to keep
+    the documented blast radius of exactly ONE global request. Entered by
+    the overlapped merge around its shard_map call (trace-time, like
+    :func:`wire_poison_scope`)."""
+    prev = _wire_poison_state.dp_axis
+    _wire_poison_state.dp_axis = dp_axis
+    try:
+        yield
+    finally:
+        _wire_poison_state.dp_axis = prev
+
+
+def _maybe_poison_partial(x: jax.Array) -> jax.Array:
+    """The ``wire`` failpoint site: corrupt THIS device's shipped partial
+    (the payload every ring hop forwards) for GLOBAL batch row 0 only
+    (local row 0 of dp shard 0 — see :func:`wire_poison_dp_scope`),
+    driven by the ambient poison scalar
+    (``runtime.numerics.WIRE_POISON_CODES``: 3 = NaN, >=4 = +Inf; 0-2 are
+    clean here — they belong to the ``logits`` site). The selector is
+    traced, so arming chaos never recompiles; row-0-only corruption
+    proves the downstream non-finite tripwire contains the blast radius
+    to one request."""
+    p = _wire_poison_state.poison
+    if p is None:
+        return x
+    bad = jnp.where(p >= 4.0, jnp.float32(jnp.inf), jnp.float32(jnp.nan))
+    hit = p >= 3.0
+    dp_ax = _wire_poison_state.dp_axis
+    if dp_ax is not None:
+        hit = jnp.logical_and(hit, jax.lax.axis_index(dp_ax) == 0)
+    if x.ndim >= 2:
+        row0 = jnp.arange(x.shape[0])[(...,) + (None,) * (x.ndim - 1)] == 0
+        return jnp.where(jnp.logical_and(hit, row0), bad.astype(x.dtype), x)
+    return jnp.where(hit, bad.astype(x.dtype), x)
+
+
+def _ring_rank_order_sum(x: jax.Array, axis_name, n: int,
+                         quantized: bool) -> jax.Array:
+    """All-reduce ONE chunk via n-1 ``ppermute`` forwarding hops, summing
+    the n contributions in RANK order. Key properties:
+
+    * the reassembly (reverse + roll by ``axis_index``) is pure data
+      movement, so every device computes the identical rank-ordered sum —
+      replicas are bit-identical (fp addition is non-associative; a
+      per-device hop-order sum would desync downstream SPMD decisions);
+    * ``quantized`` ships Q80 planes (1.0625 B/value) and dequant-sums in
+      f32 — bit-identical to :func:`psum_q80_wire` (all_gather prepends
+      participants in rank order and sums axis 0; same values, same
+      reduce shape);
+    * wire per device is ``(n-1)`` hop payloads — same bytes as the
+      all-gather formulation, but as a chain of async permutes whose
+      in-flight time XLA can hide behind other chunks' compute.
+    """
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    idx = jax.lax.axis_index(axis_name)
+    vf = _maybe_poison_partial(x.astype(jnp.float32))
+    if quantized:
+        from ..ops.linear import q80_dequant, q80_quantize_planes
+
+        payload = q80_quantize_planes(vf)
+
+        def deq(pl):
+            return q80_dequant(pl[0], pl[1], vf.shape)
+    else:
+        payload = (vf,)
+
+        def deq(pl):
+            return pl[0]
+
+    # after k forwarding hops this device holds rank (idx - k) % n's payload
+    contribs = [deq(payload)]
+    for _ in range(n - 1):
+        payload = tuple(jax.lax.ppermute(p, axis_name, perm)
+                        for p in payload)
+        contribs.append(deq(payload))
+    stacked = jnp.stack(contribs)  # [n(hop), ...]
+    # hop->rank reindex: want ordered[r] = stacked[(idx - r) % n]; with
+    # R = stacked[::-1], roll(R, idx + 1)[r] = stacked[(idx - r) % n] —
+    # exact data movement, no one-hot contraction to round through
+    ordered = jnp.roll(stacked[::-1], idx + 1, axis=0)
+    return jnp.sum(ordered, axis=0).astype(x.dtype)
+
+
+def ring_wire_psum(x: jax.Array, axis_name, n: int) -> jax.Array:
+    """One chunk's ring all-reduce with the ambient wire format: q80 planes
+    when ``DLLAMA_TPU_WIRE=q80`` and the trailing axis is block-divisible
+    (below the crossover: forwarded-planes rank-order merge, bit-identical
+    to :func:`psum_q80_wire`; past it: the requantizing
+    :func:`psum_q80_ring`, constant ~3.76x wire win), else the f32 ring.
+    The building block :func:`overlapped_wire_psum` and the model's
+    overlapped col-split merges chunk over."""
+    if wire_q80() and x.shape[-1] % _BLOCK == 0:
+        if n <= _MAX_WIRE_PARTS or x.shape[-1] % (n * _BLOCK) != 0:
+            return _ring_rank_order_sum(x, axis_name, n, quantized=True)
+        return psum_q80_ring(x, axis_name, n)
+    return _ring_rank_order_sum(x, axis_name, n, quantized=False)
+
+
+def overlap_chunks(requested: int | str, d: int, *,
+                   auto_chunks: int = 4) -> int:
+    """Resolve a ``--comm-overlap`` value against the reduction width ``d``
+    (the model dim — both per-layer merges produce ``[B, T, dim]``).
+    ``"off"``/0 → 0. ``"auto"`` → the largest candidate ≤ ``auto_chunks``
+    whose chunks stay Q80-block-divisible (so a later ``--wire q80`` can
+    always ride them), degrading to 0 when none fits. An explicit N must
+    divide cleanly or the caller should refuse loudly (ValueError here)."""
+    if requested in (0, "0", "off", None, ""):
+        return 0
+    if requested == "auto":
+        c = auto_chunks
+        while c > 1 and (d % c != 0 or (d // c) % _BLOCK != 0):
+            c //= 2
+        return c if c > 1 else 0
+    try:
+        n = int(requested)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"--comm-overlap must be 'off', 'auto', or an integer chunk "
+            f"count, got {requested!r}") from None
+    if n < 2:
+        raise ValueError(f"--comm-overlap chunk count must be >= 2 "
+                         f"(or 'off'/'auto'), got {requested!r}")
+    if d % n != 0:
+        raise ValueError(f"--comm-overlap {n} does not divide the model "
+                         f"dim {d} (the per-layer merge width)")
+    return n
+
+
+def overlapped_wire_psum(x: jax.Array, axis_name, n: int,
+                         n_chunks: int) -> jax.Array:
+    """The overlapped all-reduce: split the trailing axis into ``n_chunks``
+    contiguous chunks and reduce each with its own :func:`ring_wire_psum`
+    hop chain. The chunks' DAGs are mutually independent, so chunk i's
+    in-flight hops overlap chunk j's dequant/accumulate compute under
+    XLA's scheduler. Contiguous trailing-axis splits are layout-preserving
+    (no transpose on either side), so the fused residual+norm that
+    consumes the merged result stays as cheap as the monolithic path.
+    Numerics: chunking is elementwise-invariant — bit-identical to
+    ``n_chunks=1`` for both wire formats."""
+    d = x.shape[-1]
+    if n_chunks <= 1 or d % n_chunks != 0:
+        return ring_wire_psum(x, axis_name, n)
+    c = d // n_chunks
+    parts = [ring_wire_psum(
+        jax.lax.slice_in_dim(x, i * c, (i + 1) * c, axis=x.ndim - 1),
+        axis_name, n) for i in range(n_chunks)]
+    return jnp.concatenate(parts, axis=-1)
+
+
+def wire_traffic_model(dim: int, n: int, n_chunks: int, q80: bool, *,
+                       q80_explicit: bool = False
+                       ) -> list[tuple[str, str, float]]:
+    """Analytic per-(row, position) wire bytes of ONE col-split partial
+    merge over ``n`` participants — the host-side accounting behind
+    ``dllama_collective_bytes_total{op,wire}`` (the compiled-HLO
+    TrafficStats is the exact oracle; this model prices the same ops
+    without an AOT compile on the hot path). Returns
+    ``[(op, wire, bytes_per_value * dim)]``.
+
+    * overlap off, GSPMD merge: one XLA all-reduce, ``2(n-1)/n × 4``
+      B/value (f32 — the GSPMD-inserted psum is not interceptable, so
+      q80 never applies there);
+    * overlap off, EXPLICIT col-split merge (``q80_explicit``: the
+      sharded Pallas kernel path routes through :func:`wire_psum`) with
+      q80 on: the all-gather formulation ``(n-1) × 1.0625`` B/value
+      below the crossover, ``psum_q80_ring``'s ``2(n-1)/n × 1.0625``
+      past it — mirroring :func:`wire_psum`'s dispatch;
+    * overlapped f32 ring: ``(n-1) × 4`` B/value of ppermute hops;
+    * overlapped q80 (below crossover): ``(n-1) × 1.0625`` B/value;
+    * overlapped q80 past crossover (``psum_q80_ring``): ``2(n-1)/n ×
+      1.0625`` B/value (reduce-scatter + all-gather halves, quantized).
+    """
+    if n <= 1:
+        return []
+    q80_bpv = 1.0 + 2.0 / _BLOCK  # int8 code + f16 scale per 32-block
+    if n_chunks <= 0:
+        if q80 and q80_explicit and dim % _BLOCK == 0:
+            if n <= _MAX_WIRE_PARTS:
+                return [("all_gather", "q80", (n - 1) * q80_bpv * dim)]
+            if dim % (n * _BLOCK) == 0:
+                return [("ppermute", "q80",
+                         2.0 * (n - 1) / n * q80_bpv * dim)]
+        return [("all_reduce", "f32", 2.0 * (n - 1) / n * 4.0 * dim)]
+    if not q80 or dim % (n_chunks * _BLOCK) != 0:
+        return [("ppermute", "f32", (n - 1) * 4.0 * dim)]
+    chunk = dim // n_chunks
+    if n <= _MAX_WIRE_PARTS or chunk % (n * _BLOCK) != 0:
+        return [("ppermute", "q80", (n - 1) * q80_bpv * dim)]
+    return [("ppermute", "q80", 2.0 * (n - 1) / n * q80_bpv * dim)]

@@ -135,6 +135,7 @@ class InferenceEngine:
                  multihost: bool = False, host_sampling: bool = False,
                  decode_chunk: int = 1, spec_lookup: int = 0,
                  kv_dtype: str = "auto", kv_block_size: int = 0,
+                 comm_overlap: int | str = "off",
                  profile_split: bool = False,
                  verify_weights: bool = False,
                  numerics_taps: bool = False,
@@ -302,6 +303,59 @@ class InferenceEngine:
         if tp > 1:
             validate_tp(self.cfg, tp)
 
+        # overlapped multichip decode (--comm-overlap {off,auto,N},
+        # parallel/qcollectives): resolve the per-merge chunk count against
+        # the model dim and refuse unsupported combos up front, the same
+        # startup-refusal discipline as --kv-block-size. The resolved count
+        # is STATIC trace config (cfg.comm_overlap), so the knob can never
+        # retrace mid-serving and multihost fingerprints it.
+        from ..parallel.qcollectives import overlap_chunks, wire_q80
+
+        requested = "off" if comm_overlap is None else comm_overlap
+        explicit = requested not in ("off", "auto", 0, "0", None, "")
+        n_chunks = overlap_chunks(requested, self.cfg.dim)  # raises on bad N
+        if n_chunks and tp <= 1:
+            if explicit:
+                raise ValueError(
+                    f"--comm-overlap {requested} needs a tensor-parallel "
+                    f"mesh to have a collective to overlap (run with "
+                    f"--tp >= 2, or use 'auto' to degrade on one device)")
+            n_chunks = 0  # auto on a single device: nothing to overlap
+        if n_chunks:
+            from ..models.llama import _OVERLAP_MAX_WIDTH
+
+            unsupported = [
+                ("--sp > 1", sp > 1),
+                ("--pp > 1", pp > 1),
+                ("--weight-mode offload", weight_mode == "offload"),
+                # turbo weights skip the overlapped merge entirely
+                # (models.llama._overlapped_col_linear returns None for
+                # TurboWeight) — a knob that silently does nothing while
+                # the banner/pricing say otherwise must refuse instead
+                ("--quant-mode turbo/turbo16",
+                 turbo_mode() is not None),
+                # a verify dispatch is K+1 columns wide; past the overlap
+                # width gate it would trace the monolithic psum while
+                # plain greedy traces the ring — their f32 sum orders
+                # differ in low ulps, so the engine's "spec output is
+                # bit-identical to plain greedy" invariant would silently
+                # break on near-tie logits
+                (f"--spec-lookup > {_OVERLAP_MAX_WIDTH - 1}",
+                 self.spec_lookup + 1 > _OVERLAP_MAX_WIDTH),
+            ]
+            bad = [name for name, hit in unsupported if hit]
+            if bad:
+                raise ValueError(
+                    f"--comm-overlap (overlapped collectives) does not "
+                    f"support {', '.join(bad)} yet — their manual-SPMD "
+                    f"regions can't nest the ring shard_map (turbo: its "
+                    f"integer-dot path has no overlapped merge); drop "
+                    f"those flags or --comm-overlap")
+        if n_chunks:
+            from dataclasses import replace as _replace
+
+            self.cfg = _replace(self.cfg, comm_overlap=n_chunks)
+
         # multi-host SPMD (reference: root + workers co-executing,
         # app.cpp:164-226): non-zero processes mirror dispatches via the
         # control broadcast (parallel.multihost); logits come back replicated
@@ -338,6 +392,50 @@ class InferenceEngine:
             _repr = ("bf16" if self.cfg.compute_dtype == "bfloat16"
                      else "f32")
         self.hbm_weight_repr = _repr
+        # analytic per-token collective wire bytes of the col-split merges
+        # (qcollectives.wire_traffic_model), priced PER MERGE: a merge
+        # whose geometry makes the overlapped path fall back (K not
+        # tp-divisible, or a quantized shard whose scale rows can't
+        # split) must be priced as the monolithic path it actually
+        # traces, or dllama_collective_bytes_total would report
+        # collectives that never execute. q80_explicit mirrors whether
+        # the sharded Pallas col-split (which routes through wire_psum)
+        # would carry the merge when overlap is off.
+        from ..formats.quants import QUANT_BLOCK_SIZE as _QBS
+        from ..ops.linear import QuantizedWeight as _QW
+        from ..ops.linear import fast_numerics_resolved as _fast_res
+        from ..ops.quant_matmul import pallas_local_choice
+        from ..parallel.qcollectives import wire_traffic_model
+
+        quant_planes = _repr in ("q40", "q80") and turbo_mode() is None
+        _by_key: dict = {}
+        for k_dim in ([self.cfg.q_dim] if self.cfg.is_moe
+                      else [self.cfg.q_dim, self.cfg.hidden_dim]):
+            chunks = self.cfg.comm_overlap
+            if chunks and (k_dim % tp != 0
+                           or (quant_planes
+                               and (k_dim // tp) % _QBS != 0)):
+                chunks = 0  # this merge keeps the monolithic path
+            q80_explicit = False
+            if not chunks and quant_planes and tp > 1 \
+                    and (k_dim // tp) % _QBS == 0:
+                k_loc = k_dim // tp
+                lw = _QW(  # shapes only — the host-side pricing probe
+                    scales=jax.ShapeDtypeStruct((k_loc // _QBS,
+                                                 self.cfg.dim),
+                                                jnp.float32),
+                    codes=jax.ShapeDtypeStruct((k_loc, self.cfg.dim),
+                                               jnp.int8))
+                q80_explicit = pallas_local_choice(
+                    (1, 1, k_loc), lw,
+                    _fast_res(self.cfg.compute_dtype)) is not None
+            for op, wire_fmt, b in wire_traffic_model(
+                    self.cfg.dim, tp, chunks, wire_q80(),
+                    q80_explicit=q80_explicit):
+                _by_key[(op, wire_fmt)] = (_by_key.get((op, wire_fmt), 0.0)
+                                           + b * self.cfg.n_layers)
+        self._wire_traffic = [(op, w, b)
+                              for (op, w), b in sorted(_by_key.items())]
         # weights shard over tp and pp only — dp replicates them, and
         # batch-1 KV degrades to replicated under dp too
         est = estimate_device_bytes(
@@ -370,6 +468,7 @@ class InferenceEngine:
         self._m_prefill_tok = self._tm.counter(telemetry.PREFILL_TOKENS)
         self._m_step_ms = self._tm.histogram(telemetry.DECODE_STEP_MS)
         self._m_decode_tok = self._tm.counter(telemetry.DECODE_TOKENS)
+        self._m_coll_bytes = self._tm.counter(telemetry.COLLECTIVE_BYTES)
         self._m_kv = self._tm.gauge(telemetry.KV_OCCUPANCY)
         # request id stamped onto trace spans by the serving layer (the
         # engine itself has no request concept; -1 = unattributed)
@@ -775,6 +874,7 @@ class InferenceEngine:
             numerics.check_nonfinite(nf, "decode", failfast=self.nf_failfast)
         self._m_step_ms.record((time.perf_counter() - t0) * 1000.0)
         self._m_decode_tok.inc()
+        self.count_collective_bytes()
         self._m_kv.set(self.pos / self.cfg.seq_len)
         return int(nxt[0])
 
@@ -891,6 +991,14 @@ class InferenceEngine:
                                  failfast=self.nf_failfast and self._is_root)
         return out
 
+    def count_collective_bytes(self, n_tokens: int = 1) -> None:
+        """Charge ``n_tokens`` emitted decode tokens' analytic wire bytes
+        into ``dllama_collective_bytes_total{op,wire}`` (the per-token
+        price was fixed at construction — the traced program can't change
+        mid-serving). No-op on a single device (no merges cross a wire)."""
+        for op, wire, bytes_ in self._wire_traffic:
+            self._m_coll_bytes.inc(bytes_ * n_tokens, op=op, wire=wire)
+
     def commit_chunk(self, n_keep: int) -> None:
         """Advance position and sampler RNG by the kept prefix of a chunk."""
         self.pos += n_keep
@@ -900,6 +1008,7 @@ class InferenceEngine:
                 _, st = xorshift_random_f32(st)
             self.sampler.rng_state = st
         self._m_decode_tok.inc(n_keep)
+        self.count_collective_bytes(n_keep)
         self._m_kv.set(self.pos / self.cfg.seq_len)
 
     # -- compile/HBM introspection -------------------------------------------
@@ -1045,6 +1154,8 @@ class InferenceEngine:
                 self.traffic.n_collectives)
         if self.split is not None:
             self._tm.gauge(telemetry.SYNC_FRACTION).set(self.split.sync_frac)
+            self._tm.gauge(telemetry.COMM_EXPOSED_MS).set(
+                self.split.exposed_ms)
         if self.split_prefill is not None:
             self._tm.gauge(telemetry.SYNC_FRACTION_PREFILL).set(
                 self.split_prefill.sync_frac)

@@ -58,6 +58,14 @@ have at least one call site:
   BlockPool.alloc``): a ``raise`` here simulates block-pool exhaustion,
   which must degrade to queueing (admission) or an explicit per-request
   failure (mid-decode growth), never a crash.
+* ``wire`` — the overlapped wire collectives' shipped partial
+  (``runtime/numerics.poison_code``, injected in-graph by
+  ``parallel/qcollectives._maybe_poison_partial``): the ``nonfinite``
+  action corrupts THIS device's ring-hop payload for batch row 0 only
+  (NaN/Inf per the mode), proving a dropped/corrupt quantized hop trips
+  the non-finite tripwire and fails only the affected request,
+  503-shaped. Requires a trace that contains the ring collectives
+  (``--comm-overlap`` on a tp mesh).
 """
 
 from __future__ import annotations

@@ -110,6 +110,14 @@ def collecting_taps():
 # dispatch ships the matching code as a traced scalar — 0.0 means clean.
 POISON_CODES = {"nan": 1.0, "inf": 2.0}
 
+# the `wire` failpoint's codes ride the SAME traced scalar but a disjoint
+# range: >= 3 corrupts THIS device's shipped ring-collective partial
+# (batch row 0 only — parallel/qcollectives._maybe_poison_partial) instead
+# of the logits, proving a poisoned quantized hop trips the tripwire for
+# exactly one request. Only reachable when the trace contains the
+# overlapped/ring wire collectives (--comm-overlap on a tp mesh).
+WIRE_POISON_CODES = {"nan": 3.0, "inf": 4.0}
+
 # module state for GET /debug/numerics: last counts per site + last taps
 _state_lock = threading.Lock()
 _last_nonfinite: dict[str, int] = {}
@@ -117,13 +125,17 @@ _last_taps: dict | None = None
 
 
 def poison_code() -> float:
-    """Fire the ``logits`` failpoint for this dispatch; returns the
-    in-graph poison code (0.0 = clean). Raise-type actions armed on the
-    site propagate as usual."""
+    """Fire the ``logits`` then ``wire`` failpoints for this dispatch;
+    returns the in-graph poison code (0.0 = clean; 1-2 poison the logits,
+    3-4 poison the wire collective's shipped partial). Raise-type actions
+    armed on either site propagate as usual."""
     mode = failpoints.fire("logits")
-    if not mode:
-        return 0.0
-    return POISON_CODES.get(str(mode), POISON_CODES["nan"])
+    if mode:
+        return POISON_CODES.get(str(mode), POISON_CODES["nan"])
+    mode = failpoints.fire("wire")
+    if mode:
+        return WIRE_POISON_CODES.get(str(mode), WIRE_POISON_CODES["nan"])
+    return 0.0
 
 
 def record_nonfinite(count: int, site: str) -> None:

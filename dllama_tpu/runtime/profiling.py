@@ -185,6 +185,12 @@ class EvalSyncSplit:
     sync_ms: float        # collective + rendezvous time per step per device
     n_steps: int          # steps profiled
     n_lanes: int          # device lanes seen in the trace
+    # EXPOSED collective wall: sync lane time NOT covered by concurrent
+    # compute on the same lane (union(sync ∪ eval) − union(eval)) — the
+    # serialization cost a compute/communication-overlapped program shrinks
+    # even when total collective time grows. Published as
+    # dllama_comm_exposed_ms by engine.measure_split.
+    exposed_ms: float = 0.0
 
     @property
     def sync_frac(self) -> float:
@@ -200,7 +206,7 @@ def split_from_trace(trace_dir: str, n_steps: int) -> EvalSyncSplit:
         raise RuntimeError(f"no xplane.pb under {trace_dir}")
     xs = _load_xplane(max(pbs, key=os.path.getmtime))
 
-    sync_ms = eval_ms = 0.0
+    sync_ms = eval_ms = exposed_ms = 0.0
     n_lanes = 0
     for plane, line in _device_lines(xs):
         evmeta = plane.event_metadata
@@ -219,11 +225,18 @@ def split_from_trace(trace_dir: str, n_steps: int) -> EvalSyncSplit:
         sync_ms += s
         # compute time nested under / overlapping a sync span counts once,
         # as sync (it is time the lane spent inside the collective)
-        eval_ms += max(0.0, _union_ms(eval_iv + sync_iv) - s)
+        both = _union_ms(eval_iv + sync_iv)
+        ev_only = _union_ms(eval_iv)
+        eval_ms += max(0.0, both - s)
+        # exposed = sync wall with no concurrent compute on this lane:
+        # union(sync ∪ eval) − union(eval). A collective fully hidden
+        # behind compute contributes sync time but zero exposed time.
+        exposed_ms += max(0.0, both - ev_only)
     lanes = max(1, n_lanes)
     return EvalSyncSplit(eval_ms=eval_ms / lanes / max(1, n_steps),
                          sync_ms=sync_ms / lanes / max(1, n_steps),
-                         n_steps=n_steps, n_lanes=n_lanes)
+                         n_steps=n_steps, n_lanes=n_lanes,
+                         exposed_ms=exposed_ms / lanes / max(1, n_steps))
 
 
 def measure_eval_sync(step, n_steps: int = 3) -> EvalSyncSplit:

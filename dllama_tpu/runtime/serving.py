@@ -405,6 +405,9 @@ class _GeneratorCore:
         self._m_step_ms.record(ms)
         if emitted:
             self._m_tokens.inc(emitted)
+            # analytic col-split wire bytes per emitted token (the batched
+            # twin of the engine decode paths' accounting)
+            self.eng.count_collective_bytes(emitted)
         self._m_kv.set(self._kv_fraction())
         self.flight.note_dispatch(ms, n_active, emitted)
 

@@ -55,6 +55,10 @@ SYNC_FRACTION_PREFILL = "dllama_sync_fraction_prefill"
 COLLECTIVE_SENT_KB = "dllama_collective_sent_kb_per_token"
 COLLECTIVE_RECV_KB = "dllama_collective_recv_kb_per_token"
 COLLECTIVE_OPS = "dllama_collective_ops_per_step"
+# overlapped/quantized multichip decode (parallel/qcollectives.py,
+# published by runtime/engine.py + runtime/serving.py)
+COLLECTIVE_BYTES = "dllama_collective_bytes_total"
+COMM_EXPOSED_MS = "dllama_comm_exposed_ms"
 
 # batched serving (runtime/serving.py)
 QUEUE_WAIT_MS = "dllama_queue_wait_ms"
@@ -171,6 +175,17 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "Per-token per-device collective bytes received, kB"),
     _spec(COLLECTIVE_OPS, "gauge",
           "Collective ops executed per decode step"),
+    _spec(COLLECTIVE_BYTES, "counter",
+          "Analytic per-device wire bytes moved by the explicit col-split "
+          "partial merges, by collective op (all_reduce/ppermute) and wire "
+          "format (f32/q80) — qcollectives.wire_traffic_model priced per "
+          "emitted decode token (the compiled-HLO TrafficStats gauges are "
+          "the exact per-program oracle)"),
+    _spec(COMM_EXPOSED_MS, "gauge",
+          "EXPOSED collective wall per decode step from the last profiler "
+          "capture (measure_split): collective lane time not covered by "
+          "concurrent compute — the quantity --comm-overlap exists to "
+          "shrink; 0 until a capture ran"),
     _spec(QUEUE_WAIT_MS, "histogram",
           "Submit-to-admission wait in the batch scheduler queue"),
     _spec(QUEUE_DEPTH, "gauge", "Requests waiting for a slot"),

@@ -871,6 +871,17 @@ def run_api_server(args) -> int:
         introspection.hbm_startup_report(engine)
     except Exception as e:  # noqa: BLE001 — the report is advisory; serving must start anyway
         print(f"🚧 HBM startup report unavailable: {type(e).__name__}: {e}")
+    if engine._wire_traffic:
+        # multichip wire price (analytic, parallel/qcollectives
+        # .wire_traffic_model): what each emitted token costs the ICI/DCN
+        # in col-split merge bytes, counted live into
+        # dllama_collective_bytes_total{op,wire}
+        per_tok = sum(b for _, _, b in engine._wire_traffic)
+        modes = ", ".join(sorted({f"{op}/{wire}"
+                                  for op, wire, _ in engine._wire_traffic}))
+        print(f"🕸️ multichip wire: ~{per_tok / 1024:.1f} kB/token of "
+              f"col-split merges ({modes}) → "
+              f"dllama_collective_bytes_total")
     if getattr(args, "stats", 0):
         start_stats_reporter(float(args.stats))
     # golden canary drift sentinel (--canary-interval SEC): record the

@@ -108,6 +108,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "block-level sharing + copy-on-write. N must be a "
                         "power of two tiling the padded context; 0 (the "
                         "default) keeps the dense slot pool")
+    p.add_argument("--comm-overlap", default="off", metavar="{off,auto,N}",
+                   help="compute/communication overlap for the two per-"
+                        "layer tp partial merges (parallel/qcollectives): "
+                        "split each merge into N chunks reduced by "
+                        "independent ppermute ring chains so chunk i's "
+                        "in-flight hops overlap chunk i+1's compute "
+                        "(TokenWeave shape; the q80 wire rides the same "
+                        "hops under --wire q80). 'auto' picks the largest "
+                        "Q80-block-divisible chunking <= 4 and degrades "
+                        "to off on one device; an explicit N must divide "
+                        "the model dim and needs --tp >= 2. Decode-regime "
+                        "dispatches only; prefill keeps the monolithic "
+                        "psum")
     p.add_argument("--nbatches", type=int, default=None,
                    help="pin a fixed prefill chunk size (reference default "
                         "32, app.cpp:28); unset = TPU-sized adaptive "
@@ -431,6 +444,7 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         spec_lookup=getattr(args, "spec_lookup", 0),
         kv_dtype=getattr(args, "kv_dtype", "auto"),
         kv_block_size=getattr(args, "kv_block_size", 0),
+        comm_overlap=getattr(args, "comm_overlap", "off"),
         profile_split=getattr(args, "profile_split", False),
         verify_weights=getattr(args, "verify_weights", False),
         numerics_taps=getattr(args, "numerics_taps", False),
@@ -442,6 +456,14 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
           f"Heads: {h.n_heads}/{h.n_kv_heads}  SeqLen: {h.seq_len}")
     print(f"🕸️ TP devices: {engine.tp}  SP devices: {engine.sp}  "
           f"PP stages: {engine.pp}")
+    if engine.cfg.comm_overlap:
+        # the ACTUAL wire format(s), from the same per-merge pricing the
+        # metrics use — non-32-divisible chunks ride f32 hops even under
+        # --wire q80, and the banner must not contradict /metrics labels
+        wires = sorted({w for _, w, _ in engine._wire_traffic}) or ["f32"]
+        print(f"🕸️ overlapped collectives: {engine.cfg.comm_overlap} "
+              f"chunks per merge, {'/'.join(wires)} wire "
+              f"(dllama_comm_exposed_ms after a --profile-split capture)")
     return engine
 
 

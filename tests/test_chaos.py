@@ -836,3 +836,43 @@ def test_kv_alloc_mid_decode_exhaustion_fails_one_request_503_shaped(
     assert len(grower.tokens) < 24  # failed at the block boundary
     assert exhaustion.total() == e0 + 1
     assert bystander.error is None and len(bystander.tokens) == 4
+
+
+def test_wire_failpoint_poisons_one_request_503_shaped(tmp_path):
+    """Armed `wire:nonfinite` + --comm-overlap on a tp mesh: the next
+    decode dispatch ships a corrupted ring-hop partial (batch row 0 only,
+    in-graph — parallel/qcollectives._maybe_poison_partial), the
+    downstream non-finite tripwire fails THAT request 503-shaped, and the
+    bystander slot finishes untouched — a poisoned quantized hop's blast
+    radius is one request, never the scheduler."""
+    from dllama_tpu.runtime import numerics
+
+    nf = tm.registry().counter(tm.NONFINITE)
+    fired = tm.registry().counter(tm.FAILPOINTS_FIRED)
+    b0, f0 = nf.total(site="batch"), fired.total(name="wire")
+    mpath, tpath = _fresh_model(tmp_path, seed=29)
+    eng = InferenceEngine(mpath, tpath, tp=2, comm_overlap="auto",
+                          temperature=0.0, seed=3, numerics_failfast=True)
+    assert eng.cfg.comm_overlap > 1  # the ring merges are in the trace
+    sched = BatchScheduler(eng, n_slots=2)
+    try:
+        fp.arm("wire", "nonfinite", times=1)
+        victim = sched.submit(_enc(eng), 8, stop_on_eos=False)
+        bystander = sched.submit(_enc(eng, "world"), 4, stop_on_eos=False)
+        assert victim.done.wait(timeout=120)
+        assert victim.error is not None and "non-finite" in victim.error
+        assert victim.server_error  # HTTP 503-shaped, not a client 400
+        assert bystander.done.wait(timeout=120)
+        assert bystander.error is None and len(bystander.tokens) == 4
+        assert nf.total(site="batch") >= b0 + 1
+        assert fired.total(name="wire") == f0 + 1
+        # recovery: the slot is reclaimed, a clean request serves
+        ok = sched.submit(_enc(eng), 4, stop_on_eos=False)
+        assert ok.done.wait(timeout=120)
+        assert ok.error is None and len(ok.tokens) == 4
+        assert isinstance(numerics.nonfinite_error("batch", 1),
+                          numerics.NumericsError)
+    finally:
+        fp.registry().clear()
+        sched.close()
+        eng.close()

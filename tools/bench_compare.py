@@ -17,14 +17,19 @@ import os
 import sys
 
 _RATES = ("decode_tok_per_s", "prefill_tok_per_s", "sampled_decode_tok_per_s",
-          "chunked_decode_tok_per_s", "agg_tok_per_s")
-# lower-is-better latencies (--scenario continuous TTFT): the printed pct
-# is still "improvement-positive", so the sign is flipped before ranking
-_LATENCIES = ("ttft_ms_p50", "ttft_ms_p95")
+          "chunked_decode_tok_per_s", "agg_tok_per_s",
+          "decode_tok_per_s_q80")
+# lower-is-better latencies (--scenario continuous TTFT; --scenario
+# multichip exposed collective wall): the printed pct is still
+# "improvement-positive", so the sign is flipped before ranking
+_LATENCIES = ("ttft_ms_p50", "ttft_ms_p95",
+              "comm_exposed_ms", "comm_exposed_ms_off")
 # context-only scenario fields: printed for both sides, never ranked (a
 # higher occupancy or sharing count is workload-dependent, not a win/loss)
 _GAUGES = ("block_occupancy_peak", "block_occupancy_mean",
-           "kv_blocks_shared_peak", "prefix_reuse_tokens")
+           "kv_blocks_shared_peak", "prefix_reuse_tokens",
+           "wire_q80_shrink", "exposed_overlap_lower",
+           "f32_tokens_identical")
 
 
 def _load(path: str) -> dict:
