@@ -17,12 +17,19 @@
 #                   route-label lint (every route a handler matches is in
 #                   serve/api.py _ROUTES, keeping the label closed-world).
 #   make bench      The driver's benchmark: ONE JSON line on stdout.
+#   make perf-check The perf-regression sentinel: run the bench and
+#                   compare against the committed PERF_BASELINE.json
+#                   (tools/perf_baseline.py). Exits nonzero naming any
+#                   regressed metric; a no-hardware run is first-class
+#                   "no evidence" and stays green. Re-record with
+#                   `python bench.py --baseline update` after a
+#                   deliberate perf change lands ON CHIP.
 #   make graft      Compile-check the jittable entry + the 8-device
 #                   multi-chip dry run (tp/pp/dp/sp/ep shardings).
 
 PY ?= python
 
-.PHONY: test test-tpu test-all native tsan bench graft lint clean
+.PHONY: test test-tpu test-all native tsan bench perf-check graft lint clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -49,6 +56,9 @@ lint:
 
 bench:
 	$(PY) bench.py
+
+perf-check:
+	$(PY) bench.py --baseline check
 
 graft:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

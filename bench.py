@@ -49,21 +49,39 @@ PROBE_TIMEOUT_S = float(os.environ.get("DLLAMA_BENCH_PROBE_TIMEOUT", "150"))
 PROBE_RETRIES = int(os.environ.get("DLLAMA_BENCH_PROBE_RETRIES", "3"))
 STAGE_DEADLINE_S = float(os.environ.get("DLLAMA_BENCH_STAGE_DEADLINE", "600"))
 
-# nominal peak dense-bf16 TFLOP/s and HBM GB/s by device kind substring
-CHIP_SPECS = (
-    ("v5e", 197.0, 819.0),
-    ("v5p", 459.0, 2765.0),
-    ("v4", 275.0, 1228.0),
-    ("v6", 918.0, 1640.0),  # trillium
-)
+def _roofline_mod():
+    """The roofline observatory's ceilings table + rate math
+    (dllama_tpu/runtime/roofline.py), loaded BY FILE PATH: importing the
+    package would pull jax (runtime/__init__ imports the KV cache), and
+    the bench parent stays jax-free by design — a wedged PJRT import
+    must not stall its emit path. The module's join functions import
+    telemetry lazily, so the standalone load carries exactly the
+    ceilings/rate surface the parent needs."""
+    global _ROOFLINE_MOD
+    try:
+        return _ROOFLINE_MOD
+    except NameError:
+        pass
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "dllama_tpu", "runtime", "roofline.py")
+    spec = importlib.util.spec_from_file_location("_dllama_roofline", p)
+    mod = importlib.util.module_from_spec(spec)
+    # register BEFORE exec: dataclasses resolves string annotations via
+    # sys.modules[cls.__module__] at class-creation time
+    sys.modules["_dllama_roofline"] = mod
+    spec.loader.exec_module(mod)
+    _ROOFLINE_MOD = mod
+    return mod
 
 
 def detect_specs(device_kind: str) -> tuple[float, float]:
-    dk = device_kind.lower()
-    for key, tflops, gbps in CHIP_SPECS:
-        if key in dk:
-            return tflops, gbps
-    return 197.0, 819.0  # conservative default (v5e-class)
+    """Nameplate (tflops, gbps) by device kind — ONE table for the whole
+    repo (roofline.NAMEPLATE_SPECS; this wrapper keeps the historical
+    bench signature)."""
+    c = _roofline_mod().nameplate_ceilings(device_kind)
+    return c.tflops, c.hbm_gbps
 
 
 def emit(result: dict) -> None:
@@ -1436,7 +1454,15 @@ def main() -> None:
         result["value"] = v
         result["metric"] = f"decode_tok_per_s_llama{head}_{wrepr}_1chip"
         result["vs_baseline"] = round(v / NORTH_STAR_TOK_S, 4)
-        # roofline + efficiency context
+        # roofline + efficiency context: the ceilings come from the hw_probe
+        # file when one exists (honest measured silicon) and the nameplate
+        # table otherwise — the section names its source either way
+        # (runtime/roofline, loaded jax-free by file path)
+        roofmod = _roofline_mod()
+        ceil = roofmod.load_ceilings(device_kind=str(info.get("kind", "")))
+        result["roofline"] = roofmod.rate_roofline(v, weight_gb, ceil)
+        # legacy flat fields (tools/analyze_capture.py and older captures
+        # read these; same numbers as the section, nameplate-based)
         result["roofline_decode_tok_per_s"] = round(gbps / weight_gb, 1)
         result["hbm_util_decode"] = round(v * weight_gb / gbps, 4)
         if head_res.get("prefill_tok_per_s"):
@@ -1477,10 +1503,87 @@ def main() -> None:
     emit(result)
 
 
+def baseline_main(argv: list) -> int:
+    """``bench.py --baseline {check,update}``: the perf-regression
+    sentinel (tools/perf_baseline.py) wrapped around a bench run.
+
+    Without ``--result FILE`` the bench runs live in a SUBPROCESS (main's
+    watchdog force-exits its process on a wedge — the comparison must
+    survive that) and its one emitted JSON line is the comparison side.
+    ``check`` exits 1 naming every regressed metric; a skipped run or a
+    run with no overlapping metrics is first-class NO EVIDENCE and exits
+    0 (so ``make perf-check`` stays green on hardware-less runners
+    without pretending it verified anything). ``update`` records the
+    result as the new ``PERF_BASELINE.json``."""
+    import argparse
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tools"))
+    import perf_baseline
+
+    ap = argparse.ArgumentParser(prog="bench.py --baseline")
+    ap.add_argument("mode", choices=("check", "update"))
+    ap.add_argument("--result", default=None,
+                    help="compare/record this bench JSON instead of "
+                         "running a live bench")
+    ap.add_argument("--baseline-file",
+                    default=os.path.join(here, "PERF_BASELINE.json"))
+    ap.add_argument("--name", default="local",
+                    help="baseline name (update mode)")
+    args = ap.parse_args(argv)
+
+    if args.result:
+        try:
+            bench = perf_baseline.load_bench_json(args.result)
+        except (OSError, ValueError) as e:
+            # filesystem error, not a perf verdict: named rc 2 (the
+            # regression exit code stays reserved for real regressions)
+            print(f"❌ result file unusable: {e}", file=sys.stderr)
+            return 2
+    else:
+        proc = subprocess.run([sys.executable,
+                               os.path.join(here, "bench.py")],
+                              capture_output=True, text=True, cwd=here)
+        bench = perf_baseline.last_json_line(proc.stdout)
+        if bench is None:
+            print(f"❌ live bench emitted no JSON line (rc={proc.returncode})"
+                  f"\n{_tail(proc.stderr)}", file=sys.stderr)
+            return 2
+
+    if args.mode == "update":
+        try:
+            doc = perf_baseline.make_baseline(bench, args.name,
+                                              source=args.result or "live")
+        except ValueError as e:
+            # a skipped/empty run must never OVERWRITE a real baseline
+            print(f"❌ not updating baseline: {e}", file=sys.stderr)
+            return 2
+        perf_baseline.write_baseline(doc, args.baseline_file)
+        return 0
+
+    try:
+        with open(args.baseline_file, encoding="utf-8") as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        # unreadable OR corrupt (truncated write, merge-conflict markers):
+        # a named rc-2, never a traceback that CI reads as a regression
+        print(f"❌ baseline file unusable: {e}", file=sys.stderr)
+        return 2
+    cmp = perf_baseline.compare(bench, baseline)
+    print(perf_baseline.format_report(cmp), file=sys.stderr)
+    emit({"metric": "baseline_check", "baseline": baseline.get("name"),
+          "verdict": cmp["verdict"],
+          "regressed": [r["metric"] for r in cmp["regressions"]],
+          "result": cmp})
+    return 1 if cmp["regressions"] else 0
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--stage":
         stage_child(sys.argv[2])
     elif len(sys.argv) >= 3 and sys.argv[1] == "--scenario":
         scenario_main(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--baseline":
+        sys.exit(baseline_main(sys.argv[2:]))
     else:
         main()

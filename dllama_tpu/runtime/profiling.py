@@ -76,6 +76,134 @@ _SYNC_RE = re.compile(
 _NOISE_RE = re.compile(
     r"(ExecuteHelper|Handle inputs|CreateOutputs|Execute$|::)")
 
+# -- per-op attribution classes ----------------------------------------------
+#
+# The ROADMAP #2 loop (profile → A/B → promote) classifies device time into
+# the op families a decode-step optimization targets. First match wins, so
+# order matters: a collective is a collective even when its name mentions a
+# dot; attention fusions are named before the generic matmul family; the
+# sampler's sort/top-k ops before anything else they could pattern-match.
+# Best-effort by construction — on TPU most compute arrives as opaque
+# `fusion.N` events, which honestly land in "other" (the tool prints the
+# top ops so an operator can still see what a fat fusion contains).
+OP_CLASSES = (
+    ("collective", _SYNC_RE),
+    ("attention", re.compile(r"(attention|attn|flash|softmax)",
+                             re.IGNORECASE)),
+    ("sampling", re.compile(r"(top_k|top-k|sort|argmax|arg_max|cumsum|"
+                            r"categorical|gumbel|threefry|random|rng_bit)",
+                            re.IGNORECASE)),
+    ("gemv/matmul", re.compile(r"(dot_general|dot\b|dot\.|_dot_|matmul|"
+                               r"gemm|gemv|einsum|convolution)",
+                               re.IGNORECASE)),
+    ("dequant", re.compile(r"(dequant|quantize|convert_element_type|"
+                           r"convert\b|bitcast_convert)", re.IGNORECASE)),
+)
+
+
+def classify_op(name: str) -> str:
+    """Op-class label for one device event name (see :data:`OP_CLASSES`;
+    ``"other"`` for everything unmatched)."""
+    for cls, rx in OP_CLASSES:
+        if rx.search(name):
+            return cls
+    return "other"
+
+
+def empty_attribution(n_steps: int = 0) -> dict:
+    """The op-attribution result shape with nothing in it — THE schema
+    both :func:`op_attribution` and the idle-window ``?ops=1`` fallback
+    build on, so the empty and populated responses can never diverge."""
+    return {"n_steps": n_steps, "n_lanes": 0, "lanes": [],
+            "device_busy_ms_per_step": 0.0, "classes": {}, "top_ops": [],
+            "total_ms_per_step": 0.0, "sum_over_union": 0.0}
+
+
+def op_attribution(trace_dir: str | None = None, *, xspace=None,
+                   n_steps: int = 1, top: int = 25) -> dict:
+    """Per-op device-time decomposition of an xplane capture — the
+    reusable core of ``tools/profile_decode.py``, also served live via
+    ``POST /debug/profile?ops=1``. Takes either a trace directory (newest
+    ``*.xplane.pb`` inside) or an already-parsed ``xspace``.
+
+    Attribution comes from the PRIMARY lane (the device lane with the
+    largest interval-union busy time): per-op duration sums, the op-class
+    rollup of :data:`OP_CLASSES`, and the top ops by time. The
+    sum-vs-union reconcile rides along because summed per-op times can
+    double-count nested/overlapping rows — ``sum_over_union`` is the
+    primary lane's per-op sum over THAT lane's own union (same lane both
+    sides, so a multi-lane capture can't deflate it), and far above 1.0
+    means the per-op percentages overstate absolute time.
+    ``device_busy_ms_per_step`` is the all-lane union — the honest
+    whole-device busy figure. All times are ms, averaged per step with
+    ``n_steps``."""
+    if xspace is None:
+        if trace_dir is None:
+            raise ValueError("op_attribution needs trace_dir or xspace")
+        pbs = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                        recursive=True)
+        if not pbs:
+            raise RuntimeError(f"no xplane.pb under {trace_dir}")
+        xspace = _load_xplane(max(pbs, key=os.path.getmtime))
+
+    lanes = []           # per-lane {plane, line, sum_ms, union_ms, n_events}
+    all_iv: list[tuple[int, int]] = []
+    best = None          # (union_ns, per_op_ns, per_op_count)
+    for plane, line in _device_lines(xspace):
+        names = {e.id: e.name for e in plane.event_metadata.values()} \
+            if hasattr(plane.event_metadata, "values") else {}
+        iv, s_ns, n = [], 0, 0
+        ops: dict[str, int] = {}
+        ops_n: dict[str, int] = {}
+        # XEvent.offset_ps is relative to ITS line's timestamp_ns: rebase
+        # to absolute ns so the cross-lane union compares real intervals
+        base_ns = getattr(line, "timestamp_ns", 0) or 0
+        for ev in line.events:
+            name = names.get(ev.metadata_id, str(ev.metadata_id))
+            if _NOISE_RE.search(name):
+                continue
+            dur = ev.duration_ps // 1000  # -> ns
+            start = base_ns + ev.offset_ps // 1000
+            iv.append((start, start + dur))
+            ops[name] = ops.get(name, 0) + dur
+            ops_n[name] = ops_n.get(name, 0) + 1
+            s_ns += dur
+            n += 1
+        u = union_span(iv)
+        lanes.append({"plane": plane.name, "line": line.name,
+                      "sum_ms": s_ns / 1e6, "union_ms": u / 1e6,
+                      "n_events": n})
+        all_iv.extend(iv)
+        if best is None or u > best[0]:
+            best = (u, ops, ops_n)
+
+    steps = max(1, n_steps)
+    g_union = union_span(all_iv)
+    out = empty_attribution(n_steps)
+    out["n_lanes"] = len(lanes)
+    out["lanes"] = lanes
+    out["device_busy_ms_per_step"] = g_union / 1e6 / steps
+    if best is None:
+        return out
+    best_u, per_op, per_op_n = best
+    total_ns = sum(per_op.values())
+    out["total_ms_per_step"] = total_ns / 1e6 / steps
+    out["sum_over_union"] = round(total_ns / max(1, best_u), 3)
+    classes: dict[str, float] = {}
+    for name, ns in per_op.items():
+        cls = classify_op(name)
+        classes[cls] = classes.get(cls, 0.0) + ns
+    out["classes"] = {
+        cls: {"ms_per_step": round(ns / 1e6 / steps, 4),
+              "frac": round(ns / max(1, total_ns), 4)}
+        for cls, ns in sorted(classes.items(), key=lambda kv: -kv[1])}
+    out["top_ops"] = [
+        {"name": name, "class": classify_op(name),
+         "ms_per_step": round(ns / 1e6 / steps, 4),
+         "count": per_op_n[name], "frac": round(ns / max(1, total_ns), 4)}
+        for name, ns in sorted(per_op.items(), key=lambda kv: -kv[1])[:top]]
+    return out
+
 
 def union_span(intervals: list[tuple[int, int]]) -> int:
     """Total covered length of possibly-overlapping [start, end] spans, in
@@ -256,7 +384,8 @@ def measure_eval_sync(step, n_steps: int = 3) -> EvalSyncSplit:
         return split_from_trace(os.path.join(d, "capture"), n_steps)
 
 
-def live_split_summary(engine, duration_s: float) -> dict:
+def live_split_summary(engine, duration_s: float, *,
+                       include_ops: bool = False) -> dict:
     """``POST /debug/profile``: hold a profiler window open over whatever
     decode steps the serving loop dispatches in the next ``duration_s``
     seconds, then classify the captured device time into the Eval/Sync
@@ -276,6 +405,7 @@ def live_split_summary(engine, duration_s: float) -> dict:
                 + reg.histogram(telemetry.DECODE_STEP_MS).count())
 
     n0 = _steps()
+    ops = None
     with tempfile.TemporaryDirectory(prefix="dllama-live-prof-") as d:
         with capture(d):
             time.sleep(duration_s)
@@ -286,6 +416,14 @@ def live_split_summary(engine, duration_s: float) -> dict:
             # no xplane written (idle window on some backends): empty split
             split = EvalSyncSplit(eval_ms=0.0, sync_ms=0.0, n_steps=0,
                                   n_lanes=0)
+        if include_ops:
+            # the per-op view (?ops=1): same capture, decomposed through
+            # op_attribution — an idle/empty window yields the empty
+            # attribution shape, never an error
+            try:
+                ops = op_attribution(d, n_steps=max(1, n))
+            except RuntimeError:
+                ops = empty_attribution()
     out = {
         "duration_ms": duration_s * 1000.0,
         "n_steps": n,
@@ -295,6 +433,8 @@ def live_split_summary(engine, duration_s: float) -> dict:
         "n_lanes": split.n_lanes,
         "collective_traffic": None,
     }
+    if ops is not None:
+        out["op_attribution"] = ops
     try:
         tr = engine.collect_traffic()
         out["collective_traffic"] = {

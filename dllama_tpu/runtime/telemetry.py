@@ -111,6 +111,10 @@ ACTIVATION_RMS = "dllama_activation_rms"
 ACTIVATION_ABSMAX = "dllama_activation_absmax"
 QUANT_AUDIT_MIN_SNR = "dllama_quant_audit_min_snr_db"
 QUANT_AUDIT_NONFINITE = "dllama_quant_audit_nonfinite_total"
+# roofline observatory (runtime/roofline.py)
+ROOFLINE_FRACTION = "dllama_roofline_fraction"
+ACHIEVED_HBM_GBPS = "dllama_achieved_hbm_gbps"
+ACHIEVED_TFLOPS = "dllama_achieved_tflops"
 # XLA compile introspection (runtime/introspection.py)
 COMPILE_TOTAL = "dllama_compile_total"
 COMPILE_SECONDS = "dllama_compile_seconds"
@@ -273,6 +277,20 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "Non-finite values found in model tensors by audit sweeps "
           "(any growth means a damaged or mis-scaled tensor; the audit "
           "table names it)"),
+    _spec(ROOFLINE_FRACTION, "gauge",
+          "Per-program roofline fraction: max of achieved-bandwidth / "
+          "ceiling-bandwidth and achieved-compute / ceiling-compute, "
+          "clamped to (0, 1] (runtime/roofline joins the compile "
+          "ledger's measured bytes/FLOPs with the step-histogram walls "
+          "against the hw_probe or nameplate ceilings; refreshed by "
+          "GET /debug/roofline, the --stats tick, and bench.py)"),
+    _spec(ACHIEVED_HBM_GBPS, "gauge",
+          "Per-program achieved HBM bandwidth, GB/s: measured "
+          "argument+temp+output bytes per dispatch over the "
+          "compile-corrected steady-state dispatch wall"),
+    _spec(ACHIEVED_TFLOPS, "gauge",
+          "Per-program achieved compute, TFLOP/s: measured FLOPs per "
+          "dispatch over the same steady-state wall"),
     _spec(COMPILE_TOTAL, "counter",
           "XLA trace+compile events by program and engine scope "
           "(runtime/introspection ledger)"),
@@ -706,6 +724,22 @@ def stats_line(reg: Registry | None = None, *,
             f"{attrib.quantile(0.5, phase=ph):.0f}"
             for ph in ("queue", "admission", "prefill", "first_decode"))
             + "ms")
+    # roofline observatory (runtime/roofline): the dominant decode
+    # program's achieved-vs-ceiling fraction — the live ROADMAP #2 number.
+    # Lazy import breaks the module cycle (roofline imports telemetry at
+    # its top); computing here keeps the gauges fresh on a --stats server.
+    # Global-registry only: the observatory joins the process-wide ledger
+    # and histograms, which say nothing about a caller's private registry.
+    frac = None
+    if reg is registry():
+        try:
+            from . import roofline as _roofline
+
+            frac = _roofline.stats_fraction()
+        except Exception:  # noqa: BLE001 — the stats line never dies on this
+            frac = None
+    if frac is not None:
+        parts.append(f"roofline={100 * frac:.1f}%")
     sync = reg.gauge(SYNC_FRACTION).value()
     sent = reg.gauge(COLLECTIVE_SENT_KB).value()
     if sync or sent:

@@ -34,7 +34,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
 from ..runtime import (failpoints, flightrec, introspection, numerics,
-                       profiling, telemetry)
+                       profiling, roofline, telemetry)
 from ..runtime.engine import InferenceEngine
 from ..runtime.serving import (HbmAdmissionError, QueueFullError,
                                RequestTimeoutError,
@@ -48,9 +48,33 @@ from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
 # Closed-world: every route literal a handler matches on must be listed here
 # (tools/check_route_labels.py enforces it in `make lint`).
 _ROUTES = ("/v1/chat/completions", "/v1/models", "/metrics",
-           "/health", "/healthz", "/readyz",
+           "/health", "/healthz", "/readyz", "/debug",
            "/debug/compiles", "/debug/requests", "/debug/profile",
-           "/debug/numerics", "/debug/flight", "/debug/timeline")
+           "/debug/numerics", "/debug/flight", "/debug/timeline",
+           "/debug/roofline")
+
+# the GET /debug index: one line per diagnostic endpoint. Closed-world with
+# _ROUTES (tools/check_route_labels.py: every /debug/* route has exactly one
+# entry here and vice versa), so the index can never silently omit a surface.
+_DEBUG_INDEX = {
+    "/debug/compiles": "GET: compile ledger — every XLA trace+compile event "
+                       "with program/scope/plan, wall time, HBM/FLOPs "
+                       "analysis, retrace-sentinel state",
+    "/debug/requests": "GET: recent per-request phase timelines from the "
+                       "always-on span ring",
+    "/debug/profile": "POST ?ms=N[&ops=1]: live profiler window over the "
+                      "serving loop — Eval/Sync split, collective traffic, "
+                      "and (ops=1) the per-op class attribution",
+    "/debug/numerics": "GET: numerics observatory — tripwire totals, tapped "
+                       "activation stats, canary status",
+    "/debug/flight": "GET: flight-recorder rings — per-tick scheduler "
+                     "decisions + request lifecycle events",
+    "/debug/timeline": "GET: Perfetto-loadable Chrome trace of the flight "
+                       "rings + span ring",
+    "/debug/roofline": "GET: roofline observatory — per-program achieved "
+                       "bytes/FLOPs vs chip ceilings, memory- vs "
+                       "compute-bound classification",
+}
 
 # POST /debug/profile capture-window bounds (ms): long enough to catch a few
 # decode steps, short enough that a handler thread never parks for minutes
@@ -633,6 +657,17 @@ def make_handler(state: ApiState):
                 self._json(200 if ready else 503,
                            {"status": "ok" if ready else "unready",
                             "reason": reason})
+            elif path == "/debug":
+                # the diagnostic surface's index: every /debug/* endpoint
+                # with a one-line description (closed-world vs _ROUTES —
+                # tools/check_route_labels.py)
+                self._json(200, {"endpoints": dict(_DEBUG_INDEX)})
+            elif path == "/debug/roofline":
+                # the roofline observatory: per-program achieved bandwidth/
+                # compute vs the chip ceilings, joined from the compile
+                # ledger + step histograms (runtime/roofline; pure host
+                # reads — never dispatches or compiles anything)
+                self._json(200, roofline.snapshot())
             elif path == "/debug/compiles":
                 # the compile ledger: every trace+compile event with program,
                 # scope, plan, wall time, HBM/FLOPs analysis, and the retrace
@@ -690,8 +725,9 @@ def make_handler(state: ApiState):
             try:
                 qs = parse_qs(urlsplit(self.path).query)
                 ms = int(qs.get("ms", [_PROFILE_MS_DEFAULT])[0])
+                ops = int(qs.get("ops", ["0"])[0])
             except ValueError:
-                self._json(400, {"error": "ms must be an integer"})
+                self._json(400, {"error": "ms and ops must be integers"})
                 return
             if not (10 <= ms <= _PROFILE_MS_MAX):
                 self._json(400, {"error": f"ms must be in "
@@ -699,7 +735,7 @@ def make_handler(state: ApiState):
                 return
             try:
                 self._json(200, profiling.live_split_summary(
-                    state.engine, ms / 1000.0))
+                    state.engine, ms / 1000.0, include_ops=bool(ops)))
             except profiling.CaptureBusyError as e:
                 self._json(409, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — diagnostics must fail as JSON, never wedge serving

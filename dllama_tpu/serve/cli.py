@@ -522,6 +522,24 @@ def run_inference(args) -> int:
     print(f"    nTokens: {n_pred}")
     print(f"   tokens/s: {result.pred_tok_per_s:.2f} "
           f"({result.pred_ms / max(1, n_pred):.2f} ms/tok)")
+    if n_pred and result.pred_tok_per_s:
+        # roofline context (runtime/roofline): the measured decode rate
+        # against the chip's HBM ceiling — every decode step streams the
+        # weight planes, so ceiling_GBps / weight_GB is the speed limit.
+        # Probe-file ceilings when present, nameplate otherwise; the
+        # source is printed because the two are different claims.
+        try:
+            from ..runtime import roofline as _roofline
+
+            ceil = _roofline.load_ceilings()
+            rf = _roofline.rate_roofline(
+                result.pred_tok_per_s,
+                engine.hbm_estimate["weights_bytes"] / 1e9, ceil)
+            print(f"   roofline: {100 * rf['roofline_fraction']:.1f}% of "
+                  f"{rf['roofline_tok_per_s']:.0f} tok/s "
+                  f"[{rf['ceiling_source']}]")
+        except Exception:  # noqa: BLE001 — context line, never kills the CLI
+            pass
     if getattr(args, "profile_split", False) and engine.split is not None:
         sp = engine.split
         tr = engine.traffic

@@ -1,0 +1,544 @@
+"""Roofline observatory (runtime/roofline) + per-op attribution
+(profiling.op_attribution) + the perf-regression sentinel
+(tools/perf_baseline.py, bench.py --baseline).
+
+Acceptance tier (ISSUE 9): on the CPU mesh, ``GET /debug/roofline``
+returns per-program entries whose achieved bytes/FLOPs are derived from
+the compile ledger's measured values, with zero post-steady compiles
+while the observatory is snapshotting — and a 20% synthetic step-time
+regression makes ``bench.py --baseline check`` exit nonzero naming the
+regressed metric."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime import introspection, profiling, roofline, telemetry
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.serve.api import _DEBUG_INDEX, _ROUTES, BatchedApiState, \
+    make_handler
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN_XPLANE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "goldens", "synthetic.xplane.pb")
+
+CEIL = roofline.Ceilings(hbm_gbps=770.0, tflops=70.0, source="test")
+
+
+# -- unit tier: the roofline math ---------------------------------------------
+
+
+def test_attribute_memory_bound_program():
+    # 8 GB streamed in 29 ms at a 770 GB/s ceiling ≈ 36% of roofline
+    out = roofline.attribute(8.5e9, 16e9, 29.0, CEIL)
+    assert out["bound"] == "memory"
+    assert out["achieved_hbm_gbps"] == pytest.approx(8.5e9 / 0.029 / 1e9,
+                                                     rel=1e-4)
+    assert out["bw_fraction"] == pytest.approx(
+        out["achieved_hbm_gbps"] / 770.0, abs=1e-3)
+    assert out["roofline_fraction"] == out["bw_fraction"]
+    assert 0.0 < out["roofline_fraction"] <= 1.0
+    assert "raw_fraction" not in out
+    # operational intensity + ridge ride along for plotting
+    assert out["flops_per_byte"] == pytest.approx(16e9 / 8.5e9, abs=1e-3)
+    assert out["ridge_flops_per_byte"] == pytest.approx(70e12 / 770e9,
+                                                        abs=1e-3)
+
+
+def test_attribute_compute_bound_program():
+    # huge FLOPs over few bytes: compute fraction dominates
+    out = roofline.attribute(1e6, 5e12, 100.0, CEIL)
+    assert out["bound"] == "compute"
+    assert out["roofline_fraction"] == out["compute_fraction"]
+
+
+def test_attribute_zero_flop_program_is_memory_bound():
+    # a pure gather/copy program (cost_analysis reports 0 FLOPs) is
+    # legitimate: classified on its bandwidth fraction alone
+    out = roofline.attribute(1e9, 0.0, 10.0, CEIL)
+    assert out["bound"] == "memory"
+    assert out["achieved_tflops"] == 0.0
+    assert out["compute_fraction"] == 0.0
+    assert out["roofline_fraction"] > 0.0
+    assert "flops_per_byte" not in out
+
+
+def test_attribute_fraction_clamped_to_unity():
+    # over-counted bytes (e.g. aliased arguments) would put the raw
+    # fraction above 1 — the published fraction clamps, the raw is kept
+    out = roofline.attribute(770e9, 0.0, 100.0, CEIL)  # 7.7 TB/s "achieved"
+    assert out["roofline_fraction"] == 1.0
+    assert out["raw_fraction"] == pytest.approx(10.0, rel=1e-3)
+
+
+def test_attribute_no_evidence_paths():
+    assert "no_evidence" in roofline.attribute(1e9, 1e9, None, CEIL)
+    assert "no_evidence" in roofline.attribute(1e9, 1e9, 0.0, CEIL)
+    assert "no_evidence" in roofline.attribute(0, 0.0, 10.0, CEIL)
+
+
+def test_snapshot_missing_memory_analysis_is_no_evidence():
+    led = introspection.ledger()
+    entry = led.register("rooftest-scope", "mystery_step")
+    try:
+        entry["compiles"] = 1  # compiled but never analyzed
+        snap = roofline.snapshot(ceilings=CEIL, scope="rooftest-scope",
+                                 publish=False)
+        progs = {p["program"]: p for p in snap["programs"]}
+        assert "mystery_step" in progs
+        assert "no_evidence" in progs["mystery_step"]
+        assert "roofline_fraction" not in progs["mystery_step"]
+    finally:
+        # surgical cleanup — a full ledger reset would wipe every other
+        # engine's history from this process-global record
+        with led._lock:
+            led._programs.pop(("rooftest-scope", "mystery_step"), None)
+            led._steady.pop("rooftest-scope", None)
+
+
+# -- ceilings: probe file vs nameplate ----------------------------------------
+
+
+def test_nameplate_ceilings_by_device_kind():
+    c = roofline.nameplate_ceilings("TPU v5e chip")
+    assert (c.tflops, c.hbm_gbps) == (197.0, 819.0)
+    assert c.source == "nameplate:v5e"
+    # "TPU v5 lite" (the real axon kind) has no v5e substring → default row
+    c = roofline.nameplate_ceilings("TPU v5 lite")
+    assert c.source == "nameplate:default"
+    assert (c.tflops, c.hbm_gbps) == (197.0, 819.0)
+    assert roofline.nameplate_ceilings("cpu").source == "nameplate:cpu"
+
+
+def test_probe_ceilings_from_hw_probe_jsonl(tmp_path):
+    p = tmp_path / "hw_probe.jsonl"
+    p.write_text(
+        json.dumps({"stage": "device", "platform": "tpu",
+                    "kind": "TPU v5 lite"}) + "\n"
+        + json.dumps({"stage": "hbm_bw", "gib": 2, "chain_gbps": 770.2,
+                      "sync_gbps": 31.1}) + "\n"
+        + json.dumps({"stage": "mxu", "tflops": 70.4}) + "\n")
+    c = roofline.load_ceilings(probe_path=str(p))
+    assert c.hbm_gbps == pytest.approx(770.2)
+    assert c.tflops == pytest.approx(70.4)
+    assert c.source.startswith("probe:")
+    assert c.device_kind == "TPU v5 lite"
+
+
+def test_probe_ceilings_plain_object_and_fallbacks(tmp_path):
+    p = tmp_path / "HW_PROBE.json"
+    p.write_text(json.dumps({"hbm_gbps": 765.0, "tflops": 69.0}))
+    c = roofline.load_ceilings(probe_path=str(p))
+    assert (c.hbm_gbps, c.tflops) == (765.0, 69.0)
+    # a half-measured probe (no mxu stage) is NOT a ceiling claim: the
+    # nameplate fallback applies instead
+    half = tmp_path / "half.jsonl"
+    half.write_text(json.dumps({"stage": "hbm_bw", "chain_gbps": 700.0}))
+    assert roofline.probe_ceilings(str(half)) is None
+    c = roofline.load_ceilings(device_kind="v5e", probe_path=str(half))
+    assert c.source == "nameplate:v5e"
+    # absent file → nameplate too
+    c = roofline.load_ceilings(device_kind="v4",
+                               probe_path=str(tmp_path / "nope.json"))
+    assert c.source == "nameplate:v4"
+
+
+# -- per-op attribution vs the checked-in xplane fixture ----------------------
+
+
+def test_op_attribution_against_golden_xplane():
+    xs = profiling._load_xplane(GOLDEN_XPLANE)
+    out = profiling.op_attribution(xspace=xs, n_steps=1)
+    # two device lanes; the primary (largest union) is TPU:0 with 7 ms busy
+    assert out["n_lanes"] == 2
+    assert out["device_busy_ms_per_step"] == pytest.approx(7.0, abs=1e-6)
+    # primary-lane per-op sums: fusion.1(4) + all-reduce.1(2) +
+    # wait:rendezvous(1) + fusion.2(2) = 9 ms; ExecuteHelper is noise
+    assert out["total_ms_per_step"] == pytest.approx(9.0, abs=1e-6)
+    assert not any(o["name"] == "ExecuteHelper" for o in out["top_ops"])
+    # class rollup: the collective family (all-reduce + rendezvous wait)
+    # is 3 ms of 9; the opaque fusions land honestly in "other"
+    assert out["classes"]["collective"]["ms_per_step"] == pytest.approx(
+        3.0, abs=1e-6)
+    assert out["classes"]["collective"]["frac"] == pytest.approx(3 / 9,
+                                                                 abs=1e-4)
+    assert out["classes"]["other"]["ms_per_step"] == pytest.approx(6.0,
+                                                                   abs=1e-6)
+    # sum-vs-union reconcile: nested rows double-count in the sum
+    assert out["sum_over_union"] == pytest.approx(9 / 7, abs=0.01)
+    top = out["top_ops"][0]
+    assert top["name"] == "fusion.1" and top["class"] == "other"
+
+
+def test_op_attribution_class_regexes():
+    cases = {
+        "all-reduce.3": "collective",
+        "ppermute.1": "collective",
+        "dot_general.7": "gemv/matmul",
+        "convert_element_type.2": "dequant",
+        "top_k.1": "sampling",
+        "sort.4": "sampling",
+        "argmax.1": "sampling",
+        "flash_attention_kernel": "attention",
+        "softmax.2": "attention",
+        "fusion.12": "other",
+    }
+    for name, want in cases.items():
+        assert profiling.classify_op(name) == want, name
+
+
+def test_op_attribution_empty_and_missing():
+    with pytest.raises(RuntimeError):
+        profiling.op_attribution(os.path.join(REPO, "tests", "goldens",
+                                              "definitely-not-a-dir"))
+    with pytest.raises(ValueError):
+        profiling.op_attribution()
+
+
+# -- acceptance tier: /debug/roofline on the CPU mesh -------------------------
+
+
+@pytest.fixture(scope="module")
+def roofline_server(tmp_path_factory):
+    d = tmp_path_factory.mktemp("roofline")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(37)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=256),
+                     rng)
+    td = byte_vocab_tokenizer()
+    td.chat_template = "<|start_header_id|>"  # detected as llama3
+    tfile.write_tfile(tpath, td)
+
+    led = introspection.ledger()
+    prev_analyze = led.analyze
+    led.analyze = True  # the observatory joins against the ledger analysis
+    engine = InferenceEngine(str(mpath), str(tpath), temperature=0.0,
+                             seed=3, tp=1)
+    state = BatchedApiState(engine, n_slots=2)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{httpd.server_address[1]}", engine
+    finally:
+        led.analyze = prev_analyze
+        httpd.shutdown()
+        state.close()
+        engine.close()
+
+
+def _get(url, timeout=60):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _chat(base, text, max_tokens=8):
+    req = urllib.request.Request(
+        base + "/v1/chat/completions",
+        data=json.dumps({"messages": [{"role": "user", "content": text}],
+                         "max_tokens": max_tokens,
+                         "temperature": 0}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_debug_roofline_joins_ledger_measurements(roofline_server):
+    base, engine = roofline_server
+    led = introspection.ledger()
+    scope = engine.introspection_scope
+    # warm to steady state: same-shaped requests until the scheduler marks
+    # the scope steady (two compile-quiet ticks)
+    for _ in range(3):
+        status, _ = _chat(base, "hello roofline")
+        assert status == 200
+    assert led.steady(scope)
+    compiles_before = led.compile_count(scope)
+
+    status, snap = _get(base + "/debug/roofline")
+    assert status == 200
+    assert snap["ceilings"]["hbm_gbps"] > 0
+    assert snap["ceilings"]["source"].startswith(("probe:", "nameplate:"))
+    mine = {p["program"]: p for p in snap["programs"]
+            if p["scope"] == scope}
+    assert mine, "no per-program entries for the serving engine"
+
+    # every achieved number is DERIVED FROM the compile ledger's measured
+    # values: the entry's bytes/FLOPs must equal the ledger analysis, and
+    # achieved GB/s must be exactly bytes / wall
+    led_snap = led.snapshot()
+    led_mine = {p["program"]: p for p in led_snap["programs"]
+                if p["scope"] == scope}
+    attributed = {n: p for n, p in mine.items()
+                  if "roofline_fraction" in p}
+    assert attributed, f"no attributed programs in {list(mine)}"
+    for name, p in attributed.items():
+        analysis = led_mine[name]["analysis"]
+        assert p["hbm_bytes"] == analysis["hbm_total_bytes"]
+        assert p["flops"] == pytest.approx(analysis.get("flops", 0.0))
+        # entries round to 3 decimals; tolerate that plus the rounding
+        # of wall_ms itself
+        assert p["achieved_hbm_gbps"] == pytest.approx(
+            p["hbm_bytes"] / (p["wall_ms"] / 1e3) / 1e9, rel=0.02,
+            abs=1e-3)
+        assert 0.0 < p["roofline_fraction"] <= 1.0
+        assert p["bound"] in ("memory", "compute")
+    # the decode program is attributed (the ROADMAP #2 target) and the
+    # summary names a decode-family program
+    decode_named = [n for n, p in attributed.items()
+                    if p["family"] == "decode"]
+    assert decode_named
+    assert snap.get("summary", {}).get("roofline_fraction", 0) > 0
+
+    # the gauges published the same numbers
+    reg = telemetry.registry()
+    some = decode_named[0]
+    assert reg.gauge(telemetry.ROOFLINE_FRACTION).value(
+        scope=scope, program=some) == attributed[some]["roofline_fraction"]
+    assert reg.gauge(telemetry.ACHIEVED_HBM_GBPS).value(
+        scope=scope, program=some) > 0
+
+    # the observatory is trace-invisible: snapshotting (HTTP + direct),
+    # the stats fragment, and more steady traffic cause ZERO compiles
+    roofline.snapshot(publish=True)
+    telemetry.stats_line(reg)
+    status, _ = _chat(base, "hello roofline")
+    assert status == 200
+    status, _ = _get(base + "/debug/roofline")
+    assert status == 200
+    assert led.compile_count(scope) == compiles_before, \
+        "the roofline observatory caused a recompile"
+
+
+def test_stats_line_carries_roofline_fraction(roofline_server):
+    base, _engine = roofline_server
+    _chat(base, "warm for stats")
+    line = telemetry.stats_line(telemetry.registry())
+    assert "roofline=" in line
+    assert "%" in line
+
+
+def test_debug_index_lists_every_debug_route(roofline_server):
+    base, _engine = roofline_server
+    status, out = _get(base + "/debug")
+    assert status == 200
+    eps = out["endpoints"]
+    debug_routes = {r for r in _ROUTES if r.startswith("/debug/")}
+    assert set(eps) == debug_routes == set(_DEBUG_INDEX)
+    assert "/debug/roofline" in eps
+    for path, desc in eps.items():
+        assert isinstance(desc, str) and desc.strip(), path
+    # the index route has its own metric label (not folded into "other")
+    with urllib.request.urlopen(base + "/metrics", timeout=60) as r:
+        text = r.read().decode()
+    assert 'route="/debug",status="200"' in text
+    assert 'route="/debug/roofline",status="200"' in text
+
+
+# -- perf-regression sentinel -------------------------------------------------
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import perf_baseline  # noqa: E402
+
+
+def _sample_bench() -> dict:
+    return {
+        "metric": "decode_tok_per_s_llama8b_q40_1chip",
+        "value": 34.54, "git": "abc1234", "device_kind": "TPU v5 lite",
+        "roofline": {"roofline_fraction": 0.356},
+        "stages": {
+            "8b": {"decode_tok_per_s": 34.54, "decode_ms_per_step": 28.949,
+                   "fetch_rtt_ms": 68.8},
+            "1b": {"decode_tok_per_s": 181.03, "decode_ms_per_step": 5.524,
+                   "fetch_rtt_ms": 66.4},
+        },
+    }
+
+
+def test_noise_thresholds_are_rtt_floor_aware():
+    m = perf_baseline.extract_metrics(_sample_bench())
+    # 8b: rtt/(64×28.9 ms) ≈ 3.7% → the flat 10% floor dominates
+    assert m["8b.decode_tok_per_s"]["noise_frac"] == pytest.approx(0.10)
+    # 1b: rtt/(64×5.5 ms) ≈ 18.8% → the RTT floor dominates
+    assert m["1b.decode_tok_per_s"]["noise_frac"] == pytest.approx(
+        66.4 / (64 * 5.524), abs=1e-3)
+    assert m["headline.roofline_fraction"]["higher_better"] is True
+
+
+def test_synthetic_20pct_regression_fails_check_naming_metric(tmp_path):
+    # THE acceptance criterion: a 20% step-time regression on the 8b
+    # preset must exit nonzero and NAME the regressed metric
+    base_res = tmp_path / "base.json"
+    reg_res = tmp_path / "regressed.json"
+    bfile = tmp_path / "PERF_BASELINE.json"
+    base_res.write_text(json.dumps(_sample_bench()))
+    worse = _sample_bench()
+    worse["stages"]["8b"]["decode_ms_per_step"] *= 1.2
+    worse["stages"]["8b"]["decode_tok_per_s"] /= 1.2
+    reg_res.write_text(json.dumps(worse))
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    rc_update = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--baseline",
+         "update", "--result", str(base_res), "--baseline-file", str(bfile),
+         "--name", "test"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert rc_update.returncode == 0, rc_update.stderr
+    # unregressed self-check passes
+    ok = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--baseline",
+         "check", "--result", str(base_res), "--baseline-file", str(bfile)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    # the regressed side fails, naming the metric in BOTH the human
+    # report and the emitted JSON line
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--baseline",
+         "check", "--result", str(reg_res), "--baseline-file", str(bfile)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "8b.decode_ms_per_step" in bad.stderr
+    line = json.loads(bad.stdout.strip().splitlines()[-1])
+    assert line["verdict"] == "regression"
+    assert "8b.decode_ms_per_step" in line["regressed"]
+    assert "8b.decode_tok_per_s" in line["regressed"]
+    # the 1b preset moved 0% — well inside ITS (RTT-floor-raised) noise
+    assert "1b.decode_tok_per_s" not in line["regressed"]
+
+
+def test_zero_baseline_metric_is_evidence_not_noise():
+    # a measured 0.0 (fully-overlapped exposed comm — the best possible
+    # result) is EVIDENCE: it must be recorded, and a real later growth
+    # is a regression, not a divide-by-zero or a silent drop
+    base = {"stages": {"multichip": {"comm_exposed_ms": 0.0,
+                                     "agg_tok_per_s": 10.0}}}
+    m = perf_baseline.extract_metrics(base)
+    assert m["multichip.comm_exposed_ms"]["value"] == 0.0
+    bl = perf_baseline.make_baseline(base, "zero")
+    worse = {"stages": {"multichip": {"comm_exposed_ms": 5.0,
+                                      "agg_tok_per_s": 10.0}}}
+    cmp = perf_baseline.compare(worse, bl)
+    assert [r["metric"] for r in cmp["regressions"]] \
+        == ["multichip.comm_exposed_ms"]
+    # holding at zero is a perfect hold, not a regression
+    cmp = perf_baseline.compare(base, bl)
+    assert cmp["verdict"] == "ok" and not cmp["regressions"]
+    # ...and sub-resolution timer jitter above an exact zero is NOISE —
+    # a 0.05 ms union sliver must not hard-fail CI as a -100% regression
+    jitter = {"stages": {"multichip": {"comm_exposed_ms": 0.05,
+                                       "agg_tok_per_s": 10.0}}}
+    cmp = perf_baseline.compare(jitter, bl)
+    assert not cmp["regressions"] and cmp["verdict"] == "ok"
+    # the band applies to NONZERO tiny latency baselines too: 0.15 ms →
+    # 0.35 ms is the same sub-resolution sliver as 0 → 0.2, not a -133%
+    # regression
+    tiny = {"stages": {"multichip": {"comm_exposed_ms": 0.15,
+                                     "agg_tok_per_s": 10.0}}}
+    bl2 = perf_baseline.make_baseline(tiny, "tiny")
+    drift = {"stages": {"multichip": {"comm_exposed_ms": 0.35,
+                                      "agg_tok_per_s": 10.0}}}
+    cmp = perf_baseline.compare(drift, bl2)
+    assert not cmp["regressions"] and cmp["verdict"] == "ok"
+
+
+def test_batched_stage_rtt_floor_uses_its_own_step_count():
+    # @b16 stages measure 32 decode steps (bench.py stage_child), not 64:
+    # their RTT floor is twice as tall as the same step time unbatched
+    bench = {"stages": {
+        "1b": {"decode_tok_per_s": 100.0, "decode_ms_per_step": 5.5,
+               "fetch_rtt_ms": 66.0},
+        "1b@b16": {"decode_tok_per_s": 400.0, "decode_ms_per_step": 5.5,
+                   "fetch_rtt_ms": 66.0},
+    }}
+    m = perf_baseline.extract_metrics(bench)
+    plain = m["1b.decode_tok_per_s"]["noise_frac"]
+    batched = m["1b@b16.decode_tok_per_s"]["noise_frac"]
+    assert plain == pytest.approx(66.0 / (64 * 5.5), abs=1e-3)
+    assert batched == pytest.approx(66.0 / (32 * 5.5), abs=1e-3)
+
+
+def test_corrupt_baseline_file_is_named_rc2_not_a_regression(tmp_path):
+    bad = tmp_path / "PERF_BASELINE.json"
+    bad.write_text('{"name": "r05", "metrics": {TRUNCATED')
+    res = tmp_path / "r.json"
+    res.write_text(json.dumps(_sample_bench()))
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--baseline",
+         "check", "--result", str(res), "--baseline-file", str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 2, p.stdout + p.stderr
+    assert "baseline file unusable" in p.stderr
+    assert "Traceback" not in p.stderr
+    # a missing/corrupt RESULT file is rc 2 too — the regression exit
+    # code stays reserved for real regressions
+    good_bl = tmp_path / "good_bl.json"
+    good_bl.write_text(json.dumps(
+        perf_baseline.make_baseline(_sample_bench(), "ok")))
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--baseline",
+         "check", "--result", str(tmp_path / "missing.json"),
+         "--baseline-file", str(good_bl)],
+        capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 2, p.stdout + p.stderr
+    assert "result file unusable" in p.stderr
+    assert "Traceback" not in p.stderr
+
+
+def test_skipped_run_is_no_evidence_never_a_verdict(tmp_path):
+    bfile = tmp_path / "PERF_BASELINE.json"
+    bfile.write_text(json.dumps(
+        perf_baseline.make_baseline(_sample_bench(), "test")))
+    skipped = {"metric": "decode_tok_per_s_llama8b_q40_1chip", "value": 0.0,
+               "skipped": True,
+               "skip_reason": "backend unavailable: 5 probe attempts failed",
+               "stages": {}}
+    cmp = perf_baseline.compare(skipped, json.loads(bfile.read_text()))
+    assert cmp["verdict"] == "no_evidence"
+    assert not cmp["regressions"] and not cmp["improvements"]
+    assert len(cmp["no_evidence"]) == len(
+        perf_baseline.extract_metrics(_sample_bench()))
+    assert all("skipped" in r["reason"] for r in cmp["no_evidence"])
+    # a skipped run must never overwrite a real baseline either
+    with pytest.raises(ValueError):
+        perf_baseline.make_baseline(skipped, "nope")
+    # and the CLI exit code for no-evidence is 0 (green, explicitly
+    # unverified — the make perf-check contract on no-hardware runners)
+    skipped_path = tmp_path / "skipped.json"
+    skipped_path.write_text(json.dumps(skipped))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--baseline",
+         "check", "--result", str(skipped_path),
+         "--baseline-file", str(bfile)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "no evidence" in p.stderr
+
+
+def test_committed_baseline_matches_recorded_bench_numbers():
+    # the committed PERF_BASELINE.json must stay loadable and carry the
+    # BENCH-trajectory headline (8B decode) with an RTT-aware threshold
+    with open(os.path.join(REPO, "PERF_BASELINE.json")) as f:
+        doc = json.load(f)
+    assert doc["metrics"]["8b.decode_tok_per_s"]["value"] > 0
+    assert 0.05 <= doc["metrics"]["8b.decode_tok_per_s"]["noise_frac"] <= 0.5
+    # and bench_compare accepts it as a side (satellite: baseline
+    # artifacts are comparable)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_compare.py"),
+         os.path.join(REPO, "PERF_BASELINE.json"),
+         os.path.join(REPO, "BENCH_r04_manual.json")],
+        capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 0, p.stderr
+    assert "decode_tok_per_s" in p.stdout

@@ -5,9 +5,14 @@ Round-5 helper: quantify what a change bought —
     python tools/bench_compare.py BENCH_r04_manual.json \\
         capture_artifacts/<ts>/BENCH_live.json
 
-Accepts bench JSON files (the one-line emit) or capture directories
-(reads BENCH_live.json inside). Prints per-stage deltas for every rate
-field present in both, most-improved first.
+Accepts bench JSON files (the one-line emit), capture directories
+(reads BENCH_live.json inside), or a ``PERF_BASELINE.json`` artifact
+from the perf-regression sentinel (``bench.py --baseline update`` /
+``tools/perf_baseline.py record``) — a baseline side is expanded back
+into per-stage fields so "current run vs enforced baseline" diffs the
+same way as "capture vs capture". Prints per-stage deltas for every
+rate field present in both, most-improved first; the roofline fraction
+ranks higher-is-better and the exposed-comm wall lower-is-better.
 """
 
 from __future__ import annotations
@@ -32,6 +37,24 @@ _GAUGES = ("block_occupancy_peak", "block_occupancy_mean",
            "f32_tokens_identical")
 
 
+def _from_baseline(doc: dict) -> dict:
+    """Expand a PERF_BASELINE.json artifact (the sentinel's recorded
+    side: flat ``{"<stage>.<field>": {value, ...}}`` metrics) into the
+    bench-result shape this tool diffs."""
+    stages: dict = {}
+    out: dict = {"metric": f"baseline:{doc.get('name')}",
+                 "git": doc.get("git"),
+                 "device_kind": doc.get("device_kind"),
+                 "stages": stages}
+    for key, rec in (doc.get("metrics") or {}).items():
+        scope, _, field = key.partition(".")
+        if scope == "headline" and field == "roofline_fraction":
+            out["roofline"] = {"roofline_fraction": rec["value"]}
+        else:
+            stages.setdefault(scope, {})[field] = rec["value"]
+    return out
+
+
 def _load(path: str) -> dict:
     if os.path.isdir(path):
         path = os.path.join(path, "BENCH_live.json")
@@ -39,6 +62,9 @@ def _load(path: str) -> dict:
         text = f.read()
     try:
         whole = json.loads(text)
+        if "metrics" in whole and "stages" not in whole \
+                and "value" not in whole:
+            return _from_baseline(whole)
         if "stages" in whole or "value" in whole:
             return whole
         # the driver's BENCH_rN.json wrapper: {n, cmd, rc, tail, parsed}
@@ -95,6 +121,14 @@ def main() -> None:
             va, vb = sa[stage].get(k), sb[stage].get(k)
             if va and vb:
                 rows.append((100 * (va - vb) / va, stage, k, va, vb))
+    # roofline observatory section (higher fraction = closer to the chip
+    # ceiling = better); ceiling source printed as context below when the
+    # two sides measured against different ceilings
+    ra, rb = a.get("roofline") or {}, b.get("roofline") or {}
+    va, vb = ra.get("roofline_fraction"), rb.get("roofline_fraction")
+    if va and vb:
+        rows.append((100 * (vb - va) / va, "headline",
+                     "roofline_fraction", va, vb))
     if not rows:
         print("no overlapping measured rates")
         return
@@ -106,10 +140,14 @@ def main() -> None:
             va, vb = sa[stage].get(k), sb[stage].get(k)
             if va is not None and vb is not None:
                 gauges.append((stage, k, va, vb))
+    if (ra.get("ceiling_source") or rb.get("ceiling_source")) \
+            and ra.get("ceiling_source") != rb.get("ceiling_source"):
+        gauges.append(("headline", "roofline_ceiling_source",
+                       ra.get("ceiling_source"), rb.get("ceiling_source")))
     if gauges:
         print("  -- context (not ranked) --")
         for stage, k, va, vb in gauges:
-            print(f"  {stage:10s} {k:28s} {va:>10} -> {vb:>10}")
+            print(f"  {stage:10s} {k:28s} {va!s:>10} -> {vb!s:>10}")
 
 
 if __name__ == "__main__":

@@ -13,7 +13,11 @@ go blind. This lint keeps the set closed-world:
 3. collect every string literal that a handler compares against the
    request path (any ``==`` / ``in`` comparison whose other side mentions
    ``path``, e.g. ``self.path``, ``self._route()``, or a local ``path``);
-4. every compared literal must appear in ``_ROUTES``.
+4. every compared literal must appear in ``_ROUTES``;
+5. the ``GET /debug`` index (``_DEBUG_INDEX``) is closed-world against
+   ``_ROUTES``: every ``/debug/*`` route has exactly one non-empty
+   description entry and every index entry is a registered route — the
+   index can never silently omit (or invent) a diagnostic surface.
 """
 
 from __future__ import annotations
@@ -51,13 +55,20 @@ def main() -> int:
     tree = ast.parse(API.read_text(encoding="utf-8"), filename=str(API))
 
     routes: set[str] | None = None
+    debug_index: dict | None = None
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Name) and tgt.id == "_ROUTES":
                     routes = set(ast.literal_eval(node.value))
+                elif isinstance(tgt, ast.Name) and tgt.id == "_DEBUG_INDEX":
+                    debug_index = ast.literal_eval(node.value)
     if routes is None:
         print("❌ serve/api.py: no _ROUTES assignment found", file=sys.stderr)
+        return 1
+    if debug_index is None:
+        print("❌ serve/api.py: no _DEBUG_INDEX assignment found "
+              "(the GET /debug index)", file=sys.stderr)
         return 1
 
     errors: list[str] = []
@@ -79,12 +90,30 @@ def main() -> int:
                         f"{lit!r} but it is not in _ROUTES — its traffic "
                         f"would be folded into the 'other' label")
 
+    # the GET /debug index ↔ _ROUTES, both directions
+    debug_routes = {r for r in routes if r.startswith("/debug/")}
+    for r in sorted(debug_routes - set(debug_index)):
+        errors.append(f"serve/api.py: /debug route {r!r} has no "
+                      f"_DEBUG_INDEX description — the GET /debug index "
+                      f"would silently omit it")
+    for r in sorted(set(debug_index) - debug_routes):
+        errors.append(f"serve/api.py: _DEBUG_INDEX entry {r!r} is not a "
+                      f"registered /debug route in _ROUTES")
+    for r, desc in sorted(debug_index.items()):
+        if not isinstance(desc, str) or not desc.strip():
+            errors.append(f"serve/api.py: _DEBUG_INDEX[{r!r}] has an "
+                          f"empty description")
+    if "/debug" not in routes:
+        errors.append("serve/api.py: the '/debug' index route itself is "
+                      "missing from _ROUTES")
+
     if errors:
         for e in errors:
             print(f"❌ {e}", file=sys.stderr)
         return 1
     print(f"✅ route labels closed-world: {len(compared)} handler-matched "
-          f"routes all listed in _ROUTES ({len(routes)} registered)")
+          f"routes all listed in _ROUTES ({len(routes)} registered); "
+          f"GET /debug index covers all {len(debug_routes)} /debug routes")
     return 0
 
 

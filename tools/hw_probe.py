@@ -43,12 +43,33 @@ import sys
 import time
 
 
+_OUT_FILE = [None]  # --out FILE: tee every stage line (JSONL) for the
+# roofline observatory's measured ceilings (runtime/roofline reads the
+# hbm_bw/mxu stages via DLLAMA_HW_PROBE_FILE or HW_PROBE.json)
+
+
 def emit(stage: str, **kw) -> None:
-    print(json.dumps({"stage": stage, **kw}), flush=True)
+    line = json.dumps({"stage": stage, **kw})
+    print(line, flush=True)
+    if _OUT_FILE[0]:
+        with open(_OUT_FILE[0], "a", encoding="utf-8") as f:
+            f.write(line + "\n")
 
 
 def main() -> None:
-    stages = set(sys.argv[1:]) or {
+    argv = list(sys.argv[1:])
+    if "--out" in argv:
+        i = argv.index("--out")
+        try:
+            _OUT_FILE[0] = argv[i + 1]
+        except IndexError:
+            raise SystemExit("--out needs a file path") from None
+        del argv[i:i + 2]
+        # truncate up front: stale hbm_bw/mxu lines from a PREVIOUS probe
+        # (possibly different silicon) must never survive into what the
+        # roofline observatory serves as THIS chip's measured ceilings
+        open(_OUT_FILE[0], "w").close()
+    stages = set(argv) or {
         "mem", "dispatch", "hbm_bw", "mxu", "decode", "chunked"}
     import jax
     import jax.numpy as jnp

@@ -1,20 +1,24 @@
-"""Profile N decode steps on the real chip and print top device ops.
+"""Profile N decode steps on the real chip and print the per-op/per-class
+device-time attribution.
 
 Answers "where do the milliseconds go" for the single-step decode program —
 the gap between measured decode (14.3 ms/step on the 1b preset, hw_probe)
 and its HBM roofline (~1.7 ms).  Usage:
 
-    python tools/profile_decode.py [1b|8b] [n_steps]
+    python tools/profile_decode.py [1b|8b] [n_steps] [--json]
 
-Aggregates per-op device time from the xplane capture via the same
-no-tensorflow-import proto loader the Eval/Sync split uses
-(runtime/profiling._load_xplane).
+The decomposition itself is ``runtime/profiling.op_attribution`` (op
+classes: dequant / gemv-matmul / attention / collective / sampling /
+other) — the same engine ``POST /debug/profile?ops=1`` serves live, so
+the offline tool and the serving surface can never disagree.  ``--json``
+prints ONE machine-readable JSON line (the attribution dict plus the
+wall measurement) so the ROADMAP #2 profile → A/B → promote loop can be
+scripted end to end.
 """
 
 from __future__ import annotations
 
-import collections
-import glob
+import json
 import os
 import sys
 import tempfile
@@ -24,8 +28,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
-    preset = sys.argv[1] if len(sys.argv) > 1 else "1b"
-    n_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    args = [a for a in sys.argv[1:] if a != "--json"]
+    as_json = "--json" in sys.argv[1:]
+    preset = args[0] if len(args) > 0 else "1b"
+    n_steps = int(args[1]) if len(args) > 1 else 4
 
     import jax
     import jax.numpy as jnp
@@ -35,7 +41,7 @@ def main() -> None:
     benchmod.force_platform_from_env()  # e.g. cpu self-test
     from dllama_tpu.models.llama import greedy_step
     from dllama_tpu.runtime import KVCache
-    from dllama_tpu.runtime.profiling import _device_lines, _load_xplane
+    from dllama_tpu.runtime.profiling import op_attribution
 
     cfg = benchmod.model_cfg(preset)
     params = benchmod.device_random_params(cfg)
@@ -58,71 +64,51 @@ def main() -> None:
                                jnp.int32(pos + i), kv)
         jax.device_get(token)
     wall = time.perf_counter() - t0
+
+    try:
+        attrib = op_attribution(d, n_steps=n_steps)
+    except RuntimeError as e:
+        if as_json:
+            print(json.dumps({"preset": preset, "n_steps": n_steps,
+                              "error": str(e)}))
+        else:
+            print(f"no usable xplane capture: {e}")
+        return
+    attrib["preset"] = preset
+    attrib["wall_ms_per_step"] = round(1e3 * wall / n_steps, 3)
+
+    if as_json:
+        print(json.dumps(attrib))
+        return
+
     print(f"wall for {n_steps} traced steps: {1e3 * wall:.1f} ms "
-          f"({1e3 * wall / n_steps:.2f} ms/step incl. one fetch)")
-
-    paths = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
-    if not paths:
-        print("no xplane capture produced")
-        return
-    xs = _load_xplane(max(paths, key=os.path.getmtime))
-
-    from dllama_tpu.runtime.profiling import union_span as union_ns
-
-    # Per-lane sum vs interval-UNION: the round-4 open question is a ~1.7x
-    # systematic between summed per-op times and measured chain time. A
-    # union can't double-count — so if sum >> union the mechanism is
-    # overlapping/nested event rows (e.g. module rollups over op rows, or
-    # multiple lanes of one core), and the union is the honest device-busy
-    # attribution; if union itself exceeds chain time, the chain-side
-    # measurement is the suspect instead.
-    lanes = []          # (plane_name, line_name, sum_ns, union_ns, n_events)
-    all_iv = []
-    per_op = collections.Counter()
-    per_op_n = collections.Counter()
-    best = None         # lane with the largest union = primary attribution
-    for plane, line in _device_lines(xs):
-        names = {e.id: e.name for e in plane.event_metadata.values()} \
-            if hasattr(plane.event_metadata, "values") else {}
-        iv, s_ns, n = [], 0, 0
-        ops = collections.Counter()
-        ops_n = collections.Counter()
-        # XEvent.offset_ps is relative to ITS line's timestamp_ns: rebase to
-        # absolute ns so the cross-lane union compares real wall intervals
-        base_ns = getattr(line, "timestamp_ns", 0) or 0
-        for ev in line.events:
-            name = names.get(ev.metadata_id, str(ev.metadata_id))
-            dur = ev.duration_ps // 1000  # -> ns
-            start = base_ns + ev.offset_ps // 1000
-            iv.append((start, start + dur))
-            ops[name] += dur
-            ops_n[name] += 1
-            s_ns += dur
-            n += 1
-        u = union_ns(iv)
-        lanes.append((plane.name, line.name, s_ns, u, n))
-        all_iv.extend(iv)
-        if best is None or u > best[0]:
-            best = (u, ops, ops_n, s_ns)
-    g_union = union_ns(all_iv)
-    print(f"lanes ({len(lanes)}):")
-    for pname, lname, s_ns, u, n in lanes:
-        print(f"  {pname[-40:]:>40s} / {lname[:20]:<20s} "
-              f"sum {s_ns / 1e6:8.2f} ms  union {u / 1e6:8.2f} ms  x{n}")
-    sum_all = sum(s for _, _, s, _, _ in lanes)
-    print(f"RECONCILE: sum-of-ops {sum_all / 1e6:.2f} ms vs device-busy "
-          f"union {g_union / 1e6:.2f} ms over {n_steps} steps "
-          f"(sum/union {sum_all / max(g_union, 1):.2f}x; "
-          f"union {g_union / 1e6 / n_steps:.3f} ms/step vs wall "
-          f"{1e3 * wall / n_steps:.3f} ms/step incl. one fetch)")
-    if best is None:
-        return
-    _, per_op, per_op_n, _ = best
-    total_ns = sum(per_op.values())
-    width = max((len(n) for n, _ in per_op.most_common(25)), default=10)
-    for name, ns in per_op.most_common(25):
-        print(f"{name:<{width}}  {ns / 1e6:9.3f} ms  x{per_op_n[name]:<5} "
-              f"({100.0 * ns / max(total_ns, 1):5.1f}%)")
+          f"({attrib['wall_ms_per_step']:.2f} ms/step incl. one fetch)")
+    print(f"lanes ({attrib['n_lanes']}):")
+    for ln in attrib["lanes"]:
+        print(f"  {ln['plane'][-40:]:>40s} / {ln['line'][:20]:<20s} "
+              f"sum {ln['sum_ms']:8.2f} ms  union {ln['union_ms']:8.2f} ms  "
+              f"x{ln['n_events']}")
+    # Per-lane sum vs interval-UNION: summed per-op times double-count
+    # overlapping/nested event rows; the union is the honest device-busy
+    # attribution. sum/union >> 1 means the per-op percentages overstate
+    # absolute time; a union above chain time points at the chain-side
+    # measurement instead.
+    print(f"RECONCILE: primary-lane sum-of-ops "
+          f"{attrib['total_ms_per_step'] * n_steps:.2f} ms "
+          f"(sum/own-union {attrib['sum_over_union']:.2f}x) vs all-lane "
+          f"device-busy union "
+          f"{attrib['device_busy_ms_per_step'] * n_steps:.2f} ms over "
+          f"{n_steps} steps "
+          f"(union {attrib['device_busy_ms_per_step']:.3f} ms/step vs wall "
+          f"{attrib['wall_ms_per_step']:.3f} ms/step incl. one fetch)")
+    print("classes (primary lane):")
+    for cls, rec in attrib["classes"].items():
+        print(f"  {cls:<14s} {rec['ms_per_step']:9.3f} ms/step "
+              f"({100.0 * rec['frac']:5.1f}%)")
+    width = max((len(o["name"]) for o in attrib["top_ops"]), default=10)
+    for o in attrib["top_ops"]:
+        print(f"{o['name']:<{width}}  {o['ms_per_step'] * n_steps:9.3f} ms  "
+              f"x{o['count']:<5} ({100.0 * o['frac']:5.1f}%)  [{o['class']}]")
 
 
 if __name__ == "__main__":
