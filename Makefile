@@ -10,12 +10,18 @@
 #                   real chip (compiles actual Pallas kernels).
 #   make test-all   Both CPU tiers, then the TPU tier if a chip answers.
 #   make native     Build the C++ host-runtime library (quant codecs, BPE).
-#   make lint       Telemetry metric-name lint (every registered name is
-#                   convention-clean and documented in PERF.md) + the
-#                   exception-hygiene lint (no bare excepts; broad handlers
-#                   in runtime//serve/ must surface their failures) + the
-#                   route-label lint (every route a handler matches is in
-#                   serve/api.py _ROUTES, keeping the label closed-world).
+#   make lint       The unified dlint static-analysis suite
+#                   (python -m tools.dlint; catalog in LINTS.md): the
+#                   trace-safety analyzer (closed-world jit entry through
+#                   plan_scoped_jit / the shard_map shim, tracer-hazard
+#                   detection in traced bodies, guarded-twin tripwire
+#                   completeness), the thread-ownership analyzer
+#                   (owner=loop/monitor/any call-graph checking,
+#                   guarded-by lock discipline, lock-order cycles), and
+#                   the six historical scanners (metric names, exception
+#                   hygiene, route labels, failpoint sites, span phases,
+#                   shard_map shim) consolidated as rules. One rule:
+#                   python -m tools.dlint --only RULE; CI summary: --json.
 #   make bench      The driver's benchmark: ONE JSON line on stdout.
 #   make perf-check The perf-regression sentinel: run the bench and
 #                   compare against the committed PERF_BASELINE.json
@@ -47,12 +53,7 @@ tsan:
 	TSAN_OPTIONS="halt_on_error=1 exitcode=66" ./dllama_tpu/native/tsan_stress
 
 lint:
-	$(PY) tools/check_metrics_names.py
-	$(PY) tools/check_exception_hygiene.py
-	$(PY) tools/check_route_labels.py
-	$(PY) tools/check_failpoint_sites.py
-	$(PY) tools/check_span_phases.py
-	$(PY) tools/check_shard_map_shim.py
+	$(PY) -m tools.dlint
 
 bench:
 	$(PY) bench.py

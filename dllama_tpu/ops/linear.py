@@ -91,7 +91,7 @@ def _kernel_mode() -> str:
     return os.environ.get("DLLAMA_TPU_QUANT_KERNEL", "auto")  # auto|pallas|xla
 
 
-def _fast_mode(x: jax.Array) -> bool:
+def _fast_mode(x: jax.Array) -> bool:  # dlint: static-fn (dtype/env gate)
     """Exact vs fast quant-matmul numerics (SURVEY §7.4's exact/fast split).
 
     ``DLLAMA_TPU_QUANT_MODE``: ``exact`` = f32 dequant + HIGHEST-precision
@@ -141,7 +141,7 @@ def quant_mode_label(activations_bf16: bool) -> str:
     return resolved if mode != "auto" else f"auto({resolved})"
 
 
-def _pallas_wanted(x: jax.Array, w: QuantizedWeight, fast: bool) -> bool:
+def _pallas_wanted(x: jax.Array, w: QuantizedWeight, fast: bool) -> bool:  # dlint: static-fn (shape/env gate)
     mode = _kernel_mode()
     if mode == "xla":
         return False

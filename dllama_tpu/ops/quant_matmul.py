@@ -255,7 +255,7 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
     return fn(x, w.scales, w.codes)
 
 
-def pallas_mode_gate(fast: bool) -> dict | None:
+def pallas_mode_gate(fast: bool) -> dict | None:  # dlint: static-fn
     """The ONE mode/numerics gate for the sharded Pallas kernel: Pallas
     only for exact mode on TPU, or when forced
     (``DLLAMA_TPU_QUANT_KERNEL=pallas`` — interpret mode off-TPU, the
@@ -274,6 +274,7 @@ def pallas_mode_gate(fast: bool) -> dict | None:
     return {"interpret": mode == "pallas" and not _on_tpu()}
 
 
+# dlint: static-fn (shape gate; w may carry ShapeDtypeStruct leaves)
 def pallas_local_choice(x_shape: tuple[int, ...], w: QuantizedWeight,
                         fast: bool) -> dict | None:
     """:func:`pallas_mode_gate` + the shard-shape ``supports`` check —
@@ -291,7 +292,7 @@ def pallas_local_choice(x_shape: tuple[int, ...], w: QuantizedWeight,
 MAX_M = 512
 
 
-def supports(x_shape: tuple[int, ...], w: QuantizedWeight) -> bool:
+def supports(x_shape: tuple[int, ...], w: QuantizedWeight) -> bool:  # dlint: static-fn
     """Whether the kernel's tile grid covers these shapes."""
     K = x_shape[-1]
     M = 1

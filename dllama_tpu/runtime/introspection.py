@@ -121,6 +121,19 @@ _HBM_KINDS = (("temp", "temp_size_in_bytes"),
               ("code", "generated_code_size_in_bytes"))
 
 
+def cost_analysis_dict(compiled) -> dict:
+    """Version-compat accessor for ``compiled.cost_analysis()``: newer
+    jax returns one properties dict, 0.4.x returns a one-element list of
+    dicts — indexing the raw return by key TypeErrors on exactly one of
+    the two. Every consumer (``analyze_compiled`` below, roofline
+    attribution, tests measuring FLOPs) goes through here so the compat
+    decision lives in one place."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # jax<=0.4.x returns [dict]
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
+
+
 def analyze_compiled(program: str, compiled, *,
                      scope: str = "default") -> dict:
     """Pull ``memory_analysis()`` bytes and ``cost_analysis()`` FLOPs off a
@@ -144,9 +157,7 @@ def analyze_compiled(program: str, compiled, *,
     except Exception as e:  # noqa: BLE001 — analysis is advisory, record why
         out["memory_analysis_error"] = f"{type(e).__name__}: {e}"
     try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):  # jax<=0.4.x returns [dict]
-            ca = ca[0] if ca else {}
+        ca = cost_analysis_dict(compiled)
         flops = float(ca.get("flops", 0.0) or 0.0)
         out["flops"] = flops
         reg.gauge(telemetry.PROGRAM_FLOPS).set(flops, scope=scope,

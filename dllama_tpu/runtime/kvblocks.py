@@ -167,7 +167,7 @@ class BlockPool:
 
     # -- alloc / free --------------------------------------------------------
 
-    def alloc(self) -> int:
+    def alloc(self) -> int:  # dlint: owner=loop-thread
         """One fresh block (refcount 1), evicting the LRU cached block when
         the free list is dry. Raises :class:`BlockPoolExhausted` when
         nothing is allocatable — including via the ``kv_alloc`` failpoint
@@ -190,7 +190,7 @@ class BlockPool:
         self._ref[bid] = 1
         return bid
 
-    def share(self, bid: int) -> None:
+    def share(self, bid: int) -> None:  # dlint: owner=loop-thread
         """Take one more reference on a live or cached block."""
         if bid == self.NULL:
             raise ValueError("cannot share the null block")
@@ -200,7 +200,7 @@ class BlockPool:
             del self._cached[bid]
         self._ref[bid] += 1
 
-    def release(self, bid: int) -> None:
+    def release(self, bid: int) -> None:  # dlint: owner=loop-thread
         """Drop one reference. At zero, a registered block parks in the
         cached LRU (still shareable); an unregistered one returns to the
         free list. Releasing a free block is a double free and raises."""
@@ -215,7 +215,7 @@ class BlockPool:
             else:
                 self._free.append(bid)
 
-    def reset(self) -> None:
+    def reset(self) -> None:  # dlint: owner=loop-thread
         """Forget everything (crash recovery): all blocks free, the prefix
         index cleared so nothing can match rows a half-finished dispatch may
         have corrupted."""
@@ -229,7 +229,7 @@ class BlockPool:
 
     # -- prefix sharing ------------------------------------------------------
 
-    def register_prompt(self, bids: list[int], tokens: list[int]) -> None:
+    def register_prompt(self, bids: list[int], tokens: list[int]) -> None:  # dlint: owner=loop-thread
         """Index a committed prompt's blocks for future sharing. ``tokens``
         are the prefill-built prompt ids (``prompt_ids[:-1]``); ``bids`` must
         cover them (``len(bids) >= ceil(len(tokens)/block_size)``). Full
@@ -263,7 +263,7 @@ class BlockPool:
                 self._meta[bid] = ("partial", cid,
                                    tuple(tokens[n_full * bs:]))
 
-    def _unregister(self, bid: int) -> None:
+    def _unregister(self, bid: int) -> None:  # dlint: owner=loop-thread
         kind, pcid, blk = self._meta.pop(bid)
         if kind == "full":
             node = self._nodes.get((pcid, blk))
@@ -281,7 +281,7 @@ class BlockPool:
             if not sibs:
                 del self._by_parent[pcid]
 
-    def match_prefix(self, tokens) -> tuple[list[int], int, int | None, int]:
+    def match_prefix(self, tokens) -> tuple[list[int], int, int | None, int]:  # dlint: owner=loop-thread
         """Longest block-level match of ``tokens`` against the index:
         ``(shared_bids, n_shared_tokens, cow_src_bid, cow_tokens)``.
 

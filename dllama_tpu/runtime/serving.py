@@ -267,7 +267,7 @@ class _GeneratorCore:
         pool prices the request in blocks."""
         return True
 
-    def abort_admit(self, adm: "_Admission") -> None:
+    def abort_admit(self, adm: "_Admission") -> None:  # dlint: owner=loop-thread
         """Roll back an admission that will never commit (client cancel
         mid-prefill, or a prefill dispatch raised). The dense pool has
         nothing to undo — the slot column is pool-owned; the paged pool
@@ -285,7 +285,7 @@ class _GeneratorCore:
         return jnp.float32(0.0 if self.eng.multihost
                            else numerics.poison_code())
 
-    def _retire(self, slot: int, reason: str = "done") -> None:
+    def _retire(self, slot: int, reason: str = "done") -> None:  # dlint: owner=loop-thread
         req = self.slots[slot]
         self.slots[slot] = None
         self._proposers[slot] = None
@@ -304,7 +304,7 @@ class _GeneratorCore:
             self._m_itl_attrib.record(req.ms_preempt, cause="preempt")
         req.done.set()
 
-    def _arm_decode(self, adm: "_Admission") -> None:
+    def _arm_decode(self, adm: "_Admission") -> None:  # dlint: owner=loop-thread
         """Shared commit tail: arm ``adm``'s slot for decode (position,
         seed token, per-request streaming decoder, telemetry span)."""
         req = adm.req
@@ -346,7 +346,7 @@ class _GeneratorCore:
 
     # -- emit/tripwire tails shared by every dispatch kind ------------------
 
-    def _handle_nonfinite(self, active: list[int], nf) -> set[int]:
+    def _handle_nonfinite(self, active: list[int], nf) -> set[int]:  # dlint: owner=loop-thread
         """Non-finite tripwire tail for one ragged dispatch: count each
         poisoned row's event (``dllama_nonfinite_total{site="batch"}``);
         with fail-fast armed, fail THAT request explicitly (503-shaped —
@@ -373,7 +373,7 @@ class _GeneratorCore:
         pool)."""
         raise NotImplementedError
 
-    def _sweep_cancelled(self) -> list[int]:
+    def _sweep_cancelled(self) -> list[int]:  # dlint: owner=loop-thread
         """Retire client-cancelled slots; return the active row list."""
         for i, s in enumerate(self.slots):
             if s is not None and s.cancel.is_set():
@@ -449,7 +449,7 @@ class _GeneratorCore:
         """Block-pool occupancy for the tick record (paged pool only)."""
         return None
 
-    def _emit_run(self, i: int, run: list[int]) -> int:
+    def _emit_run(self, i: int, run: list[int]) -> int:  # dlint: owner=loop-thread
         """Deliver a run of tokens to slot ``i``'s request: append, stream,
         advance position, retire on EOS / limits. Returns tokens emitted.
         The run is pre-truncated to the ACCEPTED prefix; EOS/max_tokens
@@ -643,12 +643,16 @@ class BatchedGenerator(_GeneratorCore):
                                              static_argnums=1,
                                              donate_argnums=(4,))
                              if engine.multihost else engine._step)
-        # slot-column gather/scatter for per-slot prefill
-        self._take = jax.jit(
+        # slot-column gather/scatter for per-slot prefill. Raw jit is
+        # deliberate: these lambdas are plan-independent data movement
+        # (no constrain() in the bodies), so the plan-scoped per-engine
+        # cache argument does not apply and sharing their executables
+        # across engines is correct.
+        self._take = jax.jit(  # dlint: disable=jit-entry
             lambda kv, b: KVCache(
                 k=jax.lax.dynamic_slice_in_dim(kv.k, b, 1, axis=1),
                 v=jax.lax.dynamic_slice_in_dim(kv.v, b, 1, axis=1)))
-        self._put = jax.jit(
+        self._put = jax.jit(  # dlint: disable=jit-entry
             lambda kv, col, b: KVCache(
                 k=jax.lax.dynamic_update_slice_in_dim(kv.k, col.k, b, axis=1),
                 v=jax.lax.dynamic_update_slice_in_dim(kv.v, col.v, b, axis=1)),
@@ -728,7 +732,7 @@ class BatchedGenerator(_GeneratorCore):
 
     # -- slot lifecycle -----------------------------------------------------
 
-    def begin_admit(self, req: Request, slot: int) -> "_Admission":
+    def begin_admit(self, req: Request, slot: int) -> "_Admission":  # dlint: owner=loop-thread
         """Start admitting a request into ``slot``: the slot's cache column
         is gathered to a [L, 1, ...] view and prefilled INCREMENTALLY — one
         n_batches chunk per :meth:`continue_admit` call — so a long prompt
@@ -770,7 +774,7 @@ class BatchedGenerator(_GeneratorCore):
                 best, best_k = s, k
         return best, best_k
 
-    def continue_admit(self, adm: "_Admission") -> bool:
+    def continue_admit(self, adm: "_Admission") -> bool:  # dlint: owner=loop-thread
         """Run one prefill chunk; True when the slot is armed for decode."""
         rest = adm.req.prompt_ids[:-1]
         if adm.pos < len(rest):
@@ -797,13 +801,13 @@ class BatchedGenerator(_GeneratorCore):
         self._arm_decode(adm)
         return True
 
-    def admit(self, req: Request, slot: int) -> None:
+    def admit(self, req: Request, slot: int) -> None:  # dlint: owner=loop-thread
         """Admit in one go (tests / non-interleaved callers)."""
         adm = self.begin_admit(req, slot)
         while not self.continue_admit(adm):
             pass
 
-    def reset_state(self) -> None:
+    def reset_state(self) -> None:  # dlint: owner=loop-thread
         """Forget every slot, cached prefix, and proposer — crash
         recovery. The pool restarts logically empty: ``_ctx`` is cleared
         so no later admission can prefix-match rows a half-finished
@@ -821,7 +825,7 @@ class BatchedGenerator(_GeneratorCore):
 
     # -- the batched step ---------------------------------------------------
 
-    def step(self) -> int:
+    def step(self) -> int:  # dlint: owner=loop-thread
         """One ragged decode step for every active slot; returns the number
         of tokens emitted. Inactive slots ride along as temp-0 rows writing
         into their own (unused) cache positions — static shapes, one
@@ -867,7 +871,7 @@ class BatchedGenerator(_GeneratorCore):
         self._record_step(len(active), ms, emitted)
         return emitted
 
-    def step_chunk(self, k: int) -> int:
+    def step_chunk(self, k: int) -> int:  # dlint: owner=loop-thread
         """K fused ragged decode steps in one dispatch (models.sampled_steps, ragged form).
 
         Falls back to :meth:`step` when chunking can't apply this tick:
@@ -934,7 +938,7 @@ class BatchedGenerator(_GeneratorCore):
                    if s is not None)
         return live / (self.n_slots * self.cfg.seq_len)
 
-    def _spec_step(self, active: list[int], temps, topps, coins) -> int:
+    def _spec_step(self, active: list[int], temps, topps, coins) -> int:  # dlint: owner=loop-thread
         """One ragged speculative verify dispatch (models.ragged_verify_step):
         greedy rows emit their accepted run, sampled rows exactly one token."""
         toks = np.zeros((self.n_slots, self.spec + 1), dtype=np.int32)
@@ -1089,9 +1093,12 @@ class PagedGenerator(_GeneratorCore):
                                                            axis=1)
             return PagedKVCache(k=cp(pkv.k), v=cp(pkv.v))
 
-        self._take = jax.jit(_take_fn)
-        self._put = jax.jit(_put_fn, donate_argnums=(0,))
-        self._copy_block = jax.jit(_copy_fn, donate_argnums=(0,))
+        # raw jit is deliberate for the three block-movement programs:
+        # plan-independent gather/scatter/copy (no constrain()), safe to
+        # share across engines — same argument as the dense pool's pair
+        self._take = jax.jit(_take_fn)  # dlint: disable=jit-entry
+        self._put = jax.jit(_put_fn, donate_argnums=(0,))  # dlint: disable=jit-entry
+        self._copy_block = jax.jit(_copy_fn, donate_argnums=(0,))  # dlint: disable=jit-entry
         # warm-up normalization: pass the freshly created (committed) pool
         # through one no-op jitted copy (null block onto itself). Two birds:
         # the copy-on-write program is compiled BEFORE serving reaches
@@ -1141,7 +1148,7 @@ class PagedGenerator(_GeneratorCore):
 
     # -- admission ----------------------------------------------------------
 
-    def begin_admit(self, req: Request, slot: int) -> "_Admission":
+    def begin_admit(self, req: Request, slot: int) -> "_Admission":  # dlint: owner=loop-thread
         """Start admitting into ``slot``: match the prompt against the
         block-level prefix index (share full blocks, copy-on-write the
         partial tail), allocate the remaining prompt blocks, and gather
@@ -1248,7 +1255,7 @@ class PagedGenerator(_GeneratorCore):
                     jnp.int32(pos), col)
             return col
 
-    def continue_admit(self, adm: "_Admission") -> bool:
+    def continue_admit(self, adm: "_Admission") -> bool:  # dlint: owner=loop-thread
         """One prefill chunk over the gathered column; commit scatters it
         back through the block table (shared-prefix entries redirected to
         the null block — a shared block is never a write target) and
@@ -1284,13 +1291,13 @@ class PagedGenerator(_GeneratorCore):
         self._arm_decode(adm)
         return True
 
-    def admit(self, req: Request, slot: int) -> None:
+    def admit(self, req: Request, slot: int) -> None:  # dlint: owner=loop-thread
         """Admit in one go (tests / non-interleaved callers)."""
         adm = self.begin_admit(req, slot)
         while not self.continue_admit(adm):
             pass
 
-    def _release_blocks(self, slot: int) -> None:
+    def _release_blocks(self, slot: int) -> None:  # dlint: owner=loop-thread
         """Drop every block reference ``slot`` holds and forget its
         bookkeeping (shared count, growth reservation, table row — the
         all-null row sends ride-along writes to the null block)."""
@@ -1302,11 +1309,11 @@ class PagedGenerator(_GeneratorCore):
         self.tables[slot, :] = self.pool.NULL
         self._update_block_gauges()
 
-    def _retire(self, slot: int, reason: str = "done") -> None:
+    def _retire(self, slot: int, reason: str = "done") -> None:  # dlint: owner=loop-thread
         super()._retire(slot, reason)
         self._release_blocks(slot)
 
-    def abort_admit(self, adm: "_Admission") -> None:
+    def abort_admit(self, adm: "_Admission") -> None:  # dlint: owner=loop-thread
         """Release everything ``begin_admit`` took for an admission that
         will never commit. Safe in every abort window: blocks this
         admission allocated fresh are unregistered (they free outright),
@@ -1314,7 +1321,7 @@ class PagedGenerator(_GeneratorCore):
         contents stay valid for other sequences."""
         self._release_blocks(adm.slot)
 
-    def reset_state(self) -> None:
+    def reset_state(self) -> None:  # dlint: owner=loop-thread
         """Crash recovery: every slot forgotten, the whole pool (refcounts
         AND the prefix index) reset — nothing can match blocks a
         half-finished dispatch may have corrupted."""
@@ -1333,7 +1340,7 @@ class PagedGenerator(_GeneratorCore):
 
     # -- decode -------------------------------------------------------------
 
-    def _ensure_block(self, i: int) -> None:
+    def _ensure_block(self, i: int) -> None:  # dlint: owner=loop-thread
         """Lazy block growth: guarantee slot ``i``'s write position has a
         physical block before the dispatch (the continuous-batching
         memory win — a sequence only ever holds the blocks its live
@@ -1345,7 +1352,7 @@ class PagedGenerator(_GeneratorCore):
             self._reserve[i] = max(0, self._reserve[i] - 1)
             self.tables[i, idx] = bid
 
-    def step(self) -> int:
+    def step(self) -> int:  # dlint: owner=loop-thread
         """One paged ragged decode step for every active slot. Inactive
         slots ride along with all-null tables (their writes land in the
         null block) — static shapes, one compiled program regardless of
@@ -1402,7 +1409,7 @@ class PagedGenerator(_GeneratorCore):
         self._update_block_gauges()
         return emitted
 
-    def step_chunk(self, k: int) -> int:
+    def step_chunk(self, k: int) -> int:  # dlint: owner=loop-thread
         """Fused multi-step decode is not built for the paged path yet
         (engine validation rejects --decode-chunk with --kv-block-size);
         direct callers degrade to single steps."""
@@ -1458,14 +1465,18 @@ class BatchScheduler:
         self.flight = self.gen.flight
         self.max_queue = max_queue
         self.max_restarts = max_restarts
-        self._queue: list[Request] = []
-        self._admissions: list[_Admission] = []
+        # shared scheduler state: mutated by handler threads (submit),
+        # the loop thread, the closer, and the watchdog monitor — every
+        # write outside __init__ must hold _lock (machine-checked by
+        # dlint's lock-guard rule via the guarded-by declarations)
+        self._queue: list[Request] = []          # dlint: guarded-by=_lock
+        self._admissions: list[_Admission] = []  # dlint: guarded-by=_lock
         self._lock = threading.Lock()
         self._wake = threading.Event()
-        self._next_rid = 0
-        self._stop = False
-        self._draining = False
-        self._healthy = True
+        self._next_rid = 0                       # dlint: guarded-by=_lock
+        self._stop = False                       # dlint: guarded-by=_lock
+        self._draining = False                   # dlint: guarded-by=_lock
+        self._healthy = True                     # dlint: guarded-by=_lock
         self._crashes = 0
         # retrace sentinel (runtime.introspection): after STEADY_TICKS
         # consecutive work-carrying loop ticks with zero compiles in this
@@ -1486,7 +1497,7 @@ class BatchScheduler:
 
     # -- admission-side API (handler threads) -------------------------------
 
-    def submit(self, prompt_ids: list[int], max_tokens: int, *,
+    def submit(self, prompt_ids: list[int], max_tokens: int, *,  # dlint: owner=any
                temperature: float = 0.0, topp: float = 0.9,
                seed: int = 0xB1A5, stop_on_eos: bool = True,
                timeout_s: float | None = None, on_token=None) -> Request:
@@ -1523,18 +1534,18 @@ class BatchScheduler:
         self._wake.set()
         return req
 
-    def generate(self, prompt_ids: list[int], max_tokens: int,
+    def generate(self, prompt_ids: list[int], max_tokens: int,  # dlint: owner=any
                  **kw) -> list[int]:
         req = self.submit(prompt_ids, max_tokens, **kw)
         req.done.wait()
         return req.tokens
 
-    def is_alive(self) -> bool:
+    def is_alive(self) -> bool:  # dlint: owner=any
         """Loop thread running and not crash-exhausted."""
         return (self._healthy and not self._stop
                 and (self._thread is None or self._thread.is_alive()))
 
-    def readiness(self) -> tuple[bool, str]:
+    def readiness(self) -> tuple[bool, str]:  # dlint: owner=any
         """(ready, reason) for ``GET /readyz``: scheduler alive ∧ not
         draining ∧ queue below the shed threshold ∧ no watchdog stall."""
         if self._watchdog is not None and self._watchdog.stalled:
@@ -1551,19 +1562,21 @@ class BatchScheduler:
 
     # -- shutdown ------------------------------------------------------------
 
-    def begin_drain(self) -> None:
+    def begin_drain(self) -> None:  # dlint: owner=any
         """Stop admitting (submits raise 503-shaped errors, ``/readyz``
         flips) while in-flight work keeps stepping — phase one of a
-        graceful shutdown."""
-        self._draining = True
+        graceful shutdown. The flag flips under the lock so no submit
+        can interleave between its availability check and the enqueue."""
+        with self._lock:
+            self._draining = True
         telemetry.registry().gauge(telemetry.SERVER_DRAINING).set(1)
         self._wake.set()
 
-    def _pending(self) -> int:
+    def _pending(self) -> int:  # dlint: owner=any
         with self._lock:
             return len(self._queue) + len(self._admissions)
 
-    def close(self, drain_s: float = 0.0) -> None:
+    def close(self, drain_s: float = 0.0) -> None:  # dlint: owner=any
         """Stop admitting, drain active work up to ``drain_s`` seconds,
         then stop the loop and fail whatever remains — every waiter's
         ``done`` is set by the time this returns."""
@@ -1574,7 +1587,8 @@ class BatchScheduler:
             while time.monotonic() < deadline and (
                     self._pending() or self.gen.n_active):
                 time.sleep(0.01)
-        self._stop = True
+        with self._lock:
+            self._stop = True
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
@@ -1584,18 +1598,18 @@ class BatchScheduler:
 
     # -- failure plumbing ----------------------------------------------------
 
-    def _fail_request(self, req: Request, msg: str) -> None:
+    def _fail_request(self, req: Request, msg: str) -> None:  # dlint: owner=any
         if not req.done.is_set():
             if not req.timed_out:
                 req.error = msg
                 req.server_error = True
             req.done.set()
 
-    def _timeout_request(self, req: Request) -> None:
+    def _timeout_request(self, req: Request) -> None:  # dlint: owner=any
         req.timed_out = True
         telemetry.registry().counter(telemetry.REQUEST_TIMEOUTS).inc()
 
-    def _fail_all(self, msg: str) -> None:
+    def _fail_all(self, msg: str) -> None:  # dlint: owner=any
         """Fail every queued, admitting, and in-flight request with
         ``msg`` (idempotent; timed-out requests keep their flag)."""
         with self._lock:
@@ -1616,7 +1630,7 @@ class BatchScheduler:
         for req in victims:
             self._fail_request(req, msg)
 
-    def _check_deadlines(self) -> None:
+    def _check_deadlines(self) -> None:  # dlint: owner=loop-thread
         """Queued requests past deadline fail now; in-flight ones are
         cancelled (their slot retires at the next step boundary)."""
         now = telemetry.now_ns()
@@ -1646,7 +1660,7 @@ class BatchScheduler:
                 self.flight.note("timeout", s.rid, reason="in_flight")
                 s.cancel.set()
 
-    def _on_stall(self, info: dict) -> None:
+    def _on_stall(self, info: dict) -> None:  # dlint: owner=monitor-thread
         """Watchdog trip (runs on the MONITOR thread — the loop thread is
         the one wedged inside a dispatch, so it cannot supervise itself):
         flip unready first, under the lock, so no submit slips in after
@@ -1673,7 +1687,7 @@ class BatchScheduler:
                                "waited_s": info.get("waited_s")})
         self._wake.set()
 
-    def _on_crash(self, exc: BaseException) -> None:
+    def _on_crash(self, exc: BaseException) -> None:  # dlint: owner=loop-thread
         """Supervision: surface the crash to every pending request, then
         restart with a fresh pool — or go permanently unready once the
         restart budget is spent (or under multihost, where replaying a
@@ -1718,7 +1732,7 @@ class BatchScheduler:
 
     # -- the loop ------------------------------------------------------------
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # dlint: owner=loop-thread
         while not self._stop:
             try:
                 self._tick()
@@ -1727,7 +1741,7 @@ class BatchScheduler:
 
     STEADY_TICKS = 2  # compile-quiet work ticks before steady is declared
 
-    def _mark_steady_if_quiet(self, compiles_before: int) -> None:
+    def _mark_steady_if_quiet(self, compiles_before: int) -> None:  # dlint: owner=loop-thread
         scope = self._introspect_scope
         led = introspection.ledger()
         if scope is None or led.steady(scope):
@@ -1739,7 +1753,7 @@ class BatchScheduler:
         else:
             self._quiet_ticks = 0
 
-    def _tick(self) -> None:
+    def _tick(self) -> None:  # dlint: owner=loop-thread
         """One loop tick under flight-recorder framing: the tick record
         (runtime/flightrec) captures every decision, dispatch, and the
         block-pool state — idle ticks are dropped by ``end_tick``, so the
@@ -1762,7 +1776,7 @@ class BatchScheduler:
                        for s in self.gen.slots],
                 prefill_budget=self.prefill_budget)
 
-    def _tick_body(self) -> None:
+    def _tick_body(self) -> None:  # dlint: owner=loop-thread
         compiles_before = (
             introspection.ledger().compile_count(self._introspect_scope)
             if self._introspect_scope else 0)
@@ -1819,7 +1833,13 @@ class BatchScheduler:
         # for the remaining ticks of the admissions ahead of it
         for adm in list(self._admissions):
             if adm.req.cancel.is_set():
-                self._admissions.remove(adm)
+                # mutation under the lock: _fail_all (any thread) clears
+                # this list concurrently — an unlocked remove could race
+                # the clear and raise into the crash supervisor
+                with self._lock:
+                    if adm not in self._admissions:
+                        continue  # a concurrent _fail_all already took it
+                    self._admissions.remove(adm)
                 self.gen.abort_admit(adm)  # paged: release the blocks
                 # counted as admitted in begin_admit: balance the pair so
                 # admissions_total - retires_total stays "live requests"
@@ -1839,9 +1859,13 @@ class BatchScheduler:
             spent += self.gen.eng._prefill_chunk_size(max(1, remaining))
             try:
                 if self.gen.continue_admit(adm):
-                    self._admissions.remove(adm)
+                    with self._lock:
+                        if adm in self._admissions:
+                            self._admissions.remove(adm)
             except Exception as e:  # noqa: BLE001 — reject, don't wedge
-                self._admissions.remove(adm)
+                with self._lock:
+                    if adm in self._admissions:
+                        self._admissions.remove(adm)
                 self.gen.abort_admit(adm)
                 telemetry.registry().counter(telemetry.RETIRES).inc()
                 adm.req.error = f"{type(e).__name__}: {e}"

@@ -102,7 +102,7 @@ class StepWatchdog:
 
     # -- budget --------------------------------------------------------------
 
-    def budget_s(self) -> float | None:
+    def budget_s(self) -> float | None:  # dlint: owner=any
         """Current deadline budget, or None while the EWMA is still
         training (fewer than ``min_samples`` observations)."""
         if not self.enabled or self.n_samples < self.min_samples \
@@ -110,7 +110,7 @@ class StepWatchdog:
             return None
         return max(self.min_budget_s, self.ewma_ms / 1000.0 * self.margin)
 
-    def observe(self, ms: float) -> None:
+    def observe(self, ms: float) -> None:  # dlint: owner=any
         """Feed one completed step's wall time into the EWMA."""
         with self._lock:
             self.ewma_ms = ms if self.ewma_ms is None else (
@@ -120,7 +120,7 @@ class StepWatchdog:
     # -- guarding ------------------------------------------------------------
 
     @contextmanager
-    def guard(self, label: str):
+    def guard(self, label: str):  # dlint: owner=any
         """Arm a deadline around one device dispatch; always records the
         observed duration on exit (the EWMA trains even before arming)."""
         budget = self.budget_s()
@@ -134,7 +134,7 @@ class StepWatchdog:
                 self._disarm()
             self.observe((time.perf_counter() - t0) * 1000.0)
 
-    def _arm(self, label: str, t0: float, deadline: float) -> None:
+    def _arm(self, label: str, t0: float, deadline: float) -> None:  # dlint: owner=any
         with self._lock:
             self._deadline = deadline
             self._armed_label = label
@@ -147,12 +147,12 @@ class StepWatchdog:
                 self._thread.start()
         self._wake.set()
 
-    def _disarm(self) -> None:
+    def _disarm(self) -> None:  # dlint: owner=any
         with self._lock:
             self._deadline = None
             self._armed_label = None
 
-    def close(self) -> None:
+    def close(self) -> None:  # dlint: owner=any
         with self._lock:
             self._closed = True
             self._deadline = None
@@ -160,7 +160,7 @@ class StepWatchdog:
 
     # -- monitor thread ------------------------------------------------------
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # dlint: owner=monitor-thread
         while True:
             with self._lock:
                 if self._closed:
@@ -187,7 +187,7 @@ class StepWatchdog:
             self._wake.wait(timeout=timeout)
             self._wake.clear()
 
-    def _trip(self, info: dict) -> None:
+    def _trip(self, info: dict) -> None:  # dlint: owner=monitor-thread
         self.stalled = True
         self.stall_count += 1
         telemetry.registry().counter(telemetry.WATCHDOG_STALLS).inc(
@@ -204,7 +204,7 @@ class StepWatchdog:
                 print(f"🛑 watchdog on_stall callback failed: "
                       f"{type(e).__name__}: {e}", flush=True)
 
-    def _dump_diagnostics(self) -> None:
+    def _dump_diagnostics(self) -> None:  # dlint: owner=monitor-thread
         """Compile-ledger state + all-thread stacks to stderr: enough to
         tell 'XLA is compiling again' from 'wedged inside a dispatch'."""
         try:

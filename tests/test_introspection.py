@@ -348,3 +348,35 @@ def test_debug_routes_have_their_own_metric_labels(two_servers):
     assert 'route="/debug/compiles",status="200"' in text
     assert 'route="/debug/profile",status="200"' in text
     assert 'route="/debug/requests",status="200"' in text
+
+
+# -- cost_analysis version compat ---------------------------------------------
+
+class _FakeCompiled:
+    """cost_analysis() return shape varies by jax version: a dict on new
+    jax, [dict] on 0.4.x. The shared accessor must normalize both."""
+
+    def __init__(self, ret):
+        self._ret = ret
+
+    def cost_analysis(self):
+        return self._ret
+
+
+@pytest.mark.parametrize("ret, want", [
+    ({"flops": 7.0}, {"flops": 7.0}),        # newer jax: one dict
+    ([{"flops": 7.0}], {"flops": 7.0}),      # 0.4.x: one-element list
+    (({"flops": 7.0},), {"flops": 7.0}),     # tuple variant
+    ([], {}),                                # no analysis available
+    (None, {}),
+])
+def test_cost_analysis_dict_normalizes_every_shape(ret, want):
+    assert introspection.cost_analysis_dict(_FakeCompiled(ret)) == want
+
+
+def test_cost_analysis_dict_is_what_the_moe_flops_test_consumes():
+    """The satellite contract: tests/test_moe.py measures FLOPs through
+    THIS accessor, so `[dict]`-returning jax can never TypeError it
+    again. Keyed access on the normalized dict must work."""
+    ca = introspection.cost_analysis_dict(_FakeCompiled([{"flops": 3.5}]))
+    assert ca["flops"] == 3.5

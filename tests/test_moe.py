@@ -344,8 +344,12 @@ def test_sparse_flops_scale_with_k_not_E():
     h = jnp.ones((1, 8, cfg.dim), jnp.float32)  # N*k = 16 -> gather path
 
     def flops(impl):
+        from dllama_tpu.runtime.introspection import cost_analysis_dict
+
         fn = jax.jit(lambda hh: _moe_ffn(_replace(cfg, moe_impl=impl), hh, lp))
-        return fn.lower(h).compile().cost_analysis()["flops"]
+        # cost_analysis() returns [dict] on this jax, a dict on newer —
+        # the shared version-compat accessor owns that decision
+        return cost_analysis_dict(fn.lower(h).compile())["flops"]
 
     dense, sparse = flops("dense"), flops("sparse")
     # dense FFN ~ N*E*3*D*H; sparse ~ N*k*3*D*H (+ routing/gather overhead).

@@ -129,9 +129,21 @@ def test_tp_forward_with_pallas_kernel_matches_xla(tp, monkeypatch):
     kv_shardings = kv_cache_sharding(plan, KVCache.create(cfg))
 
     def run():
+        from dllama_tpu.parallel.api import plan_scoped_jit
+
         kv = jax.device_put(KVCache.create(cfg), kv_shardings)
         with use_plan(plan):
-            logits, _ = jax.jit(forward, static_argnums=1)(
+            # plan_scoped_jit, NOT a raw jit of the shared module-level
+            # forward: jax's trace cache keys on the function identity,
+            # so a raw jit here reuses the trace of whichever tp ran
+            # first ("Received incompatible devices ... sharding_
+            # constraint inside jit" on the second parametrization) and
+            # lets the second run() of THIS parametrization ride the
+            # first's trace-time DLLAMA_TPU_QUANT_KERNEL decision —
+            # comparing a program against itself. A fresh per-call
+            # closure re-traces both honestly (the jit-entry invariant
+            # tools/dlint enforces in the package).
+            logits, _ = plan_scoped_jit(forward, static_argnums=1)(
                 sharded, cfg, tokens, jnp.int32(0), kv)
         return np.asarray(logits)
 

@@ -1,136 +1,24 @@
 #!/usr/bin/env python
-"""Exception-hygiene lint (Makefile ``lint`` target).
+"""Exception-hygiene lint: no bare excepts; broad handlers in runtime//serve/ must surface their failures to a waiter.
 
-The serving stack's fault-tolerance contract (ISSUE 2) is that no failure
-is silently swallowed: a request either completes, or its waiter gets an
-explicit error — never a hung ``done.wait()``. Broad exception handlers
-are where that contract quietly erodes, so this lint enforces:
-
-1. **no bare ``except:``** anywhere in ``dllama_tpu/`` — a bare clause
-   catches ``KeyboardInterrupt``/``SystemExit`` and masks shutdown;
-2. every ``except Exception`` / ``except BaseException`` handler in
-   ``dllama_tpu/runtime/`` and ``dllama_tpu/serve/`` (the layers that own
-   request lifecycles) must do at least one of:
-
-   * **re-raise** (a ``raise`` statement anywhere in the handler body),
-   * **surface the failure to a waiter** — assign to an ``.error``
-     attribute or call a failure-plumbing method (``done.set``,
-     ``_fail_all``, ``_fail_request``, ``_on_crash``, ``os._exit``),
-   * **justify itself** with ``# noqa: BLE001`` plus a reason on the
-     ``except`` line (the flake8-blind-except code, kept grep-compatible).
-
-Pure AST + source text — no imports of the package, runnable without jax.
+Thin wrapper (Makefile ``lint`` compatibility): the scanner itself now
+lives on the shared dlint framework as the ``exception-hygiene`` rule —
+``python -m tools.dlint --only exception-hygiene`` is the canonical entry point;
+this script exists so historical CLI invocations keep working.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "dllama_tpu"
-# layers that own request lifecycles: broad handlers here must plumb the
-# failure somewhere a waiter can see it
-STRICT_DIRS = (PKG / "runtime", PKG / "serve")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# calls that count as "the failure reached a waiter / supervisor".
-# Bare `set` is NOT enough (a telemetry gauge .set(0) or _wake.set()
-# would trivially pass) — only the `done.set` chain counts.
-_SURFACING_CALLS = {"_fail_all", "_fail_request", "_on_crash", "_exit"}
-
-
-def _is_broad(node: ast.ExceptHandler) -> bool:
-    """except Exception / except BaseException (bare handled separately)."""
-
-    def broad_name(t: ast.expr) -> bool:
-        return isinstance(t, ast.Name) and t.id in ("Exception",
-                                                    "BaseException")
-
-    t = node.type
-    if t is None:
-        return False
-    if broad_name(t):
-        return True
-    return isinstance(t, ast.Tuple) and any(broad_name(e) for e in t.elts)
-
-
-def _walk_same_scope(stmts):
-    """Walk statements without descending into nested function/class
-    definitions — a `raise` inside a callback defined in the handler
-    does not surface THIS handler's failure."""
-    todo = list(stmts)
-    while todo:
-        node = todo.pop()
-        yield node
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef, ast.Lambda)):
-                continue
-            todo.append(child)
-
-
-def _handler_ok(node: ast.ExceptHandler, src_lines: list[str]) -> bool:
-    line = src_lines[node.lineno - 1]
-    if "noqa: BLE001" in line:
-        return True
-    for sub in _walk_same_scope(node.body):
-        if isinstance(sub, ast.Raise):
-            return True
-        if isinstance(sub, ast.Assign):
-            for tgt in sub.targets:
-                if isinstance(tgt, ast.Attribute) and tgt.attr == "error":
-                    return True
-        if isinstance(sub, ast.Call):
-            f = sub.func
-            name = f.attr if isinstance(f, ast.Attribute) else (
-                f.id if isinstance(f, ast.Name) else "")
-            if name in _SURFACING_CALLS:
-                return True
-            # `<...>.done.set()` — the one .set() chain that counts
-            if (name == "set" and isinstance(f, ast.Attribute)
-                    and isinstance(f.value, ast.Attribute)
-                    and f.value.attr == "done"):
-                return True
-    return False
+from tools.dlint import Project, run_rules  # noqa: E402
 
 
 def main() -> int:
-    errors: list[str] = []
-    n_handlers = 0
-    for py in sorted(PKG.rglob("*.py")):
-        src = py.read_text(encoding="utf-8")
-        try:
-            tree = ast.parse(src, filename=str(py))
-        except SyntaxError as e:
-            errors.append(f"{py.relative_to(REPO)}: unparseable: {e}")
-            continue
-        src_lines = src.splitlines()
-        strict = any(d in py.parents for d in STRICT_DIRS)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            rel = py.relative_to(REPO)
-            if node.type is None:
-                errors.append(
-                    f"{rel}:{node.lineno}: bare `except:` (catches "
-                    f"KeyboardInterrupt/SystemExit; name the exception)")
-                continue
-            if strict and _is_broad(node):
-                n_handlers += 1
-                if not _handler_ok(node, src_lines):
-                    errors.append(
-                        f"{rel}:{node.lineno}: `except Exception` must "
-                        f"set a request .error, re-raise, surface via "
-                        f"done.set/_fail_*, or carry `# noqa: BLE001 — "
-                        f"<reason>` on the except line")
-    if errors:
-        for e in errors:
-            print(f"❌ {e}", file=sys.stderr)
-        return 1
-    print(f"✅ exception hygiene: no bare excepts; {n_handlers} broad "
-          f"handlers in runtime/+serve/ all surface their failures")
-    return 0
+    return run_rules(Project(), only=["exception-hygiene"])
 
 
 if __name__ == "__main__":

@@ -1,96 +1,24 @@
 #!/usr/bin/env python
-"""Failpoint-site lint (Makefile ``lint`` target).
+"""Failpoint-site lint: every failpoints.fire() site is documented in the Site registry and every documented site fires.
 
-The chaos suite (tests/test_chaos.py) can only drive failure paths whose
-injection sites exist and are named what the docs say they are named. The
-contract is closed-world, both directions:
-
-1. every ``failpoints.fire("<name>")`` call site in ``dllama_tpu/`` uses a
-   name documented in the Site registry of ``runtime/failpoints.py``'s
-   module docstring (an undocumented site is chaos coverage nobody knows
-   to arm);
-2. every documented site name has at least one call site (a documented
-   site with no ``fire`` is a failure path the chaos tests BELIEVE they
-   can drive but can't — the worst kind of rot).
-
-Pure AST + docstring parsing — no imports of the package, runnable
-without jax.
+Thin wrapper (Makefile ``lint`` compatibility): the scanner itself now
+lives on the shared dlint framework as the ``failpoint-sites`` rule —
+``python -m tools.dlint --only failpoint-sites`` is the canonical entry point;
+this script exists so historical CLI invocations keep working.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "dllama_tpu"
-FAILPOINTS = PKG / "runtime" / "failpoints.py"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# docstring registry entries: "* ``name`` — description"
-_REGISTRY_RE = re.compile(r"^\* ``([a-z_]+)``", re.MULTILINE)
-
-
-def documented_sites() -> set[str]:
-    tree = ast.parse(FAILPOINTS.read_text(encoding="utf-8"),
-                     filename=str(FAILPOINTS))
-    doc = ast.get_docstring(tree) or ""
-    return set(_REGISTRY_RE.findall(doc))
-
-
-def fired_sites() -> dict[str, list[str]]:
-    """name -> ["path:lineno", ...] over every ``failpoints.fire(<const>)``
-    call in the package (tests arm ad-hoc names like ``chaos.x`` through
-    the registry object directly; production sites all go through the
-    module-level ``failpoints.fire``)."""
-    out: dict[str, list[str]] = {}
-    for py in sorted(PKG.rglob("*.py")):
-        if py == FAILPOINTS:
-            continue  # the registry's own generic fire(name) plumbing
-        tree = ast.parse(py.read_text(encoding="utf-8"), filename=str(py))
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "fire"
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id == "failpoints"):
-                continue
-            where = f"{py.relative_to(REPO)}:{node.lineno}"
-            if not (node.args and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
-                print(f"❌ {where}: failpoints.fire() with a non-literal "
-                      f"site name — the closed world can't see it",
-                      file=sys.stderr)
-                sys.exit(1)
-            out.setdefault(node.args[0].value, []).append(where)
-    return out
+from tools.dlint import Project, run_rules  # noqa: E402
 
 
 def main() -> int:
-    documented = documented_sites()
-    fired = fired_sites()
-    errors: list[str] = []
-    if not documented:
-        errors.append("no Site registry entries found in "
-                      "runtime/failpoints.py's module docstring "
-                      "(expected '* ``name`` — ...' lines)")
-    for name, sites in sorted(fired.items()):
-        if name not in documented:
-            errors.append(f"site {name!r} is fired at {sites[0]} but not "
-                          f"documented in the failpoints.py Site registry")
-    for name in sorted(documented - set(fired)):
-        errors.append(f"site {name!r} is documented in the failpoints.py "
-                      f"Site registry but never fired anywhere in "
-                      f"dllama_tpu/ — dead chaos surface")
-    if errors:
-        for e in errors:
-            print(f"❌ {e}", file=sys.stderr)
-        return 1
-    n_sites = sum(len(v) for v in fired.values())
-    print(f"✅ failpoint sites closed-world: {len(fired)} names over "
-          f"{n_sites} call sites, all documented (and vice versa)")
-    return 0
+    return run_rules(Project(), only=["failpoint-sites"])
 
 
 if __name__ == "__main__":

@@ -1,99 +1,24 @@
 #!/usr/bin/env python
-"""Metric-name lint (Makefile ``lint`` target).
+"""Metric-name lint: telemetry.SPECS naming convention + PERF.md docs + source literals, closed-world in both directions.
 
-Closed-world in BOTH directions, all against the single declaration point
-(``dllama_tpu.runtime.telemetry.SPECS``):
-
-1. every registered metric name matches ``dllama_[a-z0-9_]+`` (the wire
-   convention Prometheus relabeling and the dashboards assume; digits
-   admitted for format names like ``q80``);
-2. every registered name is documented in PERF.md (the telemetry section
-   is the operator contract — an undocumented metric is a doc bug);
-3. every quoted ``dllama_*`` metric-shaped literal in the package source
-   is registered (catches typo'd or orphaned instrumentation that would
-   KeyError at runtime or silently never render);
-4. every ``dllama_*`` metric-shaped token in PERF.md is a registered
-   family (catches stale docs that keep promising a metric the code no
-   longer emits — the reverse of check 2). Prometheus-derived suffixes
-   (``_bucket``/``_sum``/``_count`` of a registered histogram) are
-   allowed.
-
-Importing only the telemetry module keeps this runnable without jax.
+Thin wrapper (Makefile ``lint`` compatibility): the scanner itself now
+lives on the shared dlint framework as the ``metrics-names`` rule —
+``python -m tools.dlint --only metrics-names`` is the canonical entry point;
+this script exists so historical CLI invocations keep working.
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from dllama_tpu.runtime.telemetry import SPECS  # noqa: E402
-
-NAME_RE = re.compile(r"^dllama_[a-z0-9_]+$")
-# quoted dllama_* literals in source; names continuing with '.' or '-' are
-# module paths / model ids, not metrics
-LITERAL_RE = re.compile(r"""["'](dllama_[a-z0-9_]+)["']""")
-# package-name strings that legitimately appear quoted in source
-NOT_METRICS = {"dllama_tpu"}
-# non-metric literal families: model-zoo ids (zoo.py) share the prefix
-NOT_METRIC_PREFIXES = ("dllama_model_",)
-
-
-def _not_a_metric(lit: str) -> bool:
-    return lit in NOT_METRICS or lit.startswith(NOT_METRIC_PREFIXES)
+from tools.dlint import Project, run_rules  # noqa: E402
 
 
 def main() -> int:
-    errors: list[str] = []
-
-    for name, spec in SPECS.items():
-        if not NAME_RE.match(name):
-            errors.append(f"registered metric {name!r} violates "
-                          f"dllama_[a-z0-9_]+ naming")
-        if spec.kind not in ("counter", "gauge", "histogram"):
-            errors.append(f"{name}: unknown kind {spec.kind!r}")
-        if spec.kind == "counter" and not name.endswith("_total"):
-            errors.append(f"counter {name} must end in _total "
-                          f"(Prometheus convention)")
-        if not spec.help:
-            errors.append(f"{name}: empty help text")
-
-    perf = (REPO / "PERF.md").read_text(encoding="utf-8")
-    for name in SPECS:
-        if name not in perf:
-            errors.append(f"metric {name} is not documented in PERF.md")
-
-    # reverse direction: every dllama_* token PERF.md mentions must be a
-    # registered family (or a histogram's derived _bucket/_sum/_count)
-    derived = {base + suffix for base, spec in SPECS.items()
-               if spec.kind == "histogram"
-               for suffix in ("_bucket", "_sum", "_count")}
-    for name in sorted(set(LITERAL_RE.findall(perf))
-                       | set(re.findall(r"\b(dllama_[a-z0-9_]+)", perf))):
-        if _not_a_metric(name) or name in SPECS or name in derived:
-            continue
-        errors.append(f"PERF.md mentions {name!r} but no such metric "
-                      f"family is registered in telemetry.SPECS "
-                      f"(stale doc or typo)")
-
-    for py in sorted((REPO / "dllama_tpu").rglob("*.py")):
-        for lit in LITERAL_RE.findall(py.read_text(encoding="utf-8")):
-            if _not_a_metric(lit) or lit in SPECS:
-                continue
-            errors.append(f"{py.relative_to(REPO)}: literal {lit!r} looks "
-                          f"like a metric name but is not registered in "
-                          f"telemetry.SPECS")
-
-    if errors:
-        for e in errors:
-            print(f"❌ {e}", file=sys.stderr)
-        return 1
-    print(f"✅ {len(SPECS)} metric names: convention + PERF.md docs + "
-          f"source literals all consistent")
-    return 0
+    return run_rules(Project(), only=["metrics-names"])
 
 
 if __name__ == "__main__":

@@ -1,120 +1,24 @@
 #!/usr/bin/env python
-"""Route-label lint (Makefile ``lint`` target).
+"""Route-label lint: every handler-matched route is in serve/api.py _ROUTES; the GET /debug index is closed-world both directions.
 
-``serve/api.py`` folds unknown paths into the ``other`` route label so a
-scanner can't explode ``dllama_http_requests_total``'s cardinality — which
-only works if every route a handler actually matches on is listed in
-``_ROUTES``. A handler added for ``/debug/foo`` without the ``_ROUTES``
-entry silently reports its traffic as ``other`` and per-route dashboards
-go blind. This lint keeps the set closed-world:
-
-1. parse ``serve/api.py``'s AST (no imports — runnable without jax);
-2. collect ``_ROUTES`` from its assignment;
-3. collect every string literal that a handler compares against the
-   request path (any ``==`` / ``in`` comparison whose other side mentions
-   ``path``, e.g. ``self.path``, ``self._route()``, or a local ``path``);
-4. every compared literal must appear in ``_ROUTES``;
-5. the ``GET /debug`` index (``_DEBUG_INDEX``) is closed-world against
-   ``_ROUTES``: every ``/debug/*`` route has exactly one non-empty
-   description entry and every index entry is a registered route — the
-   index can never silently omit (or invent) a diagnostic surface.
+Thin wrapper (Makefile ``lint`` compatibility): the scanner itself now
+lives on the shared dlint framework as the ``route-labels`` rule —
+``python -m tools.dlint --only route-labels`` is the canonical entry point;
+this script exists so historical CLI invocations keep working.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-API = REPO / "dllama_tpu" / "serve" / "api.py"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-
-def _mentions_path(node: ast.expr) -> bool:
-    """True when the expression reads the request path: a name or attribute
-    called ``path``, or a call of ``_route`` (the query-stripping helper)."""
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Attribute) and sub.attr in ("path", "_route"):
-            return True
-        if isinstance(sub, ast.Name) and sub.id == "path":
-            return True
-    return False
-
-
-def _route_literals(node: ast.expr) -> list[str]:
-    """String constants that look like routes inside a comparator."""
-    out = []
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
-                and sub.value.startswith("/"):
-            out.append(sub.value)
-    return out
+from tools.dlint import Project, run_rules  # noqa: E402
 
 
 def main() -> int:
-    tree = ast.parse(API.read_text(encoding="utf-8"), filename=str(API))
-
-    routes: set[str] | None = None
-    debug_index: dict | None = None
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name) and tgt.id == "_ROUTES":
-                    routes = set(ast.literal_eval(node.value))
-                elif isinstance(tgt, ast.Name) and tgt.id == "_DEBUG_INDEX":
-                    debug_index = ast.literal_eval(node.value)
-    if routes is None:
-        print("❌ serve/api.py: no _ROUTES assignment found", file=sys.stderr)
-        return 1
-    if debug_index is None:
-        print("❌ serve/api.py: no _DEBUG_INDEX assignment found "
-              "(the GET /debug index)", file=sys.stderr)
-        return 1
-
-    errors: list[str] = []
-    compared: set[str] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Compare):
-            continue
-        sides = [node.left, *node.comparators]
-        if not any(_mentions_path(s) for s in sides):
-            continue
-        for s in sides:
-            if _mentions_path(s):
-                continue
-            for lit in _route_literals(s):
-                compared.add(lit)
-                if lit not in routes:
-                    errors.append(
-                        f"serve/api.py:{node.lineno}: handler matches "
-                        f"{lit!r} but it is not in _ROUTES — its traffic "
-                        f"would be folded into the 'other' label")
-
-    # the GET /debug index ↔ _ROUTES, both directions
-    debug_routes = {r for r in routes if r.startswith("/debug/")}
-    for r in sorted(debug_routes - set(debug_index)):
-        errors.append(f"serve/api.py: /debug route {r!r} has no "
-                      f"_DEBUG_INDEX description — the GET /debug index "
-                      f"would silently omit it")
-    for r in sorted(set(debug_index) - debug_routes):
-        errors.append(f"serve/api.py: _DEBUG_INDEX entry {r!r} is not a "
-                      f"registered /debug route in _ROUTES")
-    for r, desc in sorted(debug_index.items()):
-        if not isinstance(desc, str) or not desc.strip():
-            errors.append(f"serve/api.py: _DEBUG_INDEX[{r!r}] has an "
-                          f"empty description")
-    if "/debug" not in routes:
-        errors.append("serve/api.py: the '/debug' index route itself is "
-                      "missing from _ROUTES")
-
-    if errors:
-        for e in errors:
-            print(f"❌ {e}", file=sys.stderr)
-        return 1
-    print(f"✅ route labels closed-world: {len(compared)} handler-matched "
-          f"routes all listed in _ROUTES ({len(routes)} registered); "
-          f"GET /debug index covers all {len(debug_routes)} /debug routes")
-    return 0
+    return run_rules(Project(), only=["route-labels"])
 
 
 if __name__ == "__main__":

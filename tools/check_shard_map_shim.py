@@ -1,90 +1,24 @@
 #!/usr/bin/env python
-"""shard_map shim lint (Makefile ``lint`` target).
+"""shard_map shim lint: every manual-SPMD entry point goes through the parallel.api.shard_map version-compat shim.
 
-Every manual-SPMD entry point must go through the version-compat shim
-``dllama_tpu.parallel.api.shard_map``: the top-level ``jax.shard_map``
-does not exist on 0.4.x jax and ``jax.experimental.shard_map`` is gone on
->= 0.5, so a raw call site can never trace on one of the two — it only
-"works" until the interpreter meets the other jax (the root cause of the
-13 seed qcollectives failures; CHANGES.md PR2 bonus (b)). This lint keeps
-that world closed: any ``jax.shard_map`` / ``jax.experimental.shard_map``
-reference OUTSIDE ``parallel/api.py`` (package, tests, tools) fails.
-
-Pure text scan — no jax import, runnable anywhere ``make lint`` runs.
+Thin wrapper (Makefile ``lint`` compatibility): the scanner itself now
+lives on the shared dlint framework as the ``shard-map-shim`` rule —
+``python -m tools.dlint --only shard-map-shim`` is the canonical entry point;
+this script exists so historical CLI invocations keep working.
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# the one module allowed to spell the raw names (it IS the shim)
-ALLOWED = {REPO / "dllama_tpu" / "parallel" / "api.py"}
-
-# raw-call spellings: attribute access on jax / jax.experimental, or an
-# import from the experimental module. `hasattr(jax, "shard_map")` — the
-# shim's own version probe — only appears in the allowed file.
-RAW_RE = re.compile(
-    r"(jax\.shard_map"
-    r"|jax\.experimental\.shard_map"
-    r"|from\s+jax\.experimental\.shard_map\s+import"
-    r"|from\s+jax\.experimental\s+import\s+shard_map)")
-
-SCAN_DIRS = ("dllama_tpu", "tests", "tools")
-
-
-_QUOTES = ('"""', "'''")
-
-
-def _code_lines(text: str):
-    """(lineno, line) pairs with ``#`` comments stripped and docstring
-    bodies skipped (prose may legitimately NAME the raw spellings — only
-    executable references are violations). Crude triple-quote tracking is
-    enough for this repo's style: a line with an odd number of the same
-    triple-quote toggles string state."""
-    in_str: str | None = None
-    for lineno, line in enumerate(text.splitlines(), 1):
-        if in_str is not None:
-            if line.count(in_str) % 2 == 1:
-                in_str = None
-            continue
-        opened = [q for q in _QUOTES if line.count(q) % 2 == 1]
-        if opened:
-            # code before the opening quote still counts (rare)
-            yield lineno, line.split(opened[0], 1)[0]
-            in_str = opened[0]
-            continue
-        yield lineno, line.split("#", 1)[0]
+from tools.dlint import Project, run_rules  # noqa: E402
 
 
 def main() -> int:
-    errors: list[str] = []
-    n_files = 0
-    for d in SCAN_DIRS:
-        for py in sorted((REPO / d).rglob("*.py")):
-            if py in ALLOWED or py.name == pathlib.Path(__file__).name:
-                continue
-            n_files += 1
-            for lineno, line in _code_lines(py.read_text(encoding="utf-8")):
-                m = RAW_RE.search(line)
-                if m is None:
-                    continue
-                errors.append(
-                    f"{py.relative_to(REPO)}:{lineno}: raw "
-                    f"{m.group(0)!r} — route manual SPMD through "
-                    f"dllama_tpu.parallel.api.shard_map (the version-"
-                    f"compat shim); a raw call cannot trace on every "
-                    f"supported jax")
-    if errors:
-        for e in errors:
-            print(f"❌ {e}", file=sys.stderr)
-        return 1
-    print(f"✅ {n_files} files: every shard_map call site goes through "
-          f"parallel.api's version-compat shim")
-    return 0
+    return run_rules(Project(), only=["shard-map-shim"])
 
 
 if __name__ == "__main__":

@@ -1,104 +1,24 @@
 #!/usr/bin/env python
-"""Span-phase lint (Makefile ``lint`` target).
+"""Span-phase lint: every SpanTracer phase literal is in telemetry.PHASES; the vocabulary is emitted and documented.
 
-The span ring's phase vocabulary (``runtime/telemetry.PHASES``) is an
-operator contract: ``/debug/requests`` timelines, ``--trace-out`` JSONL
-consumers, and the flight recorder's Chrome-trace export all key on the
-phase strings, and PERF.md documents them. The contract is closed-world,
-both directions — the same shape as ``check_metrics_names.py``:
-
-1. every phase literal emitted at a SpanTracer call site
-   (``telemetry.tracer().emit(rid, "<phase>", ...)``) in ``dllama_tpu/``
-   is a member of ``PHASES`` (a typo'd phase silently fragments request
-   timelines) — and every call site passes a CONSTANT phase, so the
-   world stays closeable;
-2. every ``PHASES`` member has at least one call site (a documented
-   phase nobody emits is timeline coverage that quietly rotted);
-3. every ``PHASES`` member is mentioned in the telemetry.py source (the
-   docstring vocabulary) and in PERF.md (the operator docs).
-
-AST-based; importing only the telemetry module keeps this runnable
-without jax.
+Thin wrapper (Makefile ``lint`` compatibility): the scanner itself now
+lives on the shared dlint framework as the ``span-phases`` rule —
+``python -m tools.dlint --only span-phases`` is the canonical entry point;
+this script exists so historical CLI invocations keep working.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "dllama_tpu"
-sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from dllama_tpu.runtime.telemetry import PHASES  # noqa: E402
-
-
-def _is_tracer_emit(node: ast.Call) -> bool:
-    """Matches ``<...>tracer().emit(...)`` — the SpanTracer entry point
-    (``telemetry.tracer().emit`` or a bare ``tracer().emit``)."""
-    f = node.func
-    if not (isinstance(f, ast.Attribute) and f.attr == "emit"
-            and isinstance(f.value, ast.Call)):
-        return False
-    inner = f.value.func
-    return (isinstance(inner, ast.Name) and inner.id == "tracer") or \
-        (isinstance(inner, ast.Attribute) and inner.attr == "tracer")
-
-
-def emitted_phases() -> tuple[dict[str, list[str]], list[str]]:
-    """phase -> call sites, plus errors for non-constant phase args."""
-    sites: dict[str, list[str]] = {}
-    errors: list[str] = []
-    for py in sorted(PKG.rglob("*.py")):
-        tree = ast.parse(py.read_text(encoding="utf-8"), filename=str(py))
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and _is_tracer_emit(node)):
-                continue
-            where = f"{py.relative_to(REPO)}:{node.lineno}"
-            if len(node.args) < 2 or not (
-                    isinstance(node.args[1], ast.Constant)
-                    and isinstance(node.args[1].value, str)):
-                errors.append(f"{where}: tracer().emit phase argument is "
-                              f"not a string constant — the closed-world "
-                              f"vocabulary cannot be checked")
-                continue
-            sites.setdefault(node.args[1].value, []).append(where)
-    return sites, errors
+from tools.dlint import Project, run_rules  # noqa: E402
 
 
 def main() -> int:
-    sites, errors = emitted_phases()
-
-    for phase, where in sorted(sites.items()):
-        if phase not in PHASES:
-            errors.append(f"{where[0]}: emits span phase {phase!r} which "
-                          f"is not in telemetry.PHASES (typo, or add it "
-                          f"to the documented vocabulary)")
-    for phase in PHASES:
-        if phase not in sites:
-            errors.append(f"telemetry.PHASES documents {phase!r} but no "
-                          f"tracer().emit call site emits it (dead "
-                          f"vocabulary)")
-
-    telemetry_src = (PKG / "runtime" / "telemetry.py").read_text(
-        encoding="utf-8")
-    perf = (REPO / "PERF.md").read_text(encoding="utf-8")
-    for phase in PHASES:
-        if f"``{phase}``" not in telemetry_src:
-            errors.append(f"phase {phase!r} is not described in the "
-                          f"telemetry.py vocabulary docstring")
-        if phase not in perf:
-            errors.append(f"phase {phase!r} is not documented in PERF.md")
-
-    if errors:
-        for e in errors:
-            print(f"❌ {e}", file=sys.stderr)
-        return 1
-    n_sites = sum(len(w) for w in sites.values())
-    print(f"✅ {len(PHASES)} span phases: {n_sites} call sites, vocabulary "
-          f"+ telemetry docstring + PERF.md all consistent")
-    return 0
+    return run_rules(Project(), only=["span-phases"])
 
 
 if __name__ == "__main__":

@@ -1,0 +1,49 @@
+"""``python -m tools.dlint`` — run the repo's static-analysis rules.
+
+Exit 0 on a clean repo. ``--only RULE[,RULE...]`` selects rules,
+``--json`` prints the one-line machine summary CI consumes, ``--list``
+names every registered rule, ``--root PATH`` points at a different tree
+(the fixture self-tests use this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+if __package__ in (None, ""):  # `python tools/dlint/__main__.py` direct run
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+    from tools.dlint.core import Project, all_rules, run_rules
+else:
+    from .core import Project, all_rules, run_rules
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.dlint",
+        description="unified AST static analysis (see LINTS.md)")
+    p.add_argument("--only", default=None, metavar="RULE[,RULE...]",
+                   help="run only these comma-separated rules")
+    p.add_argument("--json", action="store_true", dest="json_out",
+                   help="one-line JSON summary (CI consumption)")
+    p.add_argument("--list", action="store_true",
+                   help="list registered rules and exit")
+    p.add_argument("--root", default=None,
+                   help="analyze this tree instead of the repo")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name, r in sorted(all_rules().items()):
+            print(f"{name:24s} {r.doc}")
+        return 0
+
+    project = Project(args.root) if args.root else Project()
+    only = ([s.strip() for s in args.only.split(",") if s.strip()]
+            if args.only else None)
+    return run_rules(project, only=only, json_out=args.json_out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,327 @@
+"""dlint core — the shared machinery every rule module rides on.
+
+What the six pre-dlint scanners each re-implemented (~650 LoC of copied
+file walking, comment stripping, and ❌/✅ printing) lives here exactly
+once:
+
+* :class:`SourceFile` — one parsed file: raw text, split lines, a cached
+  ``ast`` tree, comment/docstring-stripped *code lines* (for text-regex
+  rules that must not fire on prose), and the per-line suppression table
+  parsed from ``# dlint: disable=RULE[,RULE...]`` comments.
+* :class:`Project` — the file walker: rooted at the repo, caches
+  :class:`SourceFile` objects, skips ``__pycache__``/non-UTF-8 noise.
+* :class:`Finding` — one ``file:line: message`` diagnostic, tagged with
+  the rule id that produced it.
+* :func:`rule` — the visitor/rule registry. A rule is a function
+  ``(project) -> (findings, summary)``: the findings it would report and
+  a one-line ✅ summary for the clean case.
+* :func:`run_rules` — the reporter: applies suppressions (a finding on a
+  line carrying ``# dlint: disable=<its rule>`` is counted, not
+  printed), prints ❌ per finding / ✅ per clean rule, and can emit the
+  one-line JSON summary CI consumes.
+
+No jax, no package imports at module scope — ``python -m tools.dlint``
+must run anywhere ``make lint`` runs, including bare CI runners before
+the native build.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+# -- suppressions -------------------------------------------------------------
+
+# `# dlint: disable=rule-a,rule-b` — suppresses findings of those rules ON
+# THAT LINE (one comment, one line, exactly the findings anchored there).
+_DISABLE_RE = re.compile(r"#\s*dlint:\s*disable=([a-z0-9_,-]+)")
+
+_QUOTES = ('"""', "'''")
+_INLINE_TRIPLE = re.compile(r"(\"\"\"|''').*?\1")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``rule`` id, repo-relative ``path``, 1-based
+    ``lineno`` (0 = whole-file/doc finding), human message."""
+
+    rule: str
+    path: str
+    lineno: int
+    message: str
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.lineno}" if self.lineno else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """One file's parsed views, computed lazily and cached."""
+
+    def __init__(self, path: pathlib.Path, root: pathlib.Path):
+        self.path = path
+        self.rel = str(path.relative_to(root))
+        self._text: str | None = None
+        self._tree: ast.AST | None = None
+        self._code_lines: list[tuple[int, str]] | None = None
+        self._suppress: dict[int, set[str]] | None = None
+        self.parse_error: str | None = None
+
+    @property
+    def text(self) -> str:
+        if self._text is None:
+            raw = self.path.read_bytes()
+            try:
+                self._text = raw.decode("utf-8")
+            except UnicodeDecodeError as e:
+                # never crash a rule on one undecodable file: text-regex
+                # rules run on the replaced text; AST rules see the file
+                # via parse_failures (tree stays None, parse_error set)
+                self.parse_error = f"non-UTF-8 source: {e}"
+                self._text = raw.decode("utf-8", errors="replace")
+        return self._text
+
+    @property
+    def lines(self) -> list[str]:
+        return self.text.splitlines()
+
+    @property
+    def tree(self) -> ast.AST | None:
+        """The parsed AST, or None (with ``parse_error`` set) when the
+        file does not parse — rules report unparseable files once via
+        :meth:`Project.parse_failures`, not per rule."""
+        if self._tree is None and self.parse_error is None:
+            try:
+                self._tree = ast.parse(self.text, filename=str(self.path))
+            except SyntaxError as e:
+                self.parse_error = str(e)
+        return self._tree
+
+    def code_lines(self) -> list[tuple[int, str]]:
+        """``(lineno, line)`` pairs with ``#`` comments stripped and
+        docstring/triple-quoted bodies skipped — prose may legitimately
+        NAME a banned spelling; only executable references are
+        violations. Crude triple-quote tracking (a line with an odd count
+        of the same quote toggles string state) matches this repo's
+        style, same as the historical scanners."""
+        if self._code_lines is not None:
+            return self._code_lines
+        out: list[tuple[int, str]] = []
+        in_str: str | None = None
+        for lineno, line in enumerate(self.text.splitlines(), 1):
+            if in_str is not None:
+                if line.count(in_str) % 2 == 1:
+                    in_str = None
+                continue
+            # whole triple-quoted strings on ONE line drop out entirely
+            # (one-line docstrings may name banned spellings too)
+            line = _INLINE_TRIPLE.sub('""', line)
+            opened = [q for q in _QUOTES if line.count(q) % 2 == 1]
+            if opened:
+                out.append((lineno, line.split(opened[0], 1)[0]))
+                in_str = opened[0]
+                continue
+            out.append((lineno, line.split("#", 1)[0]))
+        self._code_lines = out
+        return out
+
+    def suppressions(self) -> dict[int, set[str]]:
+        """lineno -> rule ids disabled on that line."""
+        if self._suppress is None:
+            self._suppress = {}
+            for lineno, line in enumerate(self.lines, 1):
+                m = _DISABLE_RE.search(line)
+                if m:
+                    self._suppress[lineno] = {
+                        r.strip() for r in m.group(1).split(",") if r.strip()}
+        return self._suppress
+
+    def suppressed(self, rule_id: str, lineno: int) -> bool:
+        return rule_id in self.suppressions().get(lineno, ())
+
+
+class Project:
+    """The walker: repo root + cached per-file source models."""
+
+    def __init__(self, root: pathlib.Path | str = REPO):
+        self.root = pathlib.Path(root).resolve()
+        self._files: dict[pathlib.Path, SourceFile] = {}
+
+    def file(self, rel: str | pathlib.Path) -> SourceFile | None:
+        """One file by repo-relative path, or None if it doesn't exist."""
+        path = (self.root / rel).resolve()
+        if not path.is_file():
+            return None
+        if path not in self._files:
+            self._files[path] = SourceFile(path, self.root)
+        return self._files[path]
+
+    def walk(self, *rel_dirs: str) -> list[SourceFile]:
+        """Every ``*.py`` under the given repo-relative dirs (sorted,
+        ``__pycache__`` skipped). Missing dirs contribute nothing."""
+        out: list[SourceFile] = []
+        for d in rel_dirs:
+            base = self.root / d
+            if not base.is_dir():
+                continue
+            for py in sorted(base.rglob("*.py")):
+                if "__pycache__" in py.parts:
+                    continue
+                out.append(self.file(py.relative_to(self.root)))  # type: ignore[arg-type]
+        return out
+
+    def parse_failures(self, files: Iterable[SourceFile],
+                       rule_id: str) -> list[Finding]:
+        """Findings for files that are not clean parseable UTF-8 Python
+        (forces decode + ``tree``). A non-UTF-8 file whose replaced text
+        still parses is reported too — rules analyzed a lossy view of
+        it."""
+        out = []
+        for sf in files:
+            sf.text  # force the decode so non-UTF-8 is recorded
+            if sf.tree is None or sf.parse_error:
+                out.append(Finding(rule_id, sf.rel, 0,
+                                   f"unparseable: {sf.parse_error}"))
+        return out
+
+
+# -- rule registry ------------------------------------------------------------
+
+@dataclass
+class Rule:
+    name: str
+    doc: str
+    fn: Callable[[Project], tuple[list[Finding], str]]
+    # suppressible=False for rules whose findings live in non-Python files
+    # (docs, registries) where a disable comment has nowhere to sit
+    suppressible: bool = True
+
+
+_RULES: dict[str, Rule] = {}
+
+
+def rule(name: str, doc: str, *, suppressible: bool = True):
+    """Register ``fn(project) -> (findings, clean_summary)`` as a rule."""
+
+    def deco(fn):
+        _RULES[name] = Rule(name=name, doc=doc, fn=fn,
+                            suppressible=suppressible)
+        return fn
+
+    return deco
+
+
+def load_rule_modules() -> None:
+    """Import every rule module so its ``@rule`` registrations run."""
+    from . import (  # noqa: F401
+        exception_hygiene,
+        failpoint_sites,
+        metrics_names,
+        route_labels,
+        span_phases,
+        thread_ownership,
+        trace_safety,
+    )
+
+
+def all_rules() -> dict[str, Rule]:
+    load_rule_modules()
+    return dict(_RULES)
+
+
+def get_rule(name: str) -> Rule:
+    rules = all_rules()
+    if name not in rules:
+        known = ", ".join(sorted(rules))
+        raise SystemExit(f"dlint: unknown rule {name!r} (known: {known})")
+    return rules[name]
+
+
+# -- runner / reporter --------------------------------------------------------
+
+@dataclass
+class RuleResult:
+    rule: Rule
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    summary: str = ""
+    error: str | None = None
+
+
+def run_rule(r: Rule, project: Project) -> RuleResult:
+    """Run one rule and split its findings into active vs suppressed."""
+    res = RuleResult(rule=r)
+    try:
+        findings, summary = r.fn(project)
+    except Exception as e:
+        res.error = f"{type(e).__name__}: {e}"
+        return res
+    res.summary = summary
+    for f in findings:
+        sf = project.file(f.path) if r.suppressible and f.lineno else None
+        if sf is not None and sf.suppressed(f.rule, f.lineno):
+            res.suppressed.append(f)
+        else:
+            res.findings.append(f)
+    return res
+
+
+def run_rules(project: Project | None = None, *,
+              only: Iterable[str] | None = None,
+              json_out: bool = False,
+              stream=None) -> int:
+    """Run rules and report; returns the process exit code (0 = clean)."""
+    project = project or Project()
+    stream = stream or sys.stdout
+    rules = all_rules()
+    names = list(only) if only else sorted(rules)
+    for n in names:
+        if n not in rules:
+            get_rule(n)  # raises with the known-rule list
+    results = [run_rule(rules[n], project) for n in names]
+
+    n_findings = sum(len(r.findings) for r in results)
+    n_suppressed = sum(len(r.suppressed) for r in results)
+    n_errors = sum(1 for r in results if r.error)
+    ok = n_findings == 0 and n_errors == 0
+
+    if json_out:
+        payload = {
+            "ok": ok,
+            "rules": len(results),
+            "findings": n_findings,
+            "suppressed": n_suppressed,
+            "per_rule": {
+                r.rule.name: {
+                    "findings": len(r.findings),
+                    "suppressed": len(r.suppressed),
+                    **({"error": r.error} if r.error else {}),
+                } for r in results
+            },
+        }
+        print(json.dumps(payload, sort_keys=True), file=stream)
+        return 0 if ok else 1
+
+    for r in results:
+        if r.error:
+            print(f"❌ [{r.rule.name}] rule crashed: {r.error}",
+                  file=sys.stderr)
+            continue
+        for f in r.findings:
+            print(f"❌ {f}", file=sys.stderr)
+        if not r.findings:
+            sup = f" ({len(r.suppressed)} suppressed)" if r.suppressed else ""
+            print(f"✅ [{r.rule.name}] {r.summary or r.rule.doc}{sup}",
+                  file=stream)
+    if not ok:
+        print(f"dlint: {n_findings} finding(s) across "
+              f"{sum(1 for r in results if r.findings or r.error)} rule(s)",
+              file=sys.stderr)
+    return 0 if ok else 1

@@ -1,0 +1,99 @@
+"""Span-phase rule (migrated from ``tools/check_span_phases.py``).
+
+The span ring's phase vocabulary (``runtime/telemetry.PHASES``) is an
+operator contract: every SpanTracer call site emits a CONSTANT phase
+from the vocabulary, every member is emitted somewhere, and both the
+telemetry docstring and PERF.md document it.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+
+from .core import REPO, Finding, Project, rule
+
+PKG = "dllama_tpu"
+
+
+def _load_phases():
+    sys.path.insert(0, str(REPO))
+    try:
+        from dllama_tpu.runtime.telemetry import PHASES
+    finally:
+        sys.path.pop(0)
+    return PHASES
+
+
+def _is_tracer_emit(node: ast.Call) -> bool:
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "emit"
+            and isinstance(f.value, ast.Call)):
+        return False
+    inner = f.value.func
+    return (isinstance(inner, ast.Name) and inner.id == "tracer") or \
+        (isinstance(inner, ast.Attribute) and inner.attr == "tracer")
+
+
+def check(project: Project, phases=None) -> tuple[list[Finding], str]:
+    phases = phases if phases is not None else _load_phases()
+    findings: list[Finding] = []
+    sites: dict[str, list[tuple[str, int]]] = {}
+
+    for sf in project.walk(PKG):
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and _is_tracer_emit(node)):
+                continue
+            if len(node.args) < 2 or not (
+                    isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                findings.append(Finding(
+                    "span-phases", sf.rel, node.lineno,
+                    "tracer().emit phase argument is not a string "
+                    "constant — the closed-world vocabulary cannot be "
+                    "checked"))
+                continue
+            sites.setdefault(node.args[1].value, []).append(
+                (sf.rel, node.lineno))
+
+    for phase, where in sorted(sites.items()):
+        if phase not in phases:
+            findings.append(Finding(
+                "span-phases", where[0][0], where[0][1],
+                f"emits span phase {phase!r} which is not in "
+                f"telemetry.PHASES (typo, or add it to the documented "
+                f"vocabulary)"))
+    T = f"{PKG}/runtime/telemetry.py"
+    for phase in phases:
+        if phase not in sites:
+            findings.append(Finding(
+                "span-phases", T, 0,
+                f"telemetry.PHASES documents {phase!r} but no "
+                f"tracer().emit call site emits it (dead vocabulary)"))
+
+    tsf = project.file(T)
+    telemetry_src = tsf.text if tsf is not None else ""
+    psf = project.file("PERF.md")
+    perf = psf.text if psf is not None else ""
+    for phase in phases:
+        if f"``{phase}``" not in telemetry_src:
+            findings.append(Finding(
+                "span-phases", T, 0,
+                f"phase {phase!r} is not described in the telemetry.py "
+                f"vocabulary docstring"))
+        if phase not in perf:
+            findings.append(Finding(
+                "span-phases", "PERF.md", 0,
+                f"phase {phase!r} is not documented in PERF.md"))
+
+    n_sites = sum(len(w) for w in sites.values())
+    return findings, (f"{len(phases)} span phases: {n_sites} call sites, "
+                      f"vocabulary + telemetry docstring + PERF.md all "
+                      f"consistent")
+
+
+rule("span-phases",
+     "every SpanTracer phase literal is in telemetry.PHASES; the "
+     "vocabulary is emitted and documented")(check)

@@ -1,0 +1,563 @@
+"""Thread-ownership analyzer — who may run what, and under which lock.
+
+The serving stack's thread model (``runtime/serving.py`` docstrings): ONE
+loop thread owns the generator and the ``BlockPool``; HTTP handler
+threads only submit/wait; the watchdog's monitor thread supervises a
+wedged loop thread from outside. The PR6 review caught — by hand — a
+monitor-thread path reaching a loop-thread-owned pool mutator; these
+rules make that class of bug machine-checked.
+
+Grammar (annotations live in the code, next to the methods they describe):
+
+* ``# dlint: owner=loop-thread|monitor-thread|any`` on (or directly
+  above) a ``def`` line declares which thread may run the method.
+  ``loop-thread`` = only the scheduler's loop thread; ``monitor-thread``
+  = the watchdog monitor; ``any`` = any thread (handler threads, the
+  closer, the monitor) — so an ``any`` method may never reach a
+  ``loop-thread`` one either.
+* ``# dlint: guarded-by=_lock`` on a ``self.X = ...`` line in
+  ``__init__`` declares that writes/mutations of ``self.X`` outside
+  ``__init__`` must happen inside ``with self._lock:``.
+
+Rules:
+
+* ``thread-ownership`` — call-graph check: from every method owned by
+  ``monitor-thread`` or ``any``, no transitive call path (name-resolved
+  over the annotated files; unannotated methods are pass-through) may
+  reach a ``loop-thread``-owned method. The entry points the PR6 bug
+  class lives in (``_on_stall``, ``_on_crash``, ``_fail_all``) must be
+  annotated at all.
+* ``lock-guard`` — declared-guarded attributes are only written (assign,
+  augment, or mutate via ``append``/``pop``/``clear``/...) under their
+  lock, outside ``__init__``.
+* ``lock-order`` — over ``dllama_tpu/runtime/``: build the
+  lock-acquisition-order graph (holding ``A._lock`` while a reachable
+  callee takes ``B._lock`` adds edge A→B) and reject cycles — including
+  self-edges, since every lock here is a non-reentrant
+  ``threading.Lock``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Project, SourceFile, rule
+
+PKG = "dllama_tpu"
+OWNED_FILES = (f"{PKG}/runtime/serving.py", f"{PKG}/runtime/watchdog.py",
+               f"{PKG}/runtime/kvblocks.py")
+RUNTIME_DIR = f"{PKG}/runtime"
+
+OWNER_RE = re.compile(r"#\s*dlint:\s*owner=(loop-thread|monitor-thread|any)")
+GUARDED_RE = re.compile(r"#\s*dlint:\s*guarded-by=([A-Za-z_][A-Za-z0-9_]*)")
+
+# entry points that MUST carry an owner annotation: the supervision
+# paths where the PR6 class of bug lives
+REQUIRED_OWNERS = {"_on_stall", "_on_crash", "_fail_all"}
+
+_MUTATORS = {"append", "pop", "insert", "remove", "clear", "extend",
+             "update", "popitem", "add", "discard", "setdefault", "sort",
+             "appendleft", "popleft"}
+
+
+# -- annotation harvesting ----------------------------------------------------
+
+class _Method:
+    def __init__(self, sf: SourceFile, cls: str | None,
+                 node: ast.FunctionDef, owner: str | None):
+        self.sf = sf
+        self.cls = cls
+        self.node = node
+        self.owner = owner  # None = unannotated (pass-through)
+
+    @property
+    def qual(self) -> str:
+        return f"{self.cls}.{self.node.name}" if self.cls else self.node.name
+
+
+def _owner_for(sf: SourceFile, node: ast.FunctionDef) -> str | None:
+    """owner= on the def line or the line directly above it (above the
+    decorators, when present)."""
+    first = min([node.lineno]
+                + [d.lineno for d in node.decorator_list])
+    for lineno in (node.lineno, first, first - 1):
+        if 1 <= lineno <= len(sf.lines):
+            m = OWNER_RE.search(sf.lines[lineno - 1])
+            if m:
+                return m.group(1)
+    return None
+
+
+def harvest_methods(project: Project,
+                    rel_files=OWNED_FILES) -> list[_Method]:
+    out: list[_Method] = []
+    for rel in rel_files:
+        sf = project.file(rel)
+        if sf is None or sf.tree is None:
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        out.append(_Method(sf, node.name, sub,
+                                           _owner_for(sf, sub)))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(_Method(sf, None, node, _owner_for(sf, node)))
+    return out
+
+
+def _called_method_names(fn: ast.AST) -> set[str]:
+    """Names invoked as calls: ``self.x()``, ``obj.attr.x()``, ``x()``."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                out.add(f.attr)
+            elif isinstance(f, ast.Name):
+                out.add(f.id)
+    return out
+
+
+# -- rule: thread-ownership ---------------------------------------------------
+
+@rule("thread-ownership",
+      "monitor-thread/any supervision paths never reach loop-thread-"
+      "owned pool mutators")
+def check_thread_ownership(project: Project):
+    findings: list[Finding] = []
+    methods = harvest_methods(project)
+    if not methods:
+        return findings, "no owned files (nothing to check)"
+    by_name: dict[str, list[_Method]] = {}
+    for m in methods:
+        by_name.setdefault(m.node.name, []).append(m)
+
+    # annotation completeness for the supervision entry points
+    annotated = 0
+    for m in methods:
+        if m.owner is not None:
+            annotated += 1
+        elif m.node.name in REQUIRED_OWNERS:
+            findings.append(Finding(
+                "thread-ownership", m.sf.rel, m.node.lineno,
+                f"{m.qual} is a supervision entry point and must carry "
+                f"a `# dlint: owner=...` annotation"))
+
+    # transitive reachability per entry point: a fresh BFS each time —
+    # exact under call-graph cycles and entry-specific trails. (A memo
+    # shared across entries is unsound here: results computed under a
+    # cycle cut are incomplete, and cached trails belong to the FIRST
+    # root that explored them. The graphs are dozens of nodes; exactness
+    # beats caching.) Unannotated methods are pass-through; loop-thread
+    # methods terminate the walk — inside the loop thread everything is
+    # legal.
+    def reach_loop_owned(entry: _Method) -> dict[str, tuple[str, ...]]:
+        hits: dict[str, tuple[str, ...]] = {}
+        seen: set[int] = {id(entry)}
+        frontier: list[tuple[_Method, tuple[str, ...]]] = [
+            (entry, (entry.qual,))]
+        while frontier:
+            m, trail = frontier.pop()
+            for callee_name in sorted(_called_method_names(m.node)):
+                for callee in by_name.get(callee_name, ()):
+                    t = trail + (callee.qual,)
+                    if callee.owner == "loop-thread":
+                        hits.setdefault(callee.qual, t)
+                    elif callee.owner is None and id(callee) not in seen:
+                        seen.add(id(callee))
+                        frontier.append((callee, t))
+        return hits
+
+    for m in methods:
+        if m.owner not in ("monitor-thread", "any"):
+            continue
+        hits = reach_loop_owned(m)
+        for target, trail in sorted(hits.items()):
+            findings.append(Finding(
+                "thread-ownership", m.sf.rel, m.node.lineno,
+                f"{m.qual} (owner={m.owner}) reaches loop-thread-owned "
+                f"{target} via {' -> '.join(trail)} — supervision "
+                f"threads must never touch loop-thread state (the PR6 "
+                f"pool-mutation bug class)"))
+    return findings, (f"{annotated} owner-annotated methods across "
+                      f"{len(OWNED_FILES)} files; no monitor/any path "
+                      f"reaches loop-thread state")
+
+
+# -- rule: lock-guard ---------------------------------------------------------
+
+def _guarded_attrs(sf: SourceFile,
+                   cls: ast.ClassDef) -> dict[str, str]:
+    """attr -> lock attr, from guarded-by annotations in __init__."""
+    out: dict[str, str] = {}
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            for sub in ast.walk(node):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                m = GUARDED_RE.search(sf.lines[sub.lineno - 1]) \
+                    if sub.lineno <= len(sf.lines) else None
+                if not m:
+                    continue
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        out[tgt.attr] = m.group(1)
+    return out
+
+
+def _with_locks(node: ast.With) -> set[str]:
+    """Lock attr names taken by ``with self.<lock>:`` items."""
+    out = set()
+    for item in node.items:
+        ctx = item.context_expr
+        if isinstance(ctx, ast.Attribute) and \
+                isinstance(ctx.value, ast.Name) and ctx.value.id == "self":
+            out.add(ctx.attr)
+    return out
+
+
+def _self_attr(node) -> str | None:
+    """``self.X`` / ``self.X[...]`` -> ``X``."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        return _self_attr(node.value)
+    return None
+
+
+def _check_method_guards(sf: SourceFile, cls_name: str,
+                         fn: ast.FunctionDef, guarded: dict[str, str],
+                         findings: list[Finding]) -> None:
+    def flag(node, attr, held) -> None:
+        lock = guarded.get(attr)
+        if lock is not None and lock not in held:
+            findings.append(Finding(
+                "lock-guard", sf.rel, node.lineno,
+                f"{cls_name}.{fn.name} writes self.{attr} outside "
+                f"`with self.{lock}` (declared guarded-by={lock} in "
+                f"__init__)"))
+
+    def check_stmt(st: ast.stmt, held: frozenset[str]) -> None:
+        """Writes/mutations in this statement's own expressions (block
+        bodies recurse separately with their held-set)."""
+        if isinstance(st, ast.Assign):
+            for tgt in st.targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    flag(st, attr, held)
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            attr = _self_attr(st.target)
+            if attr:
+                flag(st, attr, held)
+        for field, value in ast.iter_fields(st):
+            exprs = [value] if isinstance(value, ast.expr) else [
+                v for v in (value if isinstance(value, list) else [])
+                if isinstance(v, ast.expr)]
+            for e in exprs:
+                for node in ast.walk(e):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in _MUTATORS:
+                        attr = _self_attr(node.func.value)
+                        if attr:
+                            flag(node, attr, held)
+
+    def visit(stmts, held: frozenset[str]) -> None:
+        for st in stmts:
+            if isinstance(st, ast.With):
+                visit(st.body, held | frozenset(_with_locks(st)))
+                continue
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def runs where it is CALLED; lexically it is
+                # almost always invoked in place (closures like
+                # _go_unready) — check with the held-set of its own body
+                visit(st.body, frozenset())
+                continue
+            check_stmt(st, held)
+            for attr_name in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr_name, None)
+                if isinstance(sub, list) and sub and \
+                        isinstance(sub[0], ast.stmt):
+                    visit(sub, held)
+            for h in getattr(st, "handlers", []) or []:
+                visit(h.body, held)
+
+    visit(fn.body, frozenset())
+
+
+@rule("lock-guard",
+      "declared-guarded shared attributes are only written under their "
+      "lock")
+def check_lock_guard(project: Project):
+    findings: list[Finding] = []
+    n_attrs = 0
+    for rel in OWNED_FILES:
+        sf = project.file(rel)
+        if sf is None or sf.tree is None:
+            continue
+        for cls in sf.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _guarded_attrs(sf, cls)
+            if not guarded:
+                continue
+            n_attrs += len(guarded)
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and fn.name != "__init__":
+                    _check_method_guards(sf, cls.name, fn, guarded,
+                                         findings)
+    return findings, (f"{n_attrs} guarded-by attributes: every write "
+                      f"holds the declared lock")
+
+
+# -- rule: lock-order ---------------------------------------------------------
+
+class _LockGraph:
+    """Classes in runtime/ that own ``threading.Lock`` attrs, the
+    name-based call graph between their methods, and the
+    holds-A-acquires-B edge set.
+
+    Call resolution is deliberately conservative about noise:
+    ``self.x()`` resolves within the calling class first (falling back
+    to every class defining ``x``); ``obj.x()`` resolves only when
+    exactly one class in runtime/ defines ``x`` — an ambiguous name
+    (``close``, which files and schedulers both have) would otherwise
+    fabricate edges between unrelated locks."""
+
+    def __init__(self, project: Project):
+        self.methods: dict[str, list[tuple[str, ast.FunctionDef]]] = {}
+        self.by_class: dict[str, dict[str, ast.FunctionDef]] = {}
+        self.class_locks: dict[str, set[str]] = {}
+        self.files: list[SourceFile] = project.walk(RUNTIME_DIR)
+        for sf in self.files:
+            if sf.tree is None:
+                continue
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            self.methods.setdefault(sub.name, []).append(
+                                (node.name, sub))
+                            self.by_class.setdefault(
+                                node.name, {})[sub.name] = sub
+                            if sub.name == "__init__":
+                                self._harvest_locks(node.name, sub)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    self.methods.setdefault(node.name, []).append(
+                        ("", node))
+        self._trans: dict[int, set[str]] | None = None
+
+    def resolve(self, caller_cls: str,
+                call: ast.Call) -> list[tuple[str, ast.FunctionDef]]:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+            on_self = (isinstance(f.value, ast.Name)
+                       and f.value.id == "self")
+            if on_self and name in self.by_class.get(caller_cls, {}):
+                return [(caller_cls, self.by_class[caller_cls][name])]
+            cands = self.methods.get(name, [])
+            if on_self:
+                return cands
+            return cands if len(cands) == 1 else []
+        if isinstance(f, ast.Name):
+            cands = self.methods.get(f.id, [])
+            return [c for c in cands if c[0] == ""] or (
+                cands if len(cands) == 1 else [])
+        return []
+
+    def _harvest_locks(self, cls: str, init: ast.FunctionDef) -> None:
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                fname = None
+                f = node.value.func
+                if isinstance(f, ast.Attribute):
+                    fname = f.attr
+                elif isinstance(f, ast.Name):
+                    fname = f.id
+                if fname not in ("Lock", "RLock"):
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        self.class_locks.setdefault(cls, set()).add(tgt.attr)
+
+    def lock_id(self, cls: str, attr: str) -> str | None:
+        if attr in self.class_locks.get(cls, ()):
+            return f"{cls}.{attr}"
+        return None
+
+    def _transitive_locks(self) -> dict[int, set[str]]:
+        """Per-function transitive lock-acquisition sets by FIXPOINT over
+        the whole call graph — exact under cycles. (A recursive memo
+        with a cycle cut is unsound: a callee memoized while an ancestor
+        is on the stack caches an incomplete set, making edge detection
+        depend on call-site order.)"""
+        if self._trans is not None:
+            return self._trans
+        nodes = [(cls, fn) for lst in self.methods.values()
+                 for cls, fn in lst]
+        direct: dict[int, set[str]] = {}
+        callees: dict[int, set[int]] = {}
+        for cls, fn in nodes:
+            d: set[str] = set()
+            cs: set[int] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for attr in _with_locks(node):
+                        lid = self.lock_id(cls, attr)
+                        if lid:
+                            d.add(lid)
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    name = f.attr if isinstance(f, ast.Attribute) else (
+                        f.id if isinstance(f, ast.Name) else None)
+                    if name is None or name in _MUTATORS \
+                            or name == "__init__":
+                        continue
+                    for _, callee_fn in self.resolve(cls, node):
+                        cs.add(id(callee_fn))
+            direct[id(fn)] = d
+            callees[id(fn)] = cs
+        trans = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, cs in callees.items():
+                for c in cs:
+                    if c in trans and not trans[c] <= trans[k]:
+                        trans[k] |= trans[c]
+                        changed = True
+        self._trans = trans
+        return trans
+
+    def acquired_locks(self, cls: str, fn: ast.AST) -> frozenset[str]:
+        """Locks this function (transitively) acquires — the callee side
+        of a holds→acquires edge."""
+        return frozenset(self._transitive_locks().get(id(fn), ()))
+
+    def edges(self) -> dict[tuple[str, str], str]:
+        """(held, acquired) -> 'Class.method:lineno' witness."""
+        out: dict[tuple[str, str], str] = {}
+        for sf in self.files:
+            if sf.tree is None:
+                continue
+            for cls_node in sf.tree.body:
+                if not isinstance(cls_node, ast.ClassDef):
+                    continue
+                cls = cls_node.name
+                for fn in cls_node.body:
+                    if not isinstance(fn, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                        continue
+                    for node in ast.walk(fn):
+                        if not isinstance(node, ast.With):
+                            continue
+                        held = [self.lock_id(cls, a)
+                                for a in _with_locks(node)]
+                        held = [h for h in held if h]
+                        if not held:
+                            continue
+                        inner: set[str] = set()
+                        for sub in node.body:
+                            for call in ast.walk(sub):
+                                if isinstance(call, ast.With):
+                                    for attr in _with_locks(call):
+                                        lid = self.lock_id(cls, attr)
+                                        if lid:
+                                            inner.add(lid)
+                                elif isinstance(call, ast.Call):
+                                    f = call.func
+                                    name = f.attr if isinstance(
+                                        f, ast.Attribute) else (
+                                        f.id if isinstance(f, ast.Name)
+                                        else None)
+                                    if name is None or name in _MUTATORS:
+                                        continue
+                                    for ccls, cfn in self.resolve(
+                                            cls, call):
+                                        inner |= self.acquired_locks(
+                                            ccls, cfn)
+                        for h in held:
+                            for a in inner:
+                                out.setdefault(
+                                    (h, a),
+                                    f"{sf.rel}:{node.lineno} "
+                                    f"({cls}.{fn.name})")
+        return out
+
+
+def _find_cycle(edges: dict[tuple[str, str], str]) -> list[str] | None:
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack: list[str] = []
+
+    def dfs(n: str) -> list[str] | None:
+        color[n] = GRAY
+        stack.append(n)
+        for m in sorted(graph[n]):
+            if color[m] == GRAY:
+                return stack[stack.index(m):] + [m]
+            if color[m] == WHITE:
+                cyc = dfs(m)
+                if cyc:
+                    return cyc
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            cyc = dfs(n)
+            if cyc:
+                return cyc
+    return None
+
+
+@rule("lock-order",
+      "the runtime lock-acquisition-order graph is acyclic "
+      "(no self-edges: every Lock here is non-reentrant)",
+      suppressible=False)
+def check_lock_order(project: Project):
+    findings: list[Finding] = []
+    g = _LockGraph(project)
+    edges = g.edges()
+    # self-edges first: taking the same class's non-reentrant lock while
+    # holding it deadlocks outright
+    for (a, b), where in sorted(edges.items()):
+        if a == b:
+            findings.append(Finding(
+                "lock-order", where.split(":")[0],
+                int(where.split(":")[1].split()[0]),
+                f"holding {a} while a reachable callee re-acquires {a} "
+                f"(non-reentrant threading.Lock) — self-deadlock"))
+    acyclic_edges = {k: v for k, v in edges.items() if k[0] != k[1]}
+    cyc = _find_cycle(acyclic_edges)
+    if cyc:
+        findings.append(Finding(
+            "lock-order", RUNTIME_DIR, 0,
+            f"lock-acquisition-order cycle: {' -> '.join(cyc)} "
+            f"(witnesses: "
+            + "; ".join(acyclic_edges[(cyc[i], cyc[i + 1])]
+                        for i in range(len(cyc) - 1)
+                        if (cyc[i], cyc[i + 1]) in acyclic_edges) + ")"))
+    n_locks = sum(len(v) for v in g.class_locks.values())
+    return findings, (f"{n_locks} locks, {len(edges)} ordered "
+                      f"acquisition edges, no cycles")
