@@ -1098,8 +1098,231 @@ def bench_multichip(deadline: float, *, out: dict | None = None) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
-SCENARIOS = ("continuous", "multichip")
-SCENARIO_FNS = {"continuous": bench_continuous, "multichip": bench_multichip}
+def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
+    """``--scenario fleet``: staggered mixed traffic through the fleet
+    router (serve/router.py) over N in-process api replicas — each a
+    real engine + continuous-batching scheduler + HTTP server on a
+    loopback port — with a mid-run replica kill and restart. This is
+    the serving topology ROADMAP item 3 describes, measured the way the
+    Gemma-on-Cloud-TPU comparison argues for: aggregate tok/s and tail
+    TTFT *under churn*, not single-engine throughput. Reported fields
+    (tools/bench_compare.py ranks the first three, the counters ride as
+    context): ``agg_tok_per_s``, ``ttft_ms_p50``/``ttft_ms_p95``
+    (measured at the client through the router, queue + dispatch
+    included), and the router's retry/eject/shed counters proving the
+    kill/restart schedule actually ran.
+
+    Workload knobs (env): DLLAMA_BENCH_FLEET_REPLICAS (3),
+    DLLAMA_BENCH_SCN_REQUESTS (18), DLLAMA_BENCH_SCN_MAXTOK (12),
+    DLLAMA_BENCH_SCN_STAGGER (0.05 s)."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    out = {} if out is None else out
+    out["phase"] = "scenario_setup"
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tests"))
+    import numpy as np
+
+    from helpers import (byte_vocab_tokenizer, tiny_header_params,
+                         write_tiny_model)
+
+    from dllama_tpu.formats import tfile
+    from dllama_tpu.runtime import telemetry as tm
+    from dllama_tpu.runtime.engine import InferenceEngine
+    from dllama_tpu.serve.api import BatchedApiState, make_handler
+    from dllama_tpu.serve.router import FleetRouter, make_router_handler
+
+    n_replicas = _scn_int("DLLAMA_BENCH_FLEET_REPLICAS", 3)
+    n_reqs = _scn_int("DLLAMA_BENCH_SCN_REQUESTS", 18)
+    max_tok = _scn_int("DLLAMA_BENCH_SCN_MAXTOK", 12)
+    stagger_s = float(os.environ.get("DLLAMA_BENCH_SCN_STAGGER", "0.05"))
+    out.update(n_replicas=n_replicas, n_requests=n_reqs)
+
+    d = tempfile.mkdtemp(prefix="dllama-bench-fleet-")
+    engines: list = []
+    servers: list = []
+    states: list = []
+    fleet = router_httpd = None
+    try:
+        mpath, tpath = os.path.join(d, "m.m"), os.path.join(d, "t.t")
+        rng = np.random.default_rng(0xF1)
+        write_tiny_model(mpath, tiny_header_params(
+            dim=256, hidden_dim=512, n_layers=2, n_heads=4, n_kv_heads=2,
+            head_dim=64, vocab_size=268, seq_len=256), rng)
+        td = byte_vocab_tokenizer()
+        td.chat_template = "<|start_header_id|>"  # detected as llama3
+        tfile.write_tfile(tpath, td)
+
+        def start_replica(i, port=0):
+            # one real engine + batched scheduler + HTTP front per
+            # replica — the same stack `python -m dllama_tpu api
+            # --batch-slots 2` serves, minus the process boundary
+            if i >= len(engines):
+                engines.append(InferenceEngine(mpath, tpath, tp=1))
+            state = BatchedApiState(engines[i], n_slots=2)
+            httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                        make_handler(state))
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            return state, httpd
+
+        out["phase"] = "scenario_engines"
+        for i in range(n_replicas):
+            state, httpd = start_replica(i)
+            states.append(state)
+            servers.append(httpd)
+        urls = [f"http://127.0.0.1:{s.server_address[1]}" for s in servers]
+
+        out["phase"] = "scenario_router"
+        fleet = FleetRouter(urls, probe_interval_s=0.2, eject_after=2,
+                            backoff_min_s=0.2, backoff_max_s=1.0)
+        router_httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                           make_router_handler(fleet))
+        threading.Thread(target=router_httpd.serve_forever,
+                         daemon=True).start()
+        router_url = f"http://127.0.0.1:{router_httpd.server_address[1]}"
+        reg = tm.registry()
+        up = reg.gauge(tm.ROUTER_REPLICA_UP)
+        t_wait = time.monotonic() + 30
+        while time.monotonic() < t_wait and not all(
+                up.value(replica=r.name) for r in fleet.replicas):
+            time.sleep(0.05)
+        retries0 = reg.counter(tm.ROUTER_RETRIES).total()
+        ejects0 = reg.counter(tm.ROUTER_EJECTS).total()
+        shed0 = reg.counter(tm.ROUTER_SHED).total()
+
+        out["phase"] = "scenario_traffic"
+        results: dict = {}
+
+        def do_request(i):
+            t0 = time.perf_counter()
+            stream = i % 2 == 0
+            body = {"messages": [{"role": "user",
+                                  "content": f"fleet bench {i % 6} "
+                                             + "ab" * (i % 4)}],
+                    "max_tokens": max_tok, "temperature": 0,
+                    "stream": stream}
+            rec: dict = {"t_sub": t0}
+            try:
+                req = urllib.request.Request(
+                    router_url + "/v1/chat/completions",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    if stream:
+                        raw = b""
+                        while True:
+                            chunk = r.read1(65536)
+                            if not chunk:
+                                break
+                            if "t_first" not in rec \
+                                    and b'"delta"' in raw + chunk:
+                                rec["t_first"] = time.perf_counter()
+                            raw += chunk
+                        died = (b"upstream_error" in raw
+                                or b'"finish_reason": "error"' in raw)
+                        rec["midstream"] = died
+                        rec["ok"] = b"[DONE]" in raw and not died
+                        rec["tokens"] = (raw.count(b'"delta"')
+                                         if rec["ok"] else 0)
+                    else:
+                        data = json.loads(r.read())
+                        rec["t_first"] = time.perf_counter()
+                        rec["ok"] = True
+                        rec["tokens"] = data["usage"]["completion_tokens"]
+            except urllib.error.HTTPError as e:
+                rec.update(ok=False, status=e.code)
+            except Exception as e:  # noqa: BLE001 — per-request forensics
+                rec.update(ok=False, error=repr(e)[:120])
+            rec["t_end"] = time.perf_counter()
+            results[i] = rec
+
+        kill_at = max(2, n_reqs // 3)
+        restart_at = max(kill_at + 2, (2 * n_reqs) // 3)
+        threads: list = []
+        t0 = time.perf_counter()
+        for i in range(n_reqs):
+            if time.monotonic() > deadline:
+                out["error"] = "deadline inside traffic wave"
+                break
+            if i == kill_at:
+                # the churn event: replica 0 dies mid-traffic — new
+                # connections refused, its scheduler fails in-flight work
+                out["phase"] = "scenario_kill"
+                servers[0].shutdown()
+                servers[0].server_close()
+                states[0].close(drain_s=0.0)
+            if i == restart_at:
+                out["phase"] = "scenario_restart"
+                state, httpd = start_replica(
+                    0, port=int(urls[0].rsplit(":", 1)[1]))
+                states[0], servers[0] = state, httpd
+            th = threading.Thread(target=do_request, args=(i,))
+            th.start()
+            threads.append(th)
+            time.sleep(stagger_s)
+        for th in threads:
+            th.join(timeout=max(5.0, deadline - time.monotonic()))
+        t_end = time.perf_counter()
+
+        out["phase"] = "scenario_report"
+        done = [r for r in results.values() if r.get("ok")]
+        out["n_completed"] = len(done)
+        out["n_failed"] = sum(1 for r in results.values()
+                              if not r.get("ok") and not r.get("midstream"))
+        out["n_midstream_error"] = sum(1 for r in results.values()
+                                       if r.get("midstream"))
+        out["n_tokens"] = sum(r["tokens"] for r in done)
+        dt = t_end - t0
+        if dt > 0 and out["n_tokens"]:
+            out["agg_tok_per_s"] = round(out["n_tokens"] / dt, 2)
+        ttfts = sorted(1e3 * (r["t_first"] - r["t_sub"])
+                       for r in done if "t_first" in r)
+        out["ttft_ms_p50"] = round(_pctl(ttfts, 0.5), 1) if ttfts else None
+        out["ttft_ms_p95"] = round(_pctl(ttfts, 0.95), 1) if ttfts else None
+        out["router_retries"] = int(reg.counter(tm.ROUTER_RETRIES).total()
+                                    - retries0)
+        out["router_ejects"] = int(reg.counter(tm.ROUTER_EJECTS).total()
+                                   - ejects0)
+        out["router_shed"] = int(reg.counter(tm.ROUTER_SHED).total()
+                                 - shed0)
+        # the restart's re-admission, telemetry-asserted: the breaker
+        # must bring the killed replica back before the scenario ends
+        t_wait = time.monotonic() + 15
+        killed = fleet.replicas[0].name
+        while time.monotonic() < t_wait \
+                and not up.value(replica=killed):
+            time.sleep(0.1)
+        out["readmitted"] = bool(up.value(replica=killed))
+        out["phase"] = "done"
+        return out
+    finally:
+        if router_httpd is not None:
+            router_httpd.shutdown()
+            router_httpd.server_close()
+        if fleet is not None:
+            fleet.close()
+        for httpd in servers:
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except OSError:
+                pass  # the killed replica's server is already closed
+        for state in states:
+            state.close(drain_s=0.0)
+        for eng in engines:
+            eng.close()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+SCENARIOS = ("continuous", "multichip", "fleet")
+SCENARIO_FNS = {"continuous": bench_continuous, "multichip": bench_multichip,
+                "fleet": bench_fleet}
 
 
 def _result_skeleton(metric: str) -> dict:

@@ -58,6 +58,12 @@ have at least one call site:
   BlockPool.alloc``): a ``raise`` here simulates block-pool exhaustion,
   which must degrade to queueing (admission) or an explicit per-request
   failure (mid-decode growth), never a crash.
+* ``proxy`` — the fleet router's upstream dispatch point
+  (``serve/router.py`` ``_open_upstream``, fired per upstream request
+  before any bytes move): a ``conn_reset``/``broken_pipe``/``raise``
+  severs the replica connection deterministically, driving the
+  retry-on-another-replica and circuit-breaker paths end to end
+  (tests/test_router.py).
 * ``wire`` — the overlapped wire collectives' shipped partial
   (``runtime/numerics.poison_code``, injected in-graph by
   ``parallel/qcollectives._maybe_poison_partial``): the ``nonfinite``

@@ -1476,6 +1476,7 @@ class BatchScheduler:
         self._next_rid = 0                       # dlint: guarded-by=_lock
         self._stop = False                       # dlint: guarded-by=_lock
         self._draining = False                   # dlint: guarded-by=_lock
+        self._drain_ended = False                # dlint: guarded-by=_lock
         self._healthy = True                     # dlint: guarded-by=_lock
         self._crashes = 0
         # retrace sentinel (runtime.introspection): after STEADY_TICKS
@@ -1545,20 +1546,25 @@ class BatchScheduler:
         return (self._healthy and not self._stop
                 and (self._thread is None or self._thread.is_alive()))
 
-    def readiness(self) -> tuple[bool, str]:  # dlint: owner=any
-        """(ready, reason) for ``GET /readyz``: scheduler alive ∧ not
-        draining ∧ queue below the shed threshold ∧ no watchdog stall."""
+    def readiness(self) -> tuple[bool, str, str]:  # dlint: owner=any
+        """(ready, human reason, machine code) for ``GET /readyz``:
+        scheduler alive ∧ not draining ∧ queue below the shed threshold
+        ∧ no watchdog stall. The code comes from the closed vocabulary
+        ``serve/api.py READY_CODES`` — machines (the fleet router)
+        branch on it, humans read the reason."""
         if self._watchdog is not None and self._watchdog.stalled:
-            return False, "step watchdog tripped (wedged device dispatch)"
+            return (False, "step watchdog tripped (wedged device dispatch)",
+                    "crashed")
         if not self._healthy:
-            return False, "scheduler crashed (restart budget exhausted)"
+            return (False, "scheduler crashed (restart budget exhausted)",
+                    "crashed")
         if self._thread is not None and not self._thread.is_alive():
-            return False, "scheduler thread is not running"
+            return False, "scheduler thread is not running", "crashed"
         if self._stop or self._draining:
-            return False, "draining"
+            return False, "draining", "draining"
         if self.max_queue and len(self._queue) >= self.max_queue:
-            return False, "queue full (shedding)"
-        return True, "ok"
+            return False, "queue full (shedding)", "queue_full"
+        return True, "ok", "ok"
 
     # -- shutdown ------------------------------------------------------------
 
@@ -1566,10 +1572,18 @@ class BatchScheduler:
         """Stop admitting (submits raise 503-shaped errors, ``/readyz``
         flips) while in-flight work keeps stepping — phase one of a
         graceful shutdown. The flag flips under the lock so no submit
-        can interleave between its availability check and the enqueue."""
+        can interleave between its availability check and the enqueue.
+        Idempotent: only the FIRST call opens the flight recorder's
+        ``drain_begin``/``drain_end`` bracket, so a postmortem can tell
+        a drained death from a crash."""
         with self._lock:
+            already = self._draining
             self._draining = True
+            n_queued = len(self._queue)
         telemetry.registry().gauge(telemetry.SERVER_DRAINING).set(1)
+        if not already:
+            self.flight.note("drain_begin", n_queued=n_queued,
+                             n_active=self.gen.n_active)
         self._wake.set()
 
     def _pending(self) -> int:  # dlint: owner=any
@@ -1592,6 +1606,22 @@ class BatchScheduler:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        # close the drain bracket BEFORE failing the remainder: the
+        # lifecycle ring then reads drain_begin → … → drain_end, and a
+        # postmortem can say "drained clean" vs "drain deadline failed
+        # N requests" instead of guessing from a bare process death
+        # (once — close() is idempotent for the test fixtures)
+        with self._lock:
+            ended, self._drain_ended = self._drain_ended, True
+        if not ended:
+            remainder = self._pending() + self.gen.n_active
+            # "drain_timeout" is reserved for an actual expired drain
+            # window — a close(drain_s=0) that failed survivors was an
+            # intentional hard stop, and the postmortem must say so
+            reason = ("clean" if remainder == 0
+                      else "drain_timeout" if drain_s > 0 else "aborted")
+            self.flight.note("drain_end", n_failed=remainder,
+                             reason=reason)
         # the remainder fails EXPLICITLY (the close() that used to leak
         # waiters would leave these threads in done.wait() forever)
         self._fail_all("server shutting down")

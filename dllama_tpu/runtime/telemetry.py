@@ -95,6 +95,16 @@ ITL_ATTRIB_MS = "dllama_itl_attrib_ms"
 FLIGHT_TICKS = "dllama_flight_ticks_total"
 FLIGHT_DUMPS = "dllama_flight_dumps_total"
 
+# fleet router (serve/router.py — the scheduler-over-engines tier)
+ROUTER_REPLICA_UP = "dllama_router_replica_up"
+ROUTER_INFLIGHT = "dllama_router_inflight"
+ROUTER_DISPATCHES = "dllama_router_dispatch_total"
+ROUTER_RETRIES = "dllama_router_retries_total"
+ROUTER_EJECTS = "dllama_router_ejects_total"
+ROUTER_READMITS = "dllama_router_readmits_total"
+ROUTER_SHED = "dllama_router_shed_total"
+ROUTER_AFFINITY_HITS = "dllama_router_affinity_hits_total"
+
 # HTTP layer (serve/api.py)
 HTTP_REQUESTS = "dllama_http_requests_total"
 REQUESTS_IN_FLIGHT = "dllama_requests_in_flight"
@@ -330,6 +340,31 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "Flight-recorder postmortem dumps written, by reason "
           "(watchdog_stall / scheduler_crash / kv_block_exhaustion; "
           "rate-limited per reason)"),
+    _spec(ROUTER_REPLICA_UP, "gauge",
+          "Fleet router: 1 while the labeled replica is dispatchable "
+          "(probed up, not breaker-ejected, not draining), else 0"),
+    _spec(ROUTER_INFLIGHT, "gauge",
+          "Fleet router: requests currently proxied to the labeled "
+          "replica (the router-side share of its load score)"),
+    _spec(ROUTER_DISPATCHES, "counter",
+          "Fleet router: completion dispatches by replica (includes "
+          "retry re-dispatches)"),
+    _spec(ROUTER_RETRIES, "counter",
+          "Fleet router: dispatches transparently retried on a "
+          "different replica after a pre-first-byte failure"),
+    _spec(ROUTER_EJECTS, "counter",
+          "Fleet router: circuit-breaker ejections by replica "
+          "(consecutive connect/5xx failures reached the threshold)"),
+    _spec(ROUTER_READMITS, "counter",
+          "Fleet router: ejected replicas re-admitted by a successful "
+          "half-open probe or dispatch, by replica"),
+    _spec(ROUTER_SHED, "counter",
+          "Fleet router: requests shed 429-shaped because the router's "
+          "--max-queue in-flight bound was hit or every replica "
+          "reported queue_full"),
+    _spec(ROUTER_AFFINITY_HITS, "counter",
+          "Fleet router: dispatches that landed on their session's "
+          "sticky replica (prefix-cache-aware affinity in effect)"),
     _spec(HTTP_REQUESTS, "counter",
           "HTTP requests by route and status code"),
     _spec(REQUESTS_IN_FLIGHT, "gauge", "Completions currently executing"),

@@ -85,6 +85,26 @@ _PROFILE_MS_MAX = 10_000
 # for more than an hour — longer values are a client bug, rejected 400
 _MAX_TIMEOUT_S = 3600.0
 
+# the closed machine-readable readiness vocabulary: every /readyz and
+# 5xx-backpressure body (here and on the fleet router, serve/router.py)
+# carries one of these in its "code" field next to the human "reason" —
+# the router branches on the code, operators read the reason, and the
+# router's probe parse SANITIZES against this tuple (out-of-vocabulary
+# codes degrade to "crashed"). "loading" is the router-side state for a
+# replica it has not successfully probed yet.
+READY_CODES = ("ok", "draining", "crashed", "queue_full", "loading")
+
+# one Retry-After policy for every backpressure answer — the 429 shed
+# path, the 503 drain/crash/unready paths, and /readyz 503, here and in
+# serve/router.py — so the surfaces can't drift: 429 is transient queue
+# pressure (retry soon), 503 means the process needs orchestrator time
+RETRY_AFTER_S = {429: 1, 503: 5}
+
+
+def backpressure_headers(status: int) -> dict:
+    """The shared Retry-After header block for a 429/503 answer."""
+    return {"Retry-After": str(RETRY_AFTER_S[status])}
+
 
 class ClientDisconnect(Exception):
     """The SSE peer vanished mid-stream (BrokenPipeError /
@@ -252,13 +272,15 @@ class ApiState:
         self.cache = NaiveCache()
         self._rid = 0  # request counter for trace spans (single-threaded)
 
-    def readiness(self) -> tuple[bool, str]:
+    def readiness(self) -> tuple[bool, str, str]:
         """Single-sequence mode has no queue or supervisor, but the step
-        watchdog still applies: a wedged dispatch must flip /readyz."""
+        watchdog still applies: a wedged dispatch must flip /readyz.
+        Same (ready, reason, code) contract as the batch scheduler."""
         wd = getattr(self.engine, "watchdog", None)
         if wd is not None and wd.stalled:
-            return False, "step watchdog tripped (wedged device dispatch)"
-        return True, "ok"
+            return (False, "step watchdog tripped (wedged device dispatch)",
+                    "crashed")
+        return True, "ok", "ok"
 
     def complete(self, body: dict, emit=None) -> dict:
         """Run one chat completion; ``emit(text)`` streams deltas when set.
@@ -444,7 +466,7 @@ class BatchedApiState:
                             for t in tok.eos_token_ids]
         self.sched = BatchScheduler(engine, n_slots, max_queue=max_queue)
 
-    def readiness(self) -> tuple[bool, str]:
+    def readiness(self) -> tuple[bool, str, str]:
         return self.sched.readiness()
 
     def begin_drain(self) -> None:
@@ -653,10 +675,15 @@ def make_handler(state: ApiState):
                 # NOT be restarted but should stop receiving traffic)
                 self._json(200, {"status": "ok"})
             elif path == "/readyz":
-                ready, reason = state.readiness()
+                # machine-readable body: "code" from READY_CODES (the
+                # fleet router consumes it; humans debug with "reason"),
+                # plus the shared Retry-After on the unready answer
+                ready, reason, code = state.readiness()
                 self._json(200 if ready else 503,
                            {"status": "ok" if ready else "unready",
-                            "reason": reason})
+                            "reason": reason, "code": code},
+                           headers=None if ready
+                           else backpressure_headers(503))
             elif path == "/debug":
                 # the diagnostic surface's index: every /debug/* endpoint
                 # with a one-line description (closed-world vs _ROUTES —
@@ -824,18 +851,26 @@ def make_handler(state: ApiState):
             except QueueFullError as e:
                 status = 429  # load shed: bounded queue, explicit backoff
                 if not headers_sent:
-                    self._json(429, {"error": str(e)},
-                               headers={"Retry-After": "1"})
+                    self._json(429, {"error": str(e), "code": "queue_full"},
+                               headers=backpressure_headers(429))
                 else:
                     stream_abort("error")
             except (SchedulerUnavailableError, HbmAdmissionError) as e:
                 # draining, crashed-unready, watchdog-stalled, or the HBM
                 # admission guard refused the request — all 503-shaped:
-                # the server cannot take this work right now
+                # the server cannot take this work right now (same
+                # Retry-After policy as /readyz and the 429 shed). The
+                # body's machine code tells the fleet router whether
+                # this replica is draining/saturated (reclassify) or
+                # broken (feed the circuit breaker): an HBM reject is
+                # load pressure, not damage.
                 status = 503
+                code = ("queue_full" if isinstance(e, HbmAdmissionError)
+                        else "draining" if "draining" in str(e)
+                        else "crashed")
                 if not headers_sent:
-                    self._json(503, {"error": str(e)},
-                               headers={"Retry-After": "5"})
+                    self._json(503, {"error": str(e), "code": code},
+                               headers=backpressure_headers(503))
                 else:
                     stream_abort("error")
             except RequestTimeoutError as e:

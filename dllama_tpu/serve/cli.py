@@ -34,7 +34,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllama_tpu",
                                 description="TPU-native distributed-llama")
     p.add_argument("mode", choices=["inference", "chat", "perplexity", "api",
-                                    "worker", "verify", "audit", "timeline"])
+                                    "worker", "verify", "audit", "timeline",
+                                    "router"])
     p.add_argument("--model", required=False, help=".m model file")
     p.add_argument("--tokenizer", required=False, help=".t tokenizer file")
     p.add_argument("--verify-weights", action="store_true",
@@ -220,8 +221,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "batch/KV occupancy, tok/s, ttft/itl p50, eval/sync "
                         "share) — the serving-era version of the reference's "
                         "per-token console line")
-    p.add_argument("--port", type=int, default=9990, help="api mode port")
-    p.add_argument("--host", default="127.0.0.1", help="api mode bind host")
+    p.add_argument("--port", type=int, default=9990,
+                   help="api/router mode port")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="api/router mode bind host")
+    p.add_argument("--replica", action="append", default=None,
+                   metavar="URL",
+                   help="router mode: one api-server replica base URL "
+                        "(http://host:port; repeat the flag per replica). "
+                        "The router probes each replica's /readyz + "
+                        "/metrics and dispatches least-loaded with "
+                        "session affinity (serve/router.py)")
+    p.add_argument("--probe-interval", type=float, default=2.0,
+                   metavar="SEC",
+                   help="router mode: health-probe interval per replica "
+                        "(jittered ±20%% so a fleet of routers never "
+                        "synchronizes its probe bursts)")
     p.add_argument("--batch-slots", type=int, default=0, metavar="N",
                    help="api mode: continuous batching over N concurrent "
                         "sequence slots (one ragged decode program; requests "
@@ -942,6 +957,12 @@ def main(argv=None) -> int:
     if args.mode == "timeline":
         # offline flight-dump → Chrome trace converter: no jax either
         return run_timeline(args)
+    if args.mode == "router":
+        # fleet router tier: no model, no device, no backend init — it
+        # fronts api-server replicas over plain HTTP (serve/router.py)
+        from .router import run_router
+
+        return run_router(args)
     _setup_compile_cache(args)
     if args.mode != "worker":
         # Honor an explicit JAX_PLATFORMS (e.g. the virtual CPU mesh:

@@ -160,6 +160,9 @@ def test_healthz_and_readyz(server):
         with urllib.request.urlopen(url + path, timeout=30) as r:
             assert r.status == 200
             assert json.loads(r.read())["status"] == "ok"
+    # /readyz carries the machine-readable code next to the human reason
+    with urllib.request.urlopen(url + "/readyz", timeout=30) as r:
+        assert json.loads(r.read())["code"] == "ok"
 
 
 def test_malformed_bodies_return_400_never_500(server):

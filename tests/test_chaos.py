@@ -131,6 +131,37 @@ def test_drain_close_lets_active_work_finish(engine):
     assert len(req.tokens) == 4
 
 
+def test_drain_emits_flight_lifecycle_bracket(engine):
+    """ISSUE-12 satellite: begin_drain/close leave a drain_begin →
+    drain_end pair in the flight recorder's lifecycle ring (not just a
+    stdout banner), so a postmortem can classify a death as a drain, not
+    a crash — including whether the drain finished clean."""
+    from dllama_tpu.runtime import flightrec
+
+    flightrec.recorder().reset()
+    try:
+        sched = BatchScheduler(engine, n_slots=2)
+        req = sched.submit(_enc(engine), 4, stop_on_eos=False)
+        sched.begin_drain()
+        sched.close(drain_s=60.0)
+        assert req.done.is_set() and req.error is None
+        events = flightrec.recorder().snapshot()["events"]
+        begins = [e for e in events if e["event"] == "drain_begin"]
+        ends = [e for e in events if e["event"] == "drain_end"]
+        assert len(begins) == 1  # idempotent: close()'s begin_drain is a no-op
+        assert len(ends) == 1
+        assert ends[0]["reason"] == "clean"  # active work drained, not failed
+        assert ends[0]["n_failed"] == 0
+        # the pair brackets: begin strictly before end in ring order
+        assert events.index(begins[0]) < events.index(ends[0])
+        # a second close() must not double-close the bracket
+        sched.close()
+        events = flightrec.recorder().snapshot()["events"]
+        assert len([e for e in events if e["event"] == "drain_end"]) == 1
+    finally:
+        flightrec.recorder().reset()
+
+
 # -- load shedding -----------------------------------------------------------
 
 
@@ -142,7 +173,8 @@ def test_submit_sheds_beyond_max_queue(engine):
     try:
         sched.submit(_enc(engine), 4)
         sched.submit(_enc(engine), 4)
-        assert sched.readiness() == (False, "queue full (shedding)")
+        assert sched.readiness() == (False, "queue full (shedding)",
+                                     "queue_full")
         with pytest.raises(QueueFullError, match="queue full"):
             sched.submit(_enc(engine), 4)
         assert shed.total() == before + 1
@@ -235,8 +267,9 @@ def test_scheduler_crash_budget_exhausted_marks_unready(engine):
                 break
             assert r.done.wait(timeout=60)
         fp.registry().clear()
-        ready, reason = sched.readiness()
+        ready, reason, code = sched.readiness()
         assert not ready and "crash" in reason
+        assert code == "crashed"  # the machine-readable /readyz code
         with pytest.raises(SchedulerUnavailableError):
             sched.submit(_enc(engine), 4)
     finally:
@@ -314,7 +347,12 @@ def test_readyz_flips_to_503_during_drain(batched_server):
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(url + "/readyz", timeout=30)
         assert e.value.code == 503
-        assert json.loads(e.value.read())["reason"] == "draining"
+        # machine-readable body + the shared Retry-After (the 429 shed
+        # path's header, unified via api.backpressure_headers)
+        assert e.value.headers["Retry-After"] is not None
+        body = json.loads(e.value.read())
+        assert body["reason"] == "draining"
+        assert body["code"] == "draining"
         # liveness stays green: a draining pod must not be restarted
         with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
             assert r.status == 200
@@ -572,8 +610,9 @@ def test_watchdog_trips_within_budget_and_routes_to_supervision(tmp_path):
         assert req.error is not None and "watchdog" in req.error
         assert req.server_error  # maps to HTTP 503
         assert stalls.total() == s0 + 1
-        ready, reason = sched.readiness()
+        ready, reason, code = sched.readiness()
         assert not ready and "watchdog" in reason
+        assert code == "crashed"  # a wedged dispatch is crash-shaped
         with pytest.raises(SchedulerUnavailableError):
             sched.submit(_enc(eng), 4)
     finally:

@@ -1,0 +1,843 @@
+"""Fleet router tests (serve/router.py): health-driven dispatch over
+replica api-servers with circuit breaking, retry, affinity, shedding,
+and replica-churn survival.
+
+Most tests drive the router against STUB replicas — tiny deterministic
+HTTP servers speaking exactly the api-server surface the router consumes
+(/readyz with the machine-readable ``code``, /metrics load gauges, SSE +
+JSON completions) — so failure timing is exact and golden byte
+comparison is possible. One test fronts a real tiny CPU-mesh engine to
+prove end-to-end compatibility. The chaos acceptance test (3 replicas,
+mid-run kill + restart under continuous mixed traffic) is the ISSUE-12
+contract: zero silent failures, retries visible in telemetry, explicit
+terminal 502s for mid-stream victims, breaker re-admission after the
+restart."""
+
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dllama_tpu.runtime import failpoints as fp
+from dllama_tpu.runtime import telemetry as tm
+from dllama_tpu.serve.router import (FleetRouter, affinity_key,
+                                     make_router_handler)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.registry().clear()
+    yield
+    fp.registry().clear()
+
+
+def _wait(cond, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- stub replica ------------------------------------------------------------
+
+
+class StubReplica:
+    """A deterministic api-server stand-in. ``behavior`` is mutated by
+    tests mid-run; the handler reads it per request."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.port: int | None = None
+        self.httpd: ThreadingHTTPServer | None = None
+        self.behavior: dict = {
+            "ready": True,          # /readyz 200 vs 503
+            "ready_code": "ok",     # unready code when not ready
+            "queue_depth": 0,       # /metrics load gauges
+            "inflight": 0,
+            "completion_status": 200,   # non-200: error passthrough body
+            "error_code": None,         # machine code in the error body
+            "stream_chunks": ["Hel", "lo ", "fleet"],
+            "chunk_delay_s": 0.0,
+            "die_after_chunks": None,   # RST mid-stream after N chunks
+            "truncate_nonstream": False,  # declare CL, RST mid-body
+            "nonstream_delay_s": 0.0,
+        }
+        self.n_completions = 0
+
+    def start(self) -> None:
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _rst(self):
+                # force an RST (not a clean FIN): an EOF-delimited SSE
+                # stream must look DEAD, not complete. The LINGER(1,0)
+                # option rides the fd; the abort fires when the handler
+                # teardown closes the last file object over it —
+                # close_connection makes that happen NOW instead of
+                # parking in the keep-alive readline
+                self.connection.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    struct.pack("ii", 1, 0))
+                self.close_connection = True
+
+            def _json(self, code, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                b = stub.behavior
+                if self.path == "/readyz":
+                    if b["ready"]:
+                        self._json(200, {"status": "ok", "reason": "ok",
+                                         "code": "ok"})
+                    else:
+                        self._json(503, {"status": "unready",
+                                         "reason": b["ready_code"],
+                                         "code": b["ready_code"]},
+                                   headers={"Retry-After": "5"})
+                elif self.path == "/metrics":
+                    text = (f"dllama_queue_depth {b['queue_depth']}\n"
+                            f"dllama_requests_in_flight {b['inflight']}\n")
+                    body = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/v1/models":
+                    self._json(200, {"object": "list", "data": [
+                        {"id": f"stub-{stub.name}", "object": "model"}]})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                b = stub.behavior
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                if self.path != "/v1/chat/completions":
+                    self._json(404, {"error": "not found"})
+                    return
+                stub.n_completions += 1
+                if b["nonstream_delay_s"]:
+                    time.sleep(b["nonstream_delay_s"])
+                if b["completion_status"] != 200:
+                    hdrs = ({"Retry-After": "5"}
+                            if b["completion_status"] in (429, 503) else {})
+                    payload = {"error": f"stub error "
+                                        f"{b['completion_status']}"}
+                    if b["error_code"]:
+                        payload["code"] = b["error_code"]
+                    self._json(b["completion_status"], payload,
+                               headers=hdrs)
+                    return
+                try:
+                    body = json.loads(raw or b"{}")
+                except ValueError:
+                    self._json(400, {"error": "invalid JSON body"})
+                    return
+                if body.get("stream"):
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    for i, piece in enumerate(b["stream_chunks"]):
+                        chunk = {"object": "chat.completion.chunk",
+                                 "replica": stub.name,
+                                 "choices": [{"index": 0,
+                                              "delta": {"content": piece},
+                                              "finish_reason": None}]}
+                        self.wfile.write(b"data: "
+                                         + json.dumps(chunk).encode()
+                                         + b"\n\n")
+                        self.wfile.flush()
+                        if b["chunk_delay_s"]:
+                            time.sleep(b["chunk_delay_s"])
+                        if b["die_after_chunks"] is not None \
+                                and i + 1 >= b["die_after_chunks"]:
+                            # a dying replica closes with a clean FIN
+                            # and no [DONE] — exactly what a killed
+                            # api-server's SSE stream looks like
+                            self.close_connection = True
+                            return
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.close_connection = True
+                    return
+                if b["truncate_nonstream"]:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", "1000")
+                    self.end_headers()
+                    self.wfile.write(b'{"partial": tru')
+                    self.wfile.flush()
+                    self._rst()
+                    return
+                self._json(200, {
+                    "object": "chat.completion", "replica": stub.name,
+                    "choices": [{"index": 0,
+                                 "message": {"role": "assistant",
+                                             "content": "".join(
+                                                 b["stream_chunks"])},
+                                 "finish_reason": "stop"}],
+                    "usage": {"prompt_tokens": 3, "completion_tokens": 3,
+                              "total_tokens": 6}})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", self.port or 0),
+                                         Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def kill(self) -> None:
+        """Replica death: the listening socket closes — new connections
+        are refused (in-flight handler threads die on their own RSTs)."""
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.httpd = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+def make_router(stubs, **kw):
+    """Router + HTTP front end over the given stubs, with test-speed
+    probe/breaker timings; returns (base_url, fleet, closer)."""
+    kw.setdefault("probe_interval_s", 0.05)
+    kw.setdefault("eject_after", 2)
+    kw.setdefault("backoff_min_s", 0.1)
+    kw.setdefault("backoff_max_s", 0.4)
+    kw.setdefault("connect_timeout_s", 2.0)
+    fleet = FleetRouter([s.url for s in stubs], **kw)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                make_router_handler(fleet))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def closer():
+        httpd.shutdown()
+        httpd.server_close()
+        fleet.close()
+
+    return f"http://127.0.0.1:{port}", fleet, closer
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url + "/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _body(prompt, stream=False, **extra):
+    return {"messages": [{"role": "user", "content": prompt}],
+            "max_tokens": 8, "stream": stream, **extra}
+
+
+def _up(fleet, name):
+    return tm.registry().gauge(tm.ROUTER_REPLICA_UP).value(replica=name)
+
+
+# -- surfaces ----------------------------------------------------------------
+
+
+def test_router_surfaces_and_replica_up(tmp_path):
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    try:
+        # readiness flips at the FIRST dispatchable replica; wait for
+        # both probes before asserting fleet-wide state
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        assert fleet.readiness()[0]
+        with urllib.request.urlopen(url + "/readyz", timeout=10) as r:
+            body = json.loads(r.read())
+        assert body == {"status": "ok", "reason": "ok", "code": "ok"}
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(url + "/debug/fleet", timeout=10) as r:
+            snap = json.loads(r.read())
+        assert {s["replica"] for s in snap["replicas"]} \
+            == {r.name for r in fleet.replicas}
+        assert all(s["state"] == "up" for s in snap["replicas"])
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "dllama_router_replica_up{" in text
+        # /v1/models proxies to a live replica
+        with urllib.request.urlopen(url + "/v1/models", timeout=10) as r:
+            assert json.loads(r.read())["object"] == "list"
+        # unknown routes: JSON 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url + "/nope", timeout=10)
+        assert e.value.code == 404
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_least_loaded_dispatch_uses_probed_queue_depth():
+    a, b = StubReplica("a"), StubReplica("b")
+    a.behavior["queue_depth"] = 50
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas)
+              and fleet.replicas[0].load_score() >= 50,
+              what="probe load refresh")
+        # distinct prompts (distinct affinity keys): all land on the
+        # unloaded replica
+        for i in range(3):
+            with _post(url, _body(f"p{i}")) as r:
+                assert json.loads(r.read())["replica"] == "b"
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_session_affinity_sticks_while_healthy():
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    hits = tm.registry().counter(tm.ROUTER_AFFINITY_HITS)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        h0 = hits.total()
+        with _post(url, _body("sticky conversation")) as r:
+            first = json.loads(r.read())["replica"]
+        # load now favors the OTHER replica; affinity must still win
+        (a if first == "a" else b).behavior["queue_depth"] = 50
+        _wait(lambda: max(r.load_score() for r in fleet.replicas) >= 50,
+              what="probe load refresh")
+        for _ in range(3):
+            with _post(url, _body("sticky conversation")) as r:
+                assert json.loads(r.read())["replica"] == first
+        assert hits.total() >= h0 + 3
+        # an explicit session_id key overrides the prefix hash
+        k1 = affinity_key({"session_id": "s1", "messages": []})
+        k2 = affinity_key(_body("sticky conversation"))
+        assert k1.startswith("sid:") and k2.startswith("pfx:")
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_affinity_rebinds_when_sticky_replica_dies():
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        with _post(url, _body("rebind me")) as r:
+            first = json.loads(r.read())["replica"]
+        victim = a if first == "a" else b
+        survivor = b if first == "a" else a
+        victim.kill()
+        _wait(lambda: _up(fleet, f"127.0.0.1:{victim.port}") == 0,
+              what="victim ejected")
+        with _post(url, _body("rebind me")) as r:
+            assert json.loads(r.read())["replica"] == survivor.name
+        # the session is now stuck to the survivor — even after the old
+        # replica returns, the sticky map keeps it where its KV lives
+        victim.start()
+        _wait(lambda: _up(fleet, f"127.0.0.1:{victim.port}") == 1,
+              what="victim re-admitted")
+        with _post(url, _body("rebind me")) as r:
+            assert json.loads(r.read())["replica"] == survivor.name
+    finally:
+        close()
+        for s in (a, b):
+            if s.httpd is not None:
+                s.kill()
+
+
+# -- retry / circuit breaker -------------------------------------------------
+
+
+def test_proxy_failpoint_drives_transparent_retry():
+    """Armed `proxy` failpoint severs the first upstream connection —
+    the request transparently retries on a different replica and
+    completes; the retry is visible in dllama_router_retries_total."""
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    retries = tm.registry().counter(tm.ROUTER_RETRIES)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        r0 = retries.total()
+        fp.arm("proxy", "conn_reset", times=1)
+        with _post(url, _body("retry me")) as r:
+            out = json.loads(r.read())
+        assert out["replica"] in ("a", "b")
+        assert retries.total() == r0 + 1
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_midbody_death_retries_before_first_client_byte():
+    """A replica that dies mid-body on a Content-Length response fails
+    before anything reached the client — retried, not a 502."""
+    a, b = StubReplica("a"), StubReplica("b")
+    a.behavior["truncate_nonstream"] = True
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    retries = tm.registry().counter(tm.ROUTER_RETRIES)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        r0 = retries.total()
+        n_ok = 0
+        for i in range(4):  # distinct keys: some land on the truncator
+            with _post(url, _body(f"q{i}")) as r:
+                out = json.loads(r.read())
+            assert out["replica"] == "b"  # only b can COMPLETE one
+            n_ok += 1
+        assert n_ok == 4
+        # at least one request was dispatched to a first and retried
+        assert retries.total() >= r0 + 1
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_circuit_breaker_ejects_then_halfopen_readmits():
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    reg = tm.registry()
+    ejects = reg.counter(tm.ROUTER_EJECTS)
+    readmits = reg.counter(tm.ROUTER_READMITS)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        name = f"127.0.0.1:{a.port}"
+        e0, ra0 = ejects.total(replica=name), readmits.total(replica=name)
+        a.kill()
+        _wait(lambda: ejects.total(replica=name) == e0 + 1,
+              what="breaker ejection")
+        assert _up(fleet, name) == 0
+        snap = [s for s in fleet.fleet_snapshot()["replicas"]
+                if s["replica"] == name][0]
+        assert snap["state"] == "down" and snap["backoff_s"] > 0
+        # traffic keeps flowing on the survivor meanwhile
+        with _post(url, _body("meanwhile")) as r:
+            assert json.loads(r.read())["replica"] == "b"
+        # restart: a bounded-backoff half-open probe re-admits it
+        a.start()
+        _wait(lambda: readmits.total(replica=name) == ra0 + 1,
+              what="half-open re-admission")
+        assert _up(fleet, name) == 1
+        # dispatch returns to the re-admitted replica
+        _wait(lambda: _served_by(url, "a"), timeout=10,
+              what="dispatch back on a")
+    finally:
+        close()
+        for s in (a, b):
+            if s.httpd is not None:
+                s.kill()
+
+
+def _served_by(url, name, n=6):
+    for i in range(n):
+        with _post(url, _body(f"probe-{name}-{i}-{time.monotonic_ns()}")) \
+                as r:
+            if json.loads(r.read())["replica"] == name:
+                return True
+    return False
+
+
+# -- shedding / drain --------------------------------------------------------
+
+
+def test_all_replicas_saturated_sheds_429_with_retry_after():
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    shed = tm.registry().counter(tm.ROUTER_SHED)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        for s in (a, b):
+            s.behavior.update(ready=False, ready_code="queue_full")
+        _wait(lambda: not fleet.readiness()[0], what="fleet saturated")
+        assert fleet.readiness()[2] == "queue_full"
+        s0 = shed.total()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, _body("shed me"))
+        assert e.value.code == 429
+        assert e.value.headers["Retry-After"] is not None
+        assert json.loads(e.value.read())["code"] == "queue_full"
+        assert shed.total() == s0 + 1
+        # replicas recover -> dispatch resumes
+        for s in (a, b):
+            s.behavior.update(ready=True)
+        _wait(lambda: fleet.readiness()[0], what="fleet recovered")
+        with _post(url, _body("recovered")) as r:
+            assert r.status == 200
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_router_max_queue_bound_sheds():
+    a = StubReplica("a")
+    a.behavior["nonstream_delay_s"] = 0.6
+    a.start()
+    url, fleet, close = make_router([a], max_inflight=1)
+    shed = tm.registry().counter(tm.ROUTER_SHED)
+    try:
+        _wait(lambda: fleet.readiness()[0], what="replica up")
+        s0 = shed.total()
+        codes = []
+
+        def slow():
+            with _post(url, _body("slow one"), timeout=30) as r:
+                codes.append(r.status)
+
+        t = threading.Thread(target=slow)
+        t.start()
+        _wait(lambda: fleet.fleet_snapshot()["inflight_total"] >= 1,
+              what="first request in flight")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, _body("beyond the bound"))
+        assert e.value.code == 429
+        assert e.value.headers["Retry-After"] is not None
+        assert shed.total() == s0 + 1
+        t.join(timeout=30)
+        assert codes == [200]  # the in-flight one finished fine
+    finally:
+        close()
+        a.kill()
+
+
+def test_dispatch_503_draining_reclassifies_without_eject():
+    """The drain-awareness contract on the DISPATCH path: a replica
+    whose completions answer 503 code=draining (the probe hasn't
+    noticed yet) is reclassified unready — the request retries on the
+    other replica and the circuit breaker is NOT fed (a draining pod
+    must never be ejected into the crash-backoff schedule)."""
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    # probes too slow to see the drain first: the dispatch path must
+    # handle the classification itself
+    url, fleet, close = make_router([a, b], probe_interval_s=30.0)
+    ejects = tm.registry().counter(tm.ROUTER_EJECTS)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        name_a = f"127.0.0.1:{a.port}"
+        e0 = ejects.total(replica=name_a)
+        a.behavior.update(completion_status=503, error_code="draining")
+        for i in range(4):
+            with _post(url, _body(f"drain-race-{i}")) as r:
+                assert json.loads(r.read())["replica"] == "b"
+        assert ejects.total(replica=name_a) == e0  # reclassified, NOT ejected
+        snap = [s for s in fleet.fleet_snapshot()["replicas"]
+                if s["replica"] == name_a][0]
+        assert snap["state"] == "unready" and snap["code"] == "draining"
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_probe_sanitizes_unknown_ready_codes():
+    """An out-of-vocabulary /readyz code degrades to "crashed" — the
+    READY_CODES closed world is enforced at the router's probe parse,
+    not just documented."""
+    a = StubReplica("a")
+    a.start()
+    url, fleet, close = make_router([a])
+    try:
+        _wait(lambda: fleet.readiness()[0], what="replica up")
+        a.behavior.update(ready=False, ready_code="weird_code")
+        name = f"127.0.0.1:{a.port}"
+        _wait(lambda: _up(fleet, name) == 0, what="unready observed")
+        snap = fleet.fleet_snapshot()["replicas"][0]
+        assert snap["state"] == "unready" and snap["code"] == "crashed"
+    finally:
+        close()
+        a.kill()
+
+
+def test_draining_replica_stops_new_dispatch():
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        a.behavior.update(ready=False, ready_code="draining")
+        name = f"127.0.0.1:{a.port}"
+        _wait(lambda: _up(fleet, name) == 0, what="drain observed")
+        snap = [s for s in fleet.fleet_snapshot()["replicas"]
+                if s["replica"] == name][0]
+        assert snap["state"] == "unready" and snap["code"] == "draining"
+        for i in range(4):  # nothing new lands on the draining replica
+            with _post(url, _body(f"drain-{i}")) as r:
+                assert json.loads(r.read())["replica"] == "b"
+        # drain is not an ejection: no breaker backoff involved, and
+        # recovery is immediate on the next probe
+        a.behavior.update(ready=True)
+        _wait(lambda: _up(fleet, name) == 1, what="drain ended")
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+# -- single-replica degradation (golden) -------------------------------------
+
+
+def test_single_replica_router_is_byte_identical_passthrough():
+    """ISSUE-12 satellite: a router fronting ONE replica returns byte-
+    identical bodies to direct access — non-streaming, streaming, and
+    error statuses (with Retry-After) pass through unmangled."""
+    a = StubReplica("a")
+    a.start()
+    url, fleet, close = make_router([a], eject_after=100)
+    try:
+        _wait(lambda: fleet.readiness()[0], what="replica up")
+
+        def both(payload):
+            direct = _post(a.url, payload)
+            routed = _post(url, payload)
+            with direct, routed:
+                return (direct.status, direct.read(),
+                        routed.status, routed.read())
+
+        # non-streaming completion
+        ds, db, rs, rb = both(_body("golden"))
+        assert (ds, db) == (rs, rb)
+        # streaming completion: the SSE byte stream is identical
+        ds, db, rs, rb = both(_body("golden", stream=True))
+        assert (ds, db) == (rs, rb)
+        assert b"data: [DONE]" in rb
+        # error statuses pass through unmangled (status, body, and the
+        # upstream's own Retry-After header)
+        for status in (400, 429, 503):
+            a.behavior["completion_status"] = status
+            errs = []
+            for base in (a.url, url):
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    _post(base, _body("err"))
+                errs.append((e.value.code, e.value.read(),
+                             e.value.headers.get("Retry-After")))
+            assert errs[0] == errs[1], status
+        a.behavior["completion_status"] = 200
+    finally:
+        close()
+        a.kill()
+
+
+# -- mid-stream death --------------------------------------------------------
+
+
+def test_midstream_death_gets_terminal_502_event_never_a_hang():
+    a = StubReplica("a")
+    a.behavior["die_after_chunks"] = 2
+    a.start()
+    url, fleet, close = make_router([a])
+    http = tm.registry().counter(tm.HTTP_REQUESTS)
+    try:
+        _wait(lambda: fleet.readiness()[0], what="replica up")
+        c0 = http.total(route="/v1/chat/completions", status="502")
+        with _post(url, _body("doomed stream", stream=True),
+                   timeout=30) as r:
+            raw = r.read().decode()
+        # the two relayed chunks arrived, then the EXPLICIT terminal
+        # event naming the 502 — and the stream still ends with [DONE]
+        # (a client can always tell this abort from a dropped socket)
+        assert raw.count('"delta"') == 2
+        assert '"upstream_error"' in raw and '"code": 502' in raw
+        assert raw.rstrip().endswith("data: [DONE]")
+        assert http.total(route="/v1/chat/completions",
+                          status="502") == c0 + 1
+    finally:
+        close()
+        a.kill()
+
+
+# -- the ISSUE-12 chaos acceptance test --------------------------------------
+
+
+def test_fleet_survives_replica_kill_and_restart_under_traffic():
+    """3 replicas, continuous mixed traffic, one replica killed mid-run:
+    every request that had not yet streamed a byte completes via retry
+    on a survivor (zero silent failures; retries visible in
+    dllama_router_retries_total), mid-stream victims get the explicit
+    terminal 502 event, and after the restart the circuit breaker
+    re-admits the replica and dispatch returns to all 3 — all
+    telemetry-asserted."""
+    stubs = [StubReplica(f"r{i}") for i in range(3)]
+    for s in stubs:
+        s.behavior["stream_chunks"] = ["a", "b", "c", "d"]
+        s.behavior["chunk_delay_s"] = 0.01
+        s.start()
+    url, fleet, close = make_router(stubs)
+    reg = tm.registry()
+    retries = reg.counter(tm.ROUTER_RETRIES)
+    ejects = reg.counter(tm.ROUTER_EJECTS)
+    readmits = reg.counter(tm.ROUTER_READMITS)
+    dispatch = reg.counter(tm.ROUTER_DISPATCHES)
+    victim = stubs[1]
+    vname = f"127.0.0.1:{victim.port}"
+    r0, e0, ra0 = (retries.total(), ejects.total(replica=vname),
+                   readmits.total(replica=vname))
+    outcomes: list = []  # ("ok"|"midstream_502"|"silent"|..., detail)
+    out_lock = threading.Lock()
+    stop = threading.Event()
+
+    def traffic(i):
+        n = 0
+        while not stop.is_set():
+            n += 1
+            stream = (i + n) % 2 == 0
+            try:
+                with _post(url, _body(f"t{i}-{n}", stream=stream),
+                           timeout=30) as r:
+                    raw = r.read()
+                if not stream:
+                    ok = r.status == 200 and b'"usage"' in raw
+                    rec = ("ok" if ok else "silent", raw[:120])
+                elif b'"upstream_error"' in raw:
+                    rec = ("midstream_502", raw[-200:])
+                elif b"[DONE]" in raw:
+                    rec = ("ok", b"")
+                else:
+                    rec = ("silent", raw[:120])
+            except urllib.error.HTTPError as e:
+                rec = (f"http_{e.code}", e.read()[:120])
+            except Exception as e:  # noqa: BLE001 — recorded, asserted below
+                rec = ("silent", repr(e)[:120])
+            with out_lock:
+                outcomes.append(rec)
+            time.sleep(0.01)
+
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="all 3 replicas up")
+        threads = [threading.Thread(target=traffic, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)  # steady traffic over all three
+        # mid-run kill: streams in flight on the victim die with an RST
+        # mid-chunk; new connections are refused
+        victim.behavior["die_after_chunks"] = 1
+        time.sleep(0.1)
+        victim.kill()
+        _wait(lambda: ejects.total(replica=vname) == e0 + 1,
+              what="victim ejection", timeout=15)
+        time.sleep(0.4)  # traffic continues on the 2 survivors
+        victim.behavior["die_after_chunks"] = None
+        victim.start()
+        _wait(lambda: readmits.total(replica=vname) == ra0 + 1,
+              what="victim re-admission", timeout=15)
+        d_back = dispatch.total(replica=vname)
+        time.sleep(0.5)  # dispatch spreads back over all 3
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        silent = [o for o in outcomes if o[0] == "silent"]
+        assert not silent, silent[:3]
+        errors = [o for o in outcomes if o[0].startswith("http_")]
+        assert not errors, errors[:3]  # retries absorbed every pre-byte death
+        n_ok = sum(1 for o in outcomes if o[0] == "ok")
+        assert n_ok >= 20, f"only {n_ok} completions of {len(outcomes)}"
+        # the kill was actually felt: pre-byte deaths were retried ...
+        assert retries.total() > r0
+        # ... and the re-admitted replica serves again
+        assert dispatch.total(replica=vname) > d_back
+        assert _up(fleet, vname) == 1
+    finally:
+        stop.set()
+        close()
+        for s in stubs:
+            if s.httpd is not None:
+                s.kill()
+
+
+# -- end-to-end against a real engine ----------------------------------------
+
+
+def test_router_fronts_real_engine_replica(tmp_path):
+    """One real tiny CPU-mesh api-server behind the router: a chat
+    completion through the router matches direct access (content +
+    usage; ids/timestamps differ by design)."""
+    import numpy as np
+    from http.server import HTTPServer
+
+    from dllama_tpu.formats import tfile
+    from dllama_tpu.runtime.engine import InferenceEngine
+    from dllama_tpu.serve.api import ApiState, make_handler
+
+    from helpers import (byte_vocab_tokenizer, tiny_header_params,
+                         write_tiny_model)
+
+    mpath, tpath = tmp_path / "m.m", tmp_path / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(9))
+    td = byte_vocab_tokenizer()
+    td.chat_template = "<|start_header_id|>"
+    tfile.write_tfile(tpath, td)
+    engine = InferenceEngine(str(mpath), str(tpath), temperature=0.0, seed=3)
+    state = ApiState(engine)
+    httpd = HTTPServer(("127.0.0.1", 0), make_handler(state))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url, fleet, close = make_router([_FakeStub(port)])
+    try:
+        _wait(lambda: fleet.readiness()[0], what="engine replica up",
+              timeout=30)
+        body = {"messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 6, "temperature": 0}
+        with _post(f"http://127.0.0.1:{port}", body, timeout=120) as r:
+            direct = json.loads(r.read())
+        with _post(url, body, timeout=120) as r:
+            routed = json.loads(r.read())
+        assert routed["choices"] == direct["choices"]
+        assert routed["usage"] == direct["usage"]
+        # and the streaming path relays the real SSE stream
+        with _post(url, dict(body, stream=True), timeout=120) as r:
+            raw = r.read().decode()
+        assert "data: [DONE]" in raw
+    finally:
+        close()
+        httpd.shutdown()
+        httpd.server_close()
+        engine.close()
+
+
+class _FakeStub:
+    """Adapter so make_router can front an arbitrary local port."""
+
+    def __init__(self, port):
+        self.port = port
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"

@@ -24,17 +24,21 @@ import sys
 _RATES = ("decode_tok_per_s", "prefill_tok_per_s", "sampled_decode_tok_per_s",
           "chunked_decode_tok_per_s", "agg_tok_per_s",
           "decode_tok_per_s_q80")
-# lower-is-better latencies (--scenario continuous TTFT; --scenario
+# lower-is-better latencies (--scenario continuous/fleet TTFT; --scenario
 # multichip exposed collective wall): the printed pct is still
 # "improvement-positive", so the sign is flipped before ranking
 _LATENCIES = ("ttft_ms_p50", "ttft_ms_p95",
               "comm_exposed_ms", "comm_exposed_ms_off")
 # context-only scenario fields: printed for both sides, never ranked (a
-# higher occupancy or sharing count is workload-dependent, not a win/loss)
+# higher occupancy or sharing count is workload-dependent, not a win/loss
+# — and the fleet scenario's churn counters describe the kill/restart
+# schedule, not a performance delta)
 _GAUGES = ("block_occupancy_peak", "block_occupancy_mean",
            "kv_blocks_shared_peak", "prefix_reuse_tokens",
            "wire_q80_shrink", "exposed_overlap_lower",
-           "f32_tokens_identical")
+           "f32_tokens_identical",
+           "router_retries", "router_ejects", "router_shed",
+           "n_midstream_error", "readmitted")
 
 
 def _from_baseline(doc: dict) -> dict:

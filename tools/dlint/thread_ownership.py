@@ -9,12 +9,13 @@ rules make that class of bug machine-checked.
 
 Grammar (annotations live in the code, next to the methods they describe):
 
-* ``# dlint: owner=loop-thread|monitor-thread|any`` on (or directly
-  above) a ``def`` line declares which thread may run the method.
-  ``loop-thread`` = only the scheduler's loop thread; ``monitor-thread``
-  = the watchdog monitor; ``any`` = any thread (handler threads, the
-  closer, the monitor) — so an ``any`` method may never reach a
-  ``loop-thread`` one either.
+* ``# dlint: owner=loop-thread|monitor-thread|probe-thread|any`` on (or
+  directly above) a ``def`` line declares which thread may run the
+  method. ``loop-thread`` = only the scheduler's loop thread;
+  ``monitor-thread`` = the watchdog monitor; ``probe-thread`` = a fleet
+  router replica's health-probe thread (serve/router.py); ``any`` = any
+  thread (handler threads, the closer, the monitor) — so an ``any``
+  method may never reach a ``loop-thread`` one either.
 * ``# dlint: guarded-by=_lock`` on a ``self.X = ...`` line in
   ``__init__`` declares that writes/mutations of ``self.X`` outside
   ``__init__`` must happen inside ``with self._lock:``.
@@ -22,11 +23,11 @@ Grammar (annotations live in the code, next to the methods they describe):
 Rules:
 
 * ``thread-ownership`` — call-graph check: from every method owned by
-  ``monitor-thread`` or ``any``, no transitive call path (name-resolved
-  over the annotated files; unannotated methods are pass-through) may
-  reach a ``loop-thread``-owned method. The entry points the PR6 bug
-  class lives in (``_on_stall``, ``_on_crash``, ``_fail_all``) must be
-  annotated at all.
+  ``monitor-thread``, ``probe-thread``, or ``any``, no transitive call
+  path (name-resolved over the annotated files; unannotated methods are
+  pass-through) may reach a ``loop-thread``-owned method. The entry
+  points the PR6 bug class lives in (``_on_stall``, ``_on_crash``,
+  ``_fail_all``) must be annotated at all.
 * ``lock-guard`` — declared-guarded attributes are only written (assign,
   augment, or mutate via ``append``/``pop``/``clear``/...) under their
   lock, outside ``__init__``.
@@ -46,10 +47,11 @@ from .core import Finding, Project, SourceFile, rule
 
 PKG = "dllama_tpu"
 OWNED_FILES = (f"{PKG}/runtime/serving.py", f"{PKG}/runtime/watchdog.py",
-               f"{PKG}/runtime/kvblocks.py")
+               f"{PKG}/runtime/kvblocks.py", f"{PKG}/serve/router.py")
 RUNTIME_DIR = f"{PKG}/runtime"
 
-OWNER_RE = re.compile(r"#\s*dlint:\s*owner=(loop-thread|monitor-thread|any)")
+OWNER_RE = re.compile(
+    r"#\s*dlint:\s*owner=(loop-thread|monitor-thread|probe-thread|any)")
 GUARDED_RE = re.compile(r"#\s*dlint:\s*guarded-by=([A-Za-z_][A-Za-z0-9_]*)")
 
 # entry points that MUST carry an owner annotation: the supervision
@@ -172,7 +174,7 @@ def check_thread_ownership(project: Project):
         return hits
 
     for m in methods:
-        if m.owner not in ("monitor-thread", "any"):
+        if m.owner not in ("monitor-thread", "probe-thread", "any"):
             continue
         hits = reach_loop_owned(m)
         for target, trail in sorted(hits.items()):
