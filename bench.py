@@ -753,6 +753,62 @@ def bench_preset(preset: str, deadline: float, *, decode_steps: int = 64,
             if out["verify_k4_ms"] and out.get("decode_ms_per_step"):
                 out["verify_k4_over_decode"] = round(
                     out["verify_k4_ms"] / out["decode_ms_per_step"], 3)
+
+    # paged decode (block-table KV, runtime/kvblocks.py): the continuous-
+    # batching serving step measured on the SAME weights — one fused
+    # dispatch through a block table, so the paged gather/kernel cost
+    # becomes a ranked rate (and a roofline family below) instead of
+    # staying invisible behind the --scenario path
+    if batch == 1 and time.monotonic() < deadline:
+        from dllama_tpu.models.llama import paged_forward
+        from dllama_tpu.runtime.hbm import estimate_block_pool_bytes
+        from dllama_tpu.runtime.kvblocks import PagedKVCache, blocks_per_seq
+
+        out["phase"] = "paged_decode"
+        bs_kv = 128
+        m_blocks = blocks_per_seq(cfg.seq_len, bs_kv)
+        kv_bytes = jnp.dtype(_kv_map[kv_env]).itemsize
+        pool_bytes = estimate_block_pool_bytes(cfg, m_blocks + 1, bs_kv,
+                                               kv_bytes)
+        # the up-front guardrail priced weights + the DENSE cache only;
+        # this stage's pool is extra residency, so it gets its own check
+        # (conservative: the dense cache is deleted below but the probe
+        # prices both) and a clean skip — never a mid-run OOM wedge
+        try:
+            check_budget(est["need_per_device"] + pool_bytes,
+                         f"bench paged stage {preset}")
+        except RuntimeError as e:
+            out["paged_decode_skipped"] = str(e)[:200]
+            out["phase"] = "done"
+            return out
+        del kv  # the dense pool: the paged stage holds its own
+        pkv = PagedKVCache.create(cfg, n_blocks=m_blocks + 1,
+                                  block_size=bs_kv, dtype=_kv_map[kv_env])
+        tables = jnp.arange(1, m_blocks + 1, dtype=jnp.int32)[None, :]
+
+        def paged_greedy(params, cfg, tokens, pos_vec, pkv, tables):
+            logits, pkv = paged_forward(params, cfg, tokens, pos_vec, pkv,
+                                        tables)
+            return jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32), pkv
+
+        pstep = jax.jit(paged_greedy, static_argnums=1, donate_argnums=(4,))
+        ptok = jnp.ones((1,), jnp.int32)
+        ptok, pkv = pstep(params, cfg, ptok[:, None],
+                          jnp.zeros((1,), jnp.int32), pkv, tables)  # compile
+        sync(ptok)
+        if time.monotonic() < deadline:
+            ptok, pkv = pstep(params, cfg, ptok[:, None],
+                              jnp.ones((1,), jnp.int32), pkv, tables)
+            sync(ptok)  # throwaway: first-dispatch backlog (see prefill note)
+            n = max(8, decode_steps // 2)
+            t0 = time.perf_counter()
+            for i in range(n):
+                ptok, pkv = pstep(params, cfg, ptok[:, None],
+                                  jnp.full((1,), 2 + i, jnp.int32), pkv,
+                                  tables)
+            sync(ptok)
+            dt = _net(time.perf_counter() - t0, rtt)
+            out["paged_decode_tok_per_s"] = round(n / dt, 2) if dt else None
     out["phase"] = "done"
     return out
 
@@ -1684,6 +1740,11 @@ def main() -> None:
         roofmod = _roofline_mod()
         ceil = roofmod.load_ceilings(device_kind=str(info.get("kind", "")))
         result["roofline"] = roofmod.rate_roofline(v, weight_gb, ceil)
+        # per program-FAMILY fractions (decode vs prefill vs paged): the
+        # paged family prices the same weight stream, so its lower
+        # fraction IS the visible cost of the block-table gather/kernel
+        result["roofline"]["families"] = roofmod.rate_roofline_families(
+            head_res, weight_gb, n_params, ceil)
         # legacy flat fields (tools/analyze_capture.py and older captures
         # read these; same numbers as the section, nameplate-based)
         result["roofline_decode_tok_per_s"] = round(gbps / weight_gb, 1)

@@ -673,8 +673,12 @@ def _paged_layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     layer step on the same context (the gather materializes exactly the
     rows ``update_layer`` would have produced; rows behind unallocated
     table entries read the null block and are position-masked). The
-    TPU-native ragged-paged-attention kernel (PAPERS.md) can later replace
-    the gather+oracle pair without touching this program's callers."""
+    TPU-native ragged-paged-attention kernel (ops/paged_attention.py,
+    PAPERS.md "Ragged Paged Attention") replaces the gather+oracle pair
+    bit-identically whenever its gate resolves — same callers, same
+    program names, zero extra compiles."""
+    from ..ops import paged_attention as _pa
+
     B, T, _ = x.shape
     fq = fake_quant_q80 if cfg.sync_q80 else (lambda a: a)
     q, k, v = _attn_qkv(cfg, x, lp, cos, sin, positions, fq)
@@ -690,12 +694,21 @@ def _paged_layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     k_pool = k_pool.at[blk, :, off, :].set(k.astype(k_pool.dtype))
     v_pool = v_pool.at[blk, :, off, :].set(v.astype(v_pool.dtype))
 
-    def view(pool):
-        gathered = pool[tables]                  # [B, M, n_kv, bs, hd]
-        return jnp.moveaxis(gathered, 2, 1).reshape(
-            B, cfg.n_kv_heads, n_blocks_seq * bs, cfg.head_dim)
+    kernel = _pa.kernel_choice(tuple(q.shape), cfg.n_kv_heads,
+                               n_blocks_seq, bs)
+    if kernel is not None:
+        # walk the block table in-kernel: the dense logical cache never
+        # materializes in HBM (the whole point of the paged kernel)
+        att = _pa.paged_ragged_attention(q, k_pool, v_pool, tables,
+                                         positions, cfg.head_dim, **kernel)
+    else:
+        def view(pool):
+            gathered = pool[tables]              # [B, M, n_kv, bs, hd]
+            return jnp.moveaxis(gathered, 2, 1).reshape(
+                B, cfg.n_kv_heads, n_blocks_seq * bs, cfg.head_dim)
 
-    att = attention(q, view(k_pool), view(v_pool), positions, cfg.head_dim)
+        att = attention(q, view(k_pool), view(v_pool), positions,
+                        cfg.head_dim)
     att = constrain(att, "batch", None, "heads", None)
     x, _ = _attn_out_and_ffn(cfg, x, att, lp, fq, taps=False)
     return x, k_pool, v_pool

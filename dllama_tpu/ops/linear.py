@@ -88,7 +88,9 @@ def _on_tpu() -> bool:
 
 def _kernel_mode() -> str:
     # read per call so tests/debug sessions can flip it after import
-    return os.environ.get("DLLAMA_TPU_QUANT_KERNEL", "auto")  # auto|pallas|xla
+    # (auto|pallas|fused|xla — see quant_matmul.pallas_mode_gate, the ONE
+    # place the value turns into a kernel choice)
+    return os.environ.get("DLLAMA_TPU_QUANT_KERNEL", "auto")
 
 
 def _fast_mode(x: jax.Array) -> bool:  # dlint: static-fn (dtype/env gate)
@@ -141,27 +143,36 @@ def quant_mode_label(activations_bf16: bool) -> str:
     return resolved if mode != "auto" else f"auto({resolved})"
 
 
-def _pallas_wanted(x: jax.Array, w: QuantizedWeight, fast: bool) -> bool:  # dlint: static-fn (shape/env gate)
-    mode = _kernel_mode()
-    if mode == "xla":
-        return False
-    from .quant_matmul import supports
+def _pallas_wanted(x: jax.Array, w: QuantizedWeight, fast: bool) -> dict | None:  # dlint: static-fn (shape/env gate)
+    """quant_matmul kwargs when the plain (no-plan) Pallas path applies,
+    else None. The mode rule is quant_matmul.pallas_mode_gate — the ONE
+    gate; this adds only the shape check and the plan-free requirement.
 
-    ok = supports(tuple(x.shape), w)
-    if mode == "pallas":
-        return ok
-    # auto: Pallas only for EXACT mode on TPU (its HIGHEST-precision dots
-    # match the host oracle; CPU interpret is slow and GPU can't lower it).
-    # Fast mode always takes the XLA fused-dequant path: on the real chip it
-    # streams codes at 450-750 GB/s vs the kernel's ~130 GB/s
-    # (tools/gemv_sweep.py, 2026-07-31 capture) — XLA fuses convert+scale
-    # into the matmul's HBM loads, which a custom-call operand cannot.
-    # Under a mesh plan the sharded entry in linear() handles dispatch; this
-    # plain path must stay out of GSPMD-partitioned graphs (the auto-sharder
-    # can't split a pallas_call).
+    auto resolves Pallas only for EXACT mode on TPU (its HIGHEST-precision
+    dots match the host oracle; CPU interpret is slow and GPU can't lower
+    it). Fast mode's auto takes the XLA fused-dequant path: on the real
+    chip it streams codes at 450-750 GB/s vs the tiled kernel's ~130 GB/s
+    (tools/gemv_sweep.py, 2026-07-31 capture) — XLA fuses convert+scale
+    into the matmul's HBM loads, which a custom-call operand cannot; the
+    ``fused`` decode kernel is the candidate built to close exactly that
+    gap (single full-K pass per stripe), promotable via the perf-matrix
+    A/B. Under a mesh plan the sharded entry in linear() handles dispatch;
+    this plain path must stay out of GSPMD-partitioned graphs (the
+    auto-sharder can't split a pallas_call)."""
+    from .quant_matmul import (pallas_mode_gate, supports, supports_decode,
+                               wants_fused)
+
+    kw = pallas_mode_gate(fast)
+    if kw is None:
+        return None
+    if not (supports(tuple(x.shape), w)
+            or (wants_fused(kw) and supports_decode(tuple(x.shape), w, fast))):
+        return None
+    if _kernel_mode() in ("pallas", "fused"):
+        return kw  # forced: replicated operands are fine under a plan
     from ..parallel.api import current_plan
 
-    return ok and not fast and _on_tpu() and current_plan() is None
+    return kw if current_plan() is None else None
 
 
 def _pallas_sharded(x: jax.Array, w: QuantizedWeight, out_axis: str | None,
@@ -182,7 +193,8 @@ def _pallas_sharded(x: jax.Array, w: QuantizedWeight, out_axis: str | None,
 
     return quant_matmul_sharded(
         current_plan(), x, w, out_axis=out_axis, in_axis=in_axis,
-        interpret=kw["interpret"], fast=fast)
+        interpret=kw["interpret"], fast=fast,
+        fused=kw.get("fused", False))
 
 
 def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
@@ -196,8 +208,10 @@ def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
     reference's sliceRowMatmul/sliceColMatmul split): under a mesh plan they
     route Q40 weights to the shard_map-wrapped Pallas kernel
     (quant_matmul_sharded); single-device Q40 dispatches the plain kernel.
-    Override with DLLAMA_TPU_QUANT_KERNEL=auto|pallas|xla; unsupported shapes
-    fall back to XLA dequant+dot with identical f32 dequant values.
+    Override with DLLAMA_TPU_QUANT_KERNEL=auto|pallas|fused|xla (``fused``
+    = the decode-shaped fused dequant-GEMV; the ONE resolution rule is
+    quant_matmul.pallas_mode_gate); unsupported shapes fall back to XLA
+    dequant+dot with identical f32 dequant values.
     """
     out_dtype = x.dtype
     from .turbo import TurboWeight, turbo_matmul  # lazy: turbo imports us
@@ -217,10 +231,12 @@ def linear(x: jax.Array, w: Weight, *, out_axis: str | None = None,
             y = _pallas_sharded(x, w, out_axis, in_axis, fast)
             if y is not None:
                 return y.astype(x.dtype)
-        elif _pallas_wanted(x, w, fast):
-            from .quant_matmul import quant_matmul
+        else:
+            kernel_kw = _pallas_wanted(x, w, fast)
+            if kernel_kw is not None:
+                from .quant_matmul import quant_matmul
 
-            return quant_matmul(x, w, fast=fast)
+                return quant_matmul(x, w, fast=fast, **kernel_kw)
         # XLA fallback: in fast mode the dense dequant lands in bf16 (half the
         # HBM traffic of f32) and the dot takes one MXU pass; exact mode
         # dequantizes at the activation dtype as before

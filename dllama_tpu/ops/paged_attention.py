@@ -1,0 +1,192 @@
+"""Pallas TPU kernel: ragged paged attention over the block-table KV pool.
+
+The TPU-native replacement for ``_paged_layer_step``'s gather+oracle pair
+(models/llama.py): the XLA path materializes each row's full dense logical
+cache per layer per step (``pool[tables]`` writes ``[B, M, n_kv, bs, hd]``
+to HBM, then the oracle reads it straight back), so the paged program
+family pays the KV bytes twice plus a scatter's worth of write bandwidth.
+This kernel is the "Ragged Paged Attention" shape (PAPERS.md, arxiv
+2604.15464): the block table rides in as a scalar-prefetch operand and the
+kernel's *index maps* walk it directly — grid step ``(b, h, m)`` DMAs
+physical block ``tables[b, m]`` of the pool straight into VMEM, so the
+dense logical cache never exists in HBM at all.
+
+Semantics are exactly the gather+oracle pair's, bit for bit:
+
+* **ragged rows** — every batch row sits at its own depth; query row ``r``
+  (GQA-folded, source position ``pos0[b] + r // kv_mul``) sees cache
+  columns ``s <= pos0[b] + r // kv_mul``, the oracle's position mask;
+* **partial tail block** — the row's newest block is masked per position,
+  not per block, so a mid-block write point behaves identically;
+* **null block 0** — unallocated table tail entries point at physical
+  block 0 (runtime/kvblocks.py); its rows are gathered and then position-
+  masked to zero weight, the same argument as the oracle's padded tails.
+
+Per (b, h) instance the kernel stages per-block score stripes and f32
+value rows into VMEM scratch and runs the oracle's own epilogue (scale →
+mask → softmax → weighted sum) on the assembled arrays, so the math is
+op-for-op the oracle's and interpret-mode parity is bitwise
+(tests/test_paged_attention.py drives scrambled tables, CoW-redirects,
+T=1/T=16 and non-128-aligned head dims against the dense reference).
+
+Mode selection routes through :func:`quant_matmul.pallas_mode_gate` — the
+ONE kernel gate (dlint rule ``pallas-gate``): ``auto`` enables the kernel
+on TPU backends, ``DLLAMA_TPU_QUANT_KERNEL=pallas``/``fused`` force it
+(interpret mode off-TPU, the test path), ``xla`` is the kill switch back
+to the gather+oracle path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, out_ref,
+            kbuf_ref, vbuf_ref, *, bs: int, kv_mul: int, hd: int):
+    """One (b, h, m) grid step over physical block ``tables[b, m]``.
+
+    ``kbuf_ref`` / ``vbuf_ref [S, hd]`` assemble the (b, h) instance's f32
+    logical K/V rows (S-major, so the per-block writes are sublane
+    slices); the last block runs the oracle's own epilogue — score gemm at
+    the oracle's ``(TQ, hd) x (hd, S)`` contraction shape, scale, position
+    mask, softmax over S, value gemm — the same ops in the same order at
+    the same shapes as ops.attention.attention, which is what makes the
+    kernel bit-identical rather than merely close (an online-softmax
+    rewrite, or even per-block score dots, reassociate the reductions and
+    drift by ulps)."""
+    b = pl.program_id(0)
+    m = pl.program_id(2)
+    nm = pl.num_programs(2)
+
+    kbuf_ref[pl.ds(m * bs, bs), :] = k_ref[0, 0].astype(jnp.float32)
+    vbuf_ref[pl.ds(m * bs, bs), :] = v_ref[0, 0].astype(jnp.float32)
+
+    @pl.when(m == nm - 1)
+    def _():
+        s_total = nm * bs
+        q = q_ref[0, 0].astype(jnp.float32)      # (TQ, hd)
+        tq = q.shape[0]
+        scores = jax.lax.dot_general(
+            q, kbuf_ref[:], dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (TQ, S)
+        scores = scores / jnp.sqrt(jnp.float32(hd))
+        # the oracle's position mask: column s visible to query row r iff
+        # s <= pos0 + r // kv_mul (ragged depths, partial tail blocks and
+        # null-block garbage all handled by this one rule)
+        row_t = jax.lax.broadcasted_iota(jnp.int32, (tq, s_total), 0) // kv_mul
+        col = jax.lax.broadcasted_iota(jnp.int32, (tq, s_total), 1)
+        scores = jnp.where(col <= pos_ref[b] + row_t, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out_ref[0, 0] = jax.lax.dot_general(
+            probs, vbuf_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (TQ, hd)
+
+
+# VMEM budget for the assembled per-(b, h) resident set: K + V scratch
+# [S, hd] plus the epilogue's score matrix [TQ, S], all f32.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+MAX_TQ = 512  # folded query rows per (b, h) instance
+
+
+def supports(q_shape: tuple[int, ...], n_kv: int, n_blocks_seq: int,
+             block_size: int) -> bool:  # dlint: static-fn
+    """Whether the kernel covers this paged geometry (caller falls back to
+    the gather+oracle path otherwise)."""
+    B, T, n_heads, D = q_shape
+    if n_heads % n_kv:
+        return False
+    tq = T * (n_heads // n_kv)
+    s = n_blocks_seq * block_size
+    scratch = 4 * s * (tq + 2 * D)
+    return (D % 8 == 0 and block_size % 8 == 0 and 0 < tq <= MAX_TQ
+            and scratch <= _VMEM_BUDGET)
+
+
+def kernel_choice(q_shape: tuple[int, ...], n_kv: int, n_blocks_seq: int,
+                  block_size: int) -> dict | None:  # dlint: static-fn
+    """The paged-attention kernel gate: mode selection routes through
+    :func:`quant_matmul.pallas_mode_gate` (the ONE gate; fast=False — the
+    kernel is bit-identical, so there is no fast/exact numerics split to
+    pick), plus the shape predicate and the plan-free requirement (the
+    paged forward auto-shards under a mesh plan, and the auto-sharder
+    cannot partition a ``pallas_call``). Returns
+    :func:`paged_ragged_attention` kwargs or None (gather+oracle)."""
+    from ..parallel.api import current_plan
+    from .quant_matmul import pallas_mode_gate
+
+    kw = pallas_mode_gate(False)
+    if kw is None or current_plan() is not None:
+        return None
+    if not supports(q_shape, n_kv, n_blocks_seq, block_size):
+        return None
+    return {"interpret": kw["interpret"]}
+
+
+@functools.partial(jax.jit, static_argnames=("head_dim", "interpret"))
+def paged_ragged_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, tables: jax.Array,
+                           positions: jax.Array, head_dim: int, *,
+                           interpret: bool = False) -> jax.Array:
+    """Causal GQA attention of ``q [B, T, n_heads, hd]`` over the paged
+    pool ``k/v_pool [n_blocks, n_kv, bs, hd]`` through block ``tables
+    [B, M]`` (0 = null block), with per-row absolute positions
+    ``positions [B, T]`` (affine per row, the model's invariant).
+
+    Value-identical (bitwise, in f32) to::
+
+        gathered = pool[tables]           # the dense logical cache
+        view = moveaxis(gathered, 2, 1).reshape(B, n_kv, M*bs, hd)
+        attention(q, view_k, view_v, positions, head_dim)
+    """
+    B, T, n_heads, D = q.shape
+    n_kv, bs = k_pool.shape[1], k_pool.shape[2]
+    M = tables.shape[1]
+    kv_mul = n_heads // n_kv
+    tq = T * kv_mul
+
+    q_g = (q.reshape(B, T, n_kv, kv_mul, D)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(B, n_kv, tq, D)
+            .astype(jnp.float32))
+    pos0 = jnp.asarray(positions, jnp.int32)[:, 0]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, pos0
+        grid=(B, n_kv, M),
+        in_specs=[
+            pl.BlockSpec((1, 1, tq, D),
+                         lambda b, h, m, tbl, pos: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bs, D),
+                         lambda b, h, m, tbl, pos: (tbl[b, m], h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bs, D),
+                         lambda b, h, m, tbl, pos: (tbl[b, m], h, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, tq, D),
+                               lambda b, h, m, tbl, pos: (b, h, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((M * bs, D), jnp.float32),   # assembled f32 keys
+            pltpu.VMEM((M * bs, D), jnp.float32),   # assembled f32 values
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, bs=bs, kv_mul=kv_mul, hd=head_dim),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, n_kv, tq, D), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(tables, jnp.int32), pos0, q_g, k_pool, v_pool)
+
+    return (out.reshape(B, n_kv, T, kv_mul, D)
+               .transpose(0, 2, 1, 3, 4)
+               .reshape(B, T, n_heads, D)
+               .astype(q.dtype))

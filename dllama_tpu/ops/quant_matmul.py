@@ -97,6 +97,133 @@ BN_CANDIDATES = (512, 256, 128)
 BK_CANDIDATES = (512, 256, 128)
 
 
+def _decode_kernel(x_ref, codes_ref, scales_ref, expand_ref, out_ref, wd_ref,
+                   *, bk_e: int, fast: bool):
+    """One n-column stripe of the DECODE-shaped fused dequant-GEMV.
+
+    Unlike :func:`_kernel`'s (n, k) grid, the decode kernel keeps the whole
+    K axis in one block: the grid walks N only, each step streams the full
+    ``[K, bn]`` code stripe from HBM once, dequantizes it in-register into
+    the ``wd`` VMEM scratch (chunked scale expansion — the ``[K, K/32]``
+    expansion matrix of the full-K trick would itself be MBs), and runs ONE
+    dot over the whole contraction. No revisited output tile, no k-step
+    read-modify-write: the kernel is a single pass over the weight planes,
+    which is exactly the decode regime's byte budget (weights dominate; the
+    T<=16 activation rides along in VMEM).
+
+    The single full-K dot is also what makes the kernel bit-parity with the
+    XLA fused-dequant reference (ops.linear's dequant+dot fallback) instead
+    of merely close: the blocked k-accumulation of :func:`_kernel` sums
+    partials in a different order. Exact mode dequantizes at the activation
+    dtype (the reference's rule) with a HIGHEST dot — BITWISE vs the
+    reference on f32 activation graphs (the golden-parity configuration);
+    a bf16 graph is drift-bounded instead, because XLA's in-jaxpr fusion
+    may elide the bf16 dequant rounding on either side. Fast mode: bf16
+    dequant, one default-precision MXU pass, f32 accumulation —
+    drift-bounded for the same reason.
+    """
+    K = codes_ref.shape[0]
+    g = bk_e // Q40_BLOCK_SIZE
+    # chunked scale expansion: static python loop (K//bk_e is trace-time),
+    # each chunk element-repeats its scale rows 32x via the 0/1 matmul and
+    # lands the dequantized stripe in the wd scratch
+    wd_dt = wd_ref.dtype  # bf16 in fast mode, the activation dtype in exact
+    for i in range(K // bk_e):
+        sexp = jax.lax.dot_general(
+            expand_ref[:], scales_ref[i * g:(i + 1) * g, :],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_HIGHEST)
+        codes = codes_ref[i * bk_e:(i + 1) * bk_e, :]
+        wd_ref[i * bk_e:(i + 1) * bk_e, :] = (codes.astype(wd_dt)
+                                              * sexp.astype(wd_dt))
+    if fast:
+        out_ref[:] = jax.lax.dot_general(
+            x_ref[:], wd_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        out_ref[:] = jax.lax.dot_general(
+            x_ref[:], wd_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_HIGHEST)
+
+
+# Widest dispatch that counts as the decode regime for the fused kernel:
+# single steps (T=1), fused-chunk scan bodies, speculative verifies
+# (T=K+1, small) — the same rule as models.llama._OVERLAP_MAX_WIDTH.
+FUSED_MAX_M = 16
+
+# VMEM budget for the decode kernel's resident set: wd scratch + the
+# double-buffered code stripe + the full-K activation block must leave
+# room for Mosaic's own pipelining (~16MB/core total).
+_FUSED_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _decode_blocks(M: int, K: int, N: int,
+                   fast: bool) -> tuple[int, int] | None:
+    """``(bn, bk_e)`` for the decode kernel, or None when the shape doesn't
+    fit: bn is the largest 128-multiple (or whole-N, >=8-aligned) dividing N
+    whose resident set fits the VMEM budget; bk_e the largest expansion
+    chunk dividing K."""
+    if not (0 < M <= FUSED_MAX_M) or K % Q40_BLOCK_SIZE:
+        return None
+    bk_e = next((c for c in (512, 256, 128, 64, 32) if K % c == 0), None)
+    if bk_e is None:
+        return None
+    wd_bytes = 2 if fast else 4
+    x_bytes = M * K * (2 if fast else 4)
+    for bn in BN_CANDIDATES + ((N,) if N % 8 == 0 else ()):
+        if N % bn:
+            continue
+        resident = K * bn * (wd_bytes + 2) + x_bytes  # wd + 2x codes + x
+        if resident <= _FUSED_VMEM_BUDGET:
+            return bn, bk_e
+    return None
+
+
+# dlint: static-fn (shape gate; w may carry ShapeDtypeStruct leaves)
+def supports_decode(x_shape: tuple[int, ...], w: QuantizedWeight,
+                    fast: bool = False) -> bool:
+    """Whether the decode-shaped fused kernel covers these shapes."""
+    K = x_shape[-1]
+    M = 1
+    for d in x_shape[:-1]:
+        M *= d
+    return (w.codes.ndim == 2 and w.in_features == K
+            and _decode_blocks(M, K, w.out_features, fast) is not None)
+
+
+def _decode_call(xf: jax.Array, w: QuantizedWeight, *, interpret: bool,
+                 fast: bool) -> jax.Array:
+    """Dispatch the decode kernel over ``xf [M, K]`` (already cast).
+
+    Exact mode dequantizes at the ACTIVATION dtype — the same rule as the
+    XLA reference (``dequantize_weight(w, dtype=x.dtype)``), so an
+    exact-mode bf16 graph gets bf16 dequant on both paths instead of the
+    kernel silently upgrading to f32 and breaking xla↔fused identity."""
+    M, K = xf.shape
+    N = w.out_features
+    bn, bk_e = _decode_blocks(M, K, N, fast)
+    wd_dtype = jnp.bfloat16 if fast else xf.dtype
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, bk_e=bk_e, fast=fast),
+        grid=(N // bn,),
+        in_specs=[
+            pl.BlockSpec((M, K), lambda n: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, bn), lambda n: (0, n), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K // Q40_BLOCK_SIZE, bn), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk_e, bk_e // Q40_BLOCK_SIZE), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((M, bn), lambda n: (0, n),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((K, bn), wd_dtype)],
+        interpret=interpret,
+    )(xf, w.codes, w.scales.astype(jnp.float32), _expansion_matrix(bk_e))
+
+
 def _pick_block(dim: int, candidates: tuple[int, ...], min_align: int) -> int | None:
     """A 128-aligned block dividing ``dim``, or the whole dim (Mosaic allows a
     block equal to the array extent) when it at least meets ``min_align``."""
@@ -118,22 +245,38 @@ def _expansion_matrix(bk: int) -> np.ndarray:
                    np.ones((Q40_BLOCK_SIZE, 1), np.float32))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "fast", "bn", "bk"))
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "fast", "bn", "bk", "fused"))
 def quant_matmul(x: jax.Array, w: QuantizedWeight, *, interpret: bool = False,
                  fast: bool = False, bn: int | None = None,
-                 bk: int | None = None) -> jax.Array:
+                 bk: int | None = None, fused: bool = False) -> jax.Array:
     """``y[..., N] = x[..., K] @ dequant(w)`` via the Pallas kernel.
 
     ``fast=False``: ``x`` is cast to f32 for the dequantized dot (parity with
     the XLA exact path). ``fast=True``: bf16 operands, one MXU pass, f32
     accumulation (see _kernel). Leading dims flatten into M.  ``bn``/``bk``
     override the tile picks (tools/gemv_sweep.py measures the candidates).
+    ``fused=True`` prefers the decode-shaped full-K kernel
+    (:func:`_decode_kernel` — bit-parity with the XLA fused-dequant
+    reference) when :func:`supports_decode` holds, falling back to the
+    (n, k)-tiled kernel otherwise, so a ``fused``-mode dispatch never
+    fails on a prefill-wide shape.
     """
     *lead, K = x.shape
     N = w.out_features
     M = 1
     for d in lead:
         M *= d
+
+    if fused and bn is None and bk is None \
+            and _decode_blocks(M, K, N, fast) is not None:
+        # fast casts to bf16; exact keeps the activation dtype (the XLA
+        # reference dequantizes at x.dtype — see _decode_call)
+        xf = x.reshape(M, K)
+        if fast:
+            xf = xf.astype(jnp.bfloat16)
+        out = _decode_call(xf, w, interpret=interpret, fast=fast)
+        return out.reshape(*lead, N).astype(x.dtype)
 
     bn = bn or _pick_block(N, BN_CANDIDATES, min_align=8)
     bk = bk or _pick_block(K, BK_CANDIDATES, min_align=Q40_BLOCK_SIZE)
@@ -170,7 +313,8 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
                          out_axis: str | None = None,
                          in_axis: str | None = None, *,
                          interpret: bool = False,
-                         fast: bool = False) -> jax.Array | None:
+                         fast: bool = False,
+                         fused: bool = False) -> jax.Array | None:
     """Tensor-parallel Pallas quant matmul: the kernel inside a shard_map.
 
     The auto-sharder cannot partition a ``pallas_call``, so under a mesh plan
@@ -222,7 +366,9 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
     local_w = QuantizedWeight(
         scales=jax.ShapeDtypeStruct((k_loc // Q40_BLOCK_SIZE, n_loc), jnp.float32),
         codes=jax.ShapeDtypeStruct((k_loc, n_loc), jnp.int8))
-    if not supports((b_loc, T, k_loc), local_w):
+    if not (supports((b_loc, T, k_loc), local_w)
+            or (fused
+                and supports_decode((b_loc, T, k_loc), local_w, fast))):
         return None
 
     if k_ax is not None:
@@ -236,7 +382,7 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
             # quantized sync pipes; parallel/qcollectives.py).
             part = quant_matmul(xl.astype(jnp.float32),
                                 QuantizedWeight(scales=sc, codes=cd),
-                                interpret=interpret, fast=fast)
+                                interpret=interpret, fast=fast, fused=fused)
             return wire_psum(part, k_ax, plan._axis_size(k_ax))
 
         fn = shard_map(
@@ -246,7 +392,7 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
     else:
         def local(xl, sc, cd):
             return quant_matmul(xl, QuantizedWeight(scales=sc, codes=cd),
-                                interpret=interpret, fast=fast)
+                                interpret=interpret, fast=fast, fused=fused)
 
         fn = shard_map(
             local, mesh=plan.mesh,
@@ -256,22 +402,36 @@ def quant_matmul_sharded(plan, x: jax.Array, w: QuantizedWeight,
 
 
 def pallas_mode_gate(fast: bool) -> dict | None:  # dlint: static-fn
-    """The ONE mode/numerics gate for the sharded Pallas kernel: Pallas
-    only for exact mode on TPU, or when forced
-    (``DLLAMA_TPU_QUANT_KERNEL=pallas`` — interpret mode off-TPU, the
-    test path). Returns the :func:`quant_matmul` kwargs (currently just
-    ``interpret``) or None (XLA fused dequant+dot). Consulted by
-    ops.linear._pallas_sharded, the overlapped merge's
-    :func:`pallas_local_choice`, and the engine's wire pricing — one
-    rule, so none of them can drift from what linear() dispatches."""
+    """The ONE mode/numerics gate for every Pallas kernel dispatch:
+    ``DLLAMA_TPU_QUANT_KERNEL`` = ``auto`` (Pallas only for exact mode on
+    TPU), ``pallas`` (force the tiled kernel; interpret mode off-TPU, the
+    test path), ``fused`` (force the decode-shaped fused dequant-GEMV —
+    the built-but-unpromoted serving candidate, à la turbo: never resolved
+    from ``auto``), or ``xla`` (the fused-dequant XLA reference, also the
+    kill switch for every kernel this gate guards). Returns the
+    :func:`quant_matmul` kwargs (``interpret``, optionally ``fused``) or
+    None. Consulted by ops.linear's single-device and sharded dispatch,
+    the overlapped merge's :func:`pallas_local_choice`, the ragged paged
+    attention entry (ops.paged_attention.kernel_choice), and the engine's
+    wire pricing — one rule, so none of them can drift from what
+    linear() dispatches (dlint rule ``pallas-gate`` machine-checks the
+    routing)."""
     from .linear import _kernel_mode, _on_tpu  # lazy: linear imports us
 
     mode = _kernel_mode()
     if mode == "xla":
         return None
+    if mode == "fused":
+        return {"interpret": not _on_tpu(), "fused": True}
     if mode != "pallas" and (fast or not _on_tpu()):
         return None
     return {"interpret": mode == "pallas" and not _on_tpu()}
+
+
+def wants_fused(kw: dict | None) -> bool:  # dlint: static-fn
+    """Whether a :func:`pallas_mode_gate` result selects the decode-shaped
+    fused kernel (trace-time env config, never a traced value)."""
+    return kw is not None and kw.get("fused", False) is True
 
 
 # dlint: static-fn (shape gate; w may carry ShapeDtypeStruct leaves)
@@ -282,7 +442,10 @@ def pallas_local_choice(x_shape: tuple[int, ...], w: QuantizedWeight,
     (models.llama._overlapped_col_linear) and host-side pricing probes.
     ``w`` may carry ShapeDtypeStruct leaves."""
     kw = pallas_mode_gate(fast)
-    if kw is None or not supports(tuple(x_shape), w):
+    if kw is None:
+        return None
+    if not (supports(tuple(x_shape), w)
+            or (wants_fused(kw) and supports_decode(tuple(x_shape), w, fast))):
         return None
     return kw
 

@@ -417,3 +417,45 @@ def rate_roofline(tok_per_s: float, weight_gb: float,
     if raw > 1.0:
         out["raw_fraction"] = round(raw, 4)
     return out
+
+
+def rate_roofline_families(stage: dict, weight_gb: float, n_params: int,
+                           ceilings: Ceilings) -> dict:
+    """Bench-parent helper: ``roofline_fraction`` per program FAMILY
+    (decode vs prefill vs paged) from one measured stage's rates — the
+    same jax-free shape algebra as :func:`rate_roofline`, with first-class
+    ``no_evidence`` for any family the stage never measured.
+
+    * **decode** — memory-bound against the weight stream (the headline
+      formula).
+    * **prefill** — compute-bound: achieved TFLOP/s from ``2 * n_params``
+      FLOPs per token against the MXU ceiling (the classic MFU).
+    * **paged** — the SAME weight-stream pricing as decode, applied to the
+      block-table step: both families must stream every weight byte, so
+      the paged fraction sitting below decode's is exactly the
+      gather/kernel overhead of the paged path — previously invisible in
+      the ranked metrics (the PR6 gather materializes the dense logical
+      cache per layer per step; the ragged paged attention kernel exists
+      to close this gap)."""
+    fams: dict = {}
+    v = stage.get("decode_tok_per_s")
+    fams["decode"] = (rate_roofline(v, weight_gb, ceilings) if v
+                      else {"no_evidence": "decode never measured"})
+    v = stage.get("prefill_tok_per_s")
+    if v:
+        ach = v * 2.0 * n_params / 1e12
+        raw = ach / ceilings.tflops if ceilings.tflops else 0.0
+        rec = {"achieved_tflops": round(ach, 3),
+               "roofline_fraction": round(min(1.0, raw), 4),
+               "bound": "compute",
+               "ceiling_source": ceilings.source,
+               "ceiling_tflops": ceilings.tflops}
+        if raw > 1.0:
+            rec["raw_fraction"] = round(raw, 4)
+        fams["prefill"] = rec
+    else:
+        fams["prefill"] = {"no_evidence": "prefill never measured"}
+    v = stage.get("paged_decode_tok_per_s")
+    fams["paged"] = (rate_roofline(v, weight_gb, ceilings) if v
+                     else {"no_evidence": "paged decode never measured"})
+    return fams

@@ -41,7 +41,7 @@ def test_all_rules_registered():
     assert {"jit-entry", "shard-map-shim", "tracer-hazard", "guarded-twin",
             "thread-ownership", "lock-guard", "lock-order",
             "metrics-names", "exception-hygiene", "route-labels",
-            "failpoint-sites", "span-phases"} <= names
+            "failpoint-sites", "span-phases", "pallas-gate"} <= names
 
 
 def test_live_repo_scans_clean():
@@ -441,6 +441,85 @@ def test_span_phases_fixture_violation(tmp_path):
     msgs = "\n".join(f.message for f in findings)
     assert "bogus_phase" in msgs                    # emitted, not in PHASES
     assert "queue" in msgs                          # documented, never emitted
+
+
+def test_pallas_gate_fixture_violation(tmp_path):
+    """A new kernel module dispatching pl.pallas_call without consulting
+    quant_matmul.pallas_mode_gate fires pallas-gate at the call line; a
+    module that routes through the gate — and the exempt legacy modules —
+    stay clean."""
+    project = _tree(tmp_path, {
+        "dllama_tpu/ops/rogue_kernel.py": """\
+            from jax.experimental import pallas as pl
+
+
+            def _kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+
+            def rogue(x):
+                import os
+                interpret = os.environ.get("MY_OWN_KNOB") == "1"
+                return pl.pallas_call(_kernel, out_shape=None,
+                                      interpret=interpret)(x)
+            """,
+        "dllama_tpu/ops/good_kernel.py": """\
+            from jax.experimental import pallas as pl
+
+            from .quant_matmul import pallas_mode_gate
+
+
+            def _kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+
+            def good(x):
+                kw = pallas_mode_gate(False)
+                if kw is None:
+                    return None
+                return pl.pallas_call(_kernel, out_shape=None, **kw)(x)
+            """,
+        "dllama_tpu/ops/sneaky_kernel.py": """\
+            from jax.experimental import pallas as pl
+
+            from .quant_matmul import pallas_mode_gate  # imported, never CALLED
+
+
+            def sneaky(x):
+                return pl.pallas_call(lambda i, o: None, out_shape=None)(x)
+            """,
+        "dllama_tpu/ops/quant_matmul.py": """\
+            from jax.experimental import pallas as pl
+
+
+            def pallas_mode_gate(fast):
+                return {"interpret": True}
+
+
+            def run(x):
+                return pl.pallas_call(lambda i, o: None, out_shape=None)(x)
+            """,
+    })
+    res = _run("pallas-gate", project)
+    assert len(res.findings) == 2, [str(f) for f in res.findings]
+    by_path = {f.path.rsplit("/", 1)[-1]: f for f in res.findings}
+    # a module with its own env knob fires; so does one that merely
+    # IMPORTS the gate without calling it (an unused import is not a
+    # consult)
+    assert set(by_path) == {"rogue_kernel.py", "sneaky_kernel.py"}
+    f = by_path["rogue_kernel.py"]
+    assert "pallas_mode_gate" in f.message
+    # the finding anchors the pallas_call line itself
+    src = (tmp_path / "dllama_tpu/ops/rogue_kernel.py").read_text()
+    assert "pl.pallas_call" in src.splitlines()[f.lineno - 1]
+
+
+def test_pallas_gate_live_repo_kernels_routed():
+    """The real kernel modules: paged_attention (and any future kernel
+    module) must consult the shared gate; the two legacy modules are the
+    documented exempt list."""
+    res = _run("pallas-gate", Project(REPO))
+    assert not res.findings, [str(f) for f in res.findings]
 
 
 def test_shard_map_wrapper_cli_still_works():

@@ -244,6 +244,157 @@ def test_fast_mode_model_logit_drift(monkeypatch):
     np.testing.assert_array_equal(e.argmax(-1), f.argmax(-1))
 
 
+# ---------------------------------------------------------------------------
+# decode-shaped FUSED dequant-GEMV kernel (DLLAMA_TPU_QUANT_KERNEL=fused):
+# one full-K pass per N stripe, dequant in-register — BIT-PARITY with the
+# XLA fused-dequant reference in exact mode (the single full-K dot keeps
+# the reference's reduction structure; the tiled kernel's blocked
+# k-accumulation cannot make this claim)
+# ---------------------------------------------------------------------------
+
+from dllama_tpu.ops.linear import dequantize_weight  # noqa: E402
+from dllama_tpu.ops.quant_matmul import supports_decode  # noqa: E402
+
+
+def _xla_fused_dequant(x, w, fast=False):
+    """The XLA fused-dequant reference linear() falls back to — computed
+    with the same ops, so the kernel's parity target is the real thing."""
+    wd = dequantize_weight(w, dtype=jnp.bfloat16 if fast else x.dtype)
+    xr = x.astype(jnp.bfloat16) if fast else x
+    return jax.lax.dot_general(
+        xr, wd, dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@pytest.mark.parametrize("m,n,k", [
+    (1, 256, 512),     # decode step
+    (16, 512, 1024),   # FUSED_MAX_M edge (verify width)
+    (4, 96, 96),       # whole-N block, tiny-K
+    (2, 128, 2048),    # multi-chunk scale expansion (bk_e < K)
+])
+def test_fused_kernel_bit_parity_q40(m, n, k):
+    w = _mk(n, k, seed=n + k)
+    x = jnp.asarray(np.random.default_rng(m).standard_normal((m, k)),
+                    jnp.float32)
+    assert supports_decode((m, k), w)
+    got = quant_matmul(x, w, interpret=True, fused=True)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(_xla_fused_dequant(x, w)))
+
+
+def test_fused_kernel_bit_parity_q80_planes():
+    """Q80 weights land in the same (scales, int8-codes) planes; the fused
+    kernel consumes them unchanged and stays bit-parity."""
+    from dllama_tpu.formats.quants import quantize_q80, unpack_q80
+    from dllama_tpu.ops.linear import QuantizedWeight
+
+    rng = np.random.default_rng(5)
+    w = (rng.standard_normal((256, 512)) * 0.1).astype(np.float32)
+    scales, codes = unpack_q80(quantize_q80(w.reshape(-1)), w.size)
+    qw = QuantizedWeight(
+        scales=jnp.asarray(scales.reshape(256, 16).T.astype(np.float32)),
+        codes=jnp.asarray(np.ascontiguousarray(codes.reshape(256, 512).T)))
+    assert int(np.abs(np.asarray(qw.codes)).max()) > 8  # genuinely 8-bit
+    x = jnp.asarray(rng.standard_normal((1, 512)), jnp.float32)
+    got = quant_matmul(x, qw, interpret=True, fused=True)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(_xla_fused_dequant(x, qw)))
+
+
+def test_fused_kernel_fast_mode_drift_bounded():
+    """Fast mode (bf16 dequant, one MXU pass, f32 accumulation): the XLA
+    reference's in-jaxpr fusion may elide the bf16 rounding of the dequant
+    transient, so fast parity is drift-bounded (bf16-rounding-sized), not
+    bitwise — same contract as the tiled kernel's fast mode."""
+    w = _mk(256, 2048, seed=77)
+    x = jnp.asarray(np.random.default_rng(9).standard_normal((1, 2048)),
+                    jnp.float32)
+    fast = np.asarray(quant_matmul(x, w, interpret=True, fused=True,
+                                   fast=True))
+    exact = np.asarray(quant_matmul(x, w, interpret=True, fused=True))
+    rel = np.abs(fast - exact) / np.maximum(np.abs(exact), 1e-3)
+    assert float(np.median(rel)) < 3e-3, float(np.median(rel))
+    rms = float(np.sqrt(np.mean(exact ** 2)))
+    assert float(np.abs(fast - exact).max()) / rms < 2e-2
+
+
+def test_fused_exact_bf16_graph_mirrors_reference_dequant():
+    """An exact-mode bf16 activation graph: the kernel dequantizes at
+    bf16 like the XLA reference (dequant-at-activation-dtype rule), so
+    xla↔fused drift is bf16-rounding-sized — NOT bitwise (XLA fusion may
+    elide the bf16 rounding on either side; the bitwise claim is scoped
+    to f32 graphs)."""
+    w = _mk(256, 512, seed=61)
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((1, 512)),
+                    jnp.bfloat16)
+    got = np.asarray(quant_matmul(x, w, interpret=True, fused=True),
+                     np.float32)
+    want = np.asarray(_xla_fused_dequant(x.astype(jnp.float32), w),
+                      np.float32)
+    rms = float(np.sqrt(np.mean(want ** 2)))
+    assert float(np.abs(got - want).max()) / rms < 2e-2
+
+
+def test_fused_falls_back_to_tiled_for_prefill_widths():
+    """fused=True on an M > FUSED_MAX_M dispatch silently takes the tiled
+    kernel — a fused-mode engine never fails on its prefill chunks."""
+    from dllama_tpu.ops.quant_matmul import FUSED_MAX_M
+
+    m = FUSED_MAX_M * 2
+    w = _mk(256, 512, seed=31)
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((m, 512)),
+                    jnp.float32)
+    assert not supports_decode((m, 512), w)
+    got = quant_matmul(x, w, interpret=True, fused=True)
+    want = quant_matmul(x, w, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_mode_gate(monkeypatch):
+    """DLLAMA_TPU_QUANT_KERNEL=fused resolves through pallas_mode_gate
+    (the ONE gate): fused kwargs off-TPU carry interpret=True; auto never
+    resolves to fused (a built-but-unpromoted mode, à la turbo)."""
+    from dllama_tpu.ops.quant_matmul import pallas_mode_gate
+
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_KERNEL", "fused")
+    for fast in (False, True):
+        kw = pallas_mode_gate(fast)
+        assert kw is not None and kw["fused"] is True
+        assert kw["interpret"] is True  # off-TPU test path
+    monkeypatch.delenv("DLLAMA_TPU_QUANT_KERNEL", raising=False)
+    kw = pallas_mode_gate(False)
+    assert kw is None or "fused" not in kw
+
+
+def test_fused_mode_linear_end_to_end(monkeypatch):
+    """linear() under DLLAMA_TPU_QUANT_KERNEL=fused dispatches the decode
+    kernel for a decode-shaped activation and matches the XLA reference
+    bitwise (exact numerics)."""
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_MODE", "exact")
+    w = _mk(256, 512, seed=41)
+    x = jnp.asarray(np.random.default_rng(6).standard_normal((1, 512)),
+                    jnp.float32)
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_KERNEL", "xla")
+    want = linear(x, w)
+    monkeypatch.setenv("DLLAMA_TPU_QUANT_KERNEL", "fused")
+    got = linear(x, w)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_sharded_col_split_matches_oracle():
+    """The shard_map-wrapped fused kernel under a tp mesh (col-split: the
+    decode hot path's wo/w2 merges)."""
+    plan = make_tp_mesh(2)
+    w = _mk(256, 512, seed=51)
+    x = _x3(1, 4, 512, seed=52)
+    want = linear(x, w)
+    got = quant_matmul_sharded(plan, x, w, in_axis="hidden",
+                               interpret=True, fused=True)
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_linear_dispatches_sharded_kernel_under_plan(monkeypatch):
     """linear() no longer bypasses the kernel under a mesh plan
     (VERDICT round-1 weak #2): DLLAMA_TPU_QUANT_KERNEL=pallas + plan routes

@@ -45,6 +45,32 @@ def test_quant_matmul_error_bound_on_hw(tpu_backend):
     assert err < 5e-5, f"max abs error {err}"
 
 
+def test_fused_decode_kernel_error_bound_on_hw(tpu_backend):
+    """The decode-shaped fused dequant-GEMV (DLLAMA_TPU_QUANT_KERNEL=fused
+    candidate) compiled by Mosaic: exact mode vs the float64 host oracle at
+    the tiled kernel's error bound; fast mode within bf16-rounding drift of
+    exact (the serving-mode contract)."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.ops.linear import dequantize_weight, quantize_weight_q40
+    from dllama_tpu.ops.quant_matmul import quant_matmul, supports_decode
+
+    rng = np.random.default_rng(17)
+    w = quantize_weight_q40(
+        (rng.standard_normal((512, 2048)) * 0.1).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((1, 2048)), jnp.float32)
+    assert supports_decode((1, 2048), w)
+
+    got = np.asarray(quant_matmul(x, w, fused=True))
+    wd = np.asarray(dequantize_weight(w)).astype(np.float64)
+    want = np.asarray(x, np.float64) @ wd
+    assert np.abs(got - want).max() < 5e-5
+
+    fast = np.asarray(quant_matmul(x, w, fused=True, fast=True))
+    rms = float(np.sqrt(np.mean(got ** 2)))
+    assert np.abs(fast - got).max() / rms < 2e-2
+
+
 def test_flash_attention_parity_on_hw(tpu_backend):
     """Kernel vs XLA oracle on the MXU. At default matmul precision the MXU
     runs one bf16 pass per f32 dot, so kernel-vs-oracle differences are

@@ -22,8 +22,8 @@ import os
 import sys
 
 _RATES = ("decode_tok_per_s", "prefill_tok_per_s", "sampled_decode_tok_per_s",
-          "chunked_decode_tok_per_s", "agg_tok_per_s",
-          "decode_tok_per_s_q80")
+          "chunked_decode_tok_per_s", "paged_decode_tok_per_s",
+          "agg_tok_per_s", "decode_tok_per_s_q80")
 # lower-is-better latencies (--scenario continuous/fleet TTFT; --scenario
 # multichip exposed collective wall): the printed pct is still
 # "improvement-positive", so the sign is flipped before ranking
@@ -53,10 +53,30 @@ def _from_baseline(doc: dict) -> dict:
     for key, rec in (doc.get("metrics") or {}).items():
         scope, _, field = key.partition(".")
         if scope == "headline" and field == "roofline_fraction":
-            out["roofline"] = {"roofline_fraction": rec["value"]}
+            out.setdefault("roofline", {})["roofline_fraction"] = rec["value"]
+        elif scope == "family":
+            fam, _, ffield = field.partition(".")
+            if ffield == "roofline_fraction":
+                out.setdefault("roofline", {}).setdefault(
+                    "families", {})[fam] = {"roofline_fraction": rec["value"]}
         else:
             stages.setdefault(scope, {})[field] = rec["value"]
     return out
+
+
+def _from_gemv_sweep(doc: dict) -> dict:
+    """Expand a ``tools/gemv_sweep.py --json`` line into the bench-result
+    shape: one stage per GEMV shape, one ``gbps:<variant>`` rate per swept
+    kernel variant — so two sweeps diff (and rank by effective GB/s) the
+    same way two bench captures do."""
+    stages: dict = {}
+    for row in doc.get("rows") or ():
+        if row.get("gbps") is None:
+            continue
+        stages.setdefault(row["shape"], {})[f"gbps:{row['label']}"] = \
+            row["gbps"]
+    return {"metric": "gemv_sweep", "git": doc.get("git"),
+            "device_kind": doc.get("device_kind"), "stages": stages}
 
 
 def _load(path: str) -> dict:
@@ -66,6 +86,8 @@ def _load(path: str) -> dict:
         text = f.read()
     try:
         whole = json.loads(text)
+        if isinstance(whole, dict) and whole.get("tool") == "gemv_sweep":
+            return _from_gemv_sweep(whole)
         if "metrics" in whole and "stages" not in whole \
                 and "value" not in whole:
             return _from_baseline(whole)
@@ -117,7 +139,11 @@ def main() -> None:
     rows = []
     sa, sb = a.get("stages") or {}, b.get("stages") or {}
     for stage in sorted(set(sa) & set(sb)):
-        for k in _RATES:
+        # gbps:<variant> fields come from gemv-sweep expansion (effective
+        # GB/s per kernel variant — higher is better, ranked like rates)
+        sweep = sorted(k for k in set(sa[stage]) & set(sb[stage])
+                       if k.startswith("gbps:"))
+        for k in _RATES + tuple(sweep):
             va, vb = sa[stage].get(k), sb[stage].get(k)
             if va and vb:
                 rows.append((100 * (vb - va) / va, stage, k, va, vb))
@@ -133,6 +159,16 @@ def main() -> None:
     if va and vb:
         rows.append((100 * (vb - va) / va, "headline",
                      "roofline_fraction", va, vb))
+    # per-family fractions (decode vs prefill vs paged — the paged family
+    # is where the PR6 gather cost shows up; a no_evidence family has no
+    # fraction and drops out of the ranking by construction)
+    fa, fb = ra.get("families") or {}, rb.get("families") or {}
+    for fam in sorted(set(fa) & set(fb)):
+        va = (fa[fam] or {}).get("roofline_fraction")
+        vb = (fb[fam] or {}).get("roofline_fraction")
+        if va and vb:
+            rows.append((100 * (vb - va) / va, f"family:{fam}",
+                         "roofline_fraction", va, vb))
     if not rows:
         print("no overlapping measured rates")
         return

@@ -224,6 +224,7 @@ def load_rule_modules() -> None:
         exception_hygiene,
         failpoint_sites,
         metrics_names,
+        pallas_gate,
         route_labels,
         span_phases,
         thread_ownership,

@@ -1,0 +1,99 @@
+"""pallas-gate — every Pallas kernel module routes mode selection through
+the ONE shared gate.
+
+The PR8 review finding ("one shared gate") promoted to a machine-checked
+invariant: ``ops/quant_matmul.pallas_mode_gate`` is the single place the
+``DLLAMA_TPU_QUANT_KERNEL`` env knob turns into a kernel choice, so the
+col-split tp path, the overlapped merge, the wire pricing, and the ragged
+paged attention entry can never drift from what ``linear()`` dispatches
+— and ``DLLAMA_TPU_QUANT_KERNEL=xla`` stays a working kill switch for
+EVERY Pallas kernel in the tree.
+
+**Invariant:** a module under ``dllama_tpu/`` containing a
+``pl.pallas_call`` site must reference ``pallas_mode_gate`` (its own
+dispatch consults the shared gate), except the two modules that predate
+or define the gate: ``ops/quant_matmul.py`` (defines it) and
+``ops/flash_attention.py`` (its enablement is the attention-impl knob,
+``cfg.attn_impl``, selected by the model layer — a per-config choice,
+not the env gate). A new kernel module that invents its own ad-hoc env
+knob or hardcodes enablement fires this rule at each ``pallas_call``
+line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, rule
+
+RULE = "pallas-gate"
+
+# modules exempt from the reference requirement: the gate's own home and
+# the pre-gate attention kernel (enabled via cfg.attn_impl, see docstring)
+_EXEMPT = (
+    "dllama_tpu/ops/quant_matmul.py",
+    "dllama_tpu/ops/flash_attention.py",
+)
+
+
+def _pallas_call_lines(tree: ast.AST) -> list[int]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "pallas_call") \
+                    or (isinstance(f, ast.Name) and f.id == "pallas_call"):
+                out.append(node.lineno)
+    return sorted(out)
+
+
+def _calls_gate(tree: ast.AST) -> bool:
+    """True when the module CALLS pallas_mode_gate somewhere (directly or
+    as an attribute) — a bare import or name reference does not count, so
+    an unused ``from .quant_matmul import pallas_mode_gate`` can't
+    satisfy the invariant. Granularity is deliberately module-level: the
+    ``pallas_call`` site and the gate consult legitimately live in
+    different functions of one kernel module (the private ``_call`` vs
+    the public dispatch entry), so per-function checking would
+    false-positive on every compliant module."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "pallas_mode_gate") \
+                or (isinstance(f, ast.Name) and f.id == "pallas_mode_gate"):
+            return True
+    return False
+
+
+@rule(RULE, "pallas_call sites route mode selection through "
+            "quant_matmul.pallas_mode_gate")
+def check(project: Project):
+    files = project.walk("dllama_tpu")
+    findings = list(project.parse_failures(files, RULE))
+    n_sites = 0
+    n_modules = 0
+    for sf in files:
+        if sf.tree is None:
+            continue
+        lines = _pallas_call_lines(sf.tree)
+        if not lines:
+            continue
+        n_modules += 1
+        n_sites += len(lines)
+        if sf.rel.replace("\\", "/") in _EXEMPT:
+            continue
+        if _calls_gate(sf.tree):
+            continue
+        for lineno in lines:
+            findings.append(Finding(
+                RULE, sf.rel, lineno,
+                "pl.pallas_call in a module that never consults "
+                "quant_matmul.pallas_mode_gate — kernel mode selection "
+                "must route through the ONE shared gate (so "
+                "DLLAMA_TPU_QUANT_KERNEL=xla stays a working kill switch "
+                "and modes can't drift per kernel); call it from this "
+                "module's dispatch gate, or add the module to the "
+                "documented exempt list in tools/dlint/pallas_gate.py"))
+    return findings, (f"{n_sites} pallas_call site(s) across {n_modules} "
+                      f"module(s) gate-routed or exempt")

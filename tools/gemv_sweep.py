@@ -4,11 +4,14 @@ The decode profile (tools/profile_decode.py) shows the Q40 quant matmul
 streaming codes at ~114-130 GB/s effective against an 819 GB/s chip — the
 dominant term in the 8.4x roofline gap.  This sweep times, for the hot
 decode shapes, the production Pallas kernel at several (bn, bk) block
-choices against: the XLA dequant+dot fallback (f32- and bf16-stored
-scales), a dense bf16 matmul (the no-quantization reference point), a raw
-s8xs8 MXU dot -> s32 (rate bound for a w8a8 "turbo" mode), manually packed
-4-bit codes unpacked on the VPU (halved code HBM vs shift/mask cost), and
-multi-row activations (M=8 verify / M=256 prefill-chunk shapes).
+choices against: the decode-shaped FUSED dequant-GEMV kernel
+(ops/quant_matmul._decode_kernel — one full-K pass per N stripe, dequant
+in-register; the DLLAMA_TPU_QUANT_KERNEL=fused candidate), the XLA
+dequant+dot fallback (f32- and bf16-stored scales), a dense bf16 matmul
+(the no-quantization reference point), a raw s8xs8 MXU dot -> s32 (rate
+bound for a w8a8 "turbo" mode), manually packed 4-bit codes unpacked on
+the VPU (halved code HBM vs shift/mask cost), and multi-row activations
+(M=8 verify / M=256 prefill-chunk shapes).
 
 Timing methodology: the host->device round trip on the axon tunnel is
 ~67 ms and per-dispatch host enqueue is ~1 ms, so sub-millisecond kernels
@@ -20,12 +23,19 @@ from hoisting the matmul).  Wall time is taken at two iteration counts and
 the per-op cost is the SLOPE, which cancels the RTT and any fixed
 dispatch/loop overhead.
 
-Usage:  python tools/gemv_sweep.py [n_lo] [n_hi]
+Usage:  python tools/gemv_sweep.py [n_lo] [n_hi] [--json]
+
+``--json`` prints ONE machine-readable JSON line (same contract as
+``tools/profile_decode.py --json``): ``{"tool": "gemv_sweep",
+"device_kind": ..., "rows": [{"shape", "label", "us", "gbps"}, ...]}`` —
+scriptable kernel A/Bs, and ``tools/bench_compare.py`` diffs two sweep
+lines ranking each variant's effective GB/s.
 """
 
 from __future__ import annotations
 
 import functools
+import json
 import os
 import sys
 import time
@@ -34,13 +44,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
-    n_lo = int(sys.argv[1]) if len(sys.argv) > 1 else 64
-    n_hi = int(sys.argv[2]) if len(sys.argv) > 2 else 448
+    args = [a for a in sys.argv[1:] if a != "--json"]
+    as_json = "--json" in sys.argv[1:]
+    n_lo = int(args[0]) if len(args) > 0 else 64
+    n_hi = int(args[1]) if len(args) > 1 else 448
     import jax
     import jax.numpy as jnp
 
     from dllama_tpu.ops import quant_matmul as qm
     from dllama_tpu.ops.linear import QuantizedWeight, dequantize_weight
+
+    rows: list = []
+
+    def say(*a, **kw):
+        if not as_json:
+            print(*a, **kw)
 
     def fetch(x):
         jax.device_get(jnp.ravel(x)[0])
@@ -54,6 +72,8 @@ def main() -> None:
         scales = jax.random.uniform(ks, (K // 32, N), jnp.float32,
                                     minval=0.001, maxval=0.011)
         return QuantizedWeight(scales=scales, codes=codes)
+
+    shape_label = [""]  # current "K=..,N=.." tag for the JSON rows
 
     def bench(label, op, x, *wargs, bytes_moved: int):
         """op(x, *wargs) -> y [1, N]; loop it on device, slope-time it."""
@@ -72,6 +92,9 @@ def main() -> None:
             x, acc = jax.lax.fori_loop(0, n, body, (x, jnp.float32(0.0)))
             return acc
 
+        row = {"shape": shape_label[0], "label": label, "us": None,
+               "gbps": None}
+        rows.append(row)
         try:
             times = {}
             for n in (n_lo, n_hi):
@@ -81,21 +104,26 @@ def main() -> None:
                 times[n] = time.perf_counter() - t0
             per_op = (times[n_hi] - times[n_lo]) / (n_hi - n_lo)
             if per_op <= 0:
-                print(f"  {label:<28} not resolvable (slope <= 0)")
+                say(f"  {label:<28} not resolvable (slope <= 0)")
+                row["error"] = "slope <= 0"
                 return None
             gbps = bytes_moved / per_op / 1e9
-            print(f"  {label:<28} {1e6 * per_op:9.1f} us  {gbps:7.1f} GB/s")
+            say(f"  {label:<28} {1e6 * per_op:9.1f} us  {gbps:7.1f} GB/s")
+            row["us"] = round(1e6 * per_op, 2)
+            row["gbps"] = round(gbps, 1)
             return per_op
         except Exception as e:  # noqa: BLE001
-            print(f"  {label:<28} {type(e).__name__}: {str(e)[:70]}")
+            say(f"  {label:<28} {type(e).__name__}: {str(e)[:70]}")
+            row["error"] = f"{type(e).__name__}: {str(e)[:120]}"
             return None
 
     for K, N in ((2048, 8192), (4096, 14336), (2048, 128256)):
         w = make_w(K, N)
         x = jax.random.normal(jax.random.fold_in(key, K), (1, K), jnp.bfloat16)
         nbytes = K * N + (K // 32) * N * 4  # codes + f32 scales
-        print(f"\nGEMV [1,{K}] x [{K},{N}]  ({nbytes / 1e6:.0f} MB quant)",
-              flush=True)
+        shape_label[0] = f"K={K},N={N}"
+        say(f"\nGEMV [1,{K}] x [{K},{N}]  ({nbytes / 1e6:.0f} MB quant)",
+            flush=True)
 
         for bn, bk in ((512, 512), (1024, 512), (2048, 512), (512, 1024),
                        (1024, 1024), (2048, 1024), (1024, 2048)):
@@ -107,6 +135,17 @@ def main() -> None:
         bench("pallas default picks",
               functools.partial(qm.quant_matmul, fast=True), x, w,
               bytes_moved=nbytes)
+        # the decode-shaped fused dequant-GEMV candidate (one full-K pass
+        # per N stripe; DLLAMA_TPU_QUANT_KERNEL=fused) — fast (serving) and
+        # exact (parity) numerics
+        if qm.supports_decode((1, K), w, True):
+            bench("pallas fused (fast)",
+                  functools.partial(qm.quant_matmul, fast=True, fused=True),
+                  x, w, bytes_moved=nbytes)
+        if qm.supports_decode((1, K), w, False):
+            bench("pallas fused (exact)",
+                  functools.partial(qm.quant_matmul, fused=True),
+                  x, w, bytes_moved=nbytes)
 
         bench("xla dequant+dot (fast)",
               lambda x, w: x @ dequantize_weight(w, dtype=jnp.bfloat16),
@@ -176,6 +215,19 @@ def main() -> None:
             bench(f"xla dequant M={M}",
                   lambda x, w: x @ dequantize_weight(w, dtype=jnp.bfloat16),
                   xm, w, bytes_moved=K * N + (K // 32) * N * 4)
+            if M <= qm.FUSED_MAX_M and qm.supports_decode((M, K), w, True):
+                bench(f"pallas fused M={M}",
+                      functools.partial(qm.quant_matmul, fast=True,
+                                        fused=True),
+                      xm, w, bytes_moved=K * N + (K // 32) * N * 4)
+
+    if as_json:
+        try:
+            kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — the line must still emit
+            kind = ""
+        print(json.dumps({"tool": "gemv_sweep", "device_kind": kind,
+                          "n_lo": n_lo, "n_hi": n_hi, "rows": rows}))
 
 
 if __name__ == "__main__":

@@ -46,7 +46,8 @@ DEFAULT_BASELINE = os.path.join(REPO, "PERF_BASELINE.json")
 # (stage-scoped: the key is "<stage>.<field>")
 RATE_FIELDS = ("decode_tok_per_s", "prefill_tok_per_s",
                "sampled_decode_tok_per_s", "chunked_decode_tok_per_s",
-               "agg_tok_per_s", "decode_tok_per_s_q80")
+               "paged_decode_tok_per_s", "agg_tok_per_s",
+               "decode_tok_per_s_q80")
 LATENCY_FIELDS = ("decode_ms_per_step", "verify_k4_ms",
                   "ttft_ms_p50", "ttft_ms_p95", "comm_exposed_ms")
 # decode-region fields whose RTT floor scales with the region length
@@ -167,6 +168,16 @@ def extract_metrics(bench: dict) -> dict:
         out["headline.roofline_fraction"] = {
             "value": float(roof["roofline_fraction"]),
             "higher_better": True, "noise_frac": DEFAULT_NOISE_FRAC}
+    # per program-family fractions (decode vs prefill vs paged): lock each
+    # family's distance-to-ceiling in independently, so a paged-path
+    # regression can't hide behind a steady headline decode number (a
+    # family with no_evidence contributes nothing, same as a stage)
+    for fam, rec in (roof.get("families") or {}).items():
+        frac = (rec or {}).get("roofline_fraction")
+        if frac is not None:
+            out[f"family.{fam}.roofline_fraction"] = {
+                "value": float(frac), "higher_better": True,
+                "noise_frac": DEFAULT_NOISE_FRAC}
     return out
 
 

@@ -65,6 +65,9 @@ COMBOS = [
     ("xla-attn", None, "xla", None, None, None, None, None),     # oracle attention
     ("exact", None, None, None, "exact", None, None, None),      # parity numerics
     ("pallas", "pallas", "flash", None, None, None, None, None), # Pallas kernel
+    # decode-shaped fused dequant-GEMV (one full-K pass per N stripe;
+    # also turns the ragged paged attention kernel on via the shared gate)
+    ("fused", "fused", None, None, None, None, None, None),
 ]
 
 

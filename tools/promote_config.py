@@ -45,6 +45,10 @@ COMBO_ENV = {
     "unroll4": {"DLLAMA_TPU_SCAN_UNROLL": "4"},
     "turbo": {"DLLAMA_TPU_QUANT_MODE": "turbo"},
     "turbo16": {"DLLAMA_TPU_QUANT_MODE": "turbo16"},
+    # decode-shaped fused dequant-GEMV (ops/quant_matmul._decode_kernel):
+    # exact-mode bit-parity with the XLA fused-dequant reference, fast-mode
+    # drift same class as `fast` — a kernel choice, always eligible
+    "fused": {"DLLAMA_TPU_QUANT_KERNEL": "fused"},
     # dense bf16 planes: exact numerics (no quantization), 2x the HBM —
     # only ever wins the 1b preset (the 8b dense stack exceeds HBM, so the
     # 8b-first promotion logic keeps q40 for the headline shape)
