@@ -837,9 +837,19 @@ def bench_continuous(deadline: float, *, out: dict | None = None) -> dict:
     whole stream, TTFT percentiles (queue wait included — that IS the
     continuous-batching win), and block-pool occupancy/sharing peaks.
 
+    The same wave then re-runs with speculative decoding on
+    (``--spec-lookup``, runtime/serving.PagedGenerator's paged verify
+    path) for a spec on/off A/B: ``accepted_tok_per_s`` (the spec-on
+    wave's aggregate emitted tok/s — what acceptance actually bought),
+    ``spec_accept_rate`` (accepted / drafted over the wave), and
+    ``itl_p50_ms_delta`` (spec-on minus spec-off inter-token p50 —
+    negative when speculation wins). tools/bench_compare.py ranks
+    ``accepted_tok_per_s``; tools/perf_baseline.py guards it.
+
     Workload knobs (env): DLLAMA_BENCH_SCN_REQUESTS (24),
     DLLAMA_BENCH_SCN_SLOTS (4), DLLAMA_BENCH_KV_BLOCK (16),
-    DLLAMA_BENCH_SCN_STAGGER (0.05 s), DLLAMA_BENCH_SCN_MAXTOK (16)."""
+    DLLAMA_BENCH_SCN_STAGGER (0.05 s), DLLAMA_BENCH_SCN_MAXTOK (16),
+    DLLAMA_BENCH_SCN_SPEC (4 — the A/B's spec-lookup width)."""
     import shutil
     import tempfile
     import threading
@@ -891,120 +901,175 @@ def bench_continuous(deadline: float, *, out: dict | None = None) -> dict:
                 prompts.append([int(x) for x in rng.integers(1, 200, 96)])
 
         out["phase"] = "scenario_engine"
-        eng = InferenceEngine(mpath, tpath, tp=1, kv_block_size=block)
-        sched = BatchScheduler(eng, n_slots=n_slots)
-        reg = tm.registry()
-        g_total = reg.gauge(tm.KV_BLOCKS_TOTAL)
-        g_used = reg.gauge(tm.KV_BLOCKS_USED)
-        g_shared = reg.gauge(tm.KV_BLOCKS_SHARED)
-        reuse = reg.counter(tm.PREFIX_REUSE_TOKENS)
-        r0 = reuse.total()
 
-        occ: list = []
-        peaks = {"shared": 0.0}
-        stop_sampling = threading.Event()
+        def wave(spec_k: int) -> dict:
+            """One full staggered request wave through a fresh
+            engine/scheduler at ``--spec-lookup=spec_k`` — the spec
+            on/off A/B runs the IDENTICAL workload twice, so the two
+            sides differ only in the verify path."""
+            w: dict = {}
+            eng = InferenceEngine(mpath, tpath, tp=1, kv_block_size=block,
+                                  spec_lookup=spec_k)
+            sched = BatchScheduler(eng, n_slots=n_slots)
+            reg = tm.registry()
+            g_total = reg.gauge(tm.KV_BLOCKS_TOTAL)
+            g_used = reg.gauge(tm.KV_BLOCKS_USED)
+            g_shared = reg.gauge(tm.KV_BLOCKS_SHARED)
+            reuse = reg.counter(tm.PREFIX_REUSE_TOKENS)
+            r0 = reuse.total()
+            d0 = reg.counter(tm.SPEC_DRAFT_TOKENS).total()
+            a0 = reg.counter(tm.SPEC_ACCEPTED_TOKENS).total()
 
-        def sample():
-            while not stop_sampling.wait(0.05):
-                total = g_total.value() or 1
-                occ.append(g_used.value() / total)
-                peaks["shared"] = max(peaks["shared"], g_shared.value())
+            occ: list = []
+            peaks = {"shared": 0.0}
+            stop_sampling = threading.Event()
 
-        sampler = threading.Thread(target=sample, daemon=True)
-        sampler.start()
+            def sample():
+                while not stop_sampling.wait(0.05):
+                    total = g_total.value() or 1
+                    occ.append(g_used.value() / total)
+                    peaks["shared"] = max(peaks["shared"],
+                                          g_shared.value())
+
+            sampler = threading.Thread(target=sample, daemon=True)
+            sampler.start()
+
+            t_sub: dict = {}
+            t_toks: dict = {}  # per-request token stamps → real ITLs
+
+            def mk_cb(i):
+                def cb(tok, piece):
+                    t_toks.setdefault(i, []).append(time.perf_counter())
+                return cb
+
+            try:
+                t0 = time.perf_counter()
+                reqs = []
+                for i, ids in enumerate(prompts):
+                    t_sub[i] = time.perf_counter()
+                    reqs.append(sched.submit(ids, max_tok,
+                                             stop_on_eos=False,
+                                             on_token=mk_cb(i)))
+                    time.sleep(stagger_s)
+                for r in reqs:
+                    if not r.done.wait(
+                            timeout=max(5.0, deadline - time.monotonic())):
+                        w["error"] = "deadline inside scenario wave"
+                        break
+                t_end = time.perf_counter()
+            finally:
+                stop_sampling.set()
+                sampler.join(timeout=5)
+                sched.close()
+                eng.close()
+
+            done = [r for r in reqs if r.done.is_set() and r.error is None]
+            w["n_completed"] = len(done)
+            w["n_tokens"] = sum(len(r.tokens) for r in done)
+            errs = [r.error for r in reqs if r.error]
+            if errs:
+                w["request_errors"] = len(errs)
+                w.setdefault("error", errs[0][:200])
+            dt = t_end - t0
+            if dt > 0 and w["n_tokens"]:
+                w["agg_tok_per_s"] = round(w["n_tokens"] / dt, 2)
+            ttfts = sorted(1e3 * (t_toks[i][0] - t_sub[i]) for i in t_toks)
+            w["ttft_ms_p50"] = (round(_pctl(ttfts, 0.5), 1)
+                                if ttfts else None)
+            w["ttft_ms_p95"] = (round(_pctl(ttfts, 0.95), 1)
+                                if ttfts else None)
+            # real inter-token latencies from the callback stamps — the
+            # A/B's headline latency side (speculation exists to shrink
+            # exactly this number)
+            itls = sorted(1e3 * (b - a) for ts in t_toks.values()
+                          for a, b in zip(ts, ts[1:]))
+            w["itl_p50_ms"] = round(_pctl(itls, 0.5), 2) if itls else None
+            # latency attribution (runtime/flightrec): the scheduler-side
+            # TTFT decomposition per completed request — the
+            # continuous-batching throughput number, explained — plus the
+            # decode-phase step/preempt/verify split
+            attrib: dict = {"queue": [], "admission": [], "prefill": [],
+                            "first_decode": []}
+            itl_attrib: dict = {"step": [], "preempt": [], "verify": []}
+            rel_errs = []
+            for i, r in enumerate(reqs):
+                if not (r.done.is_set() and r.error is None):
+                    continue
+                bd = r.ttft_breakdown()  # the one phase formula (flightrec)
+                if bd is None:
+                    continue
+                attrib["queue"].append(bd["queue_ms"])
+                attrib["admission"].append(bd["admission_ms"])
+                attrib["prefill"].append(bd["prefill_ms"])
+                attrib["first_decode"].append(bd["first_decode_ms"])
+                itl_attrib["step"].append(r.ms_decode_steps)
+                itl_attrib["preempt"].append(r.ms_preempt)
+                itl_attrib["verify"].append(r.ms_verify)
+                # reassembly error vs the INDEPENDENTLY measured wall
+                # TTFT — this wave's own perf_counter stamps (submit call
+                # → first on_token callback), a different clock read at
+                # different sites than the scheduler's attribution
+                # stamps, so a broken accounting (a dropped phase, a
+                # double-charge) shows up here
+                if i in t_toks:
+                    wall = 1e3 * (t_toks[i][0] - t_sub[i])
+                    total = (bd["queue_ms"] + bd["admission_ms"]
+                             + bd["prefill_ms"] + bd["first_decode_ms"])
+                    if wall > 0:
+                        rel_errs.append(abs(total - wall) / wall)
+            if attrib["queue"]:
+                w["ttft_attrib_ms"] = {
+                    k: round(sum(v) / len(v), 2) for k, v in attrib.items()}
+                w["itl_attrib_ms"] = {
+                    k: round(sum(v) / len(v), 2)
+                    for k, v in itl_attrib.items()}
+                # phases must reassemble the measured wall TTFT (the
+                # ISSUE-7 acceptance bound is 5%; report the worst one)
+                w["ttft_attrib_max_rel_err"] = (round(max(rel_errs), 4)
+                                                if rel_errs else None)
+            if occ:
+                w["block_occupancy_peak"] = round(max(occ), 4)
+                w["block_occupancy_mean"] = round(sum(occ) / len(occ), 4)
+            w["kv_blocks_total"] = int(g_total.value())
+            w["kv_blocks_shared_peak"] = int(peaks["shared"])
+            w["prefix_reuse_tokens"] = int(reuse.total() - r0)
+            drafted = reg.counter(tm.SPEC_DRAFT_TOKENS).total() - d0
+            accepted = reg.counter(tm.SPEC_ACCEPTED_TOKENS).total() - a0
+            if drafted:
+                w["spec_drafted"] = int(drafted)
+                w["spec_accepted"] = int(accepted)
+                w["spec_accept_rate"] = round(accepted / drafted, 4)
+            return w
 
         out["phase"] = "scenario_run"
-        t_sub: dict = {}
-        t_first: dict = {}
-
-        def mk_cb(i):
-            def cb(tok, piece):
-                if i not in t_first:
-                    t_first[i] = time.perf_counter()
-            return cb
-
-        try:
-            t0 = time.perf_counter()
-            reqs = []
-            for i, ids in enumerate(prompts):
-                t_sub[i] = time.perf_counter()
-                reqs.append(sched.submit(ids, max_tok, stop_on_eos=False,
-                                         on_token=mk_cb(i)))
-                time.sleep(stagger_s)
-            for r in reqs:
-                if not r.done.wait(timeout=max(5.0,
-                                               deadline - time.monotonic())):
-                    out["error"] = "deadline inside scenario wave"
-                    break
-            t_end = time.perf_counter()
-        finally:
-            stop_sampling.set()
-            sampler.join(timeout=5)
-            sched.close()
-            eng.close()
-
-        done = [r for r in reqs if r.done.is_set() and r.error is None]
-        out["n_completed"] = len(done)
-        out["n_tokens"] = sum(len(r.tokens) for r in done)
-        errs = [r.error for r in reqs if r.error]
-        if errs:
-            out["request_errors"] = len(errs)
-            out.setdefault("error", errs[0][:200])
-        dt = t_end - t0
-        if dt > 0 and out["n_tokens"]:
-            out["agg_tok_per_s"] = round(out["n_tokens"] / dt, 2)
-        ttfts = sorted(1e3 * (t_first[i] - t_sub[i]) for i in t_first)
-        out["ttft_ms_p50"] = (round(_pctl(ttfts, 0.5), 1)
-                              if ttfts else None)
-        out["ttft_ms_p95"] = (round(_pctl(ttfts, 0.95), 1)
-                              if ttfts else None)
-        # latency attribution (runtime/flightrec): the scheduler-side TTFT
-        # decomposition per completed request — the continuous-batching
-        # throughput number, explained (where first-token time went:
-        # queue wait, admission bookkeeping, prefill dispatch, first
-        # decode) plus the decode-phase step-vs-preempt split
-        attrib: dict = {"queue": [], "admission": [], "prefill": [],
-                        "first_decode": []}
-        itl_attrib: dict = {"step": [], "preempt": []}
-        rel_errs = []
-        for i, r in enumerate(reqs):
-            if not (r.done.is_set() and r.error is None):
-                continue
-            bd = r.ttft_breakdown()  # the one phase formula (flightrec)
-            if bd is None:
-                continue
-            attrib["queue"].append(bd["queue_ms"])
-            attrib["admission"].append(bd["admission_ms"])
-            attrib["prefill"].append(bd["prefill_ms"])
-            attrib["first_decode"].append(bd["first_decode_ms"])
-            itl_attrib["step"].append(r.ms_decode_steps)
-            itl_attrib["preempt"].append(r.ms_preempt)
-            # reassembly error vs the INDEPENDENTLY measured wall TTFT —
-            # this wave's own perf_counter stamps (submit call → first
-            # on_token callback), a different clock read at different
-            # sites than the scheduler's attribution stamps, so a broken
-            # accounting (a dropped phase, a double-charge) shows up here
-            if i in t_first:
-                wall = 1e3 * (t_first[i] - t_sub[i])
-                total = (bd["queue_ms"] + bd["admission_ms"]
-                         + bd["prefill_ms"] + bd["first_decode_ms"])
-                if wall > 0:
-                    rel_errs.append(abs(total - wall) / wall)
-        if attrib["queue"]:
-            out["ttft_attrib_ms"] = {
-                k: round(sum(v) / len(v), 2) for k, v in attrib.items()}
-            out["itl_attrib_ms"] = {
-                k: round(sum(v) / len(v), 2) for k, v in itl_attrib.items()}
-            # phases must reassemble the measured wall TTFT (the ISSUE-7
-            # acceptance bound is 5%; report the worst request)
-            out["ttft_attrib_max_rel_err"] = (round(max(rel_errs), 4)
-                                              if rel_errs else None)
-        if occ:
-            out["block_occupancy_peak"] = round(max(occ), 4)
-            out["block_occupancy_mean"] = round(sum(occ) / len(occ), 4)
-        out["kv_blocks_total"] = int(g_total.value())
-        out["kv_blocks_shared_peak"] = int(peaks["shared"])
-        out["prefix_reuse_tokens"] = int(reuse.total() - r0)
+        w_off = wave(0)
+        out.update(w_off)
+        # -- spec on/off A/B over the identical wave -----------------------
+        spec_k = _scn_int("DLLAMA_BENCH_SCN_SPEC", 4)
+        out["phase"] = "scenario_spec_on"
+        w_on = wave(spec_k)
+        out["spec_lookup"] = spec_k
+        out["spec_ab"] = {
+            "off": {k: w_off.get(k)
+                    for k in ("agg_tok_per_s", "itl_p50_ms", "ttft_ms_p50",
+                              "n_completed")},
+            "on": {k: w_on.get(k)
+                   for k in ("agg_tok_per_s", "itl_p50_ms", "ttft_ms_p50",
+                             "n_completed", "spec_drafted",
+                             "spec_accepted", "spec_accept_rate")},
+        }
+        if w_on.get("error"):
+            out.setdefault("error", f"spec-on wave: {w_on['error']}"[:200])
+        if w_on.get("agg_tok_per_s"):
+            # the A/B's ranked throughput number: tok/s the spec-on wave
+            # actually delivered (accepted drafts + verify emissions)
+            out["accepted_tok_per_s"] = w_on["agg_tok_per_s"]
+        if w_on.get("spec_accept_rate") is not None:
+            out["spec_accept_rate"] = w_on["spec_accept_rate"]
+        if (w_on.get("itl_p50_ms") is not None
+                and w_off.get("itl_p50_ms") is not None):
+            out["itl_p50_ms_delta"] = round(
+                w_on["itl_p50_ms"] - w_off["itl_p50_ms"], 2)
         out["phase"] = "done"
         return out
     finally:

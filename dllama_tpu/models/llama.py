@@ -661,7 +661,8 @@ def _layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
 def _paged_layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
                       k_pool: jax.Array, v_pool: jax.Array,
                       cos: jax.Array, sin: jax.Array,
-                      positions: jax.Array, tables: jax.Array):
+                      positions: jax.Array, tables: jax.Array,
+                      write_lens: jax.Array | None = None):
     """One transformer block over the PAGED cache (runtime/kvblocks.py).
 
     ``k_pool/v_pool: [n_blocks, n_kv, block_size, hd]`` is this layer's
@@ -688,6 +689,15 @@ def _paged_layer_step(cfg: ModelConfig, x: jax.Array, lp: LayerParams,
     brow = jnp.arange(B, dtype=jnp.int32)[:, None]
     blk = tables[brow, positions // bs]                      # [B, T]
     off = positions % bs
+    if write_lens is not None:
+        # ragged verify (paged_verify_step): lane t of row b is a real
+        # input only while t <= write_lens[b] — lanes past the row's
+        # draft length carry padding whose writes must not consume (or
+        # corrupt) cells the host never allocated blocks for. Redirect
+        # them to the null block; traced, so varying per-slot draft
+        # lengths never retrace.
+        lane = jnp.arange(T, dtype=jnp.int32)[None, :]
+        blk = jnp.where(lane <= write_lens[:, None], blk, 0)
     # scatter the new rows: advanced (blk, off) indices around the head
     # slice address each row's [n_kv, hd] cell; inactive rows carry
     # all-null tables, so their ride-along writes land in the null block
@@ -1116,12 +1126,16 @@ def ragged_verify_step_guarded(params: Params, cfg: ModelConfig,
 
 
 def paged_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
-                  pos_vec: jax.Array, pkv, tables: jax.Array):
+                  pos_vec: jax.Array, pkv, tables: jax.Array,
+                  write_lens: jax.Array | None = None):
     """Full forward over the paged pool: ``tokens [B, T]`` at per-row
     ``pos_vec [B]`` with block ``tables [B, max_blocks]``. Returns float32
     logits ``[B, T, vocab]`` and the updated pool (a
     :class:`~dllama_tpu.runtime.kvblocks.PagedKVCache`). Always ragged —
-    the paged path exists for continuous batching only."""
+    the paged path exists for continuous batching only. ``write_lens``
+    (speculative verify: per-row valid input width minus one, i.e. the
+    row's draft length) masks KV writes for lanes past it to the null
+    block — see :func:`_paged_layer_step`."""
     from ..runtime.kvblocks import PagedKVCache
 
     if _numerics.taps_active():
@@ -1146,7 +1160,7 @@ def paged_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         if cfg.offload:
             lp = jax.device_put(lp, jax.memory.Space.Device)
         x, k_l, v_l = _paged_layer_step(cfg, x, lp, k_l, v_l, cos, sin,
-                                        positions, tables)
+                                        positions, tables, write_lens)
         return x, (k_l, v_l)
 
     unroll = int(os.environ.get("DLLAMA_TPU_SCAN_UNROLL", "1"))
@@ -1178,6 +1192,67 @@ def paged_sampled_step_guarded(params: Params, cfg: ModelConfig,
     last = _poison_logits(logits[:, -1, :], poison)
     return (sampled_token(last, temps, topps, coins),
             _nonfinite_rows(last)), pkv
+
+
+def paged_verify_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                      pos_vec: jax.Array, pkv, tables: jax.Array,
+                      lens: jax.Array, temps: jax.Array, topps: jax.Array,
+                      acoins: jax.Array, fcoins: jax.Array):
+    """The paged speculative verify step — the block-table twin of
+    :func:`ragged_verify_step`, widened to speculative *sampling*.
+
+    One forward over ``tokens [B, K+1]`` (each row: its committed next
+    token followed by its proposer's drafts, padded past the row's
+    ``lens [B]`` draft length) at per-row ``pos_vec``, KV scattered
+    through the block ``tables`` with writes masked past ``lens``
+    (:func:`paged_forward` ``write_lens`` — the host only allocates
+    blocks covering ``pos..pos+lens``). The logits epilogue is
+    :func:`runtime.speculative.spec_decide`: greedy rows accept the
+    longest model-matching draft prefix exactly as the dense path does;
+    sampled rows run rejection-sampling acceptance with the residual
+    resample / ``sampled_token`` bonus, so their emitted distribution is
+    exactly the non-speculative sampling distribution. Returns
+    ``(n_acc [B], out [B, K+1], pkv)``; the caller emits
+    ``out[b, : n_acc[b] + 1]``.
+
+    KV safety is the verify-step argument one level up: every write
+    lands at/above the row's committed ``pos`` in refcount-1 blocks the
+    slot owns (shared prefix blocks are never a write target —
+    ``__debug__``-asserted by the generator), so rejected lanes need no
+    device rollback: the table/pos bookkeeping alone rolls them back,
+    and the next dispatch's writes start exactly where the stale region
+    starts. Jitted once per pool geometry (``K+1``, table width, batch
+    width are static; ``lens``/coins/knobs traced), so varying per-slot
+    draft lengths and admit/retire churn never retrace."""
+    from ..runtime.speculative import spec_decide
+
+    logits, pkv = paged_forward(params, cfg, tokens, pos_vec, pkv, tables,
+                                write_lens=lens)
+    n_acc, out = spec_decide(logits, tokens, lens, temps, topps,
+                             acoins, fcoins)
+    return n_acc, out, pkv
+
+
+def paged_verify_step_guarded(params: Params, cfg: ModelConfig,
+                              tokens: jax.Array, pos_vec: jax.Array,
+                              pkv, tables: jax.Array, lens: jax.Array,
+                              temps: jax.Array, topps: jax.Array,
+                              acoins: jax.Array, fcoins: jax.Array,
+                              poison: jax.Array):
+    """:func:`paged_verify_step` + tripwire over all K+1 verify positions
+    (every one can become an emitted token): ``((n_acc, out, nf), pkv)``
+    with per-row non-finite counts so batched serving fails only the
+    poisoned slot."""
+    from ..parallel.qcollectives import wire_poison_scope
+    from ..runtime.speculative import spec_decide
+
+    with wire_poison_scope(poison):
+        logits, pkv = paged_forward(params, cfg, tokens, pos_vec, pkv,
+                                    tables, write_lens=lens)
+    logits = _poison_logits(logits, poison)
+    n_acc, out = spec_decide(logits, tokens, lens, temps, topps,
+                             acoins, fcoins)
+    return (n_acc, out, _nonfinite_rows(logits)), pkv
 
 
 # ---------------------------------------------------------------------------

@@ -229,8 +229,22 @@ class InferenceEngine:
             from .kvblocks import validate_block_size
 
             validate_block_size(self.cfg.seq_len, self.kv_block_size)
+            from ..models.llama import _OVERLAP_MAX_WIDTH as _DECODE_W
+
+            # speculative decoding is first-class on the paged path
+            # (PagedGenerator runs the paged_verify_step program family);
+            # the REAL remaining constraints: multihost (no paged worker
+            # mirror ops — which also rules out spec×multihost here) and
+            # a verify width past the decode regime — the policy width
+            # the overlapped merges gate at (_OVERLAP_MAX_WIDTH; the
+            # ragged paged-attention kernel itself folds up to MAX_TQ
+            # query rows, so it is NOT the binding constraint) and the
+            # width band the decode-shaped programs are tuned/tested for
             unsupported = [
-                ("--spec-lookup", self.spec_lookup > 0),
+                (f"--spec-lookup > {_DECODE_W - 1} (verify width K+1 "
+                 f"must stay within the decode regime's "
+                 f"{_DECODE_W}-wide dispatches)",
+                 self.spec_lookup + 1 > _DECODE_W),
                 ("--decode-chunk > 1", self.decode_chunk > 1),
                 ("multihost workers", multihost),
                 ("--sp > 1", sp > 1),
@@ -340,7 +354,12 @@ class InferenceEngine:
                 # differ in low ulps, so the engine's "spec output is
                 # bit-identical to plain greedy" invariant would silently
                 # break on near-tie logits
-                (f"--spec-lookup > {_OVERLAP_MAX_WIDTH - 1}",
+                (f"--spec-lookup > {_OVERLAP_MAX_WIDTH - 1} (verify "
+                 f"width K+1 exceeds the overlapped-merge decode-width "
+                 f"gate _OVERLAP_MAX_WIDTH={_OVERLAP_MAX_WIDTH}, "
+                 f"models/llama.py — a wider verify would trace the "
+                 f"monolithic psum and break spec≡greedy bit-identity; "
+                 f"lower --spec-lookup or run --comm-overlap off)",
                  self.spec_lookup + 1 > _OVERLAP_MAX_WIDTH),
             ]
             bad = [name for name, hit in unsupported if hit]
@@ -970,8 +989,10 @@ class InferenceEngine:
         telemetry.tracer().emit(self.trace_rid, "verify", trace_t0,
                                 telemetry.now_ns(), n_tokens=n_acc + 1)
         self._m_step_ms.record((time.perf_counter() - t0) * 1000.0)
-        self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(len(drafts))
-        self._tm.counter(telemetry.SPEC_ACCEPTED_TOKENS).inc(n_acc)
+        self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(
+            len(drafts), generator="engine")
+        self._tm.counter(telemetry.SPEC_ACCEPTED_TOKENS).inc(
+            n_acc, generator="engine")
         return [int(t) for t in preds[0, : n_acc + 1]]
 
     def _run_verify(self, tokens_2d, start_pos: int):

@@ -58,6 +58,12 @@ have at least one call site:
   BlockPool.alloc``): a ``raise`` here simulates block-pool exhaustion,
   which must degrade to queueing (admission) or an explicit per-request
   failure (mid-decode growth), never a crash.
+* ``draft`` — the speculative proposer's draft call
+  (``runtime/serving.py _GeneratorCore._safe_draft``, fired per slot
+  per verify tick): a ``raise`` simulates a poisoned/crashing proposer,
+  which must DEGRADE that slot to plain decode for the step
+  (``dllama_spec_degraded_total``; the request completes, bystanders
+  untouched), never fail the request or the batch.
 * ``proxy`` — the fleet router's upstream dispatch point
   (``serve/router.py`` ``_open_upstream``, fired per upstream request
   before any bytes move): a ``conn_reset``/``broken_pipe``/``raise``

@@ -8,9 +8,10 @@ prefill/decode token-budget tuning pass (or a postmortem of a wedged
 batch) needs. This module is that record:
 
 * **Tick ring** — one structured record per work-carrying scheduler tick
-  (batch composition, admit/retire/requeue/preempt decisions with
-  machine-readable reasons, the prefill-vs-decode token split, dispatch
-  wall time, block-pool occupancy, queue depth). Bounded
+  (batch composition, admit/retire/requeue/preempt/spec_degraded
+  decisions with machine-readable reasons, the prefill-vs-decode token
+  split, speculative draft/accept counts, dispatch wall time, block-pool
+  occupancy, queue depth). Bounded
   (:data:`RING_TICKS`), host-only, always on: recording is one lock +
   dict append per event against multi-ms ticks, touches no jitted
   program, and is therefore trace-invisible (zero post-steady compiles —
@@ -143,6 +144,21 @@ class FlightRecorder:
                 return
             self._cur["prefill_ms"] += ms
             self._cur["prefill_tokens"] += n_tokens
+
+    def note_spec(self, drafted: int, accepted: int) -> None:
+        """One speculative verify dispatch's draft/accept counts inside
+        the current tick — the tick record's view of what the verify
+        width bought (accept rate per tick, next to the dispatch wall it
+        cost). Zero-draft ticks are recorded too: a run of
+        ``spec_draft_tokens: 0`` ticks under spec serving is the
+        degraded-proposer signature a postmortem should show."""
+        with self._lock:
+            if self._cur is None:
+                return
+            self._cur["spec_draft_tokens"] = (
+                self._cur.get("spec_draft_tokens", 0) + drafted)
+            self._cur["spec_accept_tokens"] = (
+                self._cur.get("spec_accept_tokens", 0) + accepted)
 
     def end_tick(self, blocks: dict | None = None, **extra) -> None:
         """Close the tick. Idle ticks (no decisions, no dispatch, no
@@ -326,6 +342,7 @@ def to_chrome_trace(data: dict) -> dict:
         args = {k: t[k] for k in ("queue_depth", "n_admissions", "decisions",
                                   "dispatch_ms", "prefill_ms",
                                   "prefill_tokens", "decode_tokens",
+                                  "spec_draft_tokens", "spec_accept_tokens",
                                   "n_active", "slots", "blocks",
                                   "prefill_budget") if k in t}
         out.append({"ph": "X", "pid": 1, "tid": 0, "ts": ts, "dur": dur,

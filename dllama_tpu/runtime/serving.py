@@ -192,6 +192,13 @@ class Request:
     ms_preempt: float = 0.0       # others' interleaved prefill wall while
     #                               this slot was decode-armed (tick-budget
     #                               preemption share of inter-token stalls)
+    ms_verify: float = 0.0        # speculative verify dispatch wall (the
+    #                               `verify` ITL attribution cause)
+    # speculative accounting (paged/dense spec serving): drafted tokens
+    # offered to verify dispatches and the accepted count — the per-request
+    # accept rate surfaced in the opt-in `timing` response block
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     def __post_init__(self):
         self.rng_state = self.seed & _MASK64
@@ -302,6 +309,11 @@ class _GeneratorCore:
         if req.t_first_token and len(req.tokens) > 1:
             self._m_itl_attrib.record(req.ms_decode_steps, cause="step")
             self._m_itl_attrib.record(req.ms_preempt, cause="preempt")
+            if req.ms_verify:
+                # speculative serving: verify dispatch walls are their own
+                # cause — a spec-on ITL regression must name the verify
+                # widening, not hide inside `step`
+                self._m_itl_attrib.record(req.ms_verify, cause="verify")
         req.done.set()
 
     def _arm_decode(self, adm: "_Admission") -> None:  # dlint: owner=loop-thread
@@ -418,6 +430,29 @@ class _GeneratorCore:
             req = self.slots[i]
             if req is not None:
                 req.ms_decode_steps += ms
+
+    def _attrib_verify(self, active: list[int], ms: float) -> None:
+        """Charge one speculative verify dispatch's wall to every active
+        request under the ``verify`` ITL cause (published at retire)."""
+        for i in active:
+            req = self.slots[i]
+            if req is not None:
+                req.ms_verify += ms
+
+    def _safe_draft(self, i: int) -> list[int] | None:  # dlint: owner=loop-thread
+        """Slot ``i``'s proposer draft, through the ``draft`` failpoint:
+        a poisoned/raising proposer DEGRADES the slot to plain decode for
+        this step (returns None; ``dllama_spec_degraded_total``) instead
+        of failing the request — the request completes, bystanders are
+        untouched, and the proposer stays armed for later steps."""
+        try:
+            failpoints.fire("draft")
+            return self._proposers[i].draft()
+        except Exception as e:  # noqa: BLE001 — degrade, never fail the request
+            self._tm.counter(telemetry.SPEC_DEGRADED).inc()
+            self.flight.note("spec_degraded", self.slots[i].rid,
+                             reason=type(e).__name__, slot=i)
+            return None
 
     def _prefill_chunk(self, adm: "_Admission", padded, n_valid: int) -> None:
         """One timed prefill chunk dispatch for ``adm``, with attribution:
@@ -942,10 +977,22 @@ class BatchedGenerator(_GeneratorCore):
         """One ragged speculative verify dispatch (models.ragged_verify_step):
         greedy rows emit their accepted run, sampled rows exactly one token."""
         toks = np.zeros((self.n_slots, self.spec + 1), dtype=np.int32)
+        degraded: set[int] = set()
         for i in active:
             toks[i, 0] = self.next_token[i]
             if self.slots[i].temperature <= 0.0:
-                toks[i, 1:] = self._proposers[i].draft()
+                d = self._safe_draft(i)
+                if d is None:
+                    # degraded: the program's K+1 width is static, so the
+                    # row still carries filler (the committed token —
+                    # acceptance-neutral for greedy verify), but the slot
+                    # emits only its verified token and counts NO drafts
+                    # — plain decode for this step, same as the paged
+                    # path's lens=0
+                    degraded.add(i)
+                    toks[i, 1:] = int(toks[i, 0])
+                else:
+                    toks[i, 1:] = d
         if self._root_bcast:
             self._bcast(CTRL_SRV_VERIFY, self.spec, np.concatenate([
                 toks.reshape(-1), self.pos.astype(np.int32),
@@ -954,19 +1001,33 @@ class BatchedGenerator(_GeneratorCore):
         n_acc, preds, nf = self._exec_verify(toks, self.pos, temps, topps,
                                              coins)
         ms = (time.perf_counter() - t0) * 1000.0
-        self._attrib_decode(active, ms)
-        n_greedy = sum(1 for i in active if self.slots[i].temperature <= 0.0)
-        self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(n_greedy * self.spec)
+        self._attrib_verify(active, ms)
+        drafted = sum(self.spec for i in active
+                      if self.slots[i].temperature <= 0.0
+                      and i not in degraded)
+        if drafted:
+            self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(
+                drafted, generator="dense")
         poisoned = self._handle_nonfinite(active, nf)
         emitted = 0
+        accepted = 0
         for i in active:
             if i in poisoned:
                 continue
-            acc = int(n_acc[i])
-            if self.slots[i].temperature <= 0.0 and acc:
-                self._tm.counter(telemetry.SPEC_ACCEPTED_TOKENS).inc(acc)
+            req = self.slots[i]
+            # a degraded slot's filler draft must not count as drafted
+            # OR accepted — it emits exactly its verified token
+            acc = 0 if i in degraded else int(n_acc[i])
+            if req.temperature <= 0.0 and i not in degraded:
+                req.spec_drafted += self.spec
+                req.spec_accepted += acc
+                accepted += acc
+                if acc:
+                    self._tm.counter(telemetry.SPEC_ACCEPTED_TOKENS).inc(
+                        acc, generator="dense")
             run = [int(t) for t in preds[i, : acc + 1]]
             emitted += self._emit_run(i, run)
+        self.flight.note_spec(drafted, accepted)
         self._record_step(len(active), ms, emitted)
         return emitted
 
@@ -991,12 +1052,25 @@ class PagedGenerator(_GeneratorCore):
       slots' leftover columns.
     * Prefill reuses the ENGINE's own prefill program over the sequence's
       gathered dense column (take → chunked forward → scatter back), so
-      the paged path adds exactly one full-model program — the paged
-      decode step, jitted once per pool geometry.
+      the paged path adds the paged decode step plus — under
+      ``--spec-lookup`` — the paged verify step, each jitted once per
+      pool geometry.
+    * Speculative decoding is first-class (``--spec-lookup K``): every
+      slot owns an :class:`~dllama_tpu.runtime.speculative.NgramProposer`
+      and each tick runs ONE ragged verify dispatch
+      (models.llama.paged_verify_step_guarded) with per-slot draft
+      lengths — greedy rows emit their exact accepted run, sampled rows
+      run rejection-sampling acceptance (distribution-preserving,
+      runtime/speculative.spec_decide). Block growth covers the verify
+      width ``pos..pos+lens`` up front and admission prices the worst
+      case ``+spec`` rows, so organic mid-verify exhaustion stays
+      impossible; rejected lanes' writes sit at/above ``pos`` in
+      refcount-1 blocks, so rollback is pure pos/table bookkeeping.
 
-    Unsupported combinations (validated at engine construction): spec
-    lookup, fused decode chunks, multihost, sp/pp/dp meshes, forced
-    Pallas attention (the paged gather runs the XLA oracle).
+    Unsupported combinations (validated at engine construction): fused
+    decode chunks, multihost, sp/pp/dp meshes, forced Pallas attention
+    (the paged gather runs the XLA oracle), spec lookup past the decode
+    regime's verify width.
     """
 
     def __init__(self, engine: "InferenceEngine", n_slots: int = 4):
@@ -1064,6 +1138,19 @@ class PagedGenerator(_GeneratorCore):
         self._step = plan_scoped_jit(paged_sampled_step_guarded, scope=_sc,
                                      program="paged_sampled_step",
                                      static_argnums=1, donate_argnums=(4,))
+        # speculative serving (--spec-lookup composed with --kv-block-size):
+        # ONE ragged paged verify program per pool geometry — K+1 width,
+        # table width, and batch width are static; per-slot draft lengths,
+        # coins, and knobs are traced, so admit/retire churn and varying
+        # lens never retrace (ledger-asserted in tests)
+        self.spec = max(0, getattr(engine, "spec_lookup", 0))
+        if self.spec:
+            from ..models.llama import paged_verify_step_guarded
+
+            self._verify = plan_scoped_jit(
+                paged_verify_step_guarded, scope=_sc,
+                program="paged_verify_step", static_argnums=1,
+                donate_argnums=(4,))
         # prefill rides the ENGINE's jitted forward over the gathered
         # column (same program its solo path compiles — shared cache)
         self._prefill_fwd = engine._step
@@ -1132,8 +1219,14 @@ class PagedGenerator(_GeneratorCore):
     def _worst_case_blocks(self, prompt_len: int, max_tokens: int) -> int:
         """Admission price in blocks: every position the request could
         ever write (prompt prefill + decode growth, capped at seq_len) —
-        conservative (sharing only reduces the real need)."""
-        rows = min(prompt_len - 1 + max_tokens, self.cfg.seq_len)
+        conservative (sharing only reduces the real need). Under
+        speculative serving each decode boundary writes up to
+        ``pos + lens`` (``lens <= spec``), so the frontier can run
+        ``spec`` rows past the committed need — the ``+spec`` keeps
+        organic mid-VERIFY exhaustion impossible, not just mid-decode
+        (lens is clamped to ``seq_len - 1 - pos``, so the cap holds)."""
+        rows = min(prompt_len - 1 + max_tokens + self.spec,
+                   self.cfg.seq_len)
         return max(1, -(-rows // self.block_size))
 
     def can_admit(self, req: Request) -> bool:
@@ -1288,6 +1381,14 @@ class PagedGenerator(_GeneratorCore):
         # paired with a stale position
         self.tables[slot, :len(bids)] = bids
         adm.pos = len(rest)
+        if self.spec:
+            from .speculative import NgramProposer
+
+            # EVERY slot drafts — sampled rows cash the check through
+            # rejection sampling, not just greedy ones (the dense pool's
+            # greedy-only restriction does not apply here)
+            self._proposers[slot] = NgramProposer(self.spec)
+            self._proposers[slot].extend(adm.req.prompt_ids)
         self._arm_decode(adm)
         return True
 
@@ -1340,34 +1441,33 @@ class PagedGenerator(_GeneratorCore):
 
     # -- decode -------------------------------------------------------------
 
-    def _ensure_block(self, i: int) -> None:  # dlint: owner=loop-thread
-        """Lazy block growth: guarantee slot ``i``'s write position has a
-        physical block before the dispatch (the continuous-batching
-        memory win — a sequence only ever holds the blocks its live
-        context spans)."""
-        idx = int(self.pos[i]) // self.block_size
-        if self.tables[i, idx] == self.pool.NULL:
-            bid = self.pool.alloc()
-            self._seq_bids[i].append(bid)
-            self._reserve[i] = max(0, self._reserve[i] - 1)
-            self.tables[i, idx] = bid
+    def _ensure_blocks(self, i: int, last_pos: int) -> None:  # dlint: owner=loop-thread
+        """Lazy block growth: guarantee slot ``i`` has physical blocks for
+        every write position up to ``last_pos`` (inclusive) before the
+        dispatch — one block at ``pos`` for plain decode, the blocks
+        covering ``pos..pos+lens`` for a speculative verify (the
+        continuous-batching memory win holds either way: a sequence only
+        ever holds the blocks its live context — plus the verify
+        frontier — spans)."""
+        for idx in range(int(self.pos[i]) // self.block_size,
+                         last_pos // self.block_size + 1):
+            if self.tables[i, idx] == self.pool.NULL:
+                bid = self.pool.alloc()
+                self._seq_bids[i].append(bid)
+                self._reserve[i] = max(0, self._reserve[i] - 1)
+                self.tables[i, idx] = bid
 
-    def step(self) -> int:  # dlint: owner=loop-thread
-        """One paged ragged decode step for every active slot. Inactive
-        slots ride along with all-null tables (their writes land in the
-        null block) — static shapes, one compiled program regardless of
-        occupancy or block-table contents."""
-        active = self._sweep_cancelled()
-        if not active:
-            return 0
+    def _grow_or_fail(self, active: list[int], grow: list[int]) -> None:  # dlint: owner=loop-thread
+        """Lazy growth for one dispatch: ensure every active slot's write
+        range ``pos..pos+grow[i]`` has blocks; a slot whose growth finds
+        no block (injected exhaustion — admission reservations make the
+        organic case impossible) fails THAT request explicitly
+        (503-shaped), keeps the rest of the batch, and leaves a black-box
+        postmortem naming the victim and the tick decisions leading in."""
         for i in list(active):
             try:
-                self._ensure_block(i)
+                self._ensure_blocks(i, int(self.pos[i]) + int(grow[i]))
             except BlockPoolExhausted as e:
-                # mid-decode growth found no block: fail THIS request
-                # explicitly (503-shaped), keep the rest of the batch —
-                # and leave a black-box postmortem naming the victim and
-                # the tick decisions leading in
                 telemetry.registry().counter(
                     telemetry.KV_BLOCK_EXHAUSTION).inc()
                 req = self.slots[i]
@@ -1377,13 +1477,34 @@ class PagedGenerator(_GeneratorCore):
                 active.remove(i)
                 self.flight.dump("kv_block_exhaustion", victims=[req.rid],
                                  info={"error": str(e), "slot": i})
+
+    def _assert_writable(self, active: list[int], grow: list[int]) -> None:
+        if __debug__:
+            # copy-on-write safety: a write target is never a shared
+            # block — over the WHOLE verify width under speculation
+            for i in active:
+                for p in range(int(self.pos[i]),
+                               int(self.pos[i]) + int(grow[i]) + 1):
+                    bid = int(self.tables[i, p // self.block_size])
+                    assert self.pool.refcount(bid) == 1, (i, p, bid)
+
+    def step(self) -> int:  # dlint: owner=loop-thread
+        """One paged ragged decode step for every active slot. Inactive
+        slots ride along with all-null tables (their writes land in the
+        null block) — static shapes, one compiled program regardless of
+        occupancy or block-table contents. Under ``--spec-lookup`` the
+        dispatch is the ragged paged VERIFY step instead
+        (:meth:`_spec_step`)."""
+        active = self._sweep_cancelled()
         if not active:
             return 0
-        if __debug__:
-            # copy-on-write safety: a write target is never a shared block
-            for i in active:
-                bid = int(self.tables[i, int(self.pos[i]) // self.block_size])
-                assert self.pool.refcount(bid) == 1, (i, bid)
+        if self.spec:
+            return self._spec_step(active)
+        zeros = [0] * self.n_slots
+        self._grow_or_fail(active, zeros)
+        if not active:
+            return 0
+        self._assert_writable(active, zeros)
         temps, topps, coins = self._sampling_rows(active)
         t0 = time.perf_counter()
         with self.eng.watchdog.guard("batch_step"):
@@ -1405,6 +1526,104 @@ class PagedGenerator(_GeneratorCore):
             if i in poisoned:
                 continue
             emitted += self._emit_run(i, [int(nxt[i])])
+        self._record_step(len(active), ms, emitted)
+        self._update_block_gauges()
+        return emitted
+
+    def _spec_step(self, active: list[int]) -> int:  # dlint: owner=loop-thread
+        """One ragged paged speculative verify dispatch
+        (models.llama.paged_verify_step_guarded) over the whole pool.
+
+        Per-slot draft lengths are RAGGED: each row's ``lens[i]`` is its
+        proposer's draft clamped to the context tail
+        (``seq_len - 1 - pos``) and the request's remaining token budget,
+        with 0 for degraded proposers (``draft`` failpoint) — so near-cap
+        and near-done slots keep decoding at width 1 instead of retiring
+        early, and a varying-lens batch never retraces (lens is traced).
+        Greedy rows emit their exact accepted run; sampled rows emit the
+        rejection-sampled run, committing exactly the consumed coins
+        (final coin first, then one accept coin per test —
+        ``speculative.spec_coins_consumed``) from a COPY of their RNG
+        state, so every request's stream stays independent of its
+        batch-mates."""
+        from .speculative import spec_coins_consumed
+
+        spec = self.spec
+        B = self.n_slots
+        toks = np.zeros((B, spec + 1), dtype=np.int32)
+        lens = np.zeros(B, dtype=np.int32)
+        temps = np.zeros(B, dtype=np.float32)
+        topps = np.zeros(B, dtype=np.float32)
+        acoins = np.zeros((B, spec), dtype=np.float32)
+        fcoins = np.zeros(B, dtype=np.float32)
+        drafted = 0
+        for i in active:
+            req = self.slots[i]
+            toks[i, 0] = self.next_token[i]
+            temps[i] = req.temperature
+            topps[i] = req.topp
+            cap = min(spec, self.cfg.seq_len - 1 - int(self.pos[i]),
+                      max(0, req.max_tokens - len(req.tokens) - 1))
+            if cap > 0:
+                d = self._safe_draft(i)
+                if d is None:
+                    cap = 0  # degraded: plain decode for this step
+                else:
+                    toks[i, 1:cap + 1] = d[:cap]
+            lens[i] = cap
+            drafted += cap
+            if req.temperature > 0.0:
+                # pre-draw from a COPY (committed post-dispatch by the
+                # consumed count): FINAL coin first so a zero-length
+                # draft consumes exactly the one coin plain decode would
+                st = req.rng_state
+                fcoins[i], st = xorshift_random_f32(st)
+                for j in range(cap):
+                    acoins[i, j], st = xorshift_random_f32(st)
+        self._grow_or_fail(active, lens)
+        if not active:
+            return 0
+        self._assert_writable(active, lens)
+        t0 = time.perf_counter()
+        with self.eng.watchdog.guard("batch_verify"):
+            failpoints.fire("step_hang")
+            with self._plan_ctx():
+                (n_acc, out, nf), self.pkv = self._verify(
+                    self.eng.params, self.cfg, jnp.asarray(toks),
+                    jnp.asarray(self.pos.astype(np.int32)), self.pkv,
+                    jnp.asarray(self.tables), jnp.asarray(lens),
+                    jnp.asarray(temps), jnp.asarray(topps),
+                    jnp.asarray(acoins), jnp.asarray(fcoins),
+                    self._poison())
+            n_acc = np.asarray(n_acc)
+            out = np.asarray(out)
+            nf = np.asarray(nf)
+        ms = (time.perf_counter() - t0) * 1000.0
+        self._attrib_verify(active, ms)
+        if drafted:
+            self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(
+                drafted, generator="paged")
+        poisoned = self._handle_nonfinite(active, nf)
+        emitted = 0
+        accepted = 0
+        for i in active:
+            if i in poisoned:
+                continue
+            req = self.slots[i]
+            acc = int(n_acc[i])
+            accepted += acc
+            req.spec_drafted += int(lens[i])
+            req.spec_accepted += acc
+            if req.temperature > 0.0:
+                st = req.rng_state
+                for _ in range(spec_coins_consumed(acc, int(lens[i]))):
+                    _, st = xorshift_random_f32(st)
+                req.rng_state = st
+            emitted += self._emit_run(i, [int(t) for t in out[i, :acc + 1]])
+        if accepted:
+            self._tm.counter(telemetry.SPEC_ACCEPTED_TOKENS).inc(
+                accepted, generator="paged")
+        self.flight.note_spec(drafted, accepted)
         self._record_step(len(active), ms, emitted)
         self._update_block_gauges()
         return emitted

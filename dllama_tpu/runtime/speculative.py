@@ -1,4 +1,4 @@
-"""Prompt-lookup speculative drafting for greedy decode.
+"""Prompt-lookup speculative drafting — greedy verify and rejection sampling.
 
 Drafts come from the token history itself — the K tokens that followed the
 most recent *earlier* occurrence of the current trailing n-gram (trigram
@@ -14,12 +14,212 @@ documents). Identity is asserted token-for-token on the CPU mesh
 (test_speculative.py) and on real hardware by the tpu-tier transcript test
 (test_tpu_hw.py::test_spec_transcript_identity_on_hw).
 
+Sampled traffic (temperature > 0) cashes the same check through
+**speculative rejection sampling** (:func:`spec_decide`, the logits
+epilogue of the paged verify program family in models/llama.py): the
+prompt-lookup draft is a deterministic proposal — a point mass on the
+drafted token — so the standard speculative-sampling acceptance rule
+collapses to *accept draft token d with probability p_target(d); on the
+first rejection resample from the residual distribution p_target with d
+zeroed, renormalized*. The emitted-token distribution is exactly the
+target sampling distribution at every position (the point-mass case of
+the speculative-sampling theorem; asserted by a TV-distance bound in
+tests/test_speculative.py), where the target distribution is literally
+the one :func:`dllama_tpu.ops.sampling.sampled_token` samples — the
+bonus token at the all-accepted position runs that very function, so a
+zero-length draft degrades to the plain sampled decode step bit-exactly.
+
 The reference has no speculative path (one token per step, dllama.cpp:88-99);
 this is TPU-economics-driven: decode is HBM-bound, so tokens-per-weight-read
 is the lever, same reasoning as the fused decode chunk.
 """
 
 from __future__ import annotations
+
+
+def target_sampling_probs(logits, temps, topps):
+    """The probability vector of :func:`ops.sampling.sampled_token`'s
+    distribution, per row: ``logits [N, V]`` → ``[N, V]`` f32 probs.
+
+    Mirrors the reference quirks exactly (temperature softmax, the
+    ``(1-topp)/(V-1)`` cutoff pre-filter, descending-sort nucleus
+    truncation at the first ``csum > topp``, renormalization by the
+    truncated cumulative mass); ``topp`` outside (0, 1) keeps the plain
+    softmax (multinomial). ``temp <= 0`` rows return a one-hot argmax.
+
+    Traced (jit-safe). Cost discipline follows ``sampled_token``'s
+    ``TOPP_WINDOW`` fast path: the nucleus of any practical top-p draw
+    fits a 256-wide ``lax.top_k`` window, so large vocabularies pay one
+    windowed top-k + a 256-element scatter per row instead of the
+    full-[V] stable argsort (the ~6 ms/step cost on a 128k vocab that
+    motivated the window); a batch with any row whose nucleus could
+    overflow the window falls back to the exact full sort via ONE
+    batch-level cond, same rule as the sampler. N here is B·K verify
+    lanes per dispatch — the verify amortizes the cost over the tokens
+    it advances, but the window keeps the constant factor at the decode
+    step's own class. (Greedy lanes still trace the nucleus math —
+    knobs are traced so one program serves a mixed batch; their result
+    is masked out, the same dead-lane trade every ragged program makes.)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.sampling import TOPP_WINDOW
+
+    logits = logits.astype(jnp.float32)
+    N, V = logits.shape
+    temp = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(temps)), (N,))
+    topp = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(topps)), (N,))
+    safe_t = jnp.where(temp > 0.0, temp, 1.0)
+    probs = jax.nn.softmax(logits / safe_t[:, None], axis=-1)
+    topp_row = (topp > 0.0) & (topp < 1.0) & (temp > 0.0)
+
+    cutoff = ((1.0 - topp) / (V - 1))[:, None]
+    masked = jnp.where(probs >= cutoff, probs, 0.0)
+
+    def trunc_from_sorted(ps, idxs, tp, n_kept, width):
+        """The reference truncation over an already-descending prefix
+        ``ps`` (full sort: the whole row; windowed: the top-K), scattered
+        back to vocab order as a probability vector."""
+        csum = jnp.cumsum(ps)
+        over = csum > tp
+        last = jnp.where(jnp.any(over), jnp.argmax(over),
+                         jnp.clip(n_kept - 1, 0, width - 1)
+                         ).astype(jnp.int32)
+        kept = jnp.where(jnp.arange(width, dtype=jnp.int32) <= last,
+                         ps, 0.0)
+        trunc = kept / jnp.maximum(csum[last], 1e-30)
+        return jnp.zeros(V, jnp.float32).at[idxs].set(trunc)
+
+    n_kept = jnp.count_nonzero(masked, axis=-1).astype(jnp.int32)
+
+    def full():
+        order = jnp.argsort(-masked, axis=-1, stable=True)
+        ps = jnp.take_along_axis(masked, order, axis=-1)
+        return jax.vmap(trunc_from_sorted,
+                        in_axes=(0, 0, 0, 0, None))(ps, order, topp,
+                                                    n_kept, V)
+
+    if V > TOPP_WINDOW:
+        K = TOPP_WINDOW
+        vals, idxs = jax.lax.top_k(masked, K)  # ties: lower index first
+
+        def windowed():
+            return jax.vmap(trunc_from_sorted,
+                            in_axes=(0, 0, 0, 0, None))(
+                vals, idxs, topp, jnp.minimum(n_kept, K), K)
+
+        # the window covers a row's nucleus iff it exhausts the kept set
+        # or its cumulative mass already crosses topp (sampled_token's
+        # rule); one batch-level cond — a per-row cond would lower to
+        # select under vmap and run the full sort anyway
+        window_ok = ((jnp.cumsum(vals, axis=-1)[:, -1] > topp)
+                     | (n_kept <= K))
+        nucleus = jax.lax.cond(jnp.all(window_ok | ~topp_row),
+                               windowed, full)
+    else:
+        nucleus = full()
+
+    out = jnp.where(topp_row[:, None], nucleus, probs)
+    greedy = jax.nn.one_hot(jnp.argmax(logits, axis=-1), V,
+                            dtype=jnp.float32)
+    return jnp.where((temp > 0.0)[:, None], out, greedy)
+
+
+def spec_decide(logits, tokens, lens, temps, topps, acoins, fcoins):
+    """The verify program's logits epilogue — greedy exact-match AND
+    speculative rejection sampling over one ragged batch.
+
+    ``logits [B, K+1, V]`` from the verify forward over ``tokens
+    [B, K+1]`` (committed token + K drafts, padded past each row's
+    ``lens [B]`` draft length); ``temps/topps [B]`` per-row sampling
+    knobs; ``acoins [B, K]`` per-draft accept coins and ``fcoins [B]``
+    the final coin — the host draws the FINAL coin first, then the
+    accept coins, and commits ``tests + 1`` draws (``tests = n_acc`` on
+    full acceptance else ``n_acc + 1``), so the emitted tokens depend on
+    exactly the committed prefix of the request's own coin stream
+    (untested accept coins influenced nothing and are safely re-drawn).
+
+    Returns ``(n_acc [B], out [B, K+1])``; the caller emits
+    ``out[b, : n_acc[b] + 1]``:
+
+    * greedy rows (``temp <= 0``): ``n_acc`` = longest draft prefix
+      matching the model's own argmax (capped at ``lens``), ``out`` =
+      the argmax predictions — token-identical to sequential greedy.
+    * sampled rows: draft token ``i`` accepted iff ``acoins[:, i] <
+      p_target(draft)`` (point-mass proposal ⇒ accept prob =
+      ``min(1, p/1)``); ``out[:, :n_acc]`` = the accepted drafts, and
+      position ``n_acc`` carries the residual resample (first rejection:
+      ``mult_sample`` over ``p_target`` with the rejected token zeroed,
+      renormalized) or — on full acceptance — the bonus token from
+      :func:`ops.sampling.sampled_token` on that position's logits with
+      the same final coin, so ``lens == 0`` reproduces the plain sampled
+      decode step bit-exactly.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.sampling import mult_sample, sampled_token
+
+    B, W, V = logits.shape
+    K = W - 1
+    lens = jnp.asarray(lens, jnp.int32)
+    temps = jnp.asarray(temps, jnp.float32)
+    lane = jnp.arange(K, dtype=jnp.int32)[None, :]
+
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, K+1]
+    ok = ((tokens[:, 1:] == preds[:, :-1]) & (lane < lens[:, None]))
+    n_acc_g = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=-1), axis=-1)
+
+    # target probs at the K draft positions (position K never needs them:
+    # it is only ever the bonus position, sampled by sampled_token below)
+    p_draft_rows = target_sampling_probs(
+        logits[:, :K].reshape(B * K, V),
+        jnp.repeat(temps, K), jnp.repeat(jnp.asarray(topps, jnp.float32), K)
+    ).reshape(B, K, V)
+    p_d = jnp.take_along_axis(p_draft_rows, tokens[:, 1:, None],
+                              axis=2)[..., 0]                  # [B, K]
+    acc = (jnp.asarray(acoins, jnp.float32) < p_d) & (lane < lens[:, None])
+    n_acc_s = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=-1), axis=-1)
+
+    rejected = n_acc_s < lens
+    j = n_acc_s                                                # [B]
+    # residual resample at the rejection position (j <= K-1 when rejected)
+    j_draft = jnp.minimum(j, K - 1) if K else jnp.zeros_like(j)
+    pj = jnp.take_along_axis(p_draft_rows, j_draft[:, None, None],
+                             axis=1)[:, 0] if K else jnp.zeros((B, V))
+    d_j = (jnp.take_along_axis(tokens[:, 1:], j_draft[:, None], axis=1)[:, 0]
+           if K else jnp.zeros((B,), jnp.int32))
+    resid = jnp.where(jnp.arange(V, dtype=jnp.int32)[None, :] == d_j[:, None],
+                      0.0, pj)
+    resid = resid / jnp.maximum(jnp.sum(resid, axis=-1, keepdims=True), 1e-30)
+    fcoins = jnp.asarray(fcoins, jnp.float32)
+    resample = jax.vmap(mult_sample)(resid, fcoins)
+    # bonus on full acceptance: THE plain sampled-step function on the
+    # accepted position's logits with the same final coin (lens == 0 ⇒
+    # bit-identical to the non-speculative sampled decode step)
+    logits_j = jnp.take_along_axis(logits, j[:, None, None], axis=1)[:, 0]
+    bonus = sampled_token(logits_j, temps, topps, fcoins)
+    final = jnp.where(rejected, resample, bonus)
+
+    drafts_pad = jnp.concatenate(
+        [tokens[:, 1:], tokens[:, -1:]], axis=1)               # [B, K+1]
+    out_s = jnp.where(jnp.arange(W, dtype=jnp.int32)[None, :] == j[:, None],
+                      final[:, None], drafts_pad)
+    greedy_row = temps <= 0.0
+    n_acc = jnp.where(greedy_row, n_acc_g, n_acc_s)
+    out = jnp.where(greedy_row[:, None], preds, out_s)
+    return n_acc, out
+
+
+def spec_coins_consumed(n_acc: int, draft_len: int) -> int:
+    """Host-side coin-stream commit rule for one sampled row of a verify
+    dispatch: the final coin (drawn first) plus one accept coin per test
+    performed — ``n_acc`` tests on full acceptance, ``n_acc + 1`` when a
+    rejection ended the run. Shared by the generator's RNG commit and the
+    tests so the discipline can never drift."""
+    tests = n_acc if n_acc >= draft_len else n_acc + 1
+    return tests + 1
 
 
 class NgramProposer:

@@ -45,6 +45,7 @@ DECODE_STEP_MS = "dllama_decode_step_ms"
 DECODE_TOKENS = "dllama_decode_tokens_total"
 SPEC_DRAFT_TOKENS = "dllama_spec_draft_tokens_total"
 SPEC_ACCEPTED_TOKENS = "dllama_spec_accepted_tokens_total"
+SPEC_DEGRADED = "dllama_spec_degraded_total"
 KV_OCCUPANCY = "dllama_kv_occupancy"
 HBM_NEED_BYTES = "dllama_hbm_need_bytes"
 HBM_LIMIT_BYTES = "dllama_hbm_limit_bytes"
@@ -166,9 +167,14 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
     _spec(DECODE_TOKENS, "counter",
           "Tokens emitted by single-sequence decode"),
     _spec(SPEC_DRAFT_TOKENS, "counter",
-          "Speculative draft tokens submitted to verify dispatches"),
+          "Speculative draft tokens submitted to verify dispatches "
+          "(label generator = engine | dense | paged)"),
     _spec(SPEC_ACCEPTED_TOKENS, "counter",
-          "Speculative draft tokens accepted (rate = accepted / draft)"),
+          "Speculative draft tokens accepted (rate = accepted / draft; "
+          "label generator = engine | dense | paged)"),
+    _spec(SPEC_DEGRADED, "counter",
+          "Speculative steps degraded to plain decode because a "
+          "proposer raised (the `draft` failpoint drives it)"),
     _spec(KV_OCCUPANCY, "gauge",
           "KV cache rows holding live context / total rows (pooled over "
           "slots in batched serving; retired slots' rows are reclaimable "
@@ -749,6 +755,12 @@ def stats_line(reg: Registry | None = None, *,
         parts.append(f"shared={int(reg.gauge(KV_BLOCKS_SHARED).value())}")
     if window_tokens is not None and window_s:
         parts.append(f"tok/s={window_tokens / window_s:.1f}")
+    # speculative serving: accept rate over all generators + the running
+    # draft spend — invisible between Prometheus scrapes otherwise
+    n_draft = reg.counter(SPEC_DRAFT_TOKENS).total()
+    if n_draft:
+        n_acc = reg.counter(SPEC_ACCEPTED_TOKENS).total()
+        parts.append(f"spec={100 * n_acc / n_draft:.0f}%/{int(n_draft)}")
     parts.append(f"ttft_p50={ttft.quantile(0.5):.0f}ms")
     parts.append(f"itl_p50={itl.quantile(0.5):.0f}ms")
     # TTFT attribution p50s (runtime/flightrec): where first-token time

@@ -349,6 +349,7 @@ class ApiState:
         tok.reset_decoder()
 
         proposer = None
+        n_drafted = n_spec_acc = 0
         if engine.spec_active:
             from ..runtime.speculative import NgramProposer
 
@@ -372,6 +373,8 @@ class ApiState:
             if (proposer is not None
                     and max_pred - engine.pos >= engine.spec_lookup + 1):
                 run = engine.speculative_tokens(token, proposer.draft())
+                n_drafted += engine.spec_lookup
+                n_spec_acc += len(run) - 1
                 n_keep, stopped = len(run), False
                 for j, t in enumerate(run):
                     rt.token()
@@ -409,6 +412,12 @@ class ApiState:
             flightrec.record_ttft(
                 telemetry.registry().histogram(telemetry.TTFT_ATTRIB_MS), bd)
             timing = {k: round(v, 3) for k, v in bd.items()}
+            if n_drafted:
+                # single-sequence speculative decode: per-request accept
+                # rate, same field names as the batched timing block
+                timing["spec_drafted"] = n_drafted
+                timing["spec_accepted"] = n_spec_acc
+                timing["spec_accept_rate"] = round(n_spec_acc / n_drafted, 4)
 
         if not (custom_stops and finish_reason == "stop"):
             # a custom-stop finish leaves the hidden stop text and an
@@ -566,6 +575,19 @@ class BatchedApiState:
             out["timing"] = {k: round(v, 3) for k, v in bd.items()}
             out["timing"]["decode_step_ms"] = round(req.ms_decode_steps, 3)
             out["timing"]["preempt_ms"] = round(req.ms_preempt, 3)
+            if req.ms_verify:
+                # a request can spend its whole decode phase in verify
+                # dispatches without ever drafting (zero-length lens,
+                # degraded proposer) — the wall must not vanish from
+                # the report, so it gates on its own accumulator
+                out["timing"]["verify_ms"] = round(req.ms_verify, 3)
+            if req.spec_drafted:
+                # speculative serving: this request's own accept rate —
+                # the per-request view of dllama_spec_*_tokens_total
+                out["timing"]["spec_drafted"] = req.spec_drafted
+                out["timing"]["spec_accepted"] = req.spec_accepted
+                out["timing"]["spec_accept_rate"] = round(
+                    req.spec_accepted / req.spec_drafted, 4)
         return out
 
 
@@ -996,8 +1018,11 @@ def run_api_server(args) -> int:
                   f"{pool.block_size} rows (block-priced admission, "
                   f"block-level prefix sharing)")
         if engine.spec_lookup:
+            paged = bool(getattr(engine, "kv_block_size", 0))
             print(f"🕸️ speculative serving: verify K={engine.spec_lookup} "
-                  f"per slot (greedy requests)")
+                  f"per slot "
+                  + ("(greedy exact + rejection-sampled temperature>0)"
+                     if paged else "(greedy requests)"))
     else:
         state = ApiState(engine, template_type=ttype,
                          request_timeout=request_timeout)

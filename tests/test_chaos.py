@@ -915,3 +915,59 @@ def test_wire_failpoint_poisons_one_request_503_shaped(tmp_path):
         fp.registry().clear()
         sched.close()
         eng.close()
+
+
+# -- draft: speculative proposer poisoning (ISSUE 14) ------------------------
+
+
+def test_draft_failpoint_degrades_slot_to_plain_decode(tmp_path):
+    """Armed `draft:raise`: a poisoned/raising proposer DEGRADES that
+    slot to plain decode for the step — the request completes with its
+    exact spec-off transcript (a degraded greedy step emits exactly one
+    verified token), ``dllama_spec_degraded_total`` counts every degrade,
+    and bystanders are untouched. Disarming restores drafting on the
+    same live scheduler."""
+    mpath, tpath = _fresh_model(tmp_path, seed=31)
+    plain = InferenceEngine(mpath, tpath, tp=1, temperature=0.0, seed=3,
+                            kv_block_size=16)
+    sched0 = BatchScheduler(plain, n_slots=2)
+    try:
+        want = sched0.generate(_enc(plain, "hello hello hello"), 10,
+                               stop_on_eos=False)
+    finally:
+        sched0.close()
+        plain.close()
+
+    degraded = tm.registry().counter(tm.SPEC_DEGRADED)
+    fired = tm.registry().counter(tm.FAILPOINTS_FIRED)
+    drafted = tm.registry().counter(tm.SPEC_DRAFT_TOKENS)
+    g0, f0 = degraded.total(), fired.total(name="draft")
+    eng = InferenceEngine(mpath, tpath, tp=1, temperature=0.0, seed=3,
+                          kv_block_size=16, spec_lookup=4)
+    sched = BatchScheduler(eng, n_slots=2)
+    try:
+        fp.arm("draft", "raise")  # every draft call raises
+        victim = sched.submit(_enc(eng, "hello hello hello"), 10,
+                              stop_on_eos=False)
+        bystander = sched.submit(_enc(eng, "world"), 4, stop_on_eos=False)
+        assert victim.done.wait(timeout=300)
+        assert bystander.done.wait(timeout=300)
+        # the request COMPLETES — degraded means plain decode, not failure
+        assert victim.error is None and victim.tokens == want
+        assert bystander.error is None and len(bystander.tokens) == 4
+        assert victim.spec_drafted == 0  # every step degraded
+        assert degraded.total() > g0
+        assert fired.total(name="draft") > f0
+        # recovery on the SAME scheduler: disarm → drafting resumes
+        fp.registry().clear()
+        d0 = drafted.total(generator="paged")
+        again = sched.submit(_enc(eng, "hello hello hello"), 10,
+                             stop_on_eos=False)
+        assert again.done.wait(timeout=300)
+        assert again.error is None and again.tokens == want
+        assert again.spec_drafted > 0
+        assert drafted.total(generator="paged") > d0
+    finally:
+        fp.registry().clear()
+        sched.close()
+        eng.close()

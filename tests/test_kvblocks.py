@@ -334,9 +334,14 @@ def test_engine_validates_block_size_and_combos(tmp_path_factory):
         InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=24)
     with pytest.raises(ValueError, match="tile the padded context"):
         InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=512)
-    with pytest.raises(ValueError, match="--spec-lookup"):
+    # spec composes with paged KV now (ISSUE 14) — only a verify width
+    # past the decode regime refuses (spec_lookup + 1 > 16)
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=16,
+                          spec_lookup=3)
+    eng.close()
+    with pytest.raises(ValueError, match="--spec-lookup > 15"):
         InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=16,
-                        spec_lookup=3)
+                        spec_lookup=16)
     with pytest.raises(ValueError, match="--decode-chunk"):
         InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=16,
                         decode_chunk=4)

@@ -1,0 +1,255 @@
+"""Speculative decoding through the paged continuous-batching path.
+
+The paged verify program family (models.llama.paged_verify_step_guarded +
+runtime/serving.PagedGenerator._spec_step) must keep every serving
+invariant: greedy spec output token-identical to spec-off through a
+multi-request continuous stream with prefix sharing live, zero
+post-steady compiles across varying per-slot draft lengths (the verify
+program jits once per pool geometry — lens is traced), sampled requests
+deterministic per request and independent of batch-mates, accept-rate
+surfaced in /metrics and the opt-in ``timing`` response block, and the
+spec-aware block-reservation formula pricing the verify frontier so
+organic mid-verify exhaustion stays impossible.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime import introspection
+from dllama_tpu.runtime import telemetry as tm
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.runtime.serving import BatchScheduler, PagedGenerator, Request
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+
+def _mk_model(d):
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(29)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     rng)
+    td = byte_vocab_tokenizer()
+    td.chat_template = "<|start_header_id|>"  # detected as llama3 (api tests)
+    tfile.write_tfile(tpath, td)
+    return str(mpath), str(tpath)
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    return _mk_model(tmp_path_factory.mktemp("spec_paged"))
+
+
+def _enc(eng, text):
+    return eng.tokenizer.encode(text, is_start=True)
+
+
+def _stream(m, t, spec, work, n_slots=3):
+    """Run one continuous multi-request stream through the scheduler;
+    returns (tokens per request, per-request (drafted, accepted))."""
+    eng = InferenceEngine(m, t, tp=1, kv_block_size=16, spec_lookup=spec)
+    sched = BatchScheduler(eng, n_slots=n_slots)
+    try:
+        reqs = [sched.submit(_enc(eng, p), max_tok, stop_on_eos=False,
+                             **kw)
+                for p, max_tok, kw in work]
+        for r in reqs:
+            assert r.done.wait(timeout=300) and r.error is None, r.error
+        return ([r.tokens for r in reqs],
+                [(r.spec_drafted, r.spec_accepted) for r in reqs])
+    finally:
+        sched.close()
+        eng.close()
+
+
+# -- the ISSUE acceptance criterion ------------------------------------------
+
+
+def test_greedy_spec_token_exact_with_sharing_and_ledger_quiet(
+        tmp_path_factory):
+    """Greedy spec through PagedGenerator is token-exact vs spec-off on a
+    multi-request continuous stream with shared prefixes, with zero
+    post-steady compiles across varying per-slot draft lengths, and the
+    accept-rate lands in /metrics."""
+    m, t = _mk_model(tmp_path_factory.mktemp("spec_acc"))
+    base = "abcdefghijklmnopqrstuvwxyz "  # > one 16-row block shared
+    work = [(base + "hello hello hello", 12, {}),
+            (base + "hello hello there", 12, {}),
+            ("ababababababab", 16, {}),
+            (base + "hello goodbye", 10, {}),
+            ("the quick brown fox", 12, {})]
+    want, _ = _stream(m, t, 0, work)
+
+    eng = InferenceEngine(m, t, tp=1, kv_block_size=16, spec_lookup=4)
+    scope = eng.introspection_scope
+    sched = BatchScheduler(eng, n_slots=3)
+    d0 = tm.registry().counter(tm.SPEC_DRAFT_TOKENS).total(generator="paged")
+    a0 = tm.registry().counter(tm.SPEC_ACCEPTED_TOKENS).total(
+        generator="paged")
+    try:
+        # warm wave: the program family (prefill buckets, paged verify,
+        # CoW copy) compiles here; sharing is live (common base prefix)
+        warm = [sched.submit(_enc(eng, p), n, stop_on_eos=False)
+                for p, n, _kw in work[:3]]
+        for r in warm:
+            assert r.done.wait(timeout=300) and r.error is None, r.error
+        c0 = introspection.ledger().compile_count(scope)
+
+        # steady wave: same workload end to end — admit/retire churn,
+        # shared prefixes, and PER-SLOT DRAFT LENGTHS that vary (near-done
+        # slots clamp lens by their remaining budget) must not retrace
+        reqs = [sched.submit(_enc(eng, p), n, stop_on_eos=False)
+                for p, n, _kw in work]
+        for r in reqs:
+            assert r.done.wait(timeout=300) and r.error is None, r.error
+        assert introspection.ledger().compile_count(scope) == c0, \
+            "post-steady recompile on the paged verify path"
+        assert [r.tokens for r in reqs] == want, \
+            "greedy spec diverged from spec-off"
+        # per-request accept accounting feeds the timing block
+        assert all(r.spec_drafted > 0 for r in reqs)
+        assert any(r.spec_accepted > 0 for r in reqs), \
+            "repetitive greedy workload must show real acceptance"
+    finally:
+        sched.close()
+        eng.close()
+
+    # accept-rate in /metrics: the generator-labeled counters moved and
+    # the Prometheus render carries the series
+    drafted = tm.registry().counter(tm.SPEC_DRAFT_TOKENS).total(
+        generator="paged") - d0
+    accepted = tm.registry().counter(tm.SPEC_ACCEPTED_TOKENS).total(
+        generator="paged") - a0
+    assert drafted > 0 and accepted > 0
+    text = tm.registry().render()
+    assert 'dllama_spec_draft_tokens_total{generator="paged"}' in text
+    assert 'dllama_spec_accepted_tokens_total{generator="paged"}' in text
+
+
+# -- sampled traffic ----------------------------------------------------------
+
+
+def test_sampled_spec_deterministic_and_batchmate_independent(model_files):
+    """A sampled request under paged spec serving is deterministic (same
+    seed → same tokens) and independent of what shares the batch with it
+    — the coin-commit rule (speculative.spec_coins_consumed) consumes
+    exactly the draws its own emitted tokens derived from."""
+    m, t = model_files
+    sampled = ("the quick brown fox", 14,
+               dict(temperature=0.8, seed=11, topp=0.9))
+    a, _ = _stream(m, t, 3, [sampled])
+    b, _ = _stream(m, t, 3, [sampled,
+                             ("hello hello hello hello", 16, {}),
+                             ("zzzz yyyy xxxx", 12,
+                              dict(temperature=0.5, seed=5))])
+    assert a[0] == b[0], "batch-mates changed a sampled request's stream"
+
+
+def test_sampled_spec_requests_accept_and_complete(model_files):
+    """Sampled slots draft too (the whole point of speculative sampling):
+    they complete to max_tokens and report drafted > 0."""
+    m, t = model_files
+    toks, stats = _stream(
+        m, t, 4, [("hello hello hello hello", 16,
+                   dict(temperature=0.7, seed=3))])
+    assert len(toks[0]) == 16
+    assert stats[0][0] > 0  # drafted
+
+
+# -- serving-surface details --------------------------------------------------
+
+
+def test_timing_block_carries_accept_rate(model_files):
+    """The opt-in ``"timing": true`` response block gains the per-request
+    accept-rate fields under paged spec serving."""
+    from dllama_tpu.serve.api import BatchedApiState
+
+    m, t = model_files
+    eng = InferenceEngine(m, t, tp=1, temperature=0.0, seed=3,
+                          kv_block_size=16, spec_lookup=4)
+    state = BatchedApiState(eng, n_slots=2)
+    try:
+        out = state.complete({"messages": [{"role": "user",
+                                            "content": "hello hello hello"}],
+                              "max_tokens": 8, "timing": True})
+        timing = out["timing"]
+        assert timing["spec_drafted"] > 0
+        assert 0.0 <= timing["spec_accept_rate"] <= 1.0
+        assert timing["spec_accepted"] == round(
+            timing["spec_accept_rate"] * timing["spec_drafted"])
+        assert "verify_ms" in timing
+    finally:
+        state.close()
+        eng.close()
+
+
+def test_near_cap_slot_clamps_lens_instead_of_retiring(model_files):
+    """A slot within spec+1 positions of seq_len keeps decoding at a
+    clamped draft length (ragged lens) instead of retiring early — the
+    paged path trades NO tail capacity for speculation, and the final
+    tokens still match spec-off."""
+    m, t = model_files
+    eng0 = InferenceEngine(m, t, tp=1, kv_block_size=16)
+    gen0 = PagedGenerator(eng0, n_slots=1)
+    ids = _enc(eng0, "hello hello hello hello")
+    cap = eng0.cfg.seq_len - len(ids) + 1  # decode to the very last row
+    r0 = Request(rid=0, prompt_ids=list(ids), max_tokens=cap,
+                 stop_on_eos=False)
+    gen0.admit(r0, 0)
+    while gen0.n_active:
+        gen0.step()
+    eng0.close()
+
+    eng = InferenceEngine(m, t, tp=1, kv_block_size=16, spec_lookup=4)
+    gen = PagedGenerator(eng, n_slots=1)
+    r = Request(rid=0, prompt_ids=list(ids), max_tokens=cap,
+                stop_on_eos=False)
+    gen.admit(r, 0)
+    while gen.n_active:
+        gen.step()
+    eng.close()
+    assert r.tokens == r0.tokens
+    # the context is filled to the cap — nothing was traded away
+    assert len(r.tokens) == len(r0.tokens)
+
+
+def test_reservation_prices_verify_frontier(model_files):
+    """The spec-aware worst-case formula charges +spec rows: admission
+    can never over-commit the pool into a mid-verify exhaustion."""
+    m, t = model_files
+    eng = InferenceEngine(m, t, tp=1, kv_block_size=16, spec_lookup=4)
+    gen = PagedGenerator(eng, n_slots=2)
+    try:
+        plain = -(-(10 - 1 + 8) // gen.block_size)
+        with_spec = gen._worst_case_blocks(10, 8)
+        assert with_spec == -(-(10 - 1 + 8 + 4) // gen.block_size) >= plain
+        # capped at seq_len: a request that could fill the context prices
+        # the whole table, not more
+        assert gen._worst_case_blocks(10, 10_000) == \
+            -(-eng.cfg.seq_len // gen.block_size)
+    finally:
+        eng.close()
+
+
+def test_paged_spec_width_past_decode_regime_refused(model_files):
+    """Satellite: the blanket spec refusal is gone; the REAL remaining
+    constraint (verify width past the decode regime) refuses with the
+    limit named."""
+    m, t = model_files
+    with pytest.raises(ValueError, match="spec-lookup > 15"):
+        InferenceEngine(m, t, tp=1, kv_block_size=16, spec_lookup=16)
+
+
+def test_overlap_spec_refusal_names_limit(model_files):
+    """Satellite: the --comm-overlap × spec refusal names the actual
+    limit (_OVERLAP_MAX_WIDTH) and the flag that lifts it."""
+    m, t = model_files
+    if len(__import__("jax").devices()) < 2:
+        pytest.skip("needs >= 2 devices for a tp mesh")
+    with pytest.raises(ValueError) as ei:
+        InferenceEngine(m, t, tp=2, comm_overlap="2", spec_lookup=16)
+    msg = str(ei.value)
+    assert "_OVERLAP_MAX_WIDTH" in msg and "16" in msg
+    assert "--comm-overlap off" in msg

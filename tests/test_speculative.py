@@ -259,6 +259,110 @@ def test_speculative_under_sp_matches_plain(model_files, tp):
     assert got == want
 
 
+# -- rejection sampling (runtime/speculative.spec_decide) --------------------
+
+
+def test_spec_decide_zero_draft_is_plain_sampled_step():
+    """A zero-length draft degrades to the plain sampled decode step
+    BIT-exactly: the bonus token runs ops.sampling.sampled_token on the
+    position-0 logits with the final coin — the same function, the same
+    coin the non-speculative step would consume."""
+    from dllama_tpu.ops.sampling import sampled_token
+    from dllama_tpu.runtime.speculative import spec_decide
+
+    rng = np.random.default_rng(3)
+    B, K, V = 4, 3, 64
+    logits = jnp.asarray(rng.standard_normal((B, K + 1, V)), jnp.float32)
+    tokens = jnp.asarray(rng.integers(0, V, (B, K + 1)), jnp.int32)
+    temps = jnp.asarray([0.6, 0.9, 1.3, 0.8], jnp.float32)
+    topps = jnp.asarray([0.9, 0.5, 1.0, 0.95], jnp.float32)  # incl. topp=1
+    fcoins = jnp.asarray(rng.random(B), jnp.float32)
+    n_acc, out = jax.jit(spec_decide)(
+        logits, tokens, jnp.zeros(B, jnp.int32), temps, topps,
+        jnp.asarray(rng.random((B, K)), jnp.float32), fcoins)
+    np.testing.assert_array_equal(np.asarray(n_acc), 0)
+    want = sampled_token(logits[:, 0], temps, topps, fcoins)
+    np.testing.assert_array_equal(np.asarray(out)[:, 0], np.asarray(want))
+
+
+def test_spec_decide_greedy_rows_match_exact_prefix_rule():
+    """Greedy rows (temp <= 0) keep the exact-match acceptance capped at
+    the row's draft length, and emit the model's own argmax run."""
+    from dllama_tpu.runtime.speculative import spec_decide
+
+    rng = np.random.default_rng(7)
+    B, K, V = 3, 4, 32
+    logits = jnp.asarray(rng.standard_normal((B, K + 1, V)), jnp.float32)
+    preds = np.argmax(np.asarray(logits), -1)
+    # row 0: drafts equal the model's own predictions (full acceptance up
+    # to lens); row 1: first draft wrong; row 2: lens caps acceptance
+    tokens = np.zeros((B, K + 1), np.int32)
+    tokens[:, 1:] = preds[:, :-1]
+    tokens[1, 1] = (preds[1, 0] + 1) % V
+    lens = jnp.asarray([K, K, 2], jnp.int32)
+    n_acc, out = jax.jit(spec_decide)(
+        logits, jnp.asarray(tokens), lens,
+        jnp.zeros(B, jnp.float32), jnp.full((B,), 0.9, jnp.float32),
+        jnp.zeros((B, K), jnp.float32), jnp.zeros(B, jnp.float32))
+    assert list(np.asarray(n_acc)) == [K, 0, 2]
+    np.testing.assert_array_equal(np.asarray(out), preds)
+
+
+def test_spec_decide_distribution_preserved_tv_bound():
+    """The satellite's statistical acceptance: the emitted next-token
+    distribution of spec-sampled decode equals non-spec sampling within
+    a total-variation bound on a toy model (fixed seeds). Point-mass
+    proposal ⇒ accept w.p. p_target(draft), residual-resample on
+    rejection — the theorem says the marginal IS p_target; the empirical
+    TV distance over N draws concentrates within ~sqrt(V/N)."""
+    from dllama_tpu.ops.sampling import sampled_token
+    from dllama_tpu.runtime.speculative import spec_decide
+
+    rng = np.random.default_rng(17)
+    V, N, draft = 16, 20000, 3
+    logits = jnp.asarray(rng.standard_normal((1, 2, V)) * 2.0, jnp.float32)
+    toks = jnp.asarray([[0, draft]], jnp.int32)
+    lens = jnp.asarray([1], jnp.int32)
+    temps = jnp.asarray([0.8], jnp.float32)
+    topps = jnp.asarray([0.9], jnp.float32)
+
+    def one(ac, fc):
+        return spec_decide(logits, toks, lens, temps, topps,
+                           ac[None, None], fc[None])
+
+    acs = jnp.asarray(rng.random(N), jnp.float32)
+    fcs = jnp.asarray(rng.random(N), jnp.float32)
+    n_accs, outs = jax.jit(jax.vmap(one))(acs, fcs)
+    n_accs, outs = np.asarray(n_accs)[:, 0], np.asarray(outs)[:, 0]
+    first = np.where(n_accs >= 1, draft, outs[:, 0])
+
+    plain = jax.jit(jax.vmap(
+        lambda c: sampled_token(logits[:, 0], temps, topps, c)))(
+        jnp.asarray(rng.random(N), jnp.float32))
+    plain = np.asarray(plain)[:, 0]
+
+    p_spec = np.bincount(first, minlength=V) / N
+    p_plain = np.bincount(plain, minlength=V) / N
+    tv = 0.5 * np.abs(p_spec - p_plain).sum()
+    assert tv < 0.03, f"TV distance {tv:.4f} — distribution not preserved"
+    # and the accept rate itself matches the drafted token's target prob
+    from dllama_tpu.runtime.speculative import target_sampling_probs
+
+    p_d = float(target_sampling_probs(logits[:, 0], temps, topps)[0, draft])
+    assert abs(float((n_accs >= 1).mean()) - p_d) < 0.02
+
+
+def test_spec_coins_consumed_rule():
+    """The host commit rule: final coin + one accept coin per test
+    (n_acc tests on full acceptance, n_acc+1 when rejected)."""
+    from dllama_tpu.runtime.speculative import spec_coins_consumed
+
+    assert spec_coins_consumed(0, 0) == 1   # no draft: plain decode's coin
+    assert spec_coins_consumed(0, 4) == 2   # first test rejected
+    assert spec_coins_consumed(2, 4) == 4   # 3 tests + final
+    assert spec_coins_consumed(4, 4) == 5   # all accepted + bonus
+
+
 def test_speculative_identical_under_turbo(model_files, monkeypatch):
     """Speculation composes with turbo numerics: a8 quantizes activations
     per ROW, so each token position quantizes identically in a [B, K+1]

@@ -23,7 +23,7 @@ import sys
 
 _RATES = ("decode_tok_per_s", "prefill_tok_per_s", "sampled_decode_tok_per_s",
           "chunked_decode_tok_per_s", "paged_decode_tok_per_s",
-          "agg_tok_per_s", "decode_tok_per_s_q80")
+          "agg_tok_per_s", "accepted_tok_per_s", "decode_tok_per_s_q80")
 # lower-is-better latencies (--scenario continuous/fleet TTFT; --scenario
 # multichip exposed collective wall): the printed pct is still
 # "improvement-positive", so the sign is flipped before ranking
@@ -35,6 +35,7 @@ _LATENCIES = ("ttft_ms_p50", "ttft_ms_p95",
 # schedule, not a performance delta)
 _GAUGES = ("block_occupancy_peak", "block_occupancy_mean",
            "kv_blocks_shared_peak", "prefix_reuse_tokens",
+           "spec_accept_rate", "itl_p50_ms_delta",
            "wire_q80_shrink", "exposed_overlap_lower",
            "f32_tokens_identical",
            "router_retries", "router_ejects", "router_shed",

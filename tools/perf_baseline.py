@@ -47,7 +47,7 @@ DEFAULT_BASELINE = os.path.join(REPO, "PERF_BASELINE.json")
 RATE_FIELDS = ("decode_tok_per_s", "prefill_tok_per_s",
                "sampled_decode_tok_per_s", "chunked_decode_tok_per_s",
                "paged_decode_tok_per_s", "agg_tok_per_s",
-               "decode_tok_per_s_q80")
+               "accepted_tok_per_s", "decode_tok_per_s_q80")
 LATENCY_FIELDS = ("decode_ms_per_step", "verify_k4_ms",
                   "ttft_ms_p50", "ttft_ms_p95", "comm_exposed_ms")
 # decode-region fields whose RTT floor scales with the region length
