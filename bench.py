@@ -846,10 +846,20 @@ def bench_continuous(deadline: float, *, out: dict | None = None) -> dict:
     negative when speculation wins). tools/bench_compare.py ranks
     ``accepted_tok_per_s``; tools/perf_baseline.py guards it.
 
+    A third wave exercises tiered KV memory (``--kv-host-blocks``): an
+    idle/resume session stream over a device pool deliberately smaller
+    than the sessions' combined KV, reporting ``sessions_per_chip``
+    (idle sessions whose KV survived to resume via host spill +
+    page-back) and ``resume_ttft_p95_ms`` — both ranked by
+    tools/bench_compare.py and guarded by tools/perf_baseline.py
+    (no_evidence until the next on-chip ``--baseline update``).
+
     Workload knobs (env): DLLAMA_BENCH_SCN_REQUESTS (24),
     DLLAMA_BENCH_SCN_SLOTS (4), DLLAMA_BENCH_KV_BLOCK (16),
     DLLAMA_BENCH_SCN_STAGGER (0.05 s), DLLAMA_BENCH_SCN_MAXTOK (16),
-    DLLAMA_BENCH_SCN_SPEC (4 — the A/B's spec-lookup width)."""
+    DLLAMA_BENCH_SCN_SPEC (4 — the A/B's spec-lookup width),
+    DLLAMA_BENCH_SCN_SESSIONS (10 — the tiered wave's session
+    count)."""
     import shutil
     import tempfile
     import threading
@@ -988,8 +998,8 @@ def bench_continuous(deadline: float, *, out: dict | None = None) -> dict:
             # TTFT decomposition per completed request — the
             # continuous-batching throughput number, explained — plus the
             # decode-phase step/preempt/verify split
-            attrib: dict = {"queue": [], "admission": [], "prefill": [],
-                            "first_decode": []}
+            attrib: dict = {"queue": [], "pagein": [], "admission": [],
+                            "prefill": [], "first_decode": []}
             itl_attrib: dict = {"step": [], "preempt": [], "verify": []}
             rel_errs = []
             for i, r in enumerate(reqs):
@@ -999,6 +1009,7 @@ def bench_continuous(deadline: float, *, out: dict | None = None) -> dict:
                 if bd is None:
                     continue
                 attrib["queue"].append(bd["queue_ms"])
+                attrib["pagein"].append(bd["pagein_ms"])
                 attrib["admission"].append(bd["admission_ms"])
                 attrib["prefill"].append(bd["prefill_ms"])
                 attrib["first_decode"].append(bd["first_decode_ms"])
@@ -1013,8 +1024,9 @@ def bench_continuous(deadline: float, *, out: dict | None = None) -> dict:
                 # double-charge) shows up here
                 if i in t_toks:
                     wall = 1e3 * (t_toks[i][0] - t_sub[i])
-                    total = (bd["queue_ms"] + bd["admission_ms"]
-                             + bd["prefill_ms"] + bd["first_decode_ms"])
+                    total = (bd["queue_ms"] + bd["pagein_ms"]
+                             + bd["admission_ms"] + bd["prefill_ms"]
+                             + bd["first_decode_ms"])
                     if wall > 0:
                         rel_errs.append(abs(total - wall) / wall)
             if attrib["queue"]:
@@ -1070,6 +1082,87 @@ def bench_continuous(deadline: float, *, out: dict | None = None) -> dict:
                 and w_off.get("itl_p50_ms") is not None):
             out["itl_p50_ms_delta"] = round(
                 w_on["itl_p50_ms"] - w_off["itl_p50_ms"], 2)
+
+        # -- tiered KV memory: idle/resume wave (--kv-host-blocks) ---------
+        # The capacity shape the tier exists for: S sessions complete a
+        # turn and go idle (their KV parks in the cached LRU), the
+        # device pool is DELIBERATELY smaller than their combined KV so
+        # cold blocks spill to the host mirror, then every session
+        # resumes with its history + new text. Reported:
+        # `sessions_per_chip` (idle sessions whose KV survived to
+        # resume — a block-reuse hit on the resume prompt instead of a
+        # full re-prefill) and `resume_ttft_p95_ms` (what a page-in
+        # resume costs), both ranked by tools/bench_compare.py and
+        # guarded by tools/perf_baseline.py.
+        n_sessions = _scn_int("DLLAMA_BENCH_SCN_SESSIONS", 10)
+        out["phase"] = "scenario_tiered"
+
+        def tiered_wave() -> dict:
+            w: dict = {}
+            eng = InferenceEngine(mpath, tpath, tp=1, kv_block_size=block,
+                                  kv_host_blocks=8 * n_sessions)
+            # 2 slots -> a 2*table_width+1 device pool, well under the
+            # sessions' combined KV (the point of the wave)
+            sched = BatchScheduler(eng, n_slots=2)
+            reg = tm.registry()
+            reuse = reg.counter(tm.PREFIX_REUSE_TOKENS)
+            spill = reg.counter(tm.KV_SPILL_BLOCKS)
+            pagein = reg.counter(tm.KV_PAGEIN_BLOCKS)
+            s0, p0 = spill.total(), pagein.total()
+            srng = np.random.default_rng(0xC1)
+            prompts = [[int(x) for x in srng.integers(1, 200, 4 * block + 4)]
+                       for _ in range(n_sessions)]
+            try:
+                # turn 1: sessions run and retire (go idle)
+                reqs = [sched.submit(p, 4, stop_on_eos=False)
+                        for p in prompts]
+                for r in reqs:
+                    if not r.done.wait(
+                            timeout=max(5.0, deadline - time.monotonic())):
+                        w["error"] = "deadline inside tiered wave"
+                        return w
+                w["spill_blocks"] = int(spill.total() - s0)
+                w["host_used_idle"] = int(
+                    reg.gauge(tm.KV_BLOCKS_HOST_USED).value())
+                # resumes: sequential so per-session reuse attributes
+                hits = 0
+                ttfts: list = []
+                for i, p in enumerate(prompts):
+                    r0 = reuse.total()
+                    stamp: list = []
+                    t_sub = time.perf_counter()
+                    req = sched.submit(
+                        p + [int(x) for x in srng.integers(1, 200, 8)],
+                        4, stop_on_eos=False,
+                        on_token=lambda _t, _p, s=stamp:
+                            s.append(time.perf_counter()))
+                    if not req.done.wait(
+                            timeout=max(5.0, deadline - time.monotonic())):
+                        w["error"] = "deadline inside resume wave"
+                        return w
+                    if req.error is None and reuse.total() - r0 >= block:
+                        hits += 1  # KV survived idle: a retained session
+                    if stamp:
+                        ttfts.append(1e3 * (stamp[0] - t_sub))
+                w["sessions_per_chip"] = hits
+                w["pagein_blocks"] = int(pagein.total() - p0)
+                if ttfts:
+                    ttfts.sort()
+                    w["resume_ttft_p50_ms"] = round(_pctl(ttfts, 0.5), 1)
+                    w["resume_ttft_p95_ms"] = round(_pctl(ttfts, 0.95), 1)
+                return w
+            finally:
+                sched.close()
+                eng.close()
+
+        tw = tiered_wave()
+        out["tiered"] = tw
+        if tw.get("sessions_per_chip") is not None:
+            out["sessions_per_chip"] = tw["sessions_per_chip"]
+        if tw.get("resume_ttft_p95_ms") is not None:
+            out["resume_ttft_p95_ms"] = tw["resume_ttft_p95_ms"]
+        if tw.get("error"):
+            out.setdefault("error", f"tiered wave: {tw['error']}"[:200])
         out["phase"] = "done"
         return out
     finally:

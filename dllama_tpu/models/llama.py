@@ -1255,6 +1255,32 @@ def paged_verify_step_guarded(params: Params, cfg: ModelConfig,
     return (n_acc, out, _nonfinite_rows(logits)), pkv
 
 
+def gather_kv_blocks(pkv, ids: jax.Array):
+    """Device side of a KV-tier SPILL (runtime/kvblocks.HostKVMirror):
+    gather ``len(ids)`` physical blocks out of the pool as one contiguous
+    chunk ``(k, v)`` each ``[L, K, n_kv, bs, hd]`` — ONE batched read per
+    spill, then a single ``device_put`` moves the chunk to pinned host
+    memory. ``ids`` is traced (fixed K = kvblocks.SPILL_BATCH, short
+    batches padded with the null block), so tier pressure never retraces.
+    Plan-independent data movement — jitted raw at the call site, same
+    argument as PagedGenerator's take/put/copy programs."""
+    return pkv.k[:, ids], pkv.v[:, ids]
+
+
+def scatter_kv_blocks(pkv, chunk_k: jax.Array, chunk_v: jax.Array,
+                      ids: jax.Array):
+    """Device side of a KV-tier PAGE-IN: scatter a host chunk (moved back
+    device-side by ``device_put``) into the pool at physical blocks
+    ``ids``. Lanes the page-in does not want target the null block (id 0)
+    — its contents are value-invisible garbage by the pool's contract, so
+    a partial chunk restore is the same one program. Returns the updated
+    pool (donated at the jit wrapper)."""
+    from ..runtime.kvblocks import PagedKVCache
+
+    return PagedKVCache(k=pkv.k.at[:, ids].set(chunk_k.astype(pkv.k.dtype)),
+                        v=pkv.v.at[:, ids].set(chunk_v.astype(pkv.v.dtype)))
+
+
 # ---------------------------------------------------------------------------
 # Parameter construction
 # ---------------------------------------------------------------------------

@@ -135,6 +135,7 @@ class InferenceEngine:
                  multihost: bool = False, host_sampling: bool = False,
                  decode_chunk: int = 1, spec_lookup: int = 0,
                  kv_dtype: str = "auto", kv_block_size: int = 0,
+                 kv_host_blocks: int = 0,
                  comm_overlap: int | str = "off",
                  profile_split: bool = False,
                  verify_weights: bool = False,
@@ -259,6 +260,18 @@ class InferenceEngine:
                     f"--kv-block-size (paged KV serving) does not support "
                     f"{', '.join(bad)} yet — drop those flags or drop "
                     f"--kv-block-size to use the dense slot pool")
+        # tiered KV memory (--kv-host-blocks, runtime/kvblocks.py): a
+        # host-DRAM mirror pool under the paged block pool — cold cached
+        # blocks spill there under allocation pressure and page back at
+        # resume. Pure serving-tier state: sized/validated here, built by
+        # PagedGenerator (which also degrades it against the host budget,
+        # hbm.fit_host_pool).
+        self.kv_host_blocks = max(0, int(kv_host_blocks or 0))
+        if self.kv_host_blocks and not self.kv_block_size:
+            raise ValueError(
+                "--kv-host-blocks is the paged pool's host spill tier — "
+                "it needs --kv-block-size (block-granular KV) to have "
+                "blocks to spill")
 
         n_dev = len(jax.devices())
         for name, n in (("dp", dp), ("sp", sp), ("pp", pp)):

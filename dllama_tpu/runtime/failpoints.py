@@ -58,6 +58,17 @@ have at least one call site:
   BlockPool.alloc``): a ``raise`` here simulates block-pool exhaustion,
   which must degrade to queueing (admission) or an explicit per-request
   failure (mid-decode growth), never a crash.
+* ``spill`` — the KV tier's device→host spill executor
+  (``runtime/serving.py PagedGenerator._exec_spill``, fired before the
+  batched copy): a ``raise`` simulates a failed spill, which must
+  DEGRADE to the pre-tier drop-evict contract (cached content lost,
+  allocation proceeds, requeue/503 semantics unchanged) — never a crash
+  and never a failed request.
+* ``pagein`` — the KV tier's host→device page-in executor
+  (``runtime/serving.py PagedGenerator._exec_pagein``, fired before the
+  restore copy): a ``raise`` fails ONLY the resuming request
+  (503-shaped ``PageInError``; host copies stay intact for a retry),
+  bystander slots keep decoding token-intact.
 * ``draft`` — the speculative proposer's draft call
   (``runtime/serving.py _GeneratorCore._safe_draft``, fired per slot
   per verify tick): a ``raise`` simulates a poisoned/crashing proposer,

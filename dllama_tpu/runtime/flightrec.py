@@ -257,25 +257,31 @@ class FlightRecorder:
 
 
 def ttft_phases(t_submit: int, t_admit: int, t_decode: int,
-                t_first_token: int, ms_prefill: float) -> dict:
+                t_first_token: int, ms_prefill: float,
+                ms_pagein: float = 0.0) -> dict:
     """THE TTFT phase formula — every surface that decomposes a first
     token (the ``dllama_ttft_attrib_ms`` histograms, the API ``timing``
     block on both serving paths, bench.py's attribution section) derives
     from this one function, so they can never drift apart. Timestamps
     are monotonic ns; ``ms_prefill`` is the request's own prefill chunk
-    dispatch wall. Phases: queue (submit → admission start), admission
-    (admission start → decode-armed minus own prefill wall — bookkeeping
-    plus interleave gaps while other requests' chunks ran), prefill (own
-    chunk dispatch wall, clamped to the admission window), first_decode
-    (decode-armed → first token). The four sum to ``ttft_ms`` by
+    dispatch wall and ``ms_pagein`` its KV-tier page-in wall (resumed
+    sessions restoring spilled blocks; 0 everywhere else). Phases: queue
+    (submit → admission start), pagein (host→device block restore for a
+    resumed session), admission (admission start → decode-armed minus
+    own prefill and pagein walls — bookkeeping plus interleave gaps
+    while other requests' chunks ran), prefill (own chunk dispatch wall;
+    pagein+prefill clamp to the admission window), first_decode
+    (decode-armed → first token). The five sum to ``ttft_ms`` by
     construction. Single-sequence serving passes
     ``t_admit == t_submit`` (no scheduler queue → queue = 0)."""
     queue = (t_admit - t_submit) / 1e6
     window = (t_decode - t_admit) / 1e6
-    prefill = min(ms_prefill, window)
+    pagein = min(ms_pagein, window)
+    prefill = min(ms_prefill, window - pagein)
     return {"ttft_ms": (t_first_token - t_submit) / 1e6,
             "queue_ms": queue,
-            "admission_ms": window - prefill,
+            "pagein_ms": pagein,
+            "admission_ms": window - prefill - pagein,
             "prefill_ms": prefill,
             "first_decode_ms": (t_first_token - t_decode) / 1e6}
 
@@ -285,6 +291,7 @@ def record_ttft(hist, bd: dict) -> None:
     ``dllama_ttft_attrib_ms`` histogram — the one publication site for
     both serving paths, so the phase label set can never diverge."""
     hist.record(bd["queue_ms"], phase="queue")
+    hist.record(bd["pagein_ms"], phase="pagein")
     hist.record(bd["admission_ms"], phase="admission")
     hist.record(bd["prefill_ms"], phase="prefill")
     hist.record(bd["first_decode_ms"], phase="first_decode")
@@ -356,10 +363,15 @@ def to_chrome_trace(data: dict) -> dict:
                     "args": {"slots": t.get("n_active", 0)}})
         blocks = t.get("blocks")
         if blocks:
+            args = {"used": blocks.get("used", 0),
+                    "shared": blocks.get("shared", 0)}
+            if "host_used" in blocks:
+                # tiered KV memory: the host-resident block count rides
+                # the same counter track, so a Perfetto view shows spill
+                # pressure next to device occupancy
+                args["host_used"] = blocks.get("host_used", 0)
             out.append({"ph": "C", "pid": 1, "tid": 0, "ts": ts,
-                        "name": "kv_blocks",
-                        "args": {"used": blocks.get("used", 0),
-                                 "shared": blocks.get("shared", 0)}})
+                        "name": "kv_blocks", "args": args})
 
     by_rid: dict[int, list[dict]] = {}
     for s in spans:

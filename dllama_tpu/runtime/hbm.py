@@ -160,7 +160,12 @@ def fit_block_pool(cfg, n_blocks: int, *, block_size: int, min_blocks: int,
     instead of by whole max-context slots. The base charge keeps the
     engine's batch-1 cache (still resident beside the pool). Returns
     ``(n_fit, estimate)``; ``n_fit == 0`` when even ``min_blocks`` (one
-    full sequence + the null block) doesn't fit."""
+    full sequence + the null block) doesn't fit. With the host KV tier
+    on (``--kv-host-blocks``, :func:`fit_host_pool`), a degraded device
+    pool costs capacity for LIVE context only — cold (cached) blocks
+    spill to the host mirror under pressure and page back at resume, so
+    the device size stops bounding how many idle sessions keep their
+    KV."""
     limit = (None if os.environ.get("DLLAMA_SKIP_HBM_CHECK")
              else device_memory_bytes())
     base = estimate_device_bytes(
@@ -192,6 +197,61 @@ def fit_block_pool(cfg, n_blocks: int, *, block_size: int, min_blocks: int,
         else:
             hi = mid
     return lo, est_for(lo)
+
+
+def host_memory_bytes() -> int | None:
+    """Total host DRAM, or None when the platform won't say.
+    ``DLLAMA_HOST_KV_BYTES`` overrides with an explicit KV-tier budget
+    (testing + containers whose cgroup limit the sysconf number can't
+    see)."""
+    env = os.environ.get("DLLAMA_HOST_KV_BYTES")
+    if env:
+        return int(env)
+    try:
+        return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError, AttributeError):
+        return None
+
+
+# the host KV mirror may take at most this share of host DRAM when the
+# budget comes from the sysconf total (weights streaming, the OS, and the
+# runtime need the rest); an explicit DLLAMA_HOST_KV_BYTES is taken as-is
+_HOST_KV_FRACTION = 0.5
+
+
+def fit_host_pool(cfg, n_blocks: int, *, block_size: int,
+                  kv_dtype_bytes: int) -> int:
+    """Largest host-tier mirror pool ``<= n_blocks`` that fits the host
+    DRAM budget — the host twin of :func:`fit_block_pool`: host-resident
+    blocks are *reclaimable session capacity* (a spilled idle session's
+    KV pages back in at resume instead of re-prefilling), so the tier is
+    sized the same block-granular way the device pool is. Returns the
+    fitted count (0 = tier off); host capacity unknown ⇒ the request is
+    granted as-is (host allocation failures surface as ordinary
+    MemoryErrors at mirror-store time, which degrade to drop-evict).
+
+    Granularity: the mirror stores spilled blocks in
+    ``kvblocks.SPILL_BATCH``-wide chunks, so grants ≥ one batch round
+    DOWN to a batch multiple (dangling sub-batch lanes could never
+    carry a full spill and would sit dead against the chunk-accounted
+    RAM cap); a sub-batch grant is kept as-is — its mirror may hold at
+    most ONE chunk, a bounded absolute overshoot the operator accepted
+    by asking for a tier that small."""
+    from .kvblocks import SPILL_BATCH
+
+    n = max(0, n_blocks)
+    if n == 0:
+        return 0
+    limit = host_memory_bytes()
+    if limit is not None:
+        if not os.environ.get("DLLAMA_HOST_KV_BYTES"):
+            limit = int(limit * _HOST_KV_FRACTION)
+        per_block = max(1, estimate_block_pool_bytes(cfg, 1, block_size,
+                                                     kv_dtype_bytes))
+        n = min(n, limit // per_block)
+    if n >= SPILL_BATCH:
+        n = (n // SPILL_BATCH) * SPILL_BATCH
+    return n
 
 
 def estimate_prefill_temp_bytes(cfg, tokens: int) -> int:

@@ -50,7 +50,7 @@ from ..parallel.multihost import (
     CTRL_SRV_VERIFY,
 )
 from ..tokenizer.sampler import xorshift_random_f32
-from .kvblocks import BlockPoolExhausted
+from .kvblocks import SPILL_BATCH, BlockPoolExhausted, PageInError
 from .kvcache import KVCache
 
 if TYPE_CHECKING:
@@ -194,6 +194,9 @@ class Request:
     #                               preemption share of inter-token stalls)
     ms_verify: float = 0.0        # speculative verify dispatch wall (the
     #                               `verify` ITL attribution cause)
+    ms_pagein: float = 0.0        # KV-tier page-in wall during admission
+    #                               (resumed sessions restoring spilled
+    #                               blocks — the `pagein` TTFT phase)
     # speculative accounting (paged/dense spec serving): drafted tokens
     # offered to verify dispatches and the accepted count — the per-request
     # accept rate surfaced in the opt-in `timing` response block
@@ -212,7 +215,7 @@ class Request:
             return None
         return flightrec.ttft_phases(self.t_submit, self.t_admit,
                                      self.t_decode, self.t_first_token,
-                                     self.ms_prefill)
+                                     self.ms_prefill, self.ms_pagein)
 
 
 @dataclass
@@ -227,6 +230,18 @@ class _Admission:
     col: KVCache  # the slot's gathered cache column, being filled
     pos: int = 0
     reused: int = 0  # prefix tokens skipped via cross-slot KV reuse
+    # KV tier (paged pool with --kv-host-blocks): outstanding page-in
+    # pairs (host_bid, dev_bid) — drained in SPILL_BATCH batches, one per
+    # continue_admit call, so a long resume's restore interleaves with
+    # the other slots' decode ticks instead of stalling one tick
+    pagein: list = field(default_factory=list)
+    # device work deferred until the paged-in content is resident: the
+    # copy-on-write block copy (src_dev, dst_dev) and — when the source
+    # came from the host tier — the rc-1 reference on it to release after
+    # the copy; plus the column gather (need_take) for partial reuse
+    cow: tuple | None = None
+    cow_release: int = 0
+    need_take: bool = False
 
 
 class _GeneratorCore:
@@ -1108,7 +1123,20 @@ class PagedGenerator(_GeneratorCore):
                   f"({(n_blocks - 1) * block_size} cache rows) instead of "
                   f"risking an OOM (runtime/hbm.py)", flush=True)
         self.hbm_need = est["need_per_device"]
-        self.pool = BlockPool(n_blocks, block_size)
+        # tiered KV memory (--kv-host-blocks, runtime/kvblocks.py): a
+        # host-DRAM mirror pool sized through the host budget — cold
+        # cached blocks spill there under pressure instead of dropping,
+        # and resumed sessions page them back in at admission
+        from .hbm import fit_host_pool
+
+        want_host = int(getattr(engine, "kv_host_blocks", 0) or 0)
+        n_host = fit_host_pool(self.cfg, want_host, block_size=block_size,
+                               kv_dtype_bytes=engine.kv_dtype.itemsize)
+        if n_host < want_host:
+            print(f"⚠️ host KV tier: {want_host} host blocks exceed the "
+                  f"host DRAM budget — degrading to {n_host} "
+                  f"(runtime/hbm.py fit_host_pool)", flush=True)
+        self.pool = BlockPool(n_blocks, block_size, n_host_blocks=n_host)
         pkv = PagedKVCache.create(self.cfg, n_blocks, block_size,
                                   dtype=engine.kv_dtype)
         if engine.plan is not None:
@@ -1195,10 +1223,60 @@ class PagedGenerator(_GeneratorCore):
         # shapes on the first post-decode admission (the donated-output
         # recompile the canary docs measured)
         self.pkv = self._copy_block(self.pkv, jnp.int32(0), jnp.int32(0))
+        # host KV tier: the mirror owns the host buffers + transfer
+        # programs; its warmup compiles the gather/scatter pair and
+        # exercises both device_put hops on the null block NOW, so the
+        # first under-pressure spill is a copy, never a compile. The
+        # spill hook is installed only after a successful warmup — a
+        # backend that can't run the transfers serves untiered instead
+        # of degrading on every alloc.
+        self.mirror = None
+        # the one per-block size formula (hbm sizes the budget with it;
+        # the spill/pagein byte counters must price identically)
+        from .hbm import estimate_block_pool_bytes
+
+        self._block_bytes = estimate_block_pool_bytes(
+            self.cfg, 1, block_size, engine.kv_dtype.itemsize)
+        if self.pool.n_host_blocks:
+            from ..runtime.kvblocks import HostKVMirror
+
+            # chunk-accounted RAM cap: fragmentation (a chunk alive on
+            # one lane) must cost capacity, never overshoot the host
+            # budget fit_host_pool granted
+            mirror = HostKVMirror(max_chunks=max(1, n_host // SPILL_BATCH))
+            try:
+                self.pkv = mirror.warmup(self.pkv)
+            except Exception as e:  # noqa: BLE001 — tier off, serving must start
+                print(f"⚠️ host KV tier disabled: transfer warmup failed "
+                      f"({type(e).__name__}: {e})", flush=True)
+                self.pool.n_host_blocks = 0
+                self.pool._host_free.clear()
+            else:
+                self.mirror = mirror
+                self.pool.spill_fn = self._exec_spill
+                self.pool.host_drop_fn = mirror.drop
+                self.pool.host_room_fn = mirror.has_room
+        # the pool's sharding flips ONCE after the first plan-scoped step
+        # dispatch (raw-jit outputs carry SingleDeviceSharding, the model
+        # programs' outputs the plan's NamedSharding) — re-warm the tier
+        # transfer programs (and the CoW copy) against the steady
+        # sharding right after that first step, so the first
+        # under-pressure spill / resume page-in post-steady is a copy,
+        # never a compile cliff
+        self._tier_rewarmed = self.mirror is None
         self._m_blocks_total = self._tm.gauge(telemetry.KV_BLOCKS_TOTAL)
         self._m_blocks_used = self._tm.gauge(telemetry.KV_BLOCKS_USED)
         self._m_blocks_shared = self._tm.gauge(telemetry.KV_BLOCKS_SHARED)
+        self._m_host_total = self._tm.gauge(telemetry.KV_BLOCKS_HOST_TOTAL)
+        self._m_host_used = self._tm.gauge(telemetry.KV_BLOCKS_HOST_USED)
+        self._m_spill_blocks = self._tm.counter(telemetry.KV_SPILL_BLOCKS)
+        self._m_spill_bytes = self._tm.counter(telemetry.KV_SPILL_BYTES)
+        self._m_spill_ms = self._tm.counter(telemetry.KV_SPILL_MS)
+        self._m_pagein_blocks = self._tm.counter(telemetry.KV_PAGEIN_BLOCKS)
+        self._m_pagein_bytes = self._tm.counter(telemetry.KV_PAGEIN_BYTES)
+        self._m_pagein_ms = self._tm.counter(telemetry.KV_PAGEIN_MS)
         self._m_blocks_total.set(n_blocks - 1)
+        self._m_host_total.set(self.pool.n_host_blocks)
         self._update_block_gauges()
 
     # -- pool bookkeeping ---------------------------------------------------
@@ -1206,15 +1284,138 @@ class PagedGenerator(_GeneratorCore):
     def _update_block_gauges(self) -> None:
         self._m_blocks_used.set(self.pool.used_blocks())
         self._m_blocks_shared.set(self.pool.shared_blocks())
+        if self.pool.n_host_blocks:
+            self._m_host_used.set(self.pool.host_used_blocks())
 
     def _kv_fraction(self) -> float:
         return self.pool.used_blocks() / max(1, self.pool.n_blocks - 1)
 
     def flight_blocks(self) -> dict | None:
-        return {"total": self.pool.n_blocks - 1,
-                "used": self.pool.used_blocks(),
-                "shared": self.pool.shared_blocks(),
-                "reserved": sum(self._reserve)}
+        d = {"total": self.pool.n_blocks - 1,
+             "used": self.pool.used_blocks(),
+             "shared": self.pool.shared_blocks(),
+             "reserved": sum(self._reserve)}
+        if self.pool.n_host_blocks:
+            d["host_total"] = self.pool.n_host_blocks
+            d["host_used"] = self.pool.host_used_blocks()
+        return d
+
+    # -- KV tier: spill (device→host) and page-in (host→device) -------------
+
+    def _tier_rewarm(self) -> None:  # dlint: owner=loop-thread
+        """One-shot, after the first decode dispatch: re-run the transfer
+        (and CoW) warmups now that the pool carries the steady
+        NamedSharding the step programs output — executables key on
+        input shardings, and the init-time warmup could only see the
+        fresh pool's. Same failure contract as the init warmup: a
+        backend that can't run the transfers against the steady
+        sharding degrades to UNTIERED serving (nothing has spilled yet
+        — spills need retired sessions, which need decode steps), it
+        must never crash the batch."""
+        self._tier_rewarmed = True
+        try:
+            self.pkv = self._copy_block(self.pkv, jnp.int32(0),
+                                        jnp.int32(0))
+            self.pkv = self.mirror.warmup(self.pkv)
+        except Exception as e:  # noqa: BLE001 — tier off, serving continues
+            print(f"⚠️ host KV tier disabled: steady-sharding transfer "
+                  f"re-warm failed ({type(e).__name__}: {e})", flush=True)
+            self.pool.spill_fn = None
+            self.pool.host_drop_fn = None
+            self.pool.host_room_fn = None
+            self.pool.n_host_blocks = 0
+            self.pool._host_free.clear()
+            self.mirror = None
+            self._m_host_total.set(0)
+
+    def _exec_spill(self, devs: list[int], hosts: list[int]) -> bool:  # dlint: owner=loop-thread
+        """The pool's ``spill_fn``: one batched device→host copy moving
+        the LRU cached blocks ``devs`` into the mirror's ``hosts`` lanes.
+        Any failure — the ``spill`` failpoint or a real transfer error —
+        returns False, and the pool falls back to the pre-tier
+        drop-evict contract (content lost, allocation proceeds): a
+        broken host tier costs resume work, never availability."""
+        if not self.mirror.has_room():
+            # chunk-accounted budget full (fragmented chunks alive on a
+            # few lanes): capacity loss, never an overshoot — the pool
+            # drop-evicts exactly as if the tier were off
+            self.flight.note("spill_failed", reason="host_budget_full",
+                             n_blocks=len(devs))
+            return False
+        t0 = telemetry.now_ns()
+        try:
+            failpoints.fire("spill")
+            self.mirror.store(self.pkv, devs, hosts)
+        except Exception as e:  # noqa: BLE001 — degrade to drop-evict
+            self.flight.note("spill_failed", reason=type(e).__name__,
+                             n_blocks=len(devs))
+            return False
+        ms = (telemetry.now_ns() - t0) / 1e6
+        self._m_spill_blocks.inc(len(devs))
+        self._m_spill_bytes.inc(len(devs) * self._block_bytes)
+        self._m_spill_ms.inc(ms)
+        self.flight.note("spill", n_blocks=len(devs), ms=round(ms, 3))
+        return True
+
+    def _rollback_pagein(self, adm: "_Admission") -> None:  # dlint: owner=loop-thread
+        """Undo every UNcopied page-in pair of ``adm`` — THE one rollback
+        for both failure paths (a failed restore in :meth:`_exec_pagein`
+        and a cancelled admission in :meth:`abort_admit`): the staged
+        device blocks leave ``_seq_bids`` (they were never content-
+        carrying), a CoW whose source never materialized is cancelled,
+        and ``abort_pagein`` frees the devices and restores the host
+        pins — content intact and registered for the next attempt."""
+        uncopied = list(adm.pagein)
+        adm.pagein = []
+        if not uncopied:
+            return
+        pair_devs = {dev for _, dev in uncopied}
+        self._seq_bids[adm.slot] = [b for b in self._seq_bids[adm.slot]
+                                    if b not in pair_devs]
+        if adm.cow_release in pair_devs:
+            adm.cow_release = 0
+            adm.cow = None  # its source never materialized
+        self.pool.abort_pagein(uncopied)
+
+    def _exec_pagein(self, adm: "_Admission") -> None:  # dlint: owner=loop-thread
+        """Drain one SPILL_BATCH batch of ``adm``'s pending page-in pairs:
+        restore the host copies into the freshly allocated device blocks
+        and commit the rebind. Failure (the ``pagein`` failpoint or a
+        real transfer error) rolls back every UNcopied pair — host
+        content stays intact and registered for a retry — and raises
+        :class:`PageInError`, which fails only this request (503-shaped);
+        committed earlier batches stay owned via ``_seq_bids`` and are
+        released with the slot. The pool rides a one-element holder
+        through the mirror so a mid-batch failure can never strand the
+        generator on a donated (deleted) buffer."""
+        batch = adm.pagein[:SPILL_BATCH]
+        req = adm.req
+        t0 = telemetry.now_ns()
+        ref = [self.pkv]
+        try:
+            failpoints.fire("pagein")
+            self.mirror.load(ref, batch)
+        except Exception as e:
+            self.pkv = ref[0]  # whatever scatters landed, stay live
+            self._rollback_pagein(adm)
+            self._update_block_gauges()
+            raise PageInError(
+                f"KV page-in failed for request {req.rid}: "
+                f"{type(e).__name__}: {e}") from e
+        self.pkv = ref[0]
+        self.pool.commit_pagein(batch)
+        adm.pagein = adm.pagein[len(batch):]
+        t1 = telemetry.now_ns()
+        ms = (t1 - t0) / 1e6
+        req.ms_pagein += ms
+        self._m_pagein_blocks.inc(len(batch))
+        self._m_pagein_bytes.inc(len(batch) * self._block_bytes)
+        self._m_pagein_ms.inc(ms)
+        self.flight.note("pagein", req.rid, slot=adm.slot,
+                         n_blocks=len(batch), ms=round(ms, 3))
+        telemetry.tracer().emit(req.rid, "pagein", t0, t1, slot=adm.slot,
+                                n_tokens=len(batch) * self.block_size)
+        self._update_block_gauges()
 
     def _worst_case_blocks(self, prompt_len: int, max_tokens: int) -> int:
         """Admission price in blocks: every position the request could
@@ -1234,7 +1435,14 @@ class PagedGenerator(_GeneratorCore):
         outstanding worst-case growth must cover this request's own
         worst case — admission never over-commits the pool, so organic
         mid-decode exhaustion cannot happen (only injected exhaustion
-        and early-retire slack remain)."""
+        and early-retire slack remain). With the host tier on, the
+        cached share of ``free_blocks()`` is RECLAIMABLE rather than
+        disposable capacity — allocating over it spills the cold
+        content to host instead of dropping it, so saying yes here
+        costs idle sessions a page-in at resume, not their KV; the
+        worst-case price already covers the device blocks a
+        prefix-matched (possibly host-resident) prompt pages back
+        into."""
         return (self.pool.free_blocks() - sum(self._reserve)
                 >= self._worst_case_blocks(len(req.prompt_ids),
                                            req.max_tokens))
@@ -1258,24 +1466,69 @@ class PagedGenerator(_GeneratorCore):
         t_begin = telemetry.now_ns()  # the "admit" span: block bookkeeping
         rest = ids[:-1]
         shared, n_tok, cow_src, cow_r = self.pool.match_prefix(rest)
+        # KV tier: matched blocks may be HOST-resident (a resumed /
+        # prefix-matched session whose cold blocks spilled under
+        # pressure). Stage their page-in NOW — device blocks allocated
+        # atomically, same exhaustion→requeue contract — but defer the
+        # copies (and everything depending on the restored content: the
+        # CoW block copy, the column gather) to continue_admit, which
+        # drains one batch per tick so a long resume interleaves with
+        # bystander decode steps instead of stalling one tick.
+        cow_wanted = cow_src is not None and cow_r > 0
+        host_need = [b for b in shared if self.pool.is_host(b)]
+        cow_host = cow_wanted and self.pool.is_host(cow_src)
+        if cow_host:
+            host_need.append(cow_src)
+        pairs: list[tuple[int, int]] = []
         bids: list[int] = []
+        pinned: list[int] = []  # device shares taken before bids exist
+        cow_exec: tuple | None = None
+        cow_release = 0
         try:
+            # pin every DEVICE-resident matched block FIRST: the page-in
+            # (and CoW/growth) allocations below resolve pressure against
+            # the cached LRU, and an unpinned match sitting there could
+            # be spilled out (rebound to host — its dev id recycled as
+            # someone else's block) or drop-evicted (then share() raises)
+            # right out from under this admission. refcount >= 1 makes a
+            # block untouchable by either path — the pre-tier code had
+            # this property implicitly because share() ran before any
+            # alloc.
             for b in shared:
-                self.pool.share(b)
-                bids.append(b)
+                if not self.pool.is_host(b):
+                    self.pool.share(b)
+                    pinned.append(b)
+            if cow_wanted and not cow_host:
+                self.pool.share(cow_src)  # pin across ALL allocs below
+                pinned.append(cow_src)
+            if host_need:
+                pairs = self.pool.begin_pagein(host_need)
+            devmap = dict(pairs)
+            for b in shared:
+                # paged-in blocks carry rc 1 from begin_pagein; device
+                # ones carry the pin taken above
+                bids.append(devmap.get(b, b))
             reused = n_tok
-            if cow_src is not None and cow_r > 0:
+            if cow_wanted:
                 # copy-on-write: the partially-matching block cannot be
                 # shared (this sequence will overwrite rows >= cow_r), so
                 # copy it physically and reuse its first cow_r rows
-                self.pool.share(cow_src)  # pin across the alloc/eviction
-                try:
+                if cow_host:
+                    src = devmap[cow_src]  # rc 1 held; release post-copy
                     dst = self.pool.alloc()
-                finally:
-                    self.pool.release(cow_src)
-                bids.append(dst)
-                self.pkv = self._copy_block(self.pkv, jnp.int32(cow_src),
-                                            jnp.int32(dst))
+                    bids.append(dst)
+                    cow_exec, cow_release = (src, dst), src
+                else:
+                    try:
+                        dst = self.pool.alloc()
+                    finally:
+                        # copy next, so the pin can drop now (parks the
+                        # source back in the cached LRU on rc 0)
+                        self.pool.release(cow_src)
+                        pinned.remove(cow_src)
+                    bids.append(dst)
+                    self.pkv = self._copy_block(self.pkv, jnp.int32(cow_src),
+                                                jnp.int32(dst))
                 reused += cow_r
             while len(bids) < -(-len(rest) // self.block_size):
                 bids.append(self.pool.alloc())
@@ -1284,14 +1537,26 @@ class PagedGenerator(_GeneratorCore):
             # gather/scatter round-trip entirely — THE hot path of
             # repeated system prompts, where reuse must mean zero device
             # work beyond the one CoW copy
-            col = self._exec_take(bids) if reused < len(rest) else None
+            need_take = reused < len(rest)
+            col = (self._exec_take(bids)
+                   if need_take and not pairs else None)
         except Exception as e:  # noqa: BLE001 — atomic rollback, re-raised
             # ANY failure before the slot owns the blocks (exhaustion, a
             # device error in the CoW copy or the column gather) releases
-            # everything taken — a leaked refcount would shrink the pool
-            # forever
+            # every reference taken EXACTLY once — a leaked refcount
+            # would shrink the pool forever. The pinned list covers the
+            # device shares (whether or not they made it into bids);
+            # paged-in devices roll back through abort_pagein (which
+            # also restores the host pins); fresh blocks are whatever
+            # remains in bids.
+            pair_devs = {dev for _, dev in pairs}
             for b in bids:
+                if b not in pair_devs and b not in pinned:
+                    self.pool.release(b)
+            for b in pinned:
                 self.pool.release(b)
+            if pairs:
+                self.pool.abort_pagein(pairs)
             if isinstance(e, BlockPoolExhausted):
                 telemetry.registry().counter(
                     telemetry.KV_BLOCK_EXHAUSTION).inc()
@@ -1309,6 +1574,10 @@ class PagedGenerator(_GeneratorCore):
         # attend to. Prefill runs over a locally-built table instead.
         self.tables[slot, :] = self.pool.NULL
         adm = _Admission(req=req, slot=slot, col=col, reused=reused)
+        adm.pagein = pairs
+        adm.cow = cow_exec
+        adm.cow_release = cow_release
+        adm.need_take = col is None and need_take
         adm.pos = reused  # prefill resumes after the reused prefix
         # paged-lifecycle span: the admission's block match/share/alloc +
         # column gather work (n_tokens = prefix positions reused)
@@ -1349,10 +1618,33 @@ class PagedGenerator(_GeneratorCore):
             return col
 
     def continue_admit(self, adm: "_Admission") -> bool:  # dlint: owner=loop-thread
-        """One prefill chunk over the gathered column; commit scatters it
-        back through the block table (shared-prefix entries redirected to
-        the null block — a shared block is never a write target) and
-        registers the prompt's blocks for future sharing."""
+        """One admission step: drain a page-in batch (KV tier, resumed
+        sessions — one SPILL_BATCH restore per tick so bystander decode
+        interleaves), then the deferred CoW copy / column gather once the
+        content is resident, then one prefill chunk over the gathered
+        column; commit scatters it back through the block table
+        (shared-prefix entries redirected to the null block — a shared
+        block is never a write target) and registers the prompt's blocks
+        for future sharing."""
+        if adm.pagein:
+            self._exec_pagein(adm)  # raises PageInError on failure
+            if adm.pagein:
+                return False  # more batches: keep interleaving
+        if adm.cow is not None:
+            # the deferred copy-on-write block copy: its source is a
+            # paged-in block, resident only now
+            src, dst = adm.cow
+            self.pkv = self._copy_block(self.pkv, jnp.int32(src),
+                                        jnp.int32(dst))
+            adm.cow = None
+            if adm.cow_release:
+                # drop our page-in reference: the source parks in the
+                # (device) cached LRU, registered and shareable again
+                self.pool.release(adm.cow_release)
+                adm.cow_release = 0
+        if adm.need_take:
+            adm.col = self._exec_take(self._seq_bids[adm.slot])
+            adm.need_take = False
         rest = adm.req.prompt_ids[:-1]
         if adm.pos < len(rest):
             n_b = self.eng._prefill_chunk_size(len(rest) - adm.pos)
@@ -1419,7 +1711,15 @@ class PagedGenerator(_GeneratorCore):
         will never commit. Safe in every abort window: blocks this
         admission allocated fresh are unregistered (they free outright),
         shared/CoW sources just drop the extra reference — registered
-        contents stay valid for other sequences."""
+        contents stay valid for other sequences. KV tier: page-in pairs
+        whose copies never ran roll back through
+        :meth:`_rollback_pagein` (host content stays registered for the
+        next resume attempt); a paged-in CoW source we still hold
+        releases into the cached LRU."""
+        self._rollback_pagein(adm)
+        if adm.cow_release:
+            self.pool.release(adm.cow_release)
+            adm.cow_release = 0
         self._release_blocks(adm.slot)
 
     def reset_state(self) -> None:  # dlint: owner=loop-thread
@@ -1432,6 +1732,8 @@ class PagedGenerator(_GeneratorCore):
         self._n_shared = [0] * self.n_slots
         self._reserve = [0] * self.n_slots
         self.pool.reset()
+        if self.mirror is not None:
+            self.mirror.drop_all()  # host buffers follow the pool's reset
         self.tables[:, :] = self.pool.NULL
         self.pos[:] = 0
         self.next_token[:] = 0
@@ -1519,6 +1821,8 @@ class PagedGenerator(_GeneratorCore):
                     jnp.asarray(coins), self._poison())
             nxt, nf = np.asarray(nxt), np.asarray(nf)
         ms = (time.perf_counter() - t0) * 1000.0
+        if not self._tier_rewarmed:
+            self._tier_rewarm()
         self._attrib_decode(active, ms)
         poisoned = self._handle_nonfinite(active, nf)
         emitted = 0
@@ -1599,6 +1903,8 @@ class PagedGenerator(_GeneratorCore):
             out = np.asarray(out)
             nf = np.asarray(nf)
         ms = (time.perf_counter() - t0) * 1000.0
+        if not self._tier_rewarmed:
+            self._tier_rewarm()
         self._attrib_verify(active, ms)
         if drafted:
             self._tm.counter(telemetry.SPEC_DRAFT_TOKENS).inc(
@@ -2065,6 +2371,9 @@ class BatchScheduler:
                     break
                 except Exception as e:  # noqa: BLE001 — reject, don't wedge
                     req.error = f"{type(e).__name__}: {e}"
+                    # a failed KV page-in is a SERVER-side failure (the
+                    # host tier broke, not the request) — 503-shaped
+                    req.server_error = isinstance(e, PageInError)
                     self.flight.note("reject", req.rid,
                                      reason=type(e).__name__)
                     req.done.set()
@@ -2118,6 +2427,9 @@ class BatchScheduler:
                 self.gen.abort_admit(adm)
                 telemetry.registry().counter(telemetry.RETIRES).inc()
                 adm.req.error = f"{type(e).__name__}: {e}"
+                # a failed KV page-in fails ONLY the resuming request,
+                # 503-shaped — bystander slots keep decoding untouched
+                adm.req.server_error = isinstance(e, PageInError)
                 self.flight.note("reject", adm.req.rid,
                                  reason=type(e).__name__)
                 adm.req.done.set()

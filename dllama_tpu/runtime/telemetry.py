@@ -76,6 +76,15 @@ KV_BLOCKS_TOTAL = "dllama_kv_blocks_total"
 KV_BLOCKS_USED = "dllama_kv_blocks_used"
 KV_BLOCKS_SHARED = "dllama_kv_blocks_shared"
 KV_BLOCK_EXHAUSTION = "dllama_kv_block_exhaustion_total"
+
+KV_BLOCKS_HOST_TOTAL = "dllama_kv_blocks_host_total"
+KV_BLOCKS_HOST_USED = "dllama_kv_blocks_host_used"
+KV_SPILL_BLOCKS = "dllama_kv_spill_blocks_total"
+KV_SPILL_BYTES = "dllama_kv_spill_bytes_total"
+KV_SPILL_MS = "dllama_kv_spill_ms_total"
+KV_PAGEIN_BLOCKS = "dllama_kv_pagein_blocks_total"
+KV_PAGEIN_BYTES = "dllama_kv_pagein_bytes_total"
+KV_PAGEIN_MS = "dllama_kv_pagein_ms_total"
 # fault tolerance (runtime/serving.py, runtime/failpoints.py)
 REQUESTS_SHED = "dllama_requests_shed_total"
 REQUEST_TIMEOUTS = "dllama_request_timeouts_total"
@@ -233,6 +242,29 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "found no free/evictable block and degraded to queueing (or "
           "failed that one request 503-shaped mid-decode), never a "
           "crash"),
+    _spec(KV_BLOCKS_HOST_TOTAL, "gauge",
+          "Host-tier KV mirror capacity in blocks (--kv-host-blocks "
+          "through hbm.fit_host_pool; 0 = tiering off)"),
+    _spec(KV_BLOCKS_HOST_USED, "gauge",
+          "Host-tier blocks holding spilled cold KV (registered, "
+          "page-in-able; never live/refcounted)"),
+    _spec(KV_SPILL_BLOCKS, "counter",
+          "Cold KV blocks spilled device->host under allocation "
+          "pressure (batched block-granular copies; content survives "
+          "for page-in instead of drop-evicting)"),
+    _spec(KV_SPILL_BYTES, "counter",
+          "Bytes of KV moved device->host by spills"),
+    _spec(KV_SPILL_MS, "counter",
+          "Wall ms spent dispatching spill copies (the transfers "
+          "themselves run async, overlapped with decode ticks)"),
+    _spec(KV_PAGEIN_BLOCKS, "counter",
+          "Spilled KV blocks paged host->device at admission for "
+          "resumed / prefix-matched sessions"),
+    _spec(KV_PAGEIN_BYTES, "counter",
+          "Bytes of KV moved host->device by page-ins"),
+    _spec(KV_PAGEIN_MS, "counter",
+          "Wall ms of page-in batches (also the per-request `pagein` "
+          "TTFT attribution phase, dllama_ttft_attrib_ms)"),
     _spec(REQUESTS_SHED, "counter",
           "Requests rejected at admission because the queue was full "
           "(HTTP 429 load shedding)"),
@@ -600,8 +632,11 @@ def registry() -> Registry:
 # * ``verify`` — one speculative verify dispatch.
 # * ``requeue`` — an instant marker: admission found no KV blocks and
 #   the request went back to the queue head.
+# * ``pagein`` — one host→device page-in batch restoring a resumed
+#   session's spilled KV blocks during admission (the KV tier,
+#   runtime/kvblocks.py; also a TTFT attribution phase).
 PHASES = ("queue", "admit", "prefill", "prefill_chunk", "decode", "verify",
-          "requeue")
+          "requeue", "pagein")
 
 
 class SpanTracer:

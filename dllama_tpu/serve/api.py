@@ -1017,6 +1017,17 @@ def run_api_server(args) -> int:
             print(f"🕸️ paged KV: {pool.n_blocks - 1} blocks × "
                   f"{pool.block_size} rows (block-priced admission, "
                   f"block-level prefix sharing)")
+            if pool.n_host_blocks:
+                mirror = state.sched.gen.mirror
+                print(f"🕸️ tiered KV memory: {pool.n_host_blocks} host "
+                      f"blocks ({mirror.kind or 'numpy host buffers'}) — "
+                      f"cold blocks spill under pressure, resumed "
+                      f"sessions page back in "
+                      f"(dllama_kv_spill/pagein_* metrics)")
+            elif getattr(engine, "kv_host_blocks", 0):
+                print("⚠️ tiered KV memory requested but the host tier "
+                      "came up empty (budget or transfer warmup) — "
+                      "serving untiered")
         if engine.spec_lookup:
             paged = bool(getattr(engine, "kv_block_size", 0))
             print(f"🕸️ speculative serving: verify K={engine.spec_lookup} "

@@ -109,6 +109,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "block-level sharing + copy-on-write. N must be a "
                         "power of two tiling the padded context; 0 (the "
                         "default) keeps the dense slot pool")
+    p.add_argument("--kv-host-blocks", type=int, default=0, metavar="N",
+                   help="with --kv-block-size: tiered KV memory — a "
+                        "host-DRAM mirror pool of up to N blocks "
+                        "(runtime/kvblocks.py). Under allocation "
+                        "pressure, cold cached blocks (idle sessions' "
+                        "KV) spill device->host in batched block copies "
+                        "instead of dropping; a resumed/prefix-matched "
+                        "session pages them back in at admission, "
+                        "bit-exact. Sized against the host DRAM budget "
+                        "(hbm.fit_host_pool; DLLAMA_HOST_KV_BYTES "
+                        "overrides). 0 (the default) = tiering off")
     p.add_argument("--comm-overlap", default="off", metavar="{off,auto,N}",
                    help="compute/communication overlap for the two per-"
                         "layer tp partial merges (parallel/qcollectives): "
@@ -459,6 +470,7 @@ def make_engine(args, multihost: bool | None = None) -> InferenceEngine:
         spec_lookup=getattr(args, "spec_lookup", 0),
         kv_dtype=getattr(args, "kv_dtype", "auto"),
         kv_block_size=getattr(args, "kv_block_size", 0),
+        kv_host_blocks=getattr(args, "kv_host_blocks", 0),
         comm_overlap=getattr(args, "comm_overlap", "off"),
         profile_split=getattr(args, "profile_split", False),
         verify_weights=getattr(args, "verify_weights", False),

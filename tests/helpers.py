@@ -128,37 +128,46 @@ def byte_vocab_tokenizer() -> tfile.TokenizerData:
 
 
 def pinned_host_probe():
-    """Probe (once per process) whether this jaxlib can actually place
-    arrays in ``pinned_host`` memory — the capability the offload weight
-    path requires. Some jaxlib/CPU builds expose only ``unpinned_host``
-    and fail at sharding construction; offload tests skip with the
-    probe's reason instead of failing (the path itself is untouched)."""
-    global _PINNED_HOST_PROBE
-    if _PINNED_HOST_PROBE is None:
-        try:
-            import jax
-            import jax.numpy as jnp
+    """Probe (once per process) which host memory kind this jaxlib can
+    actually place arrays in: ``("pinned_host", "")`` when real pinned
+    host memory works (the capability the offload weight path requires),
+    falling back to ``("unpinned_host", reason)`` on builds that expose
+    only that kind (CPU jaxlib — it IS host DRAM there, so the KV-tier
+    spill/page-back tests exercise the real transfer path instead of
+    capability-skipping), and ``(None, reason)`` when neither places.
+    ``reason`` records why the stronger kind(s) failed. Delegates to the
+    runtime's own CAPABILITY probe (``kvblocks.probe_host_memory_kind``
+    — deliberately NOT the env-overridable ``host_memory_kind``: a
+    forced serving knob like ``DLLAMA_KV_HOST_KIND=pinned_host`` must
+    never flip capability-gated tests from skip to fail), so the tests
+    and the serving tier can never disagree about what the backend can
+    do."""
+    from dllama_tpu.runtime.kvblocks import probe_host_memory_kind
 
-            dev = jax.local_devices()[0]
-            s = jax.sharding.SingleDeviceSharding(dev,
-                                                  memory_kind="pinned_host")
-            x = jax.device_put(jnp.zeros((8,), jnp.float32), s)
-            jax.block_until_ready(x)
-            _PINNED_HOST_PROBE = (True, "")
-        except Exception as e:  # noqa: BLE001 — any failure means "unsupported here"
-            _PINNED_HOST_PROBE = (False, f"{type(e).__name__}: {e}")
-    return _PINNED_HOST_PROBE
-
-
-_PINNED_HOST_PROBE = None
+    return probe_host_memory_kind()
 
 
 def require_pinned_host():
     """``pytest.skip`` (with the probe's reason) when this jaxlib cannot
-    place arrays in pinned_host memory."""
+    place arrays in pinned_host memory specifically (the offload weight
+    path's requirement — an unpinned fallback is not enough there)."""
     import pytest
 
-    ok, reason = pinned_host_probe()
-    if not ok:
+    kind, reason = pinned_host_probe()
+    if kind != "pinned_host":
         pytest.skip(f"jaxlib pinned_host unsupported on this backend: "
                     f"{reason}")
+
+
+def require_host_memory() -> str:
+    """``pytest.skip`` only when NO host memory kind places at all —
+    the KV-tier tests run the real spill/page-back path on whatever kind
+    the backend offers (``unpinned_host`` on the CPU tier). Returns the
+    usable kind."""
+    import pytest
+
+    kind, reason = pinned_host_probe()
+    if kind is None:
+        pytest.skip(f"no jax host memory kind places on this backend: "
+                    f"{reason}")
+    return kind

@@ -802,6 +802,136 @@ def test_kv_alloc_exhaustion_dump_names_victim_and_tick_decisions(
         flightrec.recorder().reset()
 
 
+# -- spill / pagein: the tiered-KV failure contract (ISSUE 15) ---------------
+
+
+PATHS_CHAOS = {}
+
+
+@pytest.fixture(scope="module")
+def tiered_chaos_engine(tmp_path_factory):
+    d = tmp_path_factory.mktemp("chaos_tiered")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(29)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    PATHS_CHAOS["m"], PATHS_CHAOS["t"] = str(mpath), str(tpath)
+    return InferenceEngine(str(mpath), str(tpath), tp=1, temperature=0.0,
+                           seed=3, kv_block_size=16, kv_host_blocks=64)
+
+
+def _session(i):
+    return "".join(chr(97 + (i + j) % 26) for j in range(33))
+
+
+def _tick_until(sched, req, n=800):
+    for _ in range(n):
+        sched._tick()
+        if req.done.is_set():
+            return
+    raise AssertionError("request never finished")
+
+
+def test_spill_failpoint_degrades_to_drop_evict_contract(
+        tiered_chaos_engine):
+    """A failing spill must DEGRADE to the pre-tier contract: the cold
+    block is dropped instead of spilled, allocation proceeds, every
+    request completes, nothing crashes — and once the failpoint clears,
+    spilling resumes on the live scheduler."""
+    reg = tm.registry()
+    fired = reg.counter(tm.FAILPOINTS_FIRED)
+    spill = reg.counter(tm.KV_SPILL_BLOCKS)
+    crashes = reg.counter(tm.SCHEDULER_CRASHES)
+    f0, s0, c0 = fired.total(name="spill"), spill.total(), crashes.total()
+    fp.arm("spill", "raise")
+    sched = BatchScheduler(tiered_chaos_engine, n_slots=2,
+                           _start_thread=False)
+    try:
+        # enough idle sessions to overflow the 16-block device pool
+        for i in range(10):
+            r = sched.submit(_enc(tiered_chaos_engine, _session(i)), 4,
+                             stop_on_eos=False)
+            _tick_until(sched, r)
+            assert r.error is None, r.error
+        assert fired.total(name="spill") > f0, "pressure must hit the site"
+        assert spill.total() == s0, "a failed spill must not count blocks"
+        assert reg.gauge(tm.KV_BLOCKS_HOST_USED).value() == 0
+        assert crashes.total() == c0  # degrade, never a crash
+        # recovery: disarm -> the next pressure wave spills for real
+        fp.registry().clear()
+        for i in range(10, 16):
+            r = sched.submit(_enc(tiered_chaos_engine, _session(i)), 4,
+                             stop_on_eos=False)
+            _tick_until(sched, r)
+            assert r.error is None, r.error
+        assert spill.total() > s0
+        assert reg.gauge(tm.KV_BLOCKS_HOST_USED).value() > 0
+    finally:
+        fp.registry().clear()
+        sched.close()
+
+
+def test_pagein_failpoint_fails_only_resumer_503_shaped(
+        tiered_chaos_engine):
+    """A failing page-in fails ONLY the resuming request — 503-shaped
+    (``server_error``), the error naming the page-in — while a bystander
+    mid-decode keeps its exact transcript; the host copies stay intact,
+    so the SAME resume succeeds once the failpoint clears."""
+    sched = BatchScheduler(tiered_chaos_engine, n_slots=2,
+                           _start_thread=False)
+    try:
+        # idle wave on fresh prompts for this test, forcing spills
+        for i in range(20, 30):
+            r = sched.submit(_enc(tiered_chaos_engine, _session(i)), 4,
+                             stop_on_eos=False)
+            _tick_until(sched, r)
+        ids0 = _enc(tiered_chaos_engine, _session(20))
+        assert any(sched.gen.pool.is_host(b)
+                   for b in sched.gen.pool.match_prefix(ids0[:-1])[0]), \
+            "the resumed session must have spilled"
+        # oracles: ONE fresh engine per prompt (a reused engine's
+        # NaiveCache shifts the second prompt's prefill chunking — the
+        # documented ulp-flips-become-token-flips hazard)
+        solo = InferenceEngine(PATHS_CHAOS["m"], PATHS_CHAOS["t"], tp=1)
+        by_want = solo.generate("hello world", 8, stop_on_eos=False).tokens
+        solo.close()
+        resume_prompt = _session(20) + " back"
+        solo = InferenceEngine(PATHS_CHAOS["m"], PATHS_CHAOS["t"], tp=1)
+        res_want = solo.generate(resume_prompt, 6, stop_on_eos=False).tokens
+        solo.close()
+
+        bystander = sched.submit(_enc(tiered_chaos_engine, "hello world"),
+                                 8, stop_on_eos=False)
+        for _ in range(50):
+            sched._tick()
+            if bystander.t_decode:
+                break
+        assert bystander.t_decode and not bystander.done.is_set()
+
+        fp.arm("pagein", "raise", times=1)
+        resume = sched.submit(_enc(tiered_chaos_engine, resume_prompt), 6,
+                              stop_on_eos=False)
+        _tick_until(sched, resume)
+        assert resume.error is not None and "page-in" in resume.error
+        assert resume.server_error, "page-in failure must be 503-shaped"
+        _tick_until(sched, bystander)
+        assert bystander.error is None
+        assert bystander.tokens == by_want, "bystander must be token-intact"
+
+        # host copies survived the failed attempt: the retry succeeds
+        # and stays bitwise equal to the never-spilled solo run
+        fp.registry().clear()
+        retry = sched.submit(_enc(tiered_chaos_engine, resume_prompt), 6,
+                             stop_on_eos=False)
+        _tick_until(sched, retry)
+        assert retry.error is None, retry.error
+        assert retry.tokens == res_want
+    finally:
+        fp.registry().clear()
+        sched.close()
+
+
 def test_step_hang_watchdog_trip_dumps_flight_recorder(tmp_path,
                                                        monkeypatch):
     """ISSUE-7 satellite: a step_hang watchdog trip writes the black-box

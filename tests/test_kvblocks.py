@@ -243,6 +243,313 @@ def test_randomized_refcount_invariants():
 
 
 # ---------------------------------------------------------------------------
+# 1b. Tiered allocator properties (host spill tier; still pure host, no jax —
+#     a stub spill_fn stands in for the device copies)
+# ---------------------------------------------------------------------------
+
+
+def _tiered_pool(n_blocks=4, bs=4, n_host=8):
+    pool = BlockPool(n_blocks, bs, n_host_blocks=n_host)
+    pool.spill_fn = lambda devs, hosts: True
+    return pool
+
+
+def _fill_cached(pool, n, bs=4, base=100):
+    """Register n single-block prompts and retire them -> n cached."""
+    bids = []
+    for i in range(n):
+        b = pool.alloc()
+        pool.register_prompt([b], [base + bs * i + j for j in range(bs)])
+        pool.release(b)
+        bids.append(b)
+    return bids
+
+
+def test_spill_moves_cold_blocks_to_host_instead_of_dropping():
+    pool = _tiered_pool()
+    bids = _fill_cached(pool, 3)
+    fresh = pool.alloc()  # pressure: free list dry, cached spill to host
+    assert fresh in bids  # the device ids recycled
+    assert pool.host_used_blocks() == 3
+    # ALL three prompts still match — under host ids now
+    for i in range(3):
+        sh, n, _, _ = pool.match_prefix([100 + 4 * i + j for j in range(4)])
+        assert n == 4 and len(sh) == 1 and pool.is_host(sh[0]), i
+
+
+def test_pagein_restores_exact_trie_chain():
+    """Page-back restores the exact chain: a two-block chain spilled and
+    paged back matches the same prompt block-for-block, and the partial
+    CoW tail candidacy survives the round trip too."""
+    pool = BlockPool(4, 4, n_host_blocks=8)
+    pool.spill_fn = lambda devs, hosts: True
+    a, b = pool.alloc(), pool.alloc()
+    toks = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]  # 2 full blocks + tail [9,10]
+    c = pool.alloc()
+    pool.register_prompt([a, b, c], toks)
+    for x in (a, b, c):
+        pool.release(x)
+    taken = [pool.alloc() for _ in range(3)]  # spills the whole chain
+    assert pool.host_used_blocks() == 3
+    sh, n, cow, cow_r = pool.match_prefix([1, 2, 3, 4, 5, 6, 7, 8, 9, 99])
+    assert n == 8 and len(sh) == 2 and all(pool.is_host(x) for x in sh)
+    assert cow is not None and pool.is_host(cow) and cow_r == 1
+    for x in taken:
+        pool.release(x)
+    pairs = pool.begin_pagein(sh + [cow])
+    pool.commit_pagein(pairs)
+    sh2, n2, cow2, cow_r2 = pool.match_prefix([1, 2, 3, 4, 5, 6, 7, 8, 9,
+                                               99])
+    assert n2 == 8 and cow_r2 == 1
+    assert [pool.is_host(x) for x in sh2] == [False, False]
+    assert not pool.is_host(cow2)
+    assert sh2 == [dev for _, dev in pairs[:2]] and cow2 == pairs[2][1]
+    # the caller owns rc 1 on each paged-in block (share()-equivalent)
+    for _, dev in pairs:
+        assert pool.refcount(dev) == 1
+
+
+def test_spilled_then_paged_in_blocks_stay_refcount_correct():
+    """A spilled block paged back in and shared by several sequences
+    keeps exact refcounts through the whole cycle (the 'spilled shared
+    blocks stay refcount-correct' invariant)."""
+    pool = _tiered_pool()
+    _fill_cached(pool, 3)
+    fresh = pool.alloc()  # spill everything cached
+    pool.release(fresh)
+    sh, _, _, _ = pool.match_prefix([100, 101, 102, 103])
+    pairs = pool.begin_pagein(sh)
+    pool.commit_pagein(pairs)
+    dev = pairs[0][1]
+    assert pool.refcount(dev) == 1
+    pool.share(dev)
+    pool.share(dev)
+    assert pool.refcount(dev) == 3 and pool.shared_blocks() == 1
+    for _ in range(3):
+        pool.release(dev)
+    assert pool.refcount(dev) == 0
+    # back in the device cached LRU, still matchable
+    sh2, n2, _, _ = pool.match_prefix([100, 101, 102, 103])
+    assert sh2 == [dev] and n2 == 4
+    with pytest.raises(ValueError, match="double free"):
+        pool.release(dev)
+
+
+def test_host_blocks_never_sharable_or_releasable_directly():
+    pool = _tiered_pool()
+    _fill_cached(pool, 3)
+    pool.alloc()  # spill
+    sh, _, _, _ = pool.match_prefix([100, 101, 102, 103])
+    hb = sh[0]
+    assert pool.is_host(hb)
+    with pytest.raises(ValueError, match="host-resident"):
+        pool.share(hb)
+    with pytest.raises(ValueError, match="host-resident"):
+        pool.release(hb)
+
+
+def test_spill_failure_degrades_to_drop_evict():
+    """spill_fn returning False (or raising) falls back to the pre-tier
+    contract: the LRU cached block is dropped and unregistered, nothing
+    crashes, nothing leaks to the host tier."""
+    for mode in ("false", "raise"):
+        pool = BlockPool(4, 4, n_host_blocks=8)
+        if mode == "false":
+            pool.spill_fn = lambda d, h: False
+        else:
+            def _boom(d, h):
+                raise RuntimeError("injected")
+            pool.spill_fn = _boom
+        bids = _fill_cached(pool, 3)
+        fresh = pool.alloc()
+        assert fresh == bids[0]  # LRU dropped, recycled
+        assert pool.host_used_blocks() == 0
+        sh, n, _, _ = pool.match_prefix([100, 101, 102, 103])
+        assert n == 0  # dropped = unregistered, exactly the old behavior
+
+
+def test_host_lru_eviction_drops_for_real_and_notifies():
+    """When the host tier itself fills, ITS LRU drops for good (and the
+    mirror hook is told which lanes died)."""
+    pool = BlockPool(4, 4, n_host_blocks=2)
+    pool.spill_fn = lambda d, h: True
+    dropped = []
+    pool.host_drop_fn = dropped.extend
+    _fill_cached(pool, 3)
+    pool.alloc()  # spill: only 2 host lanes -> 2 spill, 1 drop-evicted
+    assert pool.host_used_blocks() == 2
+    first = [b for b in list(pool._host_cached)]
+    _fill_cached(pool, 2, base=500)
+    pool.alloc()  # second spill wave: host full -> oldest host blocks drop
+    assert dropped and all(pool.is_host(b) for b in dropped)
+    assert dropped[0] == first[0]
+    sh, n, _, _ = pool.match_prefix([100, 101, 102, 103])
+    assert n == 0  # the host-dropped chain is gone for good
+
+
+def test_spill_room_precheck_never_destroys_content_for_refused_spill():
+    """Review regression: when the mirror's chunk budget has no room and
+    the host LRU has nothing to drain, the spill must refuse WITHOUT
+    evicting host content first — destroying idle sessions' KV for a
+    spill that never happens is the exact anti-contract."""
+    pool = BlockPool(4, 4, n_host_blocks=8)
+    pool.spill_fn = lambda d, h: True
+    pool.host_room_fn = lambda: False  # budget full, nothing drainable
+    dropped = []
+    pool.host_drop_fn = dropped.extend
+    _fill_cached(pool, 3)
+    fresh = pool.alloc()  # pressure: spill refused -> drop-evict
+    assert fresh is not None
+    assert pool.host_used_blocks() == 0 and not dropped
+    sh, n, _, _ = pool.match_prefix([100, 101, 102, 103])
+    assert n == 0  # device LRU dropped: the pre-tier contract, no worse
+
+
+def test_spill_room_precheck_drains_host_lru_until_chunk_frees():
+    """The budget-full-on-fragmented-chunks wedge: evicting the host LRU
+    oldest-first frees a chunk (the drop hook fires per victim so the
+    mirror can notice the moment its last lane dies), after which the
+    spill PROCEEDS — the tier keeps cycling instead of refusing
+    forever."""
+    pool = BlockPool(4, 4, n_host_blocks=8)
+    pool.spill_fn = lambda d, h: True
+    chunk_lanes: set = set()  # the fake mirror's one resident chunk
+
+    def drop(victims):
+        chunk_lanes.difference_update(victims)
+    pool.host_drop_fn = drop
+    pool.host_room_fn = lambda: not chunk_lanes
+    bids_a = _fill_cached(pool, 3, base=100)
+    pool.alloc()  # first wave: room ok -> spills the 3 cached blocks
+    assert pool.host_used_blocks() == 3
+    chunk_lanes.update(b for b in pool._host_cached)  # chunk now "live"
+    _fill_cached(pool, 2, base=500)
+    pool.alloc()  # second wave: budget full -> drain host LRU, chunk
+    #               frees, THEN the new cold blocks spill
+    assert pool.host_used_blocks() == 2
+    assert not any(pool.is_host(b) and b in pool._meta
+                   for b in list(chunk_lanes))
+    sh, n, _, _ = pool.match_prefix([500, 501, 502, 503])
+    assert n == 4 and pool.is_host(sh[0])  # the NEW content made it out
+    sh, n, _, _ = pool.match_prefix([100, 101, 102, 103])
+    assert n == 0  # the stale chunk's content paid for it, oldest-first
+
+
+def test_begin_pagein_exhaustion_rolls_back_atomically():
+    pool = _tiered_pool()
+    _fill_cached(pool, 3)
+    pool.alloc()  # spill all three
+    # occupy the remaining device blocks
+    pool.alloc()
+    pool.alloc()
+    sh, _, _, _ = pool.match_prefix([100, 101, 102, 103])
+    sh2, _, _, _ = pool.match_prefix([104, 105, 106, 107])
+    with pytest.raises(BlockPoolExhausted):
+        pool.begin_pagein(sh + sh2)
+    # both host blocks still pinned-in-cache, still matchable
+    for i in range(2):
+        shx, n, _, _ = pool.match_prefix([100 + 4 * i + j for j in range(4)])
+        assert n == 4 and pool.is_host(shx[0])
+    assert pool.used_blocks() == 3  # no leaked device refcount
+
+
+def test_randomized_tiered_invariants():
+    """The randomized suite, tiered: random alloc/share/release/register
+    cycles with a bookkeeping-only spill_fn and random page-ins, against
+    a model of both tiers. Invariants: no logical block is ever device-
+    AND host-live, refcounts exact, the free/cached/live/host partitions
+    stay disjoint and conserve blocks, and every registered prompt keeps
+    matching (from whichever tier) until genuinely dropped."""
+    rng = np.random.default_rng(0x71E2)
+    pool = BlockPool(10, 4, n_host_blocks=6)
+    pool.spill_fn = lambda devs, hosts: True
+    dropped_host: list[int] = []
+    pool.host_drop_fn = dropped_host.extend
+    live: dict[int, int] = {}
+    next_tok = [1000]
+    prompts: dict[int, list[int]] = {}  # bid -> registered tokens (model)
+
+    for step in range(6000):
+        op = rng.integers(0, 5)
+        if op == 0:  # alloc (may spill)
+            try:
+                b = pool.alloc()
+            except BlockPoolExhausted:
+                assert sum(live.values()) > 0
+                continue
+            assert not pool.is_host(b)
+            assert b not in live
+            live[b] = 1
+        elif op == 1 and live:  # share
+            b = int(rng.choice(list(live)))
+            pool.share(b)
+            live[b] += 1
+        elif op == 2 and live:  # release
+            b = int(rng.choice(list(live)))
+            pool.release(b)
+            live[b] -= 1
+            if not live[b]:
+                del live[b]
+        elif op == 3 and live:  # register a fresh 1-block prompt
+            b = int(rng.choice(list(live)))
+            if b not in pool._meta and pool.refcount(b) == 1:
+                toks = [next_tok[0] + i for i in range(4)]
+                next_tok[0] += 4
+                pool.register_prompt([b], toks)
+                prompts[b] = toks
+        elif op == 4:  # page a random host-resident block back in
+            host_live = [b for b in prompts if pool.is_host(b)]
+            if not host_live:
+                continue
+            hb = int(rng.choice(host_live))
+            toks = prompts[hb]
+            try:
+                pairs = pool.begin_pagein([hb])
+            except BlockPoolExhausted:
+                continue
+            pool.commit_pagein(pairs)
+            dev = pairs[0][1]
+            prompts[dev] = prompts.pop(hb)
+            live[dev] = 1
+            sh, n, _, _ = pool.match_prefix(toks)
+            assert sh == [dev] and n == 4
+
+        # model sync (white-box): a spill REBINDS a registration to a
+        # host id (same tokens, new key) and a drop removes it — rebuild
+        # the id->tokens view from the pool's own meta so the match
+        # invariant below checks every surviving registration, wherever
+        # it lives now
+        prompts = {bid: list(meta[2])
+                   for bid, meta in pool._meta.items() if meta[0] == "full"}
+        # invariants ------------------------------------------------------
+        for b, r in live.items():
+            assert pool.refcount(b) == r and not pool.is_host(b)
+        assert pool.used_blocks() == len(live)
+        n_dev_cached = len(pool._cached)
+        assert pool.free_blocks() == len(pool._free) + n_dev_cached
+        assert pool.used_blocks() + pool.free_blocks() == pool.n_blocks - 1
+        # host partition: used lanes = cached host entries; disjoint ids
+        assert pool.host_used_blocks() == len(pool._host_cached)
+        assert all(pool.is_host(b) for b in pool._host_cached)
+        dev_ids = set(pool._free) | set(pool._cached) | set(live)
+        assert not (dev_ids & set(pool._host_cached))
+        # NO logical block in both tiers: every registered bid is either
+        # a device id or a host id, and each meta key appears once
+        for bid in pool._meta:
+            assert (bid in pool._host_cached) == pool.is_host(bid)
+        # every surviving registered prompt still matches from its tier
+        for bid, toks in prompts.items():
+            sh, n, _, _ = pool.match_prefix(toks)
+            assert n == 4 and sh == [bid], (bid, sh, n)
+
+    # drain
+    for b, r in list(live.items()):
+        for _ in range(r):
+            pool.release(b)
+    assert pool.used_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
 # 2. Gather parity: paged_forward ≡ dense forward through a scrambled table
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,402 @@
+"""Tiered KV memory (runtime/kvblocks.py host tier + HostKVMirror via
+runtime/serving.PagedGenerator): host spill and page-back for cold
+paged-KV blocks.
+
+Three tiers of proof:
+
+1. **Transfer round-trip** — a spilled block's device bytes equal the
+   host mirror copy equal the paged-back-in device bytes, bit for bit
+   (the whole tier is copies; any transform would break the resume
+   bit-exactness contract).
+2. **Capacity proof (THE ISSUE-15 acceptance)** — with the device pool
+   deliberately sized below the workload's total KV, a multi-session
+   idle/resume stream completes with zero KV-exhaustion 503s and zero
+   requeues-for-capacity, every resumed session's tokens bitwise equal
+   a never-spilled solo run, ``dllama_kv_spill_blocks_total > 0`` and
+   ``dllama_kv_blocks_host_used > 0`` observed mid-run, and zero
+   post-steady compiles with tiering on (ledger-asserted).
+3. **Attribution** — resumed requests carry a ``pagein`` TTFT phase
+   that sums with the others to wall TTFT; spill/pagein decisions land
+   in the flight-recorder ticks and survive into the Chrome-trace
+   export.
+
+These run the REAL spill/page-back path on the CPU tier through the
+``unpinned_host`` fallback (helpers.pinned_host_probe) instead of
+capability-skipping like the pinned_host-only offload tests must.
+"""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime import flightrec, introspection
+from dllama_tpu.runtime import telemetry as tm
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.runtime.serving import BatchScheduler, PagedGenerator, Request
+
+from helpers import (byte_vocab_tokenizer, require_host_memory,
+                     tiny_header_params, write_tiny_model)
+
+PATHS = {}
+BLOCK = 16
+
+
+@pytest.fixture(scope="module")
+def tiered_engine(tmp_path_factory):
+    d = tmp_path_factory.mktemp("kvtier")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(41)
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     rng)
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    PATHS["m"], PATHS["t"] = str(mpath), str(tpath)
+    return InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=BLOCK,
+                           kv_host_blocks=64)
+
+
+def _enc(engine, text):
+    return engine.tokenizer.encode(text, is_start=True)
+
+
+def _session_text(i: int) -> str:
+    """Distinct 33-char session prompt: >= 2 full 16-row blocks of
+    prefill-built context per session, so ~12 sessions exceed a 16-block
+    device pool several times over."""
+    return "".join(chr(97 + (i + j) % 26) for j in range(33))
+
+
+def _run(sched, req, ticks=800):
+    for _ in range(ticks):
+        sched._tick()
+        if req.done.is_set():
+            return
+    raise AssertionError(f"request {req.rid} never finished")
+
+
+def test_engine_validates_host_blocks_need_block_size(tmp_path_factory):
+    d = tmp_path_factory.mktemp("kvtier_val")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(1))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    with pytest.raises(ValueError, match="--kv-host-blocks"):
+        InferenceEngine(str(mpath), str(tpath), tp=1, kv_host_blocks=8)
+
+
+def test_spill_pagein_roundtrip_is_bit_exact(tiered_engine):
+    """Tier traffic is pure copies: device bytes -> host mirror bytes ->
+    paged-back device bytes, all array-equal. Also pins the satellite
+    contract that the CPU tier really exercises the transfer path (the
+    probe's unpinned_host fallback, not a skip)."""
+    kind = require_host_memory()
+    gen = PagedGenerator(tiered_engine, n_slots=2)
+    assert gen.mirror is not None and gen.mirror.kind == kind
+
+    r = Request(rid=0, prompt_ids=_enc(tiered_engine, _session_text(0)),
+                max_tokens=2, stop_on_eos=False)
+    gen.admit(r, 0)
+    while gen.n_active:
+        gen.step()
+    toks = _session_text(0)
+    sh, n, _, _ = gen.pool.match_prefix(r.prompt_ids[:-1])
+    assert n >= BLOCK and not gen.pool.is_host(sh[0])
+    bid = sh[0]
+    before_k = np.asarray(gen.pkv.k[:, bid]).copy()
+    before_v = np.asarray(gen.pkv.v[:, bid]).copy()
+    assert before_k.any(), "the block must hold real context rows"
+
+    # pressure: drain the free list so the cached blocks spill
+    taken = []
+    while gen.pool._cached:
+        taken.append(gen.pool.alloc())
+    sh2, n2, _, _ = gen.pool.match_prefix(r.prompt_ids[:-1])
+    assert n2 == n and gen.pool.is_host(sh2[0])
+    assert gen.pool.host_used_blocks() > 0
+
+    # the host mirror holds the exact bytes
+    cid, lane = gen.mirror._where[sh2[0]]
+    ch = gen.mirror._chunks[cid]
+    np.testing.assert_array_equal(np.asarray(ch["k"])[:, lane], before_k)
+    np.testing.assert_array_equal(np.asarray(ch["v"])[:, lane], before_v)
+
+    # page back in: device bytes restored bit-exactly under the new id
+    for b in taken[:2]:
+        gen.pool.release(b)
+    pairs = gen.pool.begin_pagein([sh2[0]])
+    ref = [gen.pkv]
+    gen.mirror.load(ref, pairs)
+    gen.pkv = ref[0]
+    gen.pool.commit_pagein(pairs)
+    dev = pairs[0][1]
+    np.testing.assert_array_equal(np.asarray(gen.pkv.k[:, dev]), before_k)
+    np.testing.assert_array_equal(np.asarray(gen.pkv.v[:, dev]), before_v)
+    sh3, n3, _, _ = gen.pool.match_prefix(r.prompt_ids[:-1])
+    assert sh3[0] == dev and n3 == n
+
+
+def test_capacity_proof_idle_resume_stream(tiered_engine):
+    """THE acceptance: 12 idle sessions' KV (~36 blocks) through a
+    16-block device pool + host tier, then resumes — zero exhaustion,
+    zero requeues, resumed transcripts bitwise equal never-spilled solo
+    runs, spill/host-used observed mid-run, ledger-quiet post-steady."""
+    flightrec.recorder().reset()
+    reg = tm.registry()
+    exh0 = reg.counter(tm.KV_BLOCK_EXHAUSTION).total()
+    spill0 = reg.counter(tm.KV_SPILL_BLOCKS).total()
+    pagein0 = reg.counter(tm.KV_PAGEIN_BLOCKS).total()
+    scope = tiered_engine.introspection_scope
+
+    sched = BatchScheduler(tiered_engine, n_slots=2, _start_thread=False)
+    assert sched.gen.pool.n_blocks - 1 == 16  # deliberately < workload KV
+    assert sched.gen.pool.n_host_blocks > 0
+    try:
+        # steady-state warmup: prefill buckets (32/4/8 widths), the paged
+        # step, CoW copy, and the tier transfer programs (init warmup +
+        # post-first-step rewarm) all compile in this wave
+        for i in (0, 1):
+            _run(sched, sched.submit(_enc(tiered_engine, _session_text(i)),
+                                     4, stop_on_eos=False))
+        _run(sched, sched.submit(_enc(tiered_engine, "hello"), 4,
+                                 stop_on_eos=False))
+        _run(sched, sched.submit(
+            _enc(tiered_engine, _session_text(0) + " warm"), 4,
+            stop_on_eos=False))
+        c0 = introspection.ledger().compile_count(scope)
+
+        # idle wave: 10 more sessions, each completing then idling —
+        # their cached blocks exceed the device pool, so cold ones spill
+        for i in range(2, 12):
+            r = sched.submit(_enc(tiered_engine, _session_text(i)), 4,
+                             stop_on_eos=False)
+            _run(sched, r)
+            assert r.error is None, r.error
+        spill_mid = reg.counter(tm.KV_SPILL_BLOCKS).total() - spill0
+        host_used_mid = reg.gauge(tm.KV_BLOCKS_HOST_USED).value()
+        assert spill_mid > 0, "pressure must have spilled cold blocks"
+        assert host_used_mid > 0
+
+        # resumes: each session comes back with its history + new text.
+        # Oracle = a never-spilled fresh solo run of the same prompt.
+        for i in (2, 5, 8, 11):
+            prompt = _session_text(i) + " and then"
+            solo = InferenceEngine(PATHS["m"], PATHS["t"], tp=1)
+            want = solo.generate(prompt, 6, stop_on_eos=False).tokens
+            solo.close()
+            r = sched.submit(_enc(tiered_engine, prompt), 6,
+                             stop_on_eos=False)
+            _run(sched, r)
+            assert r.error is None, r.error
+            assert r.tokens == want, f"resume {i} diverged from solo"
+        assert reg.counter(tm.KV_PAGEIN_BLOCKS).total() > pagein0
+
+        # zero KV-exhaustion 503s / requeues-for-capacity
+        assert reg.counter(tm.KV_BLOCK_EXHAUSTION).total() == exh0
+        events = flightrec.recorder().snapshot()["events"]
+        assert not [e for e in events if e["event"] == "requeue"]
+        # spill + pagein decisions are on the tick record, and the host
+        # occupancy rides the Chrome-trace kv_blocks counter track
+        assert [e for e in events if e["event"] == "spill"]
+        assert [e for e in events if e["event"] == "pagein"]
+        trace = flightrec.to_chrome_trace(flightrec.recorder().snapshot())
+        assert not flightrec.validate_chrome_trace(trace)
+        host_counters = [e for e in trace["traceEvents"]
+                        if e.get("name") == "kv_blocks"
+                        and "host_used" in e.get("args", {})]
+        assert host_counters
+
+        # zero post-steady compiles with tiering on
+        assert introspection.ledger().compile_count(scope) == c0, \
+            "post-steady recompile with the KV tier on"
+    finally:
+        sched.close()
+
+
+def test_resume_carries_pagein_ttft_phase(tiered_engine):
+    """A resumed session's TTFT decomposition has a nonzero ``pagein``
+    phase and the five phases sum to wall TTFT; the phase lands in the
+    ``dllama_ttft_attrib_ms`` histogram and the span ring."""
+    h = tm.registry().histogram(tm.TTFT_ATTRIB_MS)
+    p0 = h.count(phase="pagein")
+    sched = BatchScheduler(tiered_engine, n_slots=2, _start_thread=False)
+    try:
+        # distinct sessions for this test (module counters are shared)
+        texts = [_session_text(13 + i) for i in range(10)]
+        for txt in texts:
+            r = sched.submit(_enc(tiered_engine, txt), 4, stop_on_eos=False)
+            _run(sched, r)
+        # ensure this session's blocks really are host-resident
+        ids0 = _enc(tiered_engine, texts[0])
+        sh, _, cow, _ = sched.gen.pool.match_prefix(ids0[:-1])
+        assert any(sched.gen.pool.is_host(b) for b in sh), \
+            "workload must have spilled the resumed session"
+        r = sched.submit(_enc(tiered_engine, texts[0] + " resume"), 4,
+                         stop_on_eos=False)
+        _run(sched, r)
+        assert r.error is None
+        bd = r.ttft_breakdown()
+        assert bd["pagein_ms"] > 0
+        total = (bd["queue_ms"] + bd["pagein_ms"] + bd["admission_ms"]
+                 + bd["prefill_ms"] + bd["first_decode_ms"])
+        assert abs(total - bd["ttft_ms"]) <= 1e-6 * max(1.0, bd["ttft_ms"])
+        assert h.count(phase="pagein") > p0
+        spans = [s for s in tm.tracer().raw_spans()
+                 if s["phase"] == "pagein" and s["request_id"] == r.rid]
+        assert spans and spans[0]["n_tokens"] > 0
+    finally:
+        sched.close()
+
+
+def test_mixed_tier_resume_under_pressure_pins_matched_blocks(
+        tmp_path_factory):
+    """Review regression: a resume whose match spans BOTH tiers under a
+    bone-dry free list, with the matched DEVICE block sitting at the LRU
+    end of the cached list. begin_admit must pin the device-resident
+    matches BEFORE the page-in's own allocations resolve pressure
+    against the cached LRU — unpinned, the staging drop-evicts the very
+    block the match returned and then recycles its id as a page-in
+    destination, so the later share() silently points the request's
+    table at ANOTHER session's restored content (or, if unrecycled,
+    dies with a spurious 'not shareable' reject). Pinned, the eviction
+    routes to the unmatched cached blocks and the resume completes
+    token-exact."""
+    d = tmp_path_factory.mktemp("kvtier_pin")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(41))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    # host tier of exactly 2 lanes: session A's two FULL blocks spill,
+    # its partial CoW tail block stays device-cached (and, as the LRU
+    # entry, is exactly what an unpinned staging would drop-evict)
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=BLOCK,
+                          kv_host_blocks=2)
+    try:
+        sched = BatchScheduler(eng, n_slots=2, _start_thread=False)
+        gen = sched.gen
+        assert gen.pool.n_host_blocks == 2
+        a_text = _session_text(50)  # 33 ids -> 2 full blocks + 1 tail
+        solo = InferenceEngine(str(mpath), str(tpath), tp=1)
+        want = solo.generate(a_text + " back", 4, stop_on_eos=False).tokens
+        solo.close()
+        for text in (a_text, _session_text(60)):  # A idles first (LRU)
+            r = sched.submit(_enc(eng, text), 2, stop_on_eos=False)
+            _run(sched, r)
+            assert r.error is None
+        # drain the free list, trigger ONE spill (A's two full blocks
+        # fill the 2 host lanes; its tail + B's blocks stay cached on
+        # device), then drain again: free list bone-dry, host tier full
+        taken = []
+        while gen.pool._free:
+            taken.append(gen.pool.alloc())
+        taken.append(gen.pool.alloc())
+        while gen.pool._free:
+            taken.append(gen.pool.alloc())
+        ids_a = _enc(eng, a_text + " back")
+        shared, n, cow, cow_r = gen.pool.match_prefix(ids_a[:-1])
+        # the match spans both tiers: A's second full block (+ tail)
+        # spilled into the 2 host lanes, its first full block stayed
+        # device-cached — and sits at the LRU end of the cached list
+        # (session B's admission CoW-touched it last via the shared BOS)
+        assert any(gen.pool.is_host(b) for b in shared)
+        dev_matched = [b for b in shared if not gen.pool.is_host(b)]
+        assert dev_matched and cow is not None and cow_r > 0
+        assert dev_matched[0] == next(iter(gen.pool._cached)), \
+            "scenario setup: the matched device block must be the LRU"
+        assert not gen.pool._free and gen.pool._cached
+        # the resume: staging must take its device blocks from the
+        # cached LRU — whose OLDEST entry is the matched device block.
+        # The pin must route the eviction to the younger (unmatched)
+        # cached blocks.
+        resume = sched.submit(ids_a[:-1] + [ids_a[-1]], 4,
+                              stop_on_eos=False)
+        _run(sched, resume)
+        assert resume.error is None, resume.error
+        assert resume.tokens == want
+        sched.close()
+    finally:
+        eng.close()
+
+
+def test_host_budget_is_chunk_accounted_under_fragmentation(
+        tmp_path_factory):
+    """Review regression: mirror chunks are SPILL_BATCH blocks of host
+    RAM whether or not every lane is live, so the budget is enforced in
+    chunks — under fragmentation (a chunk alive on a few lanes after a
+    partial page-in) a new spill first drains the host LRU until the
+    fragmented chunk frees (oldest content pays, the tier keeps
+    cycling), and resident chunks NEVER exceed the budget."""
+    d = tmp_path_factory.mktemp("kvtier_frag")
+    mpath, tpath = d / "m.m", d / "t.t"
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=96),
+                     np.random.default_rng(41))
+    tfile.write_tfile(tpath, byte_vocab_tokenizer())
+    eng = InferenceEngine(str(mpath), str(tpath), tp=1, kv_block_size=BLOCK,
+                          kv_host_blocks=4)  # exactly ONE chunk of budget
+    try:
+        gen = PagedGenerator(eng, n_slots=2)
+        assert gen.mirror.max_chunks == 1
+        r = Request(rid=0, prompt_ids=_enc(eng, _session_text(70)),
+                    max_tokens=2, stop_on_eos=False)
+        gen.admit(r, 0)
+        while gen.n_active:
+            gen.step()
+        taken = []
+        while gen.pool._free:
+            taken.append(gen.pool.alloc())
+        taken.append(gen.pool.alloc())  # spills A's cold blocks: 1 chunk
+        assert len(gen.mirror._chunks) == 1
+        spilled = [b for b in list(gen.pool._host_cached)]
+        assert spilled
+        # partial page-in fragments the chunk: lanes free pool-side, but
+        # the chunk stays resident on the survivors
+        gen.pool.release(taken.pop())
+        pairs = gen.pool.begin_pagein(spilled[:1])
+        ref = [gen.pkv]
+        gen.mirror.load(ref, pairs)
+        gen.pkv = ref[0]
+        gen.pool.commit_pagein(pairs)
+        gen.pool.release(pairs[0][1])
+        if not gen.pool._host_cached:
+            pytest.skip("chunk fully drained — fragmentation state "
+                        "not reachable with this geometry")
+        assert len(gen.mirror._chunks) == 1  # fragmented, still resident
+        assert gen.pool._host_free, "lanes freed pool-side"
+        # new cold content under pressure: the fragmented chunk's stale
+        # survivors drain (oldest-first) so the chunk frees, the NEW
+        # content spills into a fresh chunk — and the resident count
+        # never exceeds the 1-chunk budget
+        reg = tm.registry()
+        s0 = reg.counter(tm.KV_SPILL_BLOCKS).total()
+        r2 = Request(rid=1, prompt_ids=_enc(eng, _session_text(80)),
+                     max_tokens=2, stop_on_eos=False)
+        gen.admit(r2, 1)
+        while gen.n_active:
+            gen.step()
+        while gen.pool._free:
+            taken.append(gen.pool.alloc())
+        taken.append(gen.pool.alloc())  # pressure again
+        assert len(gen.mirror._chunks) <= 1, "budget overshot by a chunk"
+        # the tier kept cycling: the fragmented chunk drained (freeing
+        # its buffer — lane ids recycle into the fresh chunk) and the
+        # new cold content spilled instead of being refused forever
+        assert reg.counter(tm.KV_SPILL_BLOCKS).total() > s0
+    finally:
+        eng.close()
+
+
+def test_scheduler_crash_reset_clears_host_tier(tiered_engine):
+    """Crash recovery: reset_state forgets the host tier (pool lanes AND
+    mirror buffers) along with everything else — nothing can page in
+    blocks a half-finished dispatch may have corrupted."""
+    gen = PagedGenerator(tiered_engine, n_slots=2)
+    r = Request(rid=0, prompt_ids=_enc(tiered_engine, _session_text(40)),
+                max_tokens=2, stop_on_eos=False)
+    gen.admit(r, 0)
+    while gen.n_active:
+        gen.step()
+    while gen.pool._cached:  # force the cached blocks out to host
+        gen.pool.alloc()
+    assert gen.pool.host_used_blocks() > 0
+    assert gen.mirror._chunks
+    gen.reset_state()
+    assert gen.pool.host_used_blocks() == 0
+    assert not gen.mirror._chunks and not gen.mirror._where
