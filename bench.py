@@ -1346,6 +1346,7 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
                          write_tiny_model)
 
     from dllama_tpu.formats import tfile
+    from dllama_tpu.runtime import slo as slo_mod
     from dllama_tpu.runtime import telemetry as tm
     from dllama_tpu.runtime.engine import InferenceEngine
     from dllama_tpu.serve.api import BatchedApiState, make_handler
@@ -1393,8 +1394,15 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
         urls = [f"http://127.0.0.1:{s.server_address[1]}" for s in servers]
 
         out["phase"] = "scenario_router"
+        # SLO objectives under which the scenario runs: deliberately
+        # loose defaults (CPU-backend-safe — the bench asserts the
+        # observatory machinery, the baseline tracks the numbers)
+        slo_spec = os.environ.get(
+            "DLLAMA_BENCH_SLO",
+            "ttft_p95_ms=30000,itl_p50_ms=1000,shed_rate=0.5")
         fleet = FleetRouter(urls, probe_interval_s=0.2, eject_after=2,
-                            backoff_min_s=0.2, backoff_max_s=1.0)
+                            backoff_min_s=0.2, backoff_max_s=1.0,
+                            slo_objectives=slo_mod.parse_slo(slo_spec))
         router_httpd = ThreadingHTTPServer(("127.0.0.1", 0),
                                            make_router_handler(fleet))
         threading.Thread(target=router_httpd.serve_forever,
@@ -1513,6 +1521,25 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
                 and not up.value(replica=killed):
             time.sleep(0.1)
         out["readmitted"] = bool(up.value(replica=killed))
+        # the SLO observatory's verdict on the run: per-objective
+        # compliance + worst burn, plus the two flat fields the
+        # compare/baseline tools rank (slo_compliance_min: 1.0 = every
+        # objective met, 0.0 = at least one violated; slo_worst_burn:
+        # the hottest error-budget burn across objectives × windows)
+        ev = fleet.slo.evaluate()
+        out["slo"] = {
+            name: {"threshold": rec["threshold"],
+                   "estimate": round(rec["estimate"], 4),
+                   "compliant": rec["compliant"],
+                   "burn": {w: round(b, 3)
+                            for w, b in rec["burn"].items()}}
+            for name, rec in ev["objectives"].items()}
+        out["slo_compliance_min"] = min(
+            (1.0 if rec["compliant"] else 0.0)
+            for rec in ev["objectives"].values())
+        out["slo_worst_burn"] = round(max(
+            max(rec["burn"].values())
+            for rec in ev["objectives"].values()), 3)
         out["phase"] = "done"
         return out
     finally:

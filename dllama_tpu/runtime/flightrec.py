@@ -458,3 +458,126 @@ def validate_chrome_trace(trace: dict, expect_rids=None) -> list[str]:
                 problems.append(f"request {rid}: no phase slice in the "
                                 f"trace")
     return problems
+
+
+# -- fleet timeline join ------------------------------------------------------
+
+
+def fleet_chrome_trace(router_dump: dict,
+                       replica_dumps: dict[str, dict]) -> dict:
+    """Join the router's span ring with each replica's flight dump into
+    one Chrome trace keyed by the fleet request id.
+
+    ``router_dump`` is a ``/debug/fleet`` body (its ``spans`` list holds
+    the RouterSpanRing records: string ``request_id``, ``phase`` from
+    telemetry.ROUTER_PHASES, ``replica``, ``hop``). ``replica_dumps``
+    maps replica name → that replica's ``/debug/flight`` body, whose
+    ``spans`` carry engine-local integer request ids plus the
+    ``fleet``/``hop`` fields the API layer bound, and whose ``events``
+    include the ``fleet_rid`` lifecycle binding (``rid`` = local id,
+    ``reason`` = fleet id, ``hop``); either join path suffices.
+
+    Track layout: pid 1 = the router (tid = hop index, so a retried
+    request's two hops stack as two visible rows), pid 2+i = one process
+    per replica with the usual per-slot threads. Every joined slice
+    carries ``args.request_id`` = the fleet id (a string — flow ids and
+    slice ids must be one type, the validator sorts them); one flow per
+    fleet id chains router and replica slices in timestamp order, so a
+    retried request reads as ONE flow crossing two replica tracks.
+    Replica spans with no fleet binding (direct/local requests) render
+    as slices under a ``local:`` id but contribute no flow. A top-level
+    ``fleetJoin`` summary counts what joined — the offline
+    ``fleettrace`` CLI exits 1 when nothing does. Timestamps are each
+    process's monotonic ns: same-process fleets (tests, bench) share one
+    clock; cross-process dumps keep per-track order but tracks may be
+    mutually offset."""
+    out: list[dict] = []
+    # (ts, dur, pid, tid) per fleet id, to chain the flow afterwards
+    by_fleet: dict[str, list[tuple[float, float, int, int]]] = {}
+
+    def meta(pid, tid, what, name):
+        e = {"ph": "M", "pid": pid, "name": what, "args": {"name": name}}
+        if tid is not None:
+            e["tid"] = tid
+        out.append(e)
+
+    meta(1, None, "process_name", "router")
+    router_spans = router_dump.get("spans") or []
+    for hop in sorted({max(0, int(s.get("hop", 0))) for s in router_spans}
+                      or {0}):
+        meta(1, hop, "thread_name", f"hop {hop}")
+    n_router_ids = len({s["request_id"] for s in router_spans})
+    for s in router_spans:
+        rid = str(s["request_id"])
+        tid = max(0, int(s.get("hop", 0)))
+        ts = s["start_ns"] / 1e3
+        dur = max(0.0, (s["end_ns"] - s["start_ns"]) / 1e3)
+        args = {"request_id": rid, "phase": s["phase"]}
+        for k in ("replica", "hop", "code", "state", "load"):
+            if k in s:
+                args[k] = s[k]
+        out.append({"ph": "X", "pid": 1, "tid": tid, "ts": ts, "dur": dur,
+                    "name": f"{s['phase']}", "cat": "router", "args": args})
+        by_fleet.setdefault(rid, []).append((ts, dur, 1, tid))
+
+    joined_ids: set[str] = set()
+    n_unjoined_spans = 0
+    for i, (name, dump) in enumerate(sorted(replica_dumps.items())):
+        pid = 2 + i
+        meta(pid, None, "process_name", f"replica {name}")
+        # fleet_rid lifecycle events: local int rid -> (fleet id, hop) —
+        # the binding for spans emitted before bind_fleet took effect
+        bind: dict[int, tuple[str, int]] = {}
+        for ev in dump.get("events") or []:
+            if ev.get("event") == "fleet_rid" and ev.get("reason"):
+                bind[ev.get("rid")] = (str(ev["reason"]),
+                                       int(ev.get("hop", 0)))
+        seen_tids: set[int] = set()
+        for s in dump.get("spans") or []:
+            local = s.get("request_id")
+            fleet, hop = (s["fleet"], s.get("hop", 0)) \
+                if "fleet" in s else bind.get(local, (None, 0))
+            tid = _span_tid(s.get("slot", -1))
+            if tid not in seen_tids:
+                seen_tids.add(tid)
+                meta(pid, tid, "thread_name",
+                     "engine" if tid == _NO_SLOT_TID else f"slot {tid}")
+            ts = s["start_ns"] / 1e3
+            dur = max(0.0, (s["end_ns"] - s["start_ns"]) / 1e3)
+            rid = fleet if fleet is not None else f"local:{name}:{local}"
+            args = {"request_id": rid, "phase": s["phase"],
+                    "local_rid": local, "hop": hop,
+                    "n_tokens": s.get("n_tokens", 0)}
+            out.append({"ph": "X", "pid": pid, "tid": tid, "ts": ts,
+                        "dur": dur, "name": f"{s['phase']}",
+                        "cat": "replica", "args": args})
+            if fleet is not None:
+                joined_ids.add(fleet)
+                by_fleet.setdefault(fleet, []).append((ts, dur, pid, tid))
+            else:
+                n_unjoined_spans += 1
+
+    for rid, slices in sorted(by_fleet.items()):
+        slices.sort()
+        if len(slices) == 1:
+            ts, dur, pid, tid = slices[0]
+            out.append({"ph": "s", "pid": pid, "tid": tid, "ts": ts,
+                        "id": rid, "name": "request", "cat": "fleet"})
+            out.append({"ph": "f", "pid": pid, "tid": tid, "ts": ts + dur,
+                        "id": rid, "bp": "e", "name": "request",
+                        "cat": "fleet"})
+            continue
+        for j, (ts, dur, pid, tid) in enumerate(slices):
+            ph = "s" if j == 0 else ("f" if j == len(slices) - 1 else "t")
+            flow = {"ph": ph, "pid": pid, "tid": tid, "ts": ts, "id": rid,
+                    "name": "request", "cat": "fleet"}
+            if ph == "f":
+                flow["bp"] = "e"
+            out.append(flow)
+
+    out.sort(key=lambda e: e.get("ts", -1.0))
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "fleetJoin": {"router_requests": n_router_ids,
+                          "joined": len(joined_ids),
+                          "replicas": len(replica_dumps),
+                          "unjoined_replica_spans": n_unjoined_spans}}

@@ -114,6 +114,13 @@ ROUTER_EJECTS = "dllama_router_ejects_total"
 ROUTER_READMITS = "dllama_router_readmits_total"
 ROUTER_SHED = "dllama_router_shed_total"
 ROUTER_AFFINITY_HITS = "dllama_router_affinity_hits_total"
+ROUTER_TTFT_MS = "dllama_router_ttft_ms"
+ROUTER_CONNECT_MS = "dllama_router_connect_ms"
+ROUTER_RETRY_MS = "dllama_router_retry_ms"
+ROUTER_RETRY_HOPS = "dllama_router_retry_hops_total"
+# SLO observatory (runtime/slo.py, evaluated at the router)
+SLO_COMPLIANCE = "dllama_slo_compliance"
+SLO_BURN_RATE = "dllama_slo_burn_rate"
 
 # HTTP layer (serve/api.py)
 HTTP_REQUESTS = "dllama_http_requests_total"
@@ -403,6 +410,28 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
     _spec(ROUTER_AFFINITY_HITS, "counter",
           "Fleet router: dispatches that landed on their session's "
           "sticky replica (prefix-cache-aware affinity in effect)"),
+    _spec(ROUTER_TTFT_MS, "histogram",
+          "Fleet router: time from request admission to the first "
+          "upstream body byte the router relayed (router-measured TTFT "
+          "— queue + dispatch + replica prefill included)"),
+    _spec(ROUTER_CONNECT_MS, "histogram",
+          "Fleet router: per-hop upstream connect + request-send time "
+          "(one observation per dispatch attempt, retries included)"),
+    _spec(ROUTER_RETRY_MS, "histogram",
+          "Fleet router: wall time burned on failed hops before the "
+          "serving hop (recorded once per retried request)"),
+    _spec(ROUTER_RETRY_HOPS, "counter",
+          "Fleet router: dispatch attempts by hop index (hop=\"0\" first "
+          "attempt, hop=\"1\" retry — the same index the "
+          "X-Dllama-Hop header carries to the replica)"),
+    _spec(SLO_COMPLIANCE, "gauge",
+          "SLO observatory: 1 while the labeled objective currently "
+          "meets its target over the evaluation window, else 0 "
+          "(runtime/slo.py; objectives from --slo)"),
+    _spec(SLO_BURN_RATE, "gauge",
+          "SLO observatory: error-budget burn rate for the labeled "
+          "objective over the labeled sliding window (1.0 = burning "
+          "exactly the budget; >1 exhausts it early)"),
     _spec(HTTP_REQUESTS, "counter",
           "HTTP requests by route and status code"),
     _spec(REQUESTS_IN_FLIGHT, "gauge", "Completions currently executing"),
@@ -490,6 +519,14 @@ class Gauge(_Metric):
     def value(self, **labels) -> float:
         with self._lock:
             return float(self._series.get(_label_key(labels), 0.0))
+
+    def items(self) -> list[tuple[tuple, float]]:
+        """Every ``(label_key, value)`` series, sorted — label keys are
+        the ``(name, value)`` pair tuples ``value(**dict(key))`` accepts
+        back. Lets the --stats line enumerate SLO objectives without
+        knowing the configured set."""
+        with self._lock:
+            return sorted((k, float(v)) for k, v in self._series.items())
 
     def _render(self, out: list[str]) -> None:
         with self._lock:
@@ -638,6 +675,27 @@ def registry() -> Registry:
 PHASES = ("queue", "admit", "prefill", "prefill_chunk", "decode", "verify",
           "requeue", "pagein")
 
+# Router span vocabulary (serve/router.py RouterSpanRing.emit_span) — the
+# fleet-side counterpart of PHASES, closed-world-checked the same way
+# (tools/dlint span-phases). One request's router-side life:
+#
+# * ``rt_queue`` — request receipt → admission decision (the router's
+#   own in-flight gate; shed requests end here).
+# * ``rt_dispatch`` — the dispatch decision: replica pick with the
+#   probe snapshot (load score, state) that justified it.
+# * ``rt_connect`` — one hop's connect + request send → response
+#   headers (per dispatch attempt; a retried request has two).
+# * ``rt_first_byte`` — admission → the first upstream body byte the
+#   router relayed (the router-measured TTFT span).
+# * ``rt_stream`` — first relayed byte → last (the body/SSE relay of
+#   the serving hop).
+# * ``rt_retry`` — one failed hop, dispatch → classified failure (the
+#   wall the retry burned before the serving hop).
+# * ``rt_eject`` — an instant marker: the circuit breaker ejected the
+#   replica this request just failed on.
+ROUTER_PHASES = ("rt_queue", "rt_dispatch", "rt_connect", "rt_first_byte",
+                 "rt_stream", "rt_retry", "rt_eject")
+
 
 class SpanTracer:
     """JSONL span sink + bounded in-memory span ring. One record per
@@ -645,6 +703,9 @@ class SpanTracer:
 
     ``{"request_id": int, "phase": <one of PHASES>,
        "start_ns": int, "end_ns": int, "slot": int, "n_tokens": int}``
+
+    plus optional ``fleet``/``hop`` fields when the request arrived
+    through the fleet router (:meth:`bind_fleet`).
 
     Timestamps are ``time.monotonic_ns`` (durations, not wall clock).
     The file sink is opt-in (``--trace-out``; ``enabled`` is one attribute
@@ -661,6 +722,24 @@ class SpanTracer:
         self._f = None
         self.enabled = False
         self._ring: deque = deque(maxlen=self.RING_SPANS)
+        # engine-local int rid -> (fleet request id, dispatch hop): the
+        # X-Dllama-Request-Id binding the API layer registers so every
+        # span for that request carries the fleet-wide join key
+        self._fleet: dict[int, tuple[str, int]] = {}
+
+    def bind_fleet(self, request_id: int, fleet_id: str,
+                   hop: int = 0) -> None:
+        """Bind an engine-local integer request id to the fleet-wide
+        request id (the router's ``X-Dllama-Request-Id``) and the
+        dispatch hop that delivered it. Every span subsequently emitted
+        for that id — the ring, ``--trace-out`` JSONL, ``/debug/flight``
+        ``spans`` — then carries ``fleet``/``hop`` fields, the join key
+        ``flightrec.fleet_chrome_trace`` groups cross-tier tracks by."""
+        with self._lock:
+            self._fleet[int(request_id)] = (str(fleet_id), int(hop))
+            while len(self._fleet) > self.RING_SPANS * 8:
+                # dicts iterate in insertion order: drop the oldest binding
+                self._fleet.pop(next(iter(self._fleet)))
 
     def configure(self, path: str | None) -> None:
         with self._lock:
@@ -677,6 +756,9 @@ class SpanTracer:
                "start_ns": start_ns, "end_ns": end_ns,
                "slot": slot, "n_tokens": n_tokens}
         with self._lock:
+            bound = self._fleet.get(request_id)
+            if bound is not None:
+                rec["fleet"], rec["hop"] = bound
             self._ring.append(rec)
             if self._f is not None:
                 self._f.write(json.dumps(rec) + "\n")
@@ -798,6 +880,18 @@ def stats_line(reg: Registry | None = None, *,
         parts.append(f"spec={100 * n_acc / n_draft:.0f}%/{int(n_draft)}")
     parts.append(f"ttft_p50={ttft.quantile(0.5):.0f}ms")
     parts.append(f"itl_p50={itl.quantile(0.5):.0f}ms")
+    # SLO observatory (runtime/slo): per-objective compliance + the worst
+    # burn rate across windows, only when --slo armed an evaluator (the
+    # gauges stay unset otherwise and the fragment disappears)
+    slo_g = reg.gauge(SLO_COMPLIANCE)
+    slo_keys = sorted(k for k, _ in slo_g.items())
+    if slo_keys:
+        burn_g = reg.gauge(SLO_BURN_RATE)
+        worst = max((v for _, v in burn_g.items()), default=0.0)
+        marks = "".join("✓" if slo_g.value(**dict(k)) >= 1.0 else "✗"
+                        for k in slo_keys)
+        parts.append(f"slo={marks} burn={worst:.2f}"
+                     + ("!" if worst > 1.0 else ""))
     # TTFT attribution p50s (runtime/flightrec): where first-token time
     # actually went — queue / admission / prefill / first decode
     attrib = reg.histogram(TTFT_ATTRIB_MS)

@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import os
 import queue
+import re
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -104,6 +105,32 @@ RETRY_AFTER_S = {429: 1, 503: 5}
 def backpressure_headers(status: int) -> dict:
     """The shared Retry-After header block for a 429/503 answer."""
     return {"Retry-After": str(RETRY_AFTER_S[status])}
+
+
+# fleet trace identity (serve/router.py is the usual sender): one request
+# id names a request at every tier — the router mints it (or sanitizes a
+# client-supplied one) and stamps the dispatch attempt index, both as
+# headers on every hop. The replica binds them to its engine-local
+# integer rid (telemetry.tracer().bind_fleet + a "fleet_rid" lifecycle
+# event), echoes the id back on its response, and threads it into the
+# opt-in timing block — so a fleet dump joins by one key end to end.
+FLEET_RID_HEADER = "X-Dllama-Request-Id"
+FLEET_HOP_HEADER = "X-Dllama-Hop"
+FLEET_RID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+def fleet_identity(headers) -> tuple[str, int] | None:
+    """Parse the fleet trace headers off a request: ``(fleet_id, hop)``,
+    or None when absent/unsanitary (an out-of-vocabulary id is dropped,
+    never stored — header values go into dumps and logs)."""
+    rid = headers.get(FLEET_RID_HEADER)
+    if not rid or not FLEET_RID_RE.match(rid):
+        return None
+    try:
+        hop = int(headers.get(FLEET_HOP_HEADER) or 0)
+    except ValueError:
+        hop = 0
+    return rid, max(0, hop)
 
 
 class ClientDisconnect(Exception):
@@ -282,8 +309,11 @@ class ApiState:
                     "crashed")
         return True, "ok", "ok"
 
-    def complete(self, body: dict, emit=None) -> dict:
+    def complete(self, body: dict, emit=None, fleet=None) -> dict:
         """Run one chat completion; ``emit(text)`` streams deltas when set.
+        ``fleet`` is the optional ``(fleet_request_id, hop)`` trace
+        identity from :func:`fleet_identity` — bound to this request's
+        engine-local rid so spans and lifecycle events join fleet-wide.
 
         Flow mirrors ApiServer::complete (dllama-api.cpp:363-484): resolve the
         delta prompt against the cache, template + encode, chunked prefill,
@@ -304,6 +334,12 @@ class ApiState:
                     if timeout_s > 0 else 0)
         self._rid += 1
         engine.trace_rid = self._rid  # stamps the engine's prefill span
+        if fleet is not None:
+            # one id from router to kernel: every span and lifecycle
+            # event for this local rid now carries the fleet identity
+            telemetry.tracer().bind_fleet(self._rid, fleet[0], fleet[1])
+            flightrec.recorder().note("fleet_rid", rid=self._rid,
+                                      reason=fleet[0], hop=fleet[1])
         t_req0 = telemetry.now_ns()  # TTFT attribution origin (queue = 0:
         # the single-threaded server has no scheduler queue)
         rt = telemetry.RequestTimer()
@@ -412,6 +448,11 @@ class ApiState:
             flightrec.record_ttft(
                 telemetry.registry().histogram(telemetry.TTFT_ATTRIB_MS), bd)
             timing = {k: round(v, 3) for k, v in bd.items()}
+            if fleet is not None:
+                # the fleet-wide id + the hop that served this attempt:
+                # the timing block names itself in a joined trace
+                timing["request_id"] = fleet[0]
+                timing["hop"] = fleet[1]
             if n_drafted:
                 # single-sequence speculative decode: per-request accept
                 # rate, same field names as the batched timing block
@@ -484,7 +525,7 @@ class BatchedApiState:
     def close(self, drain_s: float = 0.0) -> None:
         self.sched.close(drain_s)
 
-    def complete(self, body: dict, emit=None) -> dict:
+    def complete(self, body: dict, emit=None, fleet=None) -> dict:
         tok = self.engine.tokenizer
         _validate_body(body)
         messages = body["messages"]
@@ -507,6 +548,13 @@ class BatchedApiState:
             stop_on_eos=True,
             timeout_s=timeout_s if timeout_s > 0 else None,
             on_token=lambda t, p: q.put((t, p)))
+        if fleet is not None:
+            # bound AFTER submit (the scheduler assigns the rid there);
+            # the submit span predates the binding, but every later
+            # span — queue, prefill, decode, retire — joins fleet-wide
+            telemetry.tracer().bind_fleet(req.rid, fleet[0], fleet[1])
+            flightrec.recorder().note("fleet_rid", rid=req.rid,
+                                      reason=fleet[0], hop=fleet[1])
 
         gate = _EosGate(tok, _request_stops(self.stop_pieces, body), emit)
         rt = telemetry.RequestTimer()
@@ -573,6 +621,9 @@ class BatchedApiState:
             # twins land in dllama_ttft_attrib_ms / dllama_itl_attrib_ms
             # at first-token / retire)
             out["timing"] = {k: round(v, 3) for k, v in bd.items()}
+            if fleet is not None:
+                out["timing"]["request_id"] = fleet[0]
+                out["timing"]["hop"] = fleet[1]
             out["timing"]["decode_step_ms"] = round(req.ms_decode_steps, 3)
             out["timing"]["preempt_ms"] = round(req.ms_preempt, 3)
             if req.ms_verify:
@@ -640,6 +691,10 @@ def make_handler(state: ApiState):
             print(f"🕸️ {self.address_string()} {fmt % args}")
 
         _counted = False  # whether THIS request hit the telemetry counter
+        # the current request's fleet trace id (echoed on every response
+        # so callers — and the router's own client — can correlate);
+        # reset per request: keep-alive reuses the handler instance
+        _fleet_rid: str | None = None
 
         def _route(self) -> str:
             # route matching and the counter label both ignore the query
@@ -663,6 +718,8 @@ def make_handler(state: ApiState):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self._fleet_rid:
+                self.send_header(FLEET_RID_HEADER, self._fleet_rid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -675,6 +732,7 @@ def make_handler(state: ApiState):
                              "routes": list(_ROUTES)})
 
         def do_GET(self):
+            self._fleet_rid = None  # keep-alive: no stale POST echo
             path = self._route()
             if path == "/v1/models":
                 self._json(200, {"object": "list", "data": [{
@@ -729,8 +787,13 @@ def make_handler(state: ApiState):
                            {"requests": telemetry.tracer().recent_requests()})
             elif path == "/debug/flight":
                 # the flight recorder's live rings: per-tick scheduler
-                # decisions + request lifecycle events (runtime/flightrec)
-                self._json(200, flightrec.recorder().snapshot())
+                # decisions + request lifecycle events (runtime/flightrec),
+                # plus the span ring — the fleet timeline joiner
+                # (flightrec.fleet_chrome_trace) reads both off this one
+                # body, so one GET per replica suffices
+                data = flightrec.recorder().snapshot()
+                data["spans"] = telemetry.tracer().raw_spans()
+                self._json(200, data)
             elif path == "/debug/timeline":
                 # Perfetto-loadable Chrome trace of the live rings + the
                 # span ring (save the body, load in ui.perfetto.dev)
@@ -808,6 +871,8 @@ def make_handler(state: ApiState):
             if not isinstance(body, dict):
                 self._json(400, {"error": "body must be a JSON object"})
                 return
+            fleet = fleet_identity(self.headers)
+            self._fleet_rid = fleet[0] if fleet else None
             stream = bool(body.get("stream", False))
             inflight = telemetry.registry().gauge(telemetry.REQUESTS_IN_FLIGHT)
             inflight.add(1)
@@ -830,6 +895,8 @@ def make_handler(state: ApiState):
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                if self._fleet_rid:
+                    self.send_header(FLEET_RID_HEADER, self._fleet_rid)
                 self.end_headers()
                 headers_sent = True
 
@@ -859,7 +926,7 @@ def make_handler(state: ApiState):
 
             try:
                 if stream:
-                    out = state.complete(body, emit=emit)
+                    out = state.complete(body, emit=emit, fleet=fleet)
                     start_stream()  # zero-delta completion: headers now
                     final = _chunk_json(state, {}, out["finish_reason"])
                     self.wfile.write(
@@ -867,7 +934,7 @@ def make_handler(state: ApiState):
                     self.wfile.write(b"data: [DONE]\n\n")
                     status = 200
                 else:
-                    out = state.complete(body)
+                    out = state.complete(body, fleet=fleet)
                     self._json(200, _completion_json(state, out))
                     status = 200
             except QueueFullError as e:

@@ -35,7 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
                                 description="TPU-native distributed-llama")
     p.add_argument("mode", choices=["inference", "chat", "perplexity", "api",
                                     "worker", "verify", "audit", "timeline",
-                                    "router"])
+                                    "router", "fleettrace"])
     p.add_argument("--model", required=False, help=".m model file")
     p.add_argument("--tokenizer", required=False, help=".t tokenizer file")
     p.add_argument("--verify-weights", action="store_true",
@@ -218,6 +218,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="timeline mode: write the Chrome trace-event JSON "
                         "here (default: stdout); load the file in "
                         "ui.perfetto.dev or chrome://tracing")
+    p.add_argument("--router-dump", default=None, metavar="FILE",
+                   help="fleettrace mode: a saved GET /debug/fleet body "
+                        "(the router's probe + span snapshot)")
+    p.add_argument("--replica-dump", action="append", default=None,
+                   metavar="NAME=FILE",
+                   help="fleettrace mode: one replica's saved GET "
+                        "/debug/flight body, labeled with the replica "
+                        "name (repeat the flag per replica); bare FILE "
+                        "uses the filename stem as the track name")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="router mode: declarative serving objectives — "
+                        "'ttft_p95_ms=500,itl_p50_ms=40,shed_rate=0.01' "
+                        "or the path of a JSON file mapping objective "
+                        "names to thresholds. Compliance + error-budget "
+                        "burn rates at GET /debug/slo, "
+                        "dllama_slo_compliance / dllama_slo_burn_rate "
+                        "gauges on /metrics, and an slo= fragment in "
+                        "--stats (runtime/slo.py)")
     p.add_argument("--audit-json", action="store_true",
                    help="audit mode: print the per-tensor table as one "
                         "JSON object instead of text")
@@ -749,6 +767,75 @@ def run_timeline(args) -> int:
     return 1 if problems else 0
 
 
+def run_fleettrace(args) -> int:
+    """``python -m dllama_tpu fleettrace --router-dump F
+    --replica-dump name=F ...`` — offline joiner from a saved router
+    ``GET /debug/fleet`` body plus per-replica ``GET /debug/flight``
+    bodies to one fleet-wide Chrome trace: router track + one track per
+    replica, requests joined across tiers by the X-Dllama-Request-Id
+    fleet id (one flow per request; a retried request's flow crosses
+    two replica tracks). Pure host-side: no jax. Exit 1 on malformed
+    input or when nothing joins."""
+    from ..runtime import flightrec
+
+    if not args.router_dump:
+        raise SystemExit("--router-dump FILE (a saved GET /debug/fleet "
+                         "body) is required for fleettrace mode")
+
+    def _load(path: str):
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise ValueError(f"expected a JSON object, got "
+                             f"{type(data).__name__}")
+        return data
+
+    try:
+        router_dump = _load(args.router_dump)
+    except (OSError, ValueError) as e:
+        print(f"❌ {args.router_dump}: {e}")
+        return 1
+    replica_dumps: dict = {}
+    for spec in args.replica_dump or []:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            # bare FILE: the filename stem names the replica track
+            name, path = os.path.splitext(os.path.basename(spec))[0], spec
+        try:
+            replica_dumps[name] = _load(path)
+        except (OSError, ValueError) as e:
+            print(f"❌ {path}: {e}")
+            return 1
+    try:
+        trace = flightrec.fleet_chrome_trace(router_dump, replica_dumps)
+        problems = flightrec.validate_chrome_trace(trace)
+    except (KeyError, TypeError, AttributeError) as e:
+        # a truncated / hand-edited dump missing structural fields must
+        # fail with a name, not a traceback
+        print(f"❌ malformed dump ({type(e).__name__}: {e})")
+        return 1
+    join = trace.get("fleetJoin", {})
+    payload = json.dumps(trace)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload)
+        print(f"🧾 {len(trace['traceEvents'])} trace events "
+              f"({join.get('router_requests', 0)} router requests, "
+              f"{join.get('joined', 0)} joined across "
+              f"{join.get('replicas', 0)} replica dump(s)) → {args.out} "
+              f"— load in ui.perfetto.dev or chrome://tracing")
+    else:
+        print(payload)
+    for prob in problems:
+        print(f"⚠️ {prob}", file=sys.stderr)
+    if (replica_dumps and join.get("router_requests", 0) > 0
+            and join.get("joined", 0) == 0):
+        print("⚠️ no request joined across tiers (trace-id propagation "
+              "broken, or dumps from different runs)", file=sys.stderr)
+        return 1
+    return 1 if problems else 0
+
+
 def run_perplexity(args) -> int:
     engine = make_engine(args)
     if args.file:
@@ -969,6 +1056,9 @@ def main(argv=None) -> int:
     if args.mode == "timeline":
         # offline flight-dump → Chrome trace converter: no jax either
         return run_timeline(args)
+    if args.mode == "fleettrace":
+        # offline router+replica dump joiner → fleet Chrome trace: no jax
+        return run_fleettrace(args)
     if args.mode == "router":
         # fleet router tier: no model, no device, no backend init — it
         # fronts api-server replicas over plain HTTP (serve/router.py)

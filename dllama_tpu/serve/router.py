@@ -45,12 +45,28 @@ path with chaos):
   (draining) stops receiving new dispatches while its in-flight
   streams finish; the router's own SIGTERM does the same one level up
   (``/readyz`` flips, accepted work completes).
+* **Fleet trace identity** — every completion dispatch carries an
+  ``X-Dllama-Request-Id`` (client-supplied when sanitary, else minted
+  here) plus an ``X-Dllama-Hop`` attempt index; the router keeps its
+  own span ring (:class:`RouterSpanRing`, phases =
+  telemetry.ROUTER_PHASES) so ``GET /debug/fleet/timeline`` — and the
+  offline ``python -m dllama_tpu fleettrace`` joiner — can render one
+  Chrome-trace flow per request across the router and every replica
+  it touched (``runtime/flightrec.fleet_chrome_trace``).
+* **SLO observatory** — ``--slo "ttft_p95_ms=500,itl_p50_ms=40,
+  shed_rate=0.01"`` (or a JSON file) evaluates declarative objectives
+  over router-measured streaming histograms with burn-rate windows
+  (``runtime/slo``): ``GET /debug/slo``, the
+  ``dllama_slo_compliance`` / ``dllama_slo_burn_rate`` gauges, and an
+  SLO fragment on the ``--stats`` line.
 
 Surfaces: ``/readyz`` (ready iff >= 1 dispatchable replica, same JSON
 body contract as the replicas), ``/healthz``, ``/metrics``
-(``dllama_router_*`` in the PR1 telemetry vocabulary), ``/debug/fleet``
-(per-replica breaker/load/probe state), and transparent proxying of
-``/v1/chat/completions`` + ``/v1/models``.
+(``dllama_router_*`` in the PR1 telemetry vocabulary, including the
+router-measured TTFT/connect/retry latency histograms),
+``/debug/fleet`` (per-replica breaker/load/probe state + the router
+span ring), ``/debug/fleet/timeline``, ``/debug/slo``, and transparent
+proxying of ``/v1/chat/completions`` + ``/v1/models``.
 
 Thread model (machine-checked by dlint's thread-ownership rules): one
 probe thread per replica owns that replica's health transitions; HTTP
@@ -64,18 +80,29 @@ import hashlib
 import http.client
 import json
 import random
+import re
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlsplit
 
-from ..runtime import failpoints, telemetry
+from ..runtime import failpoints, flightrec, slo, telemetry
 
 # known routes for the HTTP request counter's route label (the router's
 # twin of serve/api.py _ROUTES; anything else folds into "other")
 _ROUTES = ("/v1/chat/completions", "/v1/models", "/metrics",
-           "/health", "/healthz", "/readyz", "/debug/fleet")
+           "/health", "/healthz", "/readyz", "/debug/fleet",
+           "/debug/fleet/timeline", "/debug/slo")
+
+# fleet trace identity headers — canonical parse side in serve/api.py
+# (FLEET_RID_HEADER / FLEET_HOP_HEADER / FLEET_RID_RE there); spelled
+# here too so this module's import graph stays engine-free. The id
+# charset is closed because the value travels verbatim into response
+# headers, dumps, and logs on every tier.
+FLEET_RID_HEADER = "X-Dllama-Request-Id"
+FLEET_HOP_HEADER = "X-Dllama-Hop"
+_RID_SAFE_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 # upstream response headers relayed verbatim; everything hop-by-hop or
 # regenerated by our own http.server (Date, Server) is dropped
@@ -357,6 +384,43 @@ def _parse_replica_metrics(text: str) -> dict:
     return out
 
 
+class RouterSpanRing:
+    """Bounded ring of router-side request spans — the fleet tier's
+    twin of ``telemetry.SpanTracer``. Records carry the STRING fleet
+    request id (the ``X-Dllama-Request-Id`` value) plus dispatch
+    context (``replica``, ``hop``); phases come from
+    ``telemetry.ROUTER_PHASES`` and are closed-world-checked by the
+    span-phases dlint rule exactly like the engine span vocabulary.
+    Served raw as ``/debug/fleet``'s ``spans`` key — which is also the
+    offline joiner's ``--router-dump`` input — and joined with replica
+    flight dumps by ``flightrec.fleet_chrome_trace``."""
+
+    RING = 2048
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.RING)  # dlint: guarded-by=_lock
+
+    def emit_span(self, request_id: str, phase: str, start_ns: int,
+                  end_ns: int, *, replica: str = "", hop: int = -1,
+                  **extra) -> None:  # dlint: owner=any
+        """One completed router-side span; ``start_ns == end_ns`` marks
+        an instant event (dispatch decisions, eject markers)."""
+        rec = {"request_id": str(request_id), "phase": phase,
+               "start_ns": int(start_ns), "end_ns": int(end_ns)}
+        if replica:
+            rec["replica"] = replica
+        if hop >= 0:
+            rec["hop"] = hop
+        rec.update(extra)
+        with self._lock:
+            self._ring.append(rec)
+
+    def raw_spans(self) -> list[dict]:  # dlint: owner=any
+        with self._lock:
+            return [dict(s) for s in self._ring]
+
+
 class FleetRouter:
     """Replica set + probe threads + dispatch policy — the state behind
     :func:`make_router_handler`."""
@@ -370,7 +434,8 @@ class FleetRouter:
                  backoff_max_s: float = BACKOFF_MAX_S,
                  connect_timeout_s: float = 2.0,
                  read_timeout_s: float = 120.0,
-                 start_probes: bool = True):
+                 start_probes: bool = True,
+                 slo_objectives: dict[str, float] | None = None):
         if not replica_urls:
             raise ValueError("at least one --replica URL is required")
         self.replicas = [Replica(u, eject_after=eject_after,
@@ -388,15 +453,39 @@ class FleetRouter:
         self._affinity: OrderedDict = OrderedDict()  # dlint: guarded-by=_lock
         self._inflight_total = 0                     # dlint: guarded-by=_lock
         self._draining = False                       # dlint: guarded-by=_lock
+        self._rid_seq = 0                            # dlint: guarded-by=_lock
+        # boot-unique prefix: two router incarnations never mint the
+        # same id, so joined dumps across a restart stay unambiguous
+        self._rid_boot = f"{random.getrandbits(32):08x}"
         self._stop = threading.Event()
+        self.spans = RouterSpanRing()
+        self.slo = (slo.SloEngine(slo_objectives)
+                    if slo_objectives else None)
         reg = telemetry.registry()
         self.c_dispatch = reg.counter(telemetry.ROUTER_DISPATCHES)
         self.c_retries = reg.counter(telemetry.ROUTER_RETRIES)
         self.c_shed = reg.counter(telemetry.ROUTER_SHED)
         self.c_affinity = reg.counter(telemetry.ROUTER_AFFINITY_HITS)
+        self.c_retry_hops = reg.counter(telemetry.ROUTER_RETRY_HOPS)
+        self.h_ttft = reg.histogram(telemetry.ROUTER_TTFT_MS)
+        self.h_connect = reg.histogram(telemetry.ROUTER_CONNECT_MS)
+        self.h_retry = reg.histogram(telemetry.ROUTER_RETRY_MS)
         self._threads: list[threading.Thread] = []
         if start_probes:
             self.start()
+
+    def mint_rid(self, client_rid: str | None) -> str:  # dlint: owner=any
+        """The fleet request id for one completion: a client-supplied
+        ``X-Dllama-Request-Id`` is honored when it matches the sanitary
+        charset (``[A-Za-z0-9._-]{1,64}`` — the value travels verbatim
+        into headers, dumps, and logs on every tier), anything else is
+        replaced by a freshly minted boot-unique id."""
+        if isinstance(client_rid, str) and _RID_SAFE_RE.match(client_rid):
+            return client_rid
+        with self._lock:
+            self._rid_seq += 1
+            n = self._rid_seq
+        return f"r{self._rid_boot}-{n:x}"
 
     def start(self) -> None:  # dlint: owner=any
         for rep in self.replicas:
@@ -512,7 +601,10 @@ class FleetRouter:
                 "max_inflight": self.max_inflight,
                 "affinity_entries": n_aff,
                 "draining": draining,
-                "probe_interval_s": self.probe_interval_s}
+                "probe_interval_s": self.probe_interval_s,
+                # the router span ring rides the fleet snapshot: a saved
+                # /debug/fleet body IS the fleettrace --router-dump file
+                "spans": self.spans.raw_spans()}
 
 
 class _UpstreamDied(Exception):
@@ -538,6 +630,11 @@ def make_router_handler(fleet: FleetRouter):
         protocol_version = "HTTP/1.1"
         timeout = 120  # stalled-peer guard, same rationale as serve/api.py
 
+        # per-request trace state (reset at the top of each do_GET/do_POST
+        # — keep-alive reuses the handler instance across requests)
+        _fleet_rid: str | None = None
+        _t_first_ns: int | None = None
+
         def log_message(self, fmt, *args):
             print(f"🕸️ router {self.address_string()} {fmt % args}")
 
@@ -554,6 +651,10 @@ def make_router_handler(fleet: FleetRouter):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self._fleet_rid:
+                # every router-authored answer names the request: the
+                # client learns the minted id even on shed/error paths
+                self.send_header(FLEET_RID_HEADER, self._fleet_rid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -562,10 +663,12 @@ def make_router_handler(fleet: FleetRouter):
         # -- upstream plumbing ----------------------------------------------
 
         def _open_upstream(self, rep: Replica, method: str, path: str,
-                           body: bytes | None):
+                           body: bytes | None, extra_headers=None):
             """One upstream request; returns (conn, resp) with headers
             parsed. Raises :class:`_UpstreamDied` on connect failure or
-            a 5xx answer (the breaker is fed by the caller)."""
+            a 5xx answer (the breaker is fed by the caller).
+            ``extra_headers`` carries the fleet trace identity
+            (request-id + hop index) on completion dispatches."""
             conn = http.client.HTTPConnection(
                 rep.host, rep.port, timeout=fleet.read_timeout_s)
             try:
@@ -576,6 +679,8 @@ def make_router_handler(fleet: FleetRouter):
                 headers = {}
                 if body is not None:
                     headers["Content-Type"] = "application/json"
+                if extra_headers:
+                    headers.update(extra_headers)
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
             except (OSError, http.client.HTTPException,
@@ -607,19 +712,53 @@ def make_router_handler(fleet: FleetRouter):
                            force_close: bool) -> None:
             self.send_response(status)
             for k, v in resp.getheaders():
-                if k in _RELAY_HEADERS:
+                if k in _RELAY_HEADERS and k != FLEET_RID_HEADER:
                     self.send_header(k, v)
+            if self._fleet_rid:
+                # the fleet trace id rides every relayed response, so a
+                # client can join its request into /debug/fleet/timeline
+                self.send_header(FLEET_RID_HEADER, self._fleet_rid)
             if force_close:
                 self.send_header("Connection", "close")
                 self.close_connection = True
             self.end_headers()
 
-        def _relay_response(self, rep: Replica, conn, resp) -> int:
+        def _note_first_byte(self, rid: str, rep: Replica, hop: int,
+                             t0_ns: int) -> None:
+            """First upstream body byte relayed: the router-measured
+            TTFT — ``rt_first_byte`` span (admission → now), the
+            dllama_router_ttft_ms histogram, and the SLO observation —
+            recorded once per request, whichever hop serves it."""
+            if self._t_first_ns is not None or not rid:
+                return
+            now = telemetry.now_ns()
+            self._t_first_ns = now
+            ms = (now - t0_ns) / 1e6
+            fleet.h_ttft.record(ms)
+            fleet.spans.emit_span(rid, "rt_first_byte", t0_ns, now,
+                                  replica=rep.name, hop=hop)
+            if fleet.slo is not None:
+                fleet.slo.observe_ttft(ms)
+
+        def _end_stream(self, rid: str, rep: Replica, hop: int,
+                        status) -> None:
+            """Close the ``rt_stream`` span (first relayed byte → last)
+            once the relay is over — clean end or mid-stream 502."""
+            if self._t_first_ns is None or not rid:
+                return
+            fleet.spans.emit_span(rid, "rt_stream", self._t_first_ns,
+                                  telemetry.now_ns(), replica=rep.name,
+                                  hop=hop, code=str(status))
+
+        def _relay_response(self, rep: Replica, conn, resp, *,
+                            rid: str = "", hop: int = 0,
+                            t0_ns: int = 0) -> int:
             """Stream the upstream response to the client. Buffered when
             a Content-Length is known (an upstream death mid-body stays
             retryable because nothing reached the client); incremental
             for SSE/EOF-delimited bodies, with the explicit terminal 502
-            event on a mid-stream death."""
+            event on a mid-stream death. ``rid``/``hop``/``t0_ns`` feed
+            the trace spans and the router-measured TTFT/ITL."""
             try:
                 length = resp.getheader("Content-Length")
                 if length is not None:
@@ -632,9 +771,11 @@ def make_router_handler(fleet: FleetRouter):
                     if len(data) < int(length):
                         raise _UpstreamDied(
                             f"replica {rep.name} died mid-body")
+                    self._note_first_byte(rid, rep, hop, t0_ns)
                     self._relay_headers(resp, resp.status,
                                         force_close=False)
                     self.wfile.write(data)
+                    self._end_stream(rid, rep, hop, resp.status)
                     return resp.status
                 # EOF-delimited (the api server's SSE streams): relay as
                 # data arrives; from the first byte on, failures are no
@@ -648,18 +789,30 @@ def make_router_handler(fleet: FleetRouter):
                     "text/event-stream")
                 self._relay_headers(resp, resp.status, force_close=True)
                 tail = b""
+                t_prev: int | None = None
                 while True:
                     try:
                         chunk = resp.read1(65536)
                     except (OSError, http.client.HTTPException) as e:
                         self._stream_abort(rep, e)
+                        self._end_stream(rid, rep, hop, 502)
                         return 502
                     if not chunk:
                         if is_sse and b"data: [DONE]" not in tail:
                             self._stream_abort(rep, ConnectionError(
                                 "EOF before the [DONE] sentinel"))
+                            self._end_stream(rid, rep, hop, 502)
                             return 502
+                        self._end_stream(rid, rep, hop, resp.status)
                         return resp.status
+                    now = telemetry.now_ns()
+                    if t_prev is None:
+                        self._note_first_byte(rid, rep, hop, t0_ns)
+                    elif fleet.slo is not None:
+                        # router-measured ITL: inter-chunk relay gaps
+                        # (one SSE event per chunk in practice)
+                        fleet.slo.observe_itl((now - t_prev) / 1e6)
+                    t_prev = now
                     self.wfile.write(chunk)
                     self.wfile.flush()
                     tail = (tail + chunk)[-64:]
@@ -720,7 +873,31 @@ def make_router_handler(fleet: FleetRouter):
 
         # -- routes ---------------------------------------------------------
 
+        def _fleet_timeline(self) -> None:
+            """``GET /debug/fleet/timeline`` — pull every replica's live
+            ``/debug/flight`` and join it with the router span ring into
+            one Chrome trace (``flightrec.fleet_chrome_trace``). A
+            replica that cannot answer contributes no track (its spans
+            survive in the join only if another dump carries them); the
+            trace's ``fleetJoin`` summary says how much joined."""
+            dumps: dict[str, dict] = {}
+            for rep in fleet.replicas:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=rep.connect_timeout_s)
+                try:
+                    conn.request("GET", "/debug/flight")
+                    resp = conn.getresponse()
+                    if resp.status == 200:
+                        dumps[rep.name] = json.loads(resp.read())
+                except (OSError, ValueError, http.client.HTTPException):
+                    continue  # dead replica: absent track, not a 5xx
+                finally:
+                    conn.close()
+            self._json(200, flightrec.fleet_chrome_trace(
+                fleet.fleet_snapshot(), dumps))
+
         def do_GET(self):
+            self._fleet_rid = None  # keep-alive: no stale POST echo
             path = self.path.split("?", 1)[0]
             if path in ("/health", "/healthz"):
                 self._json(200, {"status": "ok"})
@@ -732,6 +909,10 @@ def make_router_handler(fleet: FleetRouter):
                      "reason": reason, "code": code},
                     headers=None if ready else backpressure_headers(503))
             elif path == "/metrics":
+                if fleet.slo is not None:
+                    # scrape-time evaluation keeps the compliance/burn
+                    # gauges current without a timer thread of their own
+                    fleet.slo.evaluate()
                 self._count(200)
                 body = telemetry.registry().render().encode("utf-8")
                 self.send_response(200)
@@ -742,6 +923,15 @@ def make_router_handler(fleet: FleetRouter):
                 self.wfile.write(body)
             elif path == "/debug/fleet":
                 self._json(200, fleet.fleet_snapshot())
+            elif path == "/debug/fleet/timeline":
+                self._fleet_timeline()
+            elif path == "/debug/slo":
+                if fleet.slo is None:
+                    self._json(404, {"error": "no SLO objectives "
+                                              "configured (start the "
+                                              "router with --slo)"})
+                else:
+                    self._json(200, fleet.slo.evaluate())
             elif path == "/v1/models":
                 self._proxy_buffered("GET", "/v1/models", None)
             else:
@@ -749,6 +939,8 @@ def make_router_handler(fleet: FleetRouter):
                                  "routes": list(_ROUTES)})
 
         def do_POST(self):
+            self._fleet_rid = None
+            t_recv = telemetry.now_ns()  # rt_queue span origin
             path = self.path.split("?", 1)[0]
             try:
                 length = int(self.headers.get("Content-Length", 0))
@@ -777,64 +969,140 @@ def make_router_handler(fleet: FleetRouter):
                 # malformed enough that no affinity key exists; the
                 # replica owns the full validation answer
                 body = {}
+            # fleet trace identity: honor a sanitary client id, else mint
+            rid = fleet.mint_rid(self.headers.get(FLEET_RID_HEADER))
+            self._fleet_rid = rid
             if not fleet.admit():
                 if fleet.is_draining():
+                    fleet.spans.emit_span(rid, "rt_queue", t_recv,
+                                          telemetry.now_ns(),
+                                          outcome="draining")
                     self._json(503, {"error": "router is draining",
                                      "code": "draining"},
                                headers=backpressure_headers(503))
                     return
                 fleet.c_shed.inc()
+                if fleet.slo is not None:
+                    fleet.slo.observe_outcome(shed=True)
+                fleet.spans.emit_span(rid, "rt_queue", t_recv,
+                                      telemetry.now_ns(), outcome="shed")
                 self._json(429, {"error": f"router at --max-queue "
                                           f"({fleet.max_inflight} in "
                                           f"flight); retry later",
                                  "code": "queue_full"},
                            headers=backpressure_headers(429))
                 return
+            # request receipt → admission decision: the router's queue
+            # phase (near-zero here — admission is one lock — but the
+            # span anchors the request's flow at the router tier)
+            fleet.spans.emit_span(rid, "rt_queue", t_recv,
+                                  telemetry.now_ns(), outcome="admitted")
+            shed = False
             try:
-                self._dispatch_completion(raw, body)
+                shed = self._dispatch_completion(raw, body, rid, t_recv)
             finally:
                 fleet.release()
+            if fleet.slo is not None:
+                fleet.slo.observe_outcome(shed=shed)
 
-        def _dispatch_completion(self, raw: bytes, body: dict) -> None:
+        def _note_eject(self, rid: str, rep: Replica, hop: int) -> None:
+            """Instant ``rt_eject`` marker when a dispatch failure trips
+            the breaker (state observed down right after note_failure)."""
+            if rep.snapshot()["state"] == "down":
+                now = telemetry.now_ns()
+                fleet.spans.emit_span(rid, "rt_eject", now, now,
+                                      replica=rep.name, hop=hop)
+
+        def _dispatch_completion(self, raw: bytes, body: dict,
+                                 rid: str, t0_ns: int) -> bool:
+            """Dispatch one admitted completion (with one cross-replica
+            retry); returns True when the request was ultimately SHED
+            (queue_full) — the caller's SLO shed-rate observation."""
             key = affinity_key(body)
             tried: set = set()
             last: _UpstreamDied | None = None
+            ns_failed = 0  # wall burned on failed hops before serving
+            self._t_first_ns = None
             for attempt in range(2):
+                t_pick = telemetry.now_ns()
                 rep = fleet.pick(key, exclude=tried)
                 if rep is None:
                     break
                 tried.add(rep)
                 if attempt:
                     fleet.c_retries.inc()
+                # dispatch attempts by hop index: hop="1"+ are retry
+                # hops — the same index the X-Dllama-Hop header carries
+                fleet.c_retry_hops.inc(hop=str(attempt))
+                snap = rep.snapshot()
+                # the dispatch decision as an instant marker, carrying
+                # the probe snapshot that justified the pick
+                fleet.spans.emit_span(
+                    rid, "rt_dispatch", t_pick, t_pick,
+                    replica=rep.name, hop=attempt, state=snap["state"],
+                    load=round(snap["queue_depth"]
+                               + snap["engine_inflight"]
+                               + snap["router_inflight"], 3))
                 rep.begin_request()
+                t_hop0 = telemetry.now_ns()
                 try:
                     try:
                         conn, resp = self._open_upstream(
-                            rep, "POST", "/v1/chat/completions", raw)
+                            rep, "POST", "/v1/chat/completions", raw,
+                            extra_headers={
+                                FLEET_RID_HEADER: rid,
+                                FLEET_HOP_HEADER: str(attempt)})
                     except _UpstreamDied as e:
+                        t_fail = telemetry.now_ns()
+                        ns_failed += t_fail - t_hop0
+                        fleet.h_connect.record((t_fail - t_hop0) / 1e6,
+                                               replica=rep.name)
+                        fleet.spans.emit_span(
+                            rid, "rt_retry", t_hop0, t_fail,
+                            replica=rep.name, hop=attempt,
+                            code=e.code or "connect")
                         if e.code in ("draining", "queue_full"):
                             # an explicit backpressure answer: the
                             # replica is alive — reclassify, don't eject
                             rep.note_unready(e.code)
                         else:
                             rep.note_failure()
+                            self._note_eject(rid, rep, attempt)
                         last = e
                         continue
+                    t_conn = telemetry.now_ns()
+                    fleet.h_connect.record((t_conn - t_hop0) / 1e6,
+                                           replica=rep.name)
+                    fleet.spans.emit_span(rid, "rt_connect", t_hop0,
+                                          t_conn, replica=rep.name,
+                                          hop=attempt)
                     rep.note_success()
                     fleet.c_dispatch.inc(replica=rep.name)
+                    if attempt:
+                        # the serving hop follows >=1 failed hop: record
+                        # the retry tax this request paid, once
+                        fleet.h_retry.record(ns_failed / 1e6)
                     try:
-                        status = self._relay_response(rep, conn, resp)
+                        status = self._relay_response(
+                            rep, conn, resp, rid=rid, hop=attempt,
+                            t0_ns=t0_ns)
                     except _UpstreamDied as e:
                         # buffered body died before the client saw a
                         # byte: feed the breaker and retry
+                        ns_failed += telemetry.now_ns() - t_hop0
+                        fleet.spans.emit_span(
+                            rid, "rt_retry", t_hop0, telemetry.now_ns(),
+                            replica=rep.name, hop=attempt,
+                            code="mid_body")
                         rep.note_failure()
+                        self._note_eject(rid, rep, attempt)
                         last = e
                         continue
                     except (BrokenPipeError, ConnectionResetError):
                         status = "client_disconnect"
                         self.close_connection = True
                     self._count(status)
-                    return
+                    return False
                 finally:
                     rep.end_request()
             # retry budget exhausted or no replica at all
@@ -844,28 +1112,31 @@ def make_router_handler(fleet: FleetRouter):
                 # passes through unmangled (status, headers, body)
                 self._count(last.status)
                 self.send_response(last.status)
+                if self._fleet_rid:
+                    self.send_header(FLEET_RID_HEADER, self._fleet_rid)
                 for k, v in (last.headers or ()):
                     if k in _RELAY_HEADERS and k != "Content-Length":
                         self.send_header(k, v)
                 self.send_header("Content-Length", str(len(last.body)))
                 self.end_headers()
                 self.wfile.write(last.body)
-                return
+                return False
             if last is not None:
                 self._json(502, {"error": f"dispatch failed on "
                                           f"{len(tried)} replica(s): "
                                           f"{last}",
                                  "code": "crashed"},
                            headers=backpressure_headers(503))
-                return
+                return False
             reason, code = fleet.unready_reason()
             if code == "queue_full":
                 fleet.c_shed.inc()
                 self._json(429, {"error": reason, "code": code},
                            headers=backpressure_headers(429))
-            else:
-                self._json(503, {"error": reason, "code": code},
-                           headers=backpressure_headers(503))
+                return True
+            self._json(503, {"error": reason, "code": code},
+                       headers=backpressure_headers(503))
+            return False
 
     return RouterHandler
 
@@ -884,10 +1155,26 @@ def run_router(args) -> int:
     if failpoints.configure_from_env():
         print("💣 fault injection armed from DLLAMA_FAILPOINTS="
               f"{os.environ['DLLAMA_FAILPOINTS']}")
+    slo_objectives = None
+    if getattr(args, "slo", None):
+        try:
+            slo_objectives = slo.load_slo(args.slo)
+        except ValueError as e:
+            # a typo'd SLO must fail at startup with the objective
+            # named, not silently never alarm
+            raise SystemExit(f"--slo: {e}")
     fleet = FleetRouter(
         replicas,
         probe_interval_s=getattr(args, "probe_interval", 2.0) or 2.0,
-        max_inflight=getattr(args, "max_queue", 0) or 0)
+        max_inflight=getattr(args, "max_queue", 0) or 0,
+        slo_objectives=slo_objectives)
+    if slo_objectives:
+        print("🎯 SLO observatory: "
+              + ", ".join(f"{k}≤{v:g}"
+                          for k, v in slo_objectives.items())
+              + " (burn windows "
+              + "/".join(label for label, _ in slo.WINDOWS)
+              + "; GET /debug/slo)")
     server = ThreadingHTTPServer((args.host, args.port),
                                  make_router_handler(fleet))
     print(f"🕸️ fleet router: {len(fleet.replicas)} replicas "
@@ -906,6 +1193,16 @@ def run_router(args) -> int:
         signal.signal(signal.SIGTERM, _on_sigterm)
     except ValueError:
         pass  # not the main thread (embedded/test usage)
+    stats_every = getattr(args, "stats", 0) or 0
+    if stats_every:
+        def _stats_loop():  # dlint: owner=any
+            while not fleet._stop.wait(stats_every):
+                if fleet.slo is not None:
+                    fleet.slo.evaluate()  # refresh gauges for the line
+                print(telemetry.stats_line(window_s=stats_every),
+                      flush=True)
+        threading.Thread(target=_stats_loop, daemon=True,
+                         name="router-stats").start()
     print(f"🕸️ listening on http://{args.host}:{args.port}")
     try:
         server.serve_forever()

@@ -437,10 +437,28 @@ def test_span_phases_fixture_violation(tmp_path):
                 telemetry.tracer().emit(rid, "bogus_phase", t0, t1)
             """,
     })
-    findings, _ = span_phases.check(project, phases=("queue",))
+    findings, _ = span_phases.check(project, phases=(("queue",), ()))
     msgs = "\n".join(f.message for f in findings)
     assert "bogus_phase" in msgs                    # emitted, not in PHASES
     assert "queue" in msgs                          # documented, never emitted
+
+
+def test_span_phases_router_vocabulary(tmp_path):
+    """RouterSpanRing.emit_span literals are held to ROUTER_PHASES the
+    same way tracer().emit literals are held to PHASES."""
+    from tools.dlint import span_phases
+
+    project = _tree(tmp_path, {
+        "dllama_tpu/serve/rt.py": """\
+            def f(spans, rid, t0, t1):
+                spans.emit_span(rid, "rt_bogus", t0, t1)
+            """,
+    })
+    findings, _ = span_phases.check(
+        project, phases=((), ("rt_queue",)))
+    msgs = "\n".join(f.message for f in findings)
+    assert "rt_bogus" in msgs                   # emitted, not in vocabulary
+    assert "rt_queue" in msgs                   # documented, never emitted
 
 
 def test_pallas_gate_fixture_violation(tmp_path):

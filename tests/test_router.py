@@ -71,6 +71,36 @@ class StubReplica:
             "nonstream_delay_s": 0.0,
         }
         self.n_completions = 0
+        # fleet-trace capture: (fleet_rid, hop) per completion attempt,
+        # plus a flight-shaped dump served at /debug/flight so the
+        # router's fleet-timeline join can be driven end to end
+        self.seen_fleet: list = []
+        self.flight_events: list = []
+        self.flight_spans: list = []
+        self._rid_lock = threading.Lock()
+        self._local_rid = 0
+
+    def note_fleet(self, frid, fhop) -> int:
+        """Record a completion attempt's fleet identity headers the way
+        serve/api.py binds them; returns the engine-local rid."""
+        with self._rid_lock:
+            self._local_rid += 1
+            local = self._local_rid
+        if frid is not None:
+            hop = int(fhop or 0)
+            self.seen_fleet.append((frid, hop))
+            self.flight_events.append(
+                {"event": "fleet_rid", "rid": local, "reason": frid,
+                 "hop": hop, "t_ns": time.monotonic_ns()})
+        return local
+
+    def note_span(self, local, t0_ns, frid, fhop) -> None:
+        s = {"request_id": local, "phase": "decode", "start_ns": t0_ns,
+             "end_ns": time.monotonic_ns(), "slot": 0, "n_tokens": 3}
+        if frid is not None:
+            s["fleet"] = frid
+            s["hop"] = int(fhop or 0)
+        self.flight_spans.append(s)
 
     def start(self) -> None:
         stub = self
@@ -126,6 +156,11 @@ class StubReplica:
                 elif self.path == "/v1/models":
                     self._json(200, {"object": "list", "data": [
                         {"id": f"stub-{stub.name}", "object": "model"}]})
+                elif self.path == "/debug/flight":
+                    self._json(200, {
+                        "tick_seq": 0, "ticks": [], "dumps": [],
+                        "events": list(stub.flight_events),
+                        "spans": list(stub.flight_spans)})
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -137,6 +172,10 @@ class StubReplica:
                     self._json(404, {"error": "not found"})
                     return
                 stub.n_completions += 1
+                frid = self.headers.get("X-Dllama-Request-Id")
+                fhop = self.headers.get("X-Dllama-Hop")
+                t0_ns = time.monotonic_ns()
+                local = stub.note_fleet(frid, fhop)
                 if b["nonstream_delay_s"]:
                     time.sleep(b["nonstream_delay_s"])
                 if b["completion_status"] != 200:
@@ -148,6 +187,7 @@ class StubReplica:
                         payload["code"] = b["error_code"]
                     self._json(b["completion_status"], payload,
                                headers=hdrs)
+                    stub.note_span(local, t0_ns, frid, fhop)
                     return
                 try:
                     body = json.loads(raw or b"{}")
@@ -178,9 +218,11 @@ class StubReplica:
                             # and no [DONE] — exactly what a killed
                             # api-server's SSE stream looks like
                             self.close_connection = True
+                            stub.note_span(local, t0_ns, frid, fhop)
                             return
                     self.wfile.write(b"data: [DONE]\n\n")
                     self.close_connection = True
+                    stub.note_span(local, t0_ns, frid, fhop)
                     return
                 if b["truncate_nonstream"]:
                     self.send_response(200)
@@ -190,6 +232,7 @@ class StubReplica:
                     self.wfile.write(b'{"partial": tru')
                     self.wfile.flush()
                     self._rst()
+                    stub.note_span(local, t0_ns, frid, fhop)
                     return
                 self._json(200, {
                     "object": "chat.completion", "replica": stub.name,
@@ -200,6 +243,7 @@ class StubReplica:
                                  "finish_reason": "stop"}],
                     "usage": {"prompt_tokens": 3, "completion_tokens": 3,
                               "total_tokens": 6}})
+                stub.note_span(local, t0_ns, frid, fhop)
 
         self.httpd = ThreadingHTTPServer(("127.0.0.1", self.port or 0),
                                          Handler)
@@ -781,6 +825,291 @@ def test_fleet_survives_replica_kill_and_restart_under_traffic():
                 s.kill()
 
 
+# -- fleet tracing + SLO observatory ------------------------------------------
+
+
+def _post_raw(url, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url + "/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_fleet_rid_minted_forwarded_and_echoed():
+    """The trace-identity contract: the router mints (or accepts a
+    sanitary) X-Dllama-Request-Id, forwards it with a hop index, and
+    echoes it on the response."""
+    a = StubReplica("a")
+    a.start()
+    url, fleet, close = make_router([a])
+    try:
+        _wait(lambda: fleet.readiness()[0], what="replica up")
+        # no client id: router mints one, forwards it at hop 0, echoes
+        with _post(url, _body("mint me")) as r:
+            rid = r.headers["X-Dllama-Request-Id"]
+        assert rid and a.seen_fleet[-1] == (rid, 0)
+        # a sanitary client id is honored end to end
+        with _post_raw(url, _body("keep me"),
+                       headers={"X-Dllama-Request-Id": "client.id-7"}) as r:
+            assert r.headers["X-Dllama-Request-Id"] == "client.id-7"
+        assert a.seen_fleet[-1] == ("client.id-7", 0)
+        # an unsanitary id is replaced, never trusted
+        with _post_raw(url, _body("spoof me"),
+                       headers={"X-Dllama-Request-Id": "bad id!{}"}) as r:
+            rid = r.headers["X-Dllama-Request-Id"]
+        assert rid != "bad id!{}" and rid.startswith("r")
+        assert a.seen_fleet[-1] == (rid, 0)
+    finally:
+        close()
+        a.kill()
+
+
+def test_retry_carries_hop_index_to_replica():
+    """ISSUE-16 satellite: a retried request is visible AT THE REPLICA —
+    the serving hop arrives with X-Dllama-Hop: 1 under the same fleet
+    id, and dllama_router_retry_hops_total counts both hops."""
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    hops = tm.registry().counter(tm.ROUTER_RETRY_HOPS)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        h0, h1 = hops.total(hop="0"), hops.total(hop="1")
+        fp.arm("proxy", "conn_reset", times=1)
+        with _post(url, _body("retry with id")) as r:
+            rid = r.headers["X-Dllama-Request-Id"]
+        assert hops.total(hop="0") == h0 + 1
+        assert hops.total(hop="1") == h1 + 1
+        # the hop that actually served carries index 1 — the replica's
+        # flight dump can name which attempt it was
+        served = [s for s in (a, b) if (rid, 1) in s.seen_fleet]
+        assert len(served) == 1
+        # the retry/TTFT/connect histograms populated on /metrics
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "dllama_router_ttft_ms_bucket" in text
+        assert "dllama_router_connect_ms_bucket" in text
+        assert "dllama_router_retry_ms_count 1" in text \
+            or "dllama_router_retry_ms_count" in text
+        assert 'dllama_router_retry_hops_total{hop="1"}' in text
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_fleet_timeline_joins_chaos_run(tmp_path):
+    """ISSUE-16 satellite: a 3-replica run with a mid-run kill/restart
+    joins into ONE strictly-valid Chrome trace — every completed
+    request id in exactly one flow, a pre-byte-retried request's flow
+    crossing two replica tracks, no orphaned replica spans — and the
+    same join runs offline through the fleettrace CLI."""
+    from dllama_tpu.runtime import flightrec
+    from dllama_tpu.serve.cli import main as cli_main
+
+    stubs = [StubReplica(f"r{i}") for i in range(3)]
+    for s in stubs:
+        s.start()
+    url, fleet, close = make_router(stubs)
+    completed: list = []
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="all 3 replicas up")
+
+        def go(prompt, stream=False):
+            with _post(url, _body(prompt, stream=stream)) as r:
+                raw = r.read()
+                assert (b"[DONE]" in raw) if stream else (b"usage" in raw)
+                completed.append(r.headers["X-Dllama-Request-Id"])
+
+        for i in range(6):           # steady phase, mixed traffic
+            go(f"steady-{i}", stream=i % 2 == 0)
+        # churn phase 1: r0 answers then dies mid-body — the router
+        # retries pre-first-byte, so the SAME fleet id lands on two
+        # replica tracks
+        stubs[0].behavior["truncate_nonstream"] = True
+        retries = tm.registry().counter(tm.ROUTER_RETRIES)
+        r0 = retries.total()
+        for i in range(4):
+            go(f"churn-{i}")
+        assert retries.total() > r0
+        stubs[0].behavior["truncate_nonstream"] = False
+        # churn phase 2: hard kill + restart under sequential traffic
+        victim = stubs[1]
+        vname = f"127.0.0.1:{victim.port}"
+        victim.kill()
+        _wait(lambda: _up(fleet, vname) == 0, what="victim ejected",
+              timeout=15)
+        for i in range(3):
+            go(f"post-kill-{i}")
+        victim.start()
+        _wait(lambda: _up(fleet, vname) == 1, what="victim re-admitted",
+              timeout=15)
+        for i in range(3):
+            go(f"post-restart-{i}", stream=True)
+
+        with urllib.request.urlopen(url + "/debug/fleet/timeline",
+                                    timeout=10) as r:
+            trace = json.loads(r.read())
+        assert flightrec.validate_chrome_trace(
+            trace, expect_rids=completed) == []
+        evs = trace["traceEvents"]
+        # every completed request id: exactly one flow (one "s" start)
+        starts: dict = {}
+        for e in evs:
+            if e.get("cat") == "fleet" and e["ph"] == "s":
+                starts[e["id"]] = starts.get(e["id"], 0) + 1
+        for rid in completed:
+            assert starts.get(rid) == 1, rid
+        # the retried ids cross two replica tracks (two distinct pids>1)
+        repl_pids: dict = {}
+        for e in evs:
+            if e.get("ph") == "X" and e.get("cat") == "replica":
+                repl_pids.setdefault(
+                    e["args"]["request_id"], set()).add(e["pid"])
+        assert any(len(pids) >= 2 for pids in repl_pids.values())
+        # no orphaned replica spans: all traffic came via the router
+        assert trace["fleetJoin"]["unjoined_replica_spans"] == 0
+        assert trace["fleetJoin"]["joined"] >= len(set(completed))
+        # router track present with the full phase story
+        phases = {e["args"]["phase"] for e in evs
+                  if e.get("ph") == "X" and e.get("cat") == "router"}
+        assert {"rt_queue", "rt_dispatch", "rt_connect", "rt_first_byte",
+                "rt_stream", "rt_retry"} <= phases
+
+        # -- offline joiner over saved dumps ------------------------------
+        with urllib.request.urlopen(url + "/debug/fleet",
+                                    timeout=10) as r:
+            (tmp_path / "fleet.json").write_bytes(r.read())
+        args = ["fleettrace", "--router-dump",
+                str(tmp_path / "fleet.json"),
+                "--out", str(tmp_path / "trace.json")]
+        for s in stubs:
+            with urllib.request.urlopen(s.url + "/debug/flight",
+                                        timeout=10) as r:
+                (tmp_path / f"{s.name}.json").write_bytes(r.read())
+            args += ["--replica-dump",
+                     f"{s.name}={tmp_path / f'{s.name}.json'}"]
+        assert cli_main(args) == 0
+        offline = json.loads((tmp_path / "trace.json").read_text())
+        assert flightrec.validate_chrome_trace(
+            offline, expect_rids=completed) == []
+        assert offline["fleetJoin"]["joined"] >= len(set(completed))
+    finally:
+        close()
+        for s in stubs:
+            if s.httpd is not None:
+                s.kill()
+
+
+def test_fleettrace_cli_rejects_malformed_and_unjoinable(tmp_path):
+    from dllama_tpu.serve.cli import main as cli_main
+
+    # malformed: not JSON at all
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert cli_main(["fleettrace", "--router-dump", str(bad)]) == 1
+    # malformed: spans that are not span-shaped
+    broken = tmp_path / "broken.json"
+    broken.write_text(json.dumps({"spans": [{"wrong": 1}]}))
+    assert cli_main(["fleettrace", "--router-dump", str(broken)]) == 1
+    # unjoinable: router saw requests, replica dump shares none of them
+    router_dump = tmp_path / "router.json"
+    router_dump.write_text(json.dumps({"spans": [
+        {"request_id": "r1-1", "phase": "rt_queue",
+         "start_ns": 1000, "end_ns": 2000}]}))
+    replica_dump = tmp_path / "replica.json"
+    replica_dump.write_text(json.dumps(
+        {"ticks": [], "events": [], "spans": []}))
+    assert cli_main(["fleettrace", "--router-dump", str(router_dump),
+                     "--replica-dump", f"r0={replica_dump}"]) == 1
+    # the same dumps WITH a joining replica span succeed
+    replica_dump.write_text(json.dumps({"ticks": [], "events": [], "spans": [
+        {"request_id": 5, "phase": "decode", "start_ns": 1200,
+         "end_ns": 1800, "slot": 0, "fleet": "r1-1", "hop": 0}]}))
+    out = tmp_path / "ok.json"
+    assert cli_main(["fleettrace", "--router-dump", str(router_dump),
+                     "--replica-dump", f"r0={replica_dump}",
+                     "--out", str(out)]) == 0
+    assert json.loads(out.read_text())["fleetJoin"]["joined"] == 1
+
+
+def test_debug_slo_endpoint_and_metrics():
+    """--slo objectives evaluated from router-measured observations:
+    /debug/slo compliance + burn, gauges on /metrics, 404 without
+    --slo."""
+    a = StubReplica("a")
+    a.behavior["chunk_delay_s"] = 0.01
+    a.start()
+    url, fleet, close = make_router(
+        [a], slo_objectives={"ttft_p95_ms": 60000.0, "itl_p50_ms": 60000.0,
+                             "shed_rate": 0.9})
+    try:
+        _wait(lambda: fleet.readiness()[0], what="replica up")
+        for i in range(3):
+            with _post(url, _body(f"slo-{i}", stream=i % 2 == 0)) as r:
+                r.read()
+        with urllib.request.urlopen(url + "/debug/slo", timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["windows"] == ["5m", "1h"]
+        objs = body["objectives"]
+        assert set(objs) == {"ttft_p95_ms", "itl_p50_ms", "shed_rate"}
+        assert objs["ttft_p95_ms"]["n"] >= 3
+        assert objs["ttft_p95_ms"]["compliant"]      # loose threshold
+        assert objs["itl_p50_ms"]["n"] >= 1          # SSE chunk gaps
+        assert objs["shed_rate"]["estimate"] == 0.0
+        assert all(b == 0.0 for b in objs["ttft_p95_ms"]["burn"].values())
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert 'dllama_slo_compliance{objective="ttft_p95_ms"} 1' in text
+        assert 'dllama_slo_burn_rate{objective="shed_rate",window="5m"}' \
+            in text
+    finally:
+        close()
+        a.kill()
+
+
+def test_debug_slo_404_without_objectives():
+    a = StubReplica("a")
+    a.start()
+    url, fleet, close = make_router([a])
+    try:
+        _wait(lambda: fleet.readiness()[0], what="replica up")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url + "/debug/slo", timeout=10)
+        assert e.value.code == 404
+    finally:
+        close()
+        a.kill()
+
+
+def test_shed_feeds_slo_outcome():
+    a = StubReplica("a")
+    a.start()
+    url, fleet, close = make_router(
+        [a], slo_objectives={"shed_rate": 0.25})
+    try:
+        _wait(lambda: fleet.readiness()[0], what="replica up")
+        with _post(url, _body("admitted one")) as r:
+            r.read()
+        a.behavior.update(ready=False, ready_code="queue_full")
+        _wait(lambda: not fleet.readiness()[0], what="fleet saturated")
+        for _ in range(3):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(url, _body("shed me"))
+            assert e.value.code == 429
+        body = json.loads(urllib.request.urlopen(
+            url + "/debug/slo", timeout=10).read())
+        rec = body["objectives"]["shed_rate"]
+        assert rec["n"] == 4 and rec["estimate"] == pytest.approx(0.75)
+        assert not rec["compliant"]         # 75% shed vs a 25% budget
+        assert rec["burn"]["5m"] == pytest.approx(0.75 / 0.25)
+    finally:
+        close()
+        a.kill()
+
+
 # -- end-to-end against a real engine ----------------------------------------
 
 
@@ -825,6 +1154,31 @@ def test_router_fronts_real_engine_replica(tmp_path):
         with _post(url, dict(body, stream=True), timeout=120) as r:
             raw = r.read().decode()
         assert "data: [DONE]" in raw
+        # trace identity reaches the REAL replica: a completion routed
+        # with a client-chosen id lands in the api server's flight dump
+        # as a fleet_rid binding with the serving hop, its span ring
+        # records carry the fleet id, and the opt-in timing block names
+        # the request by the same id
+        req = urllib.request.Request(
+            url + "/v1/chat/completions",
+            data=json.dumps(dict(body, timing=True)).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Dllama-Request-Id": "e2e.trace-1"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers["X-Dllama-Request-Id"] == "e2e.trace-1"
+            timed = json.loads(r.read())
+        assert timed["timing"]["request_id"] == "e2e.trace-1"
+        assert timed["timing"]["hop"] == 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/flight", timeout=30) as r:
+            flight = json.loads(r.read())
+        binds = [ev for ev in flight["events"]
+                 if ev.get("event") == "fleet_rid"
+                 and ev.get("reason") == "e2e.trace-1"]
+        assert len(binds) == 1 and binds[0]["hop"] == 0
+        fleet_spans = [s for s in flight["spans"]
+                       if s.get("fleet") == "e2e.trace-1"]
+        assert fleet_spans and all(s["hop"] == 0 for s in fleet_spans)
     finally:
         close()
         httpd.shutdown()

@@ -1,0 +1,203 @@
+"""SLO observatory math (runtime/slo.py): the streaming log-bucket
+histogram's bounded quantile error vs exact quantiles, burn-rate
+windows under an injectable clock (no wall reads in the hot path), and
+compliance flipping exactly at the configured threshold. Pure host-side
+— no jax, no engine, no sockets."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from dllama_tpu.runtime import slo, telemetry
+
+
+# -- grammar -----------------------------------------------------------------
+
+
+def test_parse_slo_happy_path():
+    got = slo.parse_slo("ttft_p95_ms=500, itl_p50_ms=40,shed_rate=0.01")
+    assert got == {"ttft_p95_ms": 500.0, "itl_p50_ms": 40.0,
+                   "shed_rate": 0.01}
+
+
+@pytest.mark.parametrize("spec,frag", [
+    ("ttft_p95_ms", "not name=value"),
+    ("latency_p95=5", "unknown SLO objective"),
+    ("ttft_p95_ms=500,ttft_p95_ms=600", "duplicate"),
+    ("ttft_p95_ms=banana", "not a number"),
+    ("ttft_p95_ms=0", "positive"),
+    ("ttft_p95_ms=-3", "positive"),
+    ("ttft_p95_ms=inf", "positive"),
+    ("", "empty SLO spec"),
+    (" , ,", "empty SLO spec"),
+])
+def test_parse_slo_rejects(spec, frag):
+    with pytest.raises(ValueError, match=frag):
+        slo.parse_slo(spec)
+
+
+def test_load_slo_json_file(tmp_path):
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps({"ttft_p95_ms": 500, "shed_rate": 0.01}))
+    assert slo.load_slo(str(p)) == {"ttft_p95_ms": 500.0,
+                                    "shed_rate": 0.01}
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2]")
+    with pytest.raises(ValueError, match="JSON object"):
+        slo.load_slo(str(bad))
+    # a non-file argument parses as the inline grammar
+    assert slo.load_slo("itl_p50_ms=40") == {"itl_p50_ms": 40.0}
+
+
+# -- streaming histogram vs exact quantiles ----------------------------------
+
+
+def _exact_quantile(values, q):
+    s = sorted(values)
+    return s[max(1, math.ceil(q * len(s))) - 1]
+
+
+@pytest.mark.parametrize("name,values", [
+    # a point mass: every estimate must land in the value's own bucket
+    ("point_mass", [250.0] * 500),
+    # bimodal: the p50/p95 straddle the modes
+    ("bimodal", [10.0] * 400 + [900.0] * 100),
+    # heavy tail: two decades of spread (deterministic lognormal-ish)
+    ("heavy_tail", [math.exp(1 + 3 * ((i * 37 % 500) / 500.0))
+                    for i in range(500)]),
+])
+@pytest.mark.parametrize("q", [0.50, 0.90, 0.95, 0.99])
+def test_log_histogram_quantile_error_bound(name, values, q):
+    h = slo.LogHistogram()
+    for v in values:
+        h.record(v)
+    exact = _exact_quantile(values, q)
+    est = h.quantile(q)
+    assert abs(est - exact) / exact <= h.rel_error_bound() + 1e-12, \
+        f"{name} q={q}: est {est} vs exact {exact}"
+
+
+def test_log_histogram_underflow_and_empty():
+    h = slo.LogHistogram()
+    assert h.quantile(0.5) == 0.0
+    h.record(0.0)
+    h.record(-5.0)
+    h.record(100.0)
+    assert h.quantile(0.25) == 0.0          # the non-positive mass
+    assert h.quantile(0.99) > 0.0           # the real observation
+    assert h.n == 3
+
+
+# -- burn windows under an injectable clock ----------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_burn_rate_windows_fill_and_expire():
+    clk = _Clock()
+    eng = slo.SloEngine({"ttft_p95_ms": 100.0}, clock=clk,
+                        registry=telemetry.Registry())
+    # 10% of requests blow the threshold against a 5% budget → burn 2.0
+    for i in range(100):
+        eng.observe_ttft(500.0 if i % 10 == 0 else 10.0)
+        clk.t += 1.0
+    ev = eng.evaluate()
+    rec = ev["objectives"]["ttft_p95_ms"]
+    assert rec["burn"]["5m"] == pytest.approx(0.10 / 0.05, rel=1e-6)
+    assert rec["burn"]["1h"] == pytest.approx(0.10 / 0.05, rel=1e-6)
+    # advance past the short window with no traffic: the 5m burn
+    # expires, the 1h burn still remembers
+    clk.t += 400.0
+    rec = eng.evaluate()["objectives"]["ttft_p95_ms"]
+    assert rec["burn"]["5m"] == 0.0
+    assert rec["burn"]["1h"] == pytest.approx(0.10 / 0.05, rel=1e-6)
+    clk.t += 4000.0
+    rec = eng.evaluate()["objectives"]["ttft_p95_ms"]
+    assert rec["burn"]["1h"] == 0.0
+
+
+def test_no_wall_clock_reads_in_hot_path(monkeypatch):
+    """The hot path must use the injected clock only — a wall/monotonic
+    read would let a clock step fabricate or destroy a burn window."""
+    import time as _time
+
+    def _bomb():  # pragma: no cover - failing is the test
+        raise AssertionError("slo hot path read the process clock")
+
+    clk = _Clock()
+    eng = slo.SloEngine({"ttft_p95_ms": 100.0, "shed_rate": 0.01},
+                        clock=clk, registry=telemetry.Registry())
+    monkeypatch.setattr(_time, "monotonic", _bomb)
+    monkeypatch.setattr(_time, "time", _bomb)
+    eng.observe_ttft(50.0)
+    eng.observe_itl(5.0)
+    eng.observe_outcome(shed=False)
+    eng.evaluate()
+
+
+# -- compliance semantics ----------------------------------------------------
+
+
+def test_latency_compliance_flips_exactly_at_threshold():
+    clk = _Clock()
+    reg = telemetry.Registry()
+    probe = slo.LogHistogram()
+    for _ in range(200):
+        probe.record(250.0)
+    est = probe.quantile(0.95)  # the bucket-midpoint estimate
+    # threshold == estimate → compliant (<=); one ulp below → violated
+    eng_at = slo.SloEngine({"ttft_p95_ms": est}, clock=clk, registry=reg)
+    eng_below = slo.SloEngine(
+        {"ttft_p95_ms": math.nextafter(est, 0.0)}, clock=clk,
+        registry=telemetry.Registry())
+    for _ in range(200):
+        eng_at.observe_ttft(250.0)
+        eng_below.observe_ttft(250.0)
+    assert eng_at.evaluate()["objectives"]["ttft_p95_ms"]["compliant"]
+    rec = eng_below.evaluate()["objectives"]["ttft_p95_ms"]
+    assert not rec["compliant"]
+    assert rec["estimate"] == est
+
+
+def test_shed_rate_compliance_and_gauges():
+    clk = _Clock()
+    reg = telemetry.Registry()
+    eng = slo.SloEngine({"shed_rate": 0.10}, clock=clk, registry=reg)
+    for i in range(100):
+        eng.observe_outcome(shed=(i < 10))   # exactly at the 10% budget
+    ev = eng.evaluate()
+    rec = ev["objectives"]["shed_rate"]
+    assert rec["compliant"] and rec["estimate"] == pytest.approx(0.10)
+    assert rec["burn"]["5m"] == pytest.approx(1.0)   # burning the whole
+    # budget exactly — the boundary of sustainable
+    comp = reg.gauge(telemetry.SLO_COMPLIANCE)
+    burn = reg.gauge(telemetry.SLO_BURN_RATE)
+    assert comp.value(objective="shed_rate") == 1.0
+    assert burn.value(objective="shed_rate", window="5m") \
+        == pytest.approx(1.0)
+    # one more shed tips the lifetime fraction over the threshold
+    eng.observe_outcome(shed=True)
+    assert not eng.evaluate()["objectives"]["shed_rate"]["compliant"]
+    assert comp.value(objective="shed_rate") == 0.0
+
+
+def test_itl_objective_routes_to_its_own_histogram():
+    clk = _Clock()
+    eng = slo.SloEngine({"itl_p50_ms": 40.0, "ttft_p95_ms": 500.0},
+                        clock=clk, registry=telemetry.Registry())
+    for _ in range(50):
+        eng.observe_itl(10.0)
+        eng.observe_ttft(1000.0)   # blows ttft, must not touch itl
+    ev = eng.evaluate()["objectives"]
+    assert ev["itl_p50_ms"]["compliant"]
+    assert not ev["ttft_p95_ms"]["compliant"]
+    assert ev["itl_p50_ms"]["n"] == 50

@@ -24,13 +24,14 @@ import sys
 _RATES = ("decode_tok_per_s", "prefill_tok_per_s", "sampled_decode_tok_per_s",
           "chunked_decode_tok_per_s", "paged_decode_tok_per_s",
           "agg_tok_per_s", "accepted_tok_per_s", "decode_tok_per_s_q80",
-          "sessions_per_chip")
+          "sessions_per_chip", "slo_compliance_min")
 # lower-is-better latencies (--scenario continuous/fleet TTFT + the
 # tiered wave's resume TTFT; --scenario multichip exposed collective
-# wall): the printed pct is still "improvement-positive", so the sign is
-# flipped before ranking
+# wall; the fleet scenario's worst SLO error-budget burn): the printed
+# pct is still "improvement-positive", so the sign is flipped before
+# ranking
 _LATENCIES = ("ttft_ms_p50", "ttft_ms_p95", "resume_ttft_p95_ms",
-              "comm_exposed_ms", "comm_exposed_ms_off")
+              "comm_exposed_ms", "comm_exposed_ms_off", "slo_worst_burn")
 # context-only scenario fields: printed for both sides, never ranked (a
 # higher occupancy or sharing count is workload-dependent, not a win/loss
 # — and the fleet scenario's churn counters describe the kill/restart

@@ -14,6 +14,9 @@ suppressions — and every repo invariant as a rule module on top:
   ``route_labels``, ``failpoint_sites``, ``span_phases``,
   ``shard_map_shim``) — each old CLI entry point survives as a thin
   wrapper.
+* :mod:`tools.dlint.slo_names` — the SLO observatory's objective
+  vocabulary (``runtime/slo.OBJECTIVES``) closed-world across the cli
+  grammar, gauges, bench output, and docs.
 
 Run everything: ``python -m tools.dlint`` (repo-clean exit 0); one rule:
 ``--only RULE``; machine-readable: ``--json``. The invariant catalog

@@ -226,6 +226,7 @@ def load_rule_modules() -> None:
         metrics_names,
         pallas_gate,
         route_labels,
+        slo_names,
         span_phases,
         thread_ownership,
         trace_safety,
