@@ -1328,7 +1328,20 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
 
     Workload knobs (env): DLLAMA_BENCH_FLEET_REPLICAS (3),
     DLLAMA_BENCH_SCN_REQUESTS (18), DLLAMA_BENCH_SCN_MAXTOK (12),
-    DLLAMA_BENCH_SCN_STAGGER (0.05 s)."""
+    DLLAMA_BENCH_SCN_STAGGER (0.05 s).
+
+    DLLAMA_BENCH_FLEET_DISAGG=1 switches the fleet to prefill/decode
+    disaggregation: every replica runs the paged pool, replica 0 is
+    tagged ``--role prefill``, and the router warms cold prefixes there
+    before dispatching decode with an ``X-Dllama-KV-Peer`` pointer — so
+    decode replicas pull KV over the checksummed Q80 wire instead of
+    recomputing. The churn kill then lands on a DECODE replica (the
+    scenario keeps its mid-run death, but the lone prefill stays up so
+    the disaggregated path is measured, not just its absence). Extra
+    reported fields: ``kv_migrations``/``kv_fallbacks`` (wire outcomes),
+    ``kvwire_tx_bytes``/``kvwire_rx_bytes`` (wire volume),
+    ``kvmigrate_ms_p50``/``p95`` (per-request TTFT attribution of the
+    parked fetch, from the opt-in timing block)."""
     import shutil
     import tempfile
     import threading
@@ -1356,7 +1369,10 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
     n_reqs = _scn_int("DLLAMA_BENCH_SCN_REQUESTS", 18)
     max_tok = _scn_int("DLLAMA_BENCH_SCN_MAXTOK", 12)
     stagger_s = float(os.environ.get("DLLAMA_BENCH_SCN_STAGGER", "0.05"))
+    disagg = os.environ.get("DLLAMA_BENCH_FLEET_DISAGG", "") not in ("", "0")
     out.update(n_replicas=n_replicas, n_requests=n_reqs)
+    if disagg:
+        out["disagg"] = True
 
     d = tempfile.mkdtemp(prefix="dllama-bench-fleet-")
     engines: list = []
@@ -1376,10 +1392,17 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
         def start_replica(i, port=0):
             # one real engine + batched scheduler + HTTP front per
             # replica — the same stack `python -m dllama_tpu api
-            # --batch-slots 2` serves, minus the process boundary
+            # --batch-slots 2` serves, minus the process boundary.
+            # Disagg needs the paged pool on every replica (KV export
+            # and import are both block-granular), and replica 0 is
+            # the fleet's prefill tier.
             if i >= len(engines):
-                engines.append(InferenceEngine(mpath, tpath, tp=1))
-            state = BatchedApiState(engines[i], n_slots=2)
+                engines.append(InferenceEngine(
+                    mpath, tpath, tp=1,
+                    kv_block_size=16 if disagg else 0))
+            state = BatchedApiState(
+                engines[i], n_slots=2,
+                role="prefill" if disagg and i == 0 else None)
             httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                         make_handler(state))
             threading.Thread(target=httpd.serve_forever,
@@ -1417,6 +1440,19 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
         retries0 = reg.counter(tm.ROUTER_RETRIES).total()
         ejects0 = reg.counter(tm.ROUTER_EJECTS).total()
         shed0 = reg.counter(tm.ROUTER_SHED).total()
+        mig0 = reg.counter(tm.KVWIRE_MIGRATIONS).total(outcome="migrated")
+        fb0 = reg.counter(tm.KVWIRE_MIGRATIONS).total(outcome="fallback")
+        txb0 = reg.counter(tm.KVWIRE_TX_BYTES).total()
+        rxb0 = reg.counter(tm.KVWIRE_RX_BYTES).total()
+        if disagg:
+            # the router must have probed the prefill tag before traffic
+            # (otherwise the first wave silently measures non-disagg)
+            t_wait = time.monotonic() + 15
+            while time.monotonic() < t_wait and not any(
+                    r.is_prefill() for r in fleet.replicas):
+                time.sleep(0.05)
+            out["prefill_probed"] = any(r.is_prefill()
+                                        for r in fleet.replicas)
 
         out["phase"] = "scenario_traffic"
         results: dict = {}
@@ -1429,6 +1465,8 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
                                              + "ab" * (i % 4)}],
                     "max_tokens": max_tok, "temperature": 0,
                     "stream": stream}
+            if disagg and not stream:
+                body["timing"] = True  # carries kvmigrate_ms attribution
             rec: dict = {"t_sub": t0}
             try:
                 req = urllib.request.Request(
@@ -1457,6 +1495,9 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
                         rec["t_first"] = time.perf_counter()
                         rec["ok"] = True
                         rec["tokens"] = data["usage"]["completion_tokens"]
+                        kvms = data.get("timing", {}).get("kvmigrate_ms")
+                        if kvms:
+                            rec["kvmigrate_ms"] = kvms
             except urllib.error.HTTPError as e:
                 rec.update(ok=False, status=e.code)
             except Exception as e:  # noqa: BLE001 — per-request forensics
@@ -1466,6 +1507,10 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
 
         kill_at = max(2, n_reqs // 3)
         restart_at = max(kill_at + 2, (2 * n_reqs) // 3)
+        # disagg keeps the churn but aims it at a DECODE replica — the
+        # lone prefill tier dying would just measure the (covered
+        # elsewhere) no-prefill fallback instead of disaggregation
+        ki = (n_replicas - 1) if disagg else 0
         threads: list = []
         t0 = time.perf_counter()
         for i in range(n_reqs):
@@ -1473,17 +1518,17 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
                 out["error"] = "deadline inside traffic wave"
                 break
             if i == kill_at:
-                # the churn event: replica 0 dies mid-traffic — new
+                # the churn event: a replica dies mid-traffic — new
                 # connections refused, its scheduler fails in-flight work
                 out["phase"] = "scenario_kill"
-                servers[0].shutdown()
-                servers[0].server_close()
-                states[0].close(drain_s=0.0)
+                servers[ki].shutdown()
+                servers[ki].server_close()
+                states[ki].close(drain_s=0.0)
             if i == restart_at:
                 out["phase"] = "scenario_restart"
                 state, httpd = start_replica(
-                    0, port=int(urls[0].rsplit(":", 1)[1]))
-                states[0], servers[0] = state, httpd
+                    ki, port=int(urls[ki].rsplit(":", 1)[1]))
+                states[ki], servers[ki] = state, httpd
             th = threading.Thread(target=do_request, args=(i,))
             th.start()
             threads.append(th)
@@ -1513,10 +1558,27 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
                                    - ejects0)
         out["router_shed"] = int(reg.counter(tm.ROUTER_SHED).total()
                                  - shed0)
+        if disagg:
+            # wire outcomes + volume: what the disaggregation actually
+            # moved instead of recomputing, and what fell back
+            out["kv_migrations"] = int(reg.counter(
+                tm.KVWIRE_MIGRATIONS).total(outcome="migrated") - mig0)
+            out["kv_fallbacks"] = int(reg.counter(
+                tm.KVWIRE_MIGRATIONS).total(outcome="fallback") - fb0)
+            out["kvwire_tx_bytes"] = int(reg.counter(
+                tm.KVWIRE_TX_BYTES).total() - txb0)
+            out["kvwire_rx_bytes"] = int(reg.counter(
+                tm.KVWIRE_RX_BYTES).total() - rxb0)
+            kvms = sorted(r["kvmigrate_ms"] for r in done
+                          if r.get("kvmigrate_ms"))
+            out["kvmigrate_ms_p50"] = (round(_pctl(kvms, 0.5), 1)
+                                       if kvms else None)
+            out["kvmigrate_ms_p95"] = (round(_pctl(kvms, 0.95), 1)
+                                       if kvms else None)
         # the restart's re-admission, telemetry-asserted: the breaker
         # must bring the killed replica back before the scenario ends
         t_wait = time.monotonic() + 15
-        killed = fleet.replicas[0].name
+        killed = fleet.replicas[ki].name
         while time.monotonic() < t_wait \
                 and not up.value(replica=killed):
             time.sleep(0.1)

@@ -81,6 +81,15 @@ have at least one call site:
   severs the replica connection deterministically, driving the
   retry-on-another-replica and circuit-breaker paths end to end
   (tests/test_router.py).
+* ``kvwire`` — the KV-migration wire's per-frame receive point
+  (``runtime/kvwire.py read_frames``, fired before each frame read on
+  the import side): ``raise`` severs the transfer like a peer death
+  (fallback reason ``peer_death``), ``short_read`` truncates the frame
+  so it fails integrity verification (fallback reason ``crc``), and
+  ``sleep`` stalls the stream past the per-transfer deadline (fallback
+  reason ``timeout``). Every action must end in the destination
+  rolling back its staged blocks and recomputing the prefix locally —
+  never in a user-visible failure.
 * ``wire`` — the overlapped wire collectives' shipped partial
   (``runtime/numerics.poison_code``, injected in-graph by
   ``parallel/qcollectives._maybe_poison_partial``): the ``nonfinite``

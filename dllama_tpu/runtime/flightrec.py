@@ -258,28 +258,36 @@ class FlightRecorder:
 
 def ttft_phases(t_submit: int, t_admit: int, t_decode: int,
                 t_first_token: int, ms_prefill: float,
-                ms_pagein: float = 0.0) -> dict:
+                ms_pagein: float = 0.0,
+                ms_kvmigrate: float = 0.0) -> dict:
     """THE TTFT phase formula — every surface that decomposes a first
     token (the ``dllama_ttft_attrib_ms`` histograms, the API ``timing``
     block on both serving paths, bench.py's attribution section) derives
     from this one function, so they can never drift apart. Timestamps
     are monotonic ns; ``ms_prefill`` is the request's own prefill chunk
-    dispatch wall and ``ms_pagein`` its KV-tier page-in wall (resumed
-    sessions restoring spilled blocks; 0 everywhere else). Phases: queue
-    (submit → admission start), pagein (host→device block restore for a
-    resumed session), admission (admission start → decode-armed minus
-    own prefill and pagein walls — bookkeeping plus interleave gaps
-    while other requests' chunks ran), prefill (own chunk dispatch wall;
-    pagein+prefill clamp to the admission window), first_decode
-    (decode-armed → first token). The five sum to ``ttft_ms`` by
-    construction. Single-sequence serving passes
+    dispatch wall, ``ms_pagein`` its KV-tier page-in wall (resumed
+    sessions restoring spilled blocks; 0 everywhere else), and
+    ``ms_kvmigrate`` its peer-KV migration wall (fetch + stage + commit,
+    or the failed attempt before a recompute fallback; 0 everywhere
+    else). Phases: queue (submit → admission start minus the migration
+    wall — migration runs while the request is parked pre-admission, so
+    it is carved out of the queue window), kvmigrate (peer-KV fetch +
+    scatter, clamped to the queue window), pagein (host→device block
+    restore for a resumed session), admission (admission start →
+    decode-armed minus own prefill and pagein walls — bookkeeping plus
+    interleave gaps while other requests' chunks ran), prefill (own
+    chunk dispatch wall; pagein+prefill clamp to the admission window),
+    first_decode (decode-armed → first token). The six sum to
+    ``ttft_ms`` by construction. Single-sequence serving passes
     ``t_admit == t_submit`` (no scheduler queue → queue = 0)."""
-    queue = (t_admit - t_submit) / 1e6
+    queue_window = (t_admit - t_submit) / 1e6
+    kvmigrate = min(ms_kvmigrate, queue_window)
     window = (t_decode - t_admit) / 1e6
     pagein = min(ms_pagein, window)
     prefill = min(ms_prefill, window - pagein)
     return {"ttft_ms": (t_first_token - t_submit) / 1e6,
-            "queue_ms": queue,
+            "queue_ms": queue_window - kvmigrate,
+            "kvmigrate_ms": kvmigrate,
             "pagein_ms": pagein,
             "admission_ms": window - prefill - pagein,
             "prefill_ms": prefill,
@@ -291,6 +299,7 @@ def record_ttft(hist, bd: dict) -> None:
     ``dllama_ttft_attrib_ms`` histogram — the one publication site for
     both serving paths, so the phase label set can never diverge."""
     hist.record(bd["queue_ms"], phase="queue")
+    hist.record(bd["kvmigrate_ms"], phase="kvmigrate")
     hist.record(bd["pagein_ms"], phase="pagein")
     hist.record(bd["admission_ms"], phase="admission")
     hist.record(bd["prefill_ms"], phase="prefill")

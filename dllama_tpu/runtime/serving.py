@@ -26,6 +26,7 @@ fixed-seed reproducibility.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from contextlib import nullcontext
@@ -197,6 +198,15 @@ class Request:
     ms_pagein: float = 0.0        # KV-tier page-in wall during admission
     #                               (resumed sessions restoring spilled
     #                               blocks — the `pagein` TTFT phase)
+    ms_kvmigrate: float = 0.0     # peer-KV migration wall while parked
+    #                               pre-admission (runtime/kvwire fetch +
+    #                               scatter — the `kvmigrate` TTFT phase)
+    # KV migration (runtime/kvwire): a peer replica URL whose paged pool
+    # holds this prompt's prefix. The scheduler fetches the blocks over
+    # the checksummed Q80 wire before admission; ANY failure clears the
+    # field and the request admits normally (recompute fallback) — a
+    # migration is an optimization, never a correctness dependency.
+    kv_peer: str | None = None
     # speculative accounting (paged/dense spec serving): drafted tokens
     # offered to verify dispatches and the accepted count — the per-request
     # accept rate surfaced in the opt-in `timing` response block
@@ -215,7 +225,8 @@ class Request:
             return None
         return flightrec.ttft_phases(self.t_submit, self.t_admit,
                                      self.t_decode, self.t_first_token,
-                                     self.ms_prefill, self.ms_pagein)
+                                     self.ms_prefill, self.ms_pagein,
+                                     self.ms_kvmigrate)
 
 
 @dataclass
@@ -242,6 +253,36 @@ class _Admission:
     cow: tuple | None = None
     cow_release: int = 0
     need_take: bool = False
+
+
+@dataclass
+class _KVMigration:
+    """One in-flight peer-KV pull (runtime/kvwire): the request parks
+    here — popped from the queue, not yet admitted — while a daemon
+    thread streams frames from the peer. The fetch thread writes ONLY
+    this holder (blocks/error/finished) and never touches scheduler or
+    pool state; the loop thread commits or falls back in
+    ``_service_migrations`` once ``finished`` flips."""
+
+    req: Request
+    peer: str
+    t0_ns: int
+    blocks: list = field(default_factory=list)
+    error: BaseException | None = None
+    finished: bool = False
+
+
+@dataclass
+class _KVExportJob:
+    """One pending ``/v1/kv/export`` gather: the HTTP handler thread
+    parks on ``done`` while the loop thread (the pool's owner) runs
+    :meth:`PagedGenerator.export_prefix` between ticks."""
+
+    tokens: list[int]
+    done: threading.Event = field(default_factory=threading.Event)
+    n_tokens: int = 0
+    blocks: list = field(default_factory=list)
+    error: BaseException | None = None
 
 
 class _GeneratorCore:
@@ -1214,6 +1255,16 @@ class PagedGenerator(_GeneratorCore):
         self._take = jax.jit(_take_fn)  # dlint: disable=jit-entry
         self._put = jax.jit(_put_fn, donate_argnums=(0,))  # dlint: disable=jit-entry
         self._copy_block = jax.jit(_copy_fn, donate_argnums=(0,))  # dlint: disable=jit-entry
+        # KV migration wire (runtime/kvwire): export gathers one block at
+        # a time, import scatters one block at a time — ids is a traced
+        # 1-element array, so a migration of ANY length reuses the same
+        # two executables (the tier's gather/scatter transfer programs,
+        # shape-stable by construction). Cold path: raw jit, same
+        # plan-independence argument as the trio above.
+        from ..models.llama import gather_kv_blocks, scatter_kv_blocks
+
+        self._wire_take = jax.jit(gather_kv_blocks)  # dlint: disable=jit-entry
+        self._wire_put = jax.jit(scatter_kv_blocks, donate_argnums=(0,))  # dlint: disable=jit-entry
         # warm-up normalization: pass the freshly created (committed) pool
         # through one no-op jitted copy (null block onto itself). Two birds:
         # the copy-on-write program is compiled BEFORE serving reaches
@@ -1446,6 +1497,99 @@ class PagedGenerator(_GeneratorCore):
         return (self.pool.free_blocks() - sum(self._reserve)
                 >= self._worst_case_blocks(len(req.prompt_ids),
                                            req.max_tokens))
+
+    # -- KV migration wire: export (peer pull) / ingest (local commit) ------
+
+    def wire_geometry(self) -> dict:  # dlint: owner=any
+        """The layout facts a KV-wire transfer must agree on bit-for-bit
+        (``runtime/kvwire.GEOMETRY_KEYS``) — pure config reads, safe from
+        any thread."""
+        import numpy as _np
+
+        return {"n_layers": self.cfg.n_layers,
+                "n_kv_heads": self.cfg.n_kv_heads,
+                "block_size": self.block_size,
+                "head_dim": self.cfg.head_dim,
+                "dtype": str(_np.dtype(self.eng.kv_dtype))}
+
+    def export_prefix(self, tokens: list[int]) -> tuple[int, list]:  # dlint: owner=loop-thread
+        """Gather the device-resident shared-prefix blocks matching
+        ``tokens`` for a peer's ``/v1/kv/export`` pull: ``(n_tokens,
+        [(k, v), ...])`` with each plane ``[L, n_kv, bs, hd]`` float32
+        numpy. The match truncates at the first HOST-resident block (a
+        cold block would need a page-in the exporter must not spend on a
+        peer's behalf); blocks are pinned via :meth:`BlockPool.share`
+        across the gather so a concurrent admission's pressure cannot
+        spill or evict them mid-read, and released after — refcounts
+        balance exactly."""
+        shared, _n_tok, _cow, _cow_r = self.pool.match_prefix(list(tokens))
+        dev: list[int] = []
+        for b in shared:
+            if self.pool.is_host(b):
+                break
+            dev.append(b)
+        if not dev:
+            return 0, []
+        for b in dev:
+            self.pool.share(b)
+        try:
+            out = []
+            for b in dev:
+                k, v = self._wire_take(self.pkv,
+                                       jnp.asarray([b], jnp.int32))
+                out.append((np.asarray(k[:, 0], np.float32),
+                            np.asarray(v[:, 0], np.float32)))
+        finally:
+            for b in dev:
+                self.pool.release(b)
+        return len(dev) * self.block_size, out
+
+    def ingest_prefix(self, tokens: list[int], blocks: list) -> int:  # dlint: owner=loop-thread
+        """Commit peer-migrated KV into the pool: one fresh device block
+        per received ``(k, v)`` pair, scattered via the wire transfer
+        program and registered under the prompt's prefix — the very next
+        ``begin_admit`` finds them through ``match_prefix`` and reuses
+        them exactly like locally computed blocks. Atomic: exhaustion
+        mid-allocation releases every staged block and re-raises
+        (``BlockPoolExhausted`` → the caller's ``exhaustion`` fallback
+        reason); nothing is registered until every block is resident, so
+        a failed ingest leaves the pool untouched. Returns the number of
+        prefix tokens now resident (0 when already matched locally —
+        a duplicate migration must not burn blocks)."""
+        n_tokens = len(blocks) * self.block_size
+        usable = list(tokens[:n_tokens])
+        if len(usable) < n_tokens:
+            # peer sent more blocks than this prompt has prefill
+            # positions (mismatched transfer): refuse the surplus
+            n_full = len(usable) // self.block_size
+            blocks = blocks[:n_full]
+            n_tokens = n_full * self.block_size
+            usable = usable[:n_tokens]
+        if not blocks:
+            return 0
+        _, already, _c, _r = self.pool.match_prefix(usable)
+        if already >= n_tokens:
+            return 0
+        bids: list[int] = []
+        try:
+            for _ in blocks:
+                bids.append(self.pool.alloc())
+        except BlockPoolExhausted:
+            for b in bids:
+                self.pool.release(b)
+            raise
+        for b, (k, v) in zip(bids, blocks):
+            self.pkv = self._wire_put(
+                self.pkv, jnp.asarray(k[:, None]), jnp.asarray(v[:, None]),
+                jnp.asarray([b], jnp.int32))
+        self.pool.register_prompt(bids, usable)
+        for b in bids:
+            # rc → 0 parks each registered block in the cached LRU:
+            # matchable by the admission that triggered the migration,
+            # evictable/spillable under pressure like any cached prefix
+            self.pool.release(b)
+        self._update_block_gauges()
+        return n_tokens
 
     # -- admission ----------------------------------------------------------
 
@@ -1996,6 +2140,12 @@ class BatchScheduler:
         # dlint's lock-guard rule via the guarded-by declarations)
         self._queue: list[Request] = []          # dlint: guarded-by=_lock
         self._admissions: list[_Admission] = []  # dlint: guarded-by=_lock
+        # KV migration (runtime/kvwire): requests parked mid-transfer +
+        # peer export gathers awaiting the loop thread. Guarded so
+        # _fail_all (any thread) can drain the parked requests without
+        # racing the loop's service sweep.
+        self._migrating: list[_KVMigration] = []   # dlint: guarded-by=_lock
+        self._export_jobs: list[_KVExportJob] = []  # dlint: guarded-by=_lock
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._next_rid = 0                       # dlint: guarded-by=_lock
@@ -2026,7 +2176,8 @@ class BatchScheduler:
     def submit(self, prompt_ids: list[int], max_tokens: int, *,  # dlint: owner=any
                temperature: float = 0.0, topp: float = 0.9,
                seed: int = 0xB1A5, stop_on_eos: bool = True,
-               timeout_s: float | None = None, on_token=None) -> Request:
+               timeout_s: float | None = None, on_token=None,
+               kv_peer: str | None = None) -> Request:
         with self._lock:
             if self._stop or self._draining or not self._healthy or (
                     self._thread is not None and not self._thread.is_alive()):
@@ -2049,6 +2200,10 @@ class BatchScheduler:
                           max_tokens=max_tokens, temperature=temperature,
                           topp=topp, seed=seed, stop_on_eos=stop_on_eos,
                           on_token=on_token)
+            if kv_peer and hasattr(self.gen, "wire_geometry"):
+                # peer-KV migration is paged-pool-only; a dense pool (or
+                # an empty peer) just recomputes — no error, no field
+                req.kv_peer = kv_peer
             req.t_submit = telemetry.now_ns()
             if timeout_s is not None and timeout_s > 0:
                 req.deadline_ns = req.t_submit + int(timeout_s * 1e9)
@@ -2178,6 +2333,11 @@ class BatchScheduler:
             # live pool
             victims += [a.req for a in self._admissions]
             self._admissions.clear()
+            # parked migrations hold NO pool state (the fetch thread
+            # writes only its holder) — failing them here leaks nothing,
+            # and the orphaned fetch thread's result is simply dropped
+            victims += [m.req for m in self._migrating]
+            self._migrating.clear()
             telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(0)
         for s in list(self.gen.slots):
             if s is not None:
@@ -2214,6 +2374,143 @@ class BatchScheduler:
                 self._timeout_request(s)
                 self.flight.note("timeout", s.rid, reason="in_flight")
                 s.cancel.set()
+
+    # -- KV migration (runtime/kvwire): peer pull before admission -----------
+
+    def _spawn_migration(self, mig: _KVMigration) -> None:  # dlint: owner=loop-thread
+        """Launch the fetch thread for a freshly parked migration. The
+        per-transfer deadline is bounded by the request's own remaining
+        deadline — a migration may never park a request past the point
+        its recompute fallback could still finish in time."""
+        from . import kvwire
+
+        deadline_s = float(os.environ.get("DLLAMA_KVWIRE_DEADLINE_S", 0)
+                           or 0) or kvwire.DEFAULT_DEADLINE_S
+        if mig.req.deadline_ns:
+            remaining = (mig.req.deadline_ns - telemetry.now_ns()) / 1e9
+            deadline_s = max(0.05, min(deadline_s, remaining))
+        self.flight.note("kvmigrate_begin", mig.req.rid, peer=mig.peer)
+        threading.Thread(target=self._migrate_worker,
+                         args=(mig, deadline_s), daemon=True,
+                         name=f"dllama-kvwire-{mig.req.rid}").start()
+
+    def _migrate_worker(self, mig: _KVMigration,
+                        deadline_s: float) -> None:  # dlint: owner=any
+        """The fetch thread body: stream + verify the peer's frames.
+        Writes ONLY the migration holder — never scheduler or pool
+        state — so a fetch outliving a fail-all sweep (its holder
+        already dropped) is harmless."""
+        from . import kvwire
+
+        try:
+            _, blocks = kvwire.fetch_kv(mig.peer, mig.req.prompt_ids[:-1],
+                                        self.gen.wire_geometry(),
+                                        deadline_s=deadline_s)
+            mig.blocks = [(k, v) for _i, k, v
+                          in sorted(blocks, key=lambda t: t[0])]
+        except BaseException as e:  # noqa: BLE001 — every failure class falls back to recompute
+            mig.error = e
+        mig.finished = True
+        self._wake.set()
+
+    def _service_migrations(self) -> None:  # dlint: owner=loop-thread
+        """Commit or fall back every finished migration: success ingests
+        the blocks (scatter + prefix registration — the request's own
+        admission then reuses them like any shared prefix); ANY failure
+        — wire error, injected chaos, destination exhaustion — counts
+        its reason in ``dllama_kvwire_fallback_total`` and requeues the
+        request at the head for ordinary chunked-prefill recompute.
+        Either way the wall spent parked lands in the request's
+        ``kvmigrate`` TTFT phase and span; a user-visible failure is
+        impossible by construction."""
+        from . import kvwire
+
+        with self._lock:
+            finished = [m for m in self._migrating if m.finished]
+            for m in finished:
+                self._migrating.remove(m)
+        for mig in finished:
+            req = mig.req
+            if req.done.is_set():
+                continue  # failed (shutdown/deadline sweep) while parked
+            n_tokens, reason = 0, None
+            if mig.error is None:
+                try:
+                    n_tokens = self.gen.ingest_prefix(req.prompt_ids[:-1],
+                                                      mig.blocks)
+                except BlockPoolExhausted:
+                    reason = "exhaustion"
+                except Exception as e:  # noqa: BLE001 — a bad ingest degrades to recompute
+                    reason = kvwire.classify_failure(e)
+            else:
+                reason = kvwire.classify_failure(mig.error)
+            now = telemetry.now_ns()
+            req.ms_kvmigrate += (now - mig.t0_ns) / 1e6
+            telemetry.tracer().emit(req.rid, "kvmigrate", mig.t0_ns, now,
+                                    n_tokens=n_tokens)
+            reg = telemetry.registry()
+            if reason is None:
+                reg.counter(telemetry.KVWIRE_MIGRATIONS).inc(
+                    outcome="migrated")
+                self.flight.note("kvmigrate", req.rid, n_tokens=n_tokens,
+                                 peer=mig.peer)
+            else:
+                reg.counter(telemetry.KVWIRE_MIGRATIONS).inc(
+                    outcome="fallback")
+                reg.counter(telemetry.KVWIRE_FALLBACK).inc(reason=reason)
+                self.flight.note("kvmigrate_fallback", req.rid,
+                                 reason=reason, peer=mig.peer)
+            with self._lock:
+                # head of the queue: the request was at the front when it
+                # parked, and its prefix (migrated or not) admits through
+                # the one ordinary path — match, share, chunked prefill
+                self._queue.insert(0, req)
+                telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
+                    len(self._queue))
+            self._wake.set()
+
+    # -- KV export (the peer-pull source side) -------------------------------
+
+    def request_kv_export(self, tokens: list[int],
+                          timeout_s: float = 5.0) -> tuple[int, list]:  # dlint: owner=any
+        """Gather the device-resident prefix blocks matching ``tokens``
+        for a peer's ``/v1/kv/export`` pull: parks the calling handler
+        thread while the loop thread (the pool's owner) runs
+        :meth:`PagedGenerator.export_prefix` between ticks. Returns
+        ``(n_tokens, [(k, v), ...])``; raises
+        :class:`SchedulerUnavailableError` when the loop cannot service
+        the gather (stopped, crashed, or past ``timeout_s``)."""
+        if not hasattr(self.gen, "export_prefix"):
+            raise SchedulerUnavailableError(
+                "KV export needs the paged block pool (--kv-block-size)")
+        job = _KVExportJob(tokens=list(tokens))
+        with self._lock:
+            if self._stop or not self._healthy or (
+                    self._thread is not None
+                    and not self._thread.is_alive()):
+                raise SchedulerUnavailableError("scheduler is not running")
+            self._export_jobs.append(job)
+        self._wake.set()
+        if not job.done.wait(timeout_s):
+            raise SchedulerUnavailableError(
+                f"KV export gather timed out after {timeout_s:g}s")
+        if job.error is not None:
+            raise job.error
+        return job.n_tokens, job.blocks
+
+    def _service_exports(self) -> None:  # dlint: owner=loop-thread
+        """Drain pending export gathers (loop thread — the only thread
+        allowed to touch the block pool). A gather failure answers THAT
+        export request with the error; serving is untouched."""
+        with self._lock:
+            jobs, self._export_jobs = list(self._export_jobs), []
+        for job in jobs:
+            try:
+                job.n_tokens, job.blocks = self.gen.export_prefix(
+                    job.tokens)
+            except Exception as e:  # noqa: BLE001 — the export answers with the error, serving continues
+                job.error = e
+            job.done.set()
 
     def _on_stall(self, info: dict) -> None:  # dlint: owner=monitor-thread
         """Watchdog trip (runs on the MONITOR thread — the loop thread is
@@ -2336,13 +2633,36 @@ class BatchScheduler:
             introspection.ledger().compile_count(self._introspect_scope)
             if self._introspect_scope else 0)
         self._check_deadlines()
+        # KV migration service points (runtime/kvwire): peer export
+        # gathers run here (the loop thread owns the pool), and finished
+        # peer pulls commit or fall back before this tick's admissions —
+        # a just-migrated prefix is matchable by its own request's
+        # begin_admit below
+        if self._export_jobs:
+            self._service_exports()
+        if self._migrating:
+            self._service_migrations()
         reserved = {a.slot for a in self._admissions}
+        started: list[_KVMigration] = []
         with self._lock:
             # start admissions into free, unreserved slots; on the paged
             # pool each request is priced in BLOCKS first (worst-case
             # need vs free+evictable blocks) — an unaffordable request
             # stays queued, preserving FIFO order
             while self._queue:
+                head = self._queue[0]
+                if head.kv_peer:
+                    # peer-KV pull: park the request while a fetch
+                    # thread streams frames across ticks — bystanders
+                    # keep admitting and decoding untouched; any wire
+                    # failure requeues it for ordinary recompute
+                    self._queue.pop(0)
+                    mig = _KVMigration(req=head, peer=head.kv_peer,
+                                       t0_ns=telemetry.now_ns())
+                    head.kv_peer = None  # one attempt, ever
+                    self._migrating.append(mig)
+                    started.append(mig)
+                    continue
                 free = [s for s in self.gen.free_slots()
                         if s not in reserved]
                 if not free:
@@ -2382,6 +2702,11 @@ class BatchScheduler:
                 reserved.add(adm.slot)
             telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
                 len(self._queue))
+        # fetch threads launch OUTSIDE the admission lock (the spawn
+        # takes no scheduler state, and _migrate_worker's first wake
+        # could otherwise re-enter a non-reentrant lock path)
+        for mig in started:
+            self._spawn_migration(mig)
         # interleaved chunked prefill under the token-budget policy: the
         # FIRST admission always advances one chunk (progress guarantee);
         # further admissions run only while the tick's budget lasts, so a

@@ -85,6 +85,15 @@ KV_SPILL_MS = "dllama_kv_spill_ms_total"
 KV_PAGEIN_BLOCKS = "dllama_kv_pagein_blocks_total"
 KV_PAGEIN_BYTES = "dllama_kv_pagein_bytes_total"
 KV_PAGEIN_MS = "dllama_kv_pagein_ms_total"
+# KV migration wire (runtime/kvwire.py, runtime/serving.py import path)
+KVWIRE_TX_FRAMES = "dllama_kvwire_tx_frames_total"
+KVWIRE_TX_BYTES = "dllama_kvwire_tx_bytes_total"
+KVWIRE_TX_MS = "dllama_kvwire_tx_ms_total"
+KVWIRE_RX_FRAMES = "dllama_kvwire_rx_frames_total"
+KVWIRE_RX_BYTES = "dllama_kvwire_rx_bytes_total"
+KVWIRE_RX_MS = "dllama_kvwire_rx_ms_total"
+KVWIRE_MIGRATIONS = "dllama_kvwire_migrations_total"
+KVWIRE_FALLBACK = "dllama_kvwire_fallback_total"
 # fault tolerance (runtime/serving.py, runtime/failpoints.py)
 REQUESTS_SHED = "dllama_requests_shed_total"
 REQUEST_TIMEOUTS = "dllama_request_timeouts_total"
@@ -114,6 +123,7 @@ ROUTER_EJECTS = "dllama_router_ejects_total"
 ROUTER_READMITS = "dllama_router_readmits_total"
 ROUTER_SHED = "dllama_router_shed_total"
 ROUTER_AFFINITY_HITS = "dllama_router_affinity_hits_total"
+ROUTER_AFFINITY_PURGED = "dllama_router_affinity_purged_total"
 ROUTER_TTFT_MS = "dllama_router_ttft_ms"
 ROUTER_CONNECT_MS = "dllama_router_connect_ms"
 ROUTER_RETRY_MS = "dllama_router_retry_ms"
@@ -272,6 +282,34 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
     _spec(KV_PAGEIN_MS, "counter",
           "Wall ms of page-in batches (also the per-request `pagein` "
           "TTFT attribution phase, dllama_ttft_attrib_ms)"),
+    _spec(KVWIRE_TX_FRAMES, "counter",
+          "KV-wire frames serialized and written by the export side "
+          "(runtime/kvwire.py; header + per-block + end frames)"),
+    _spec(KVWIRE_TX_BYTES, "counter",
+          "Bytes of framed Q80 KV written by the export side (wire "
+          "payload + framing + crc32 trailers)"),
+    _spec(KVWIRE_TX_MS, "counter",
+          "Wall ms spent encoding + writing KV-wire frames on the "
+          "export side"),
+    _spec(KVWIRE_RX_FRAMES, "counter",
+          "KV-wire frames read and crc32-verified by the import side"),
+    _spec(KVWIRE_RX_BYTES, "counter",
+          "Bytes of framed Q80 KV read by the import side"),
+    _spec(KVWIRE_RX_MS, "counter",
+          "Wall ms spent reading + decoding KV-wire frames on the "
+          "import side (the fetch thread's wall, not the loop thread's)"),
+    _spec(KVWIRE_MIGRATIONS, "counter",
+          "KV migrations attempted, by outcome (migrated: prefix KV "
+          "fetched from the peer, scattered, and committed; fallback: "
+          "any failure rolled back to ordinary chunked-prefill "
+          "recompute)"),
+    _spec(KVWIRE_FALLBACK, "counter",
+          "KV migrations that fell back to local recompute, by reason "
+          "(timeout: per-transfer deadline exceeded; crc: checksum "
+          "mismatch or truncated frame; peer_death: connect/read "
+          "failure or clean EOF mid-stream; exhaustion: destination "
+          "block pool could not stage the blocks). A fallback is never "
+          "a user-visible failure"),
     _spec(REQUESTS_SHED, "counter",
           "Requests rejected at admission because the queue was full "
           "(HTTP 429 load shedding)"),
@@ -364,12 +402,14 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "WARN-logged and kept in the /debug/compiles ledger)"),
     _spec(TTFT_ATTRIB_MS, "histogram",
           "Per-request TTFT decomposition by phase (queue: submit to "
-          "admission start; admission: admission start to decode-armed "
-          "minus own prefill dispatch wall; prefill: own prefill chunk "
-          "dispatch wall; first_decode: decode-armed to first emitted "
-          "token). The four phases sum to wall TTFT by construction "
-          "(runtime/flightrec, recorded by the generators and the "
-          "single-sequence API path)"),
+          "admission start minus any peer-KV migration wall; kvmigrate: "
+          "peer-KV fetch + scatter while parked pre-admission; pagein: "
+          "host->device restore of spilled blocks; admission: admission "
+          "start to decode-armed minus own prefill dispatch wall; "
+          "prefill: own prefill chunk dispatch wall; first_decode: "
+          "decode-armed to first emitted token). The six phases sum to "
+          "wall TTFT by construction (runtime/flightrec, recorded by "
+          "the generators and the single-sequence API path)"),
     _spec(ITL_ATTRIB_MS, "histogram",
           "Per-request decode-phase wall attribution by cause (step: "
           "total decode dispatch wall while the request's slot was "
@@ -410,6 +450,11 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
     _spec(ROUTER_AFFINITY_HITS, "counter",
           "Fleet router: dispatches that landed on their session's "
           "sticky replica (prefix-cache-aware affinity in effect)"),
+    _spec(ROUTER_AFFINITY_PURGED, "counter",
+          "Fleet router: sticky affinity entries purged from the LRU "
+          "because their replica was circuit-breaker-ejected, by "
+          "replica (a restarted cold-cache replica must not inherit "
+          "stale stickiness)"),
     _spec(ROUTER_TTFT_MS, "histogram",
           "Fleet router: time from request admission to the first "
           "upstream body byte the router relayed (router-measured TTFT "
@@ -672,8 +717,12 @@ def registry() -> Registry:
 # * ``pagein`` — one host→device page-in batch restoring a resumed
 #   session's spilled KV blocks during admission (the KV tier,
 #   runtime/kvblocks.py; also a TTFT attribution phase).
+# * ``kvmigrate`` — one peer-KV migration attempt: fetch start → staged
+#   blocks committed (or rolled back to recompute) on the destination
+#   (runtime/kvwire.py + the serving import path; also a TTFT
+#   attribution phase).
 PHASES = ("queue", "admit", "prefill", "prefill_chunk", "decode", "verify",
-          "requeue", "pagein")
+          "requeue", "pagein", "kvmigrate")
 
 # Router span vocabulary (serve/router.py RouterSpanRing.emit_span) — the
 # fleet-side counterpart of PHASES, closed-world-checked the same way
@@ -693,8 +742,16 @@ PHASES = ("queue", "admit", "prefill", "prefill_chunk", "decode", "verify",
 #   wall the retry burned before the serving hop).
 # * ``rt_eject`` — an instant marker: the circuit breaker ejected the
 #   replica this request just failed on.
+# * ``rt_prefill`` — one synchronous warm-up completion on a
+#   ``--role prefill`` replica before the decode dispatch
+#   (prefill/decode disaggregation; failures are spanned too — the
+#   dispatch then proceeds without a donor).
+# * ``rt_kv_donor`` — an instant marker: the dispatch carried an
+#   ``X-Dllama-KV-Peer`` pointer naming the replica the decode side
+#   should pull its prefix KV from (runtime/kvwire).
 ROUTER_PHASES = ("rt_queue", "rt_dispatch", "rt_connect", "rt_first_byte",
-                 "rt_stream", "rt_retry", "rt_eject")
+                 "rt_stream", "rt_retry", "rt_eject", "rt_prefill",
+                 "rt_kv_donor")
 
 
 class SpanTracer:

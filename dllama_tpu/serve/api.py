@@ -29,8 +29,10 @@ import json
 import os
 import queue
 import re
+import threading
 import time
 import uuid
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
@@ -48,7 +50,7 @@ from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
 # folded into "other" so a scanner can't explode the label cardinality.
 # Closed-world: every route literal a handler matches on must be listed here
 # (tools/check_route_labels.py enforces it in `make lint`).
-_ROUTES = ("/v1/chat/completions", "/v1/models", "/metrics",
+_ROUTES = ("/v1/chat/completions", "/v1/kv/export", "/v1/models", "/metrics",
            "/health", "/healthz", "/readyz", "/debug",
            "/debug/compiles", "/debug/requests", "/debug/profile",
            "/debug/numerics", "/debug/flight", "/debug/timeline",
@@ -117,6 +119,25 @@ def backpressure_headers(status: int) -> dict:
 FLEET_RID_HEADER = "X-Dllama-Request-Id"
 FLEET_HOP_HEADER = "X-Dllama-Hop"
 FLEET_RID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+# KV migration hint (serve/router.py sends it): "host:port" of a peer
+# replica whose paged pool holds this prompt's prefix. The replica pulls
+# the prefix over the kvwire stream (POST /v1/kv/export on the peer)
+# before admission instead of recomputing it; ANY wire failure degrades
+# to ordinary chunked prefill. Advisory by construction — an unsanitary
+# or stale value is dropped, never an error.
+KV_PEER_HEADER = "X-Dllama-KV-Peer"
+KV_PEER_RE = re.compile(r"^[A-Za-z0-9._\-\[\]:]{1,255}:\d{1,5}$")
+
+
+def kv_peer(headers) -> str | None:
+    """Parse + sanitize the KV migration hint header (values feed
+    ``http.client`` connections and flight-ring notes — out-of-vocabulary
+    strings are dropped, never stored)."""
+    peer = headers.get(KV_PEER_HEADER)
+    if not peer or not KV_PEER_RE.match(peer):
+        return None
+    return peer
 
 
 def fleet_identity(headers) -> tuple[str, int] | None:
@@ -309,8 +330,12 @@ class ApiState:
                     "crashed")
         return True, "ok", "ok"
 
-    def complete(self, body: dict, emit=None, fleet=None) -> dict:
+    def complete(self, body: dict, emit=None, fleet=None,
+                 kv_peer: str | None = None) -> dict:
         """Run one chat completion; ``emit(text)`` streams deltas when set.
+        ``kv_peer`` is accepted for interface parity with the batched
+        state and ignored — the single-sequence engine has no paged pool
+        to migrate into (its NaiveCache already reuses local prefixes).
         ``fleet`` is the optional ``(fleet_request_id, hop)`` trace
         identity from :func:`fleet_identity` — bound to this request's
         engine-local rid so spans and lifecycle events join fleet-wide.
@@ -498,15 +523,25 @@ class BatchedApiState:
     Handler threads block on a per-request queue fed by the scheduler
     thread's ``on_token`` callback."""
 
+    # how many prefix keys the residency advertisement remembers: enough
+    # for a fleet's worth of sticky sessions, small enough that /readyz
+    # bodies stay probe-sized
+    KV_PREFIX_MAX = 64
+
     def __init__(self, engine: InferenceEngine, n_slots: int,
                  model_name: str = "dllama-tpu",
                  template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
-                 max_queue: int = 0, request_timeout: float = 0.0):
+                 max_queue: int = 0, request_timeout: float = 0.0,
+                 role: str | None = None):
         from ..runtime.serving import BatchScheduler
 
         self.engine = engine
         self.model_name = model_name
         self.request_timeout = request_timeout  # server default (0 = none)
+        # disaggregation tag (--role prefill|decode, None = untagged):
+        # advertised on /readyz so the fleet router can keep prefill
+        # replicas out of the decode dispatch pool
+        self.role = role
         tok = engine.tokenizer
         eos_piece = (tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
                      if tok.eos_token_ids else "")
@@ -515,9 +550,32 @@ class BatchedApiState:
         self.stop_pieces = [tok.vocab[t].decode("utf-8", "replace")
                             for t in tok.eos_token_ids]
         self.sched = BatchScheduler(engine, n_slots, max_queue=max_queue)
+        # prefix-residency advertisement: affinity keys (serve/router.py
+        # affinity_key — the router joins on the same function) of
+        # prompts whose KV this replica's paged pool RECENTLY held.
+        # Advisory: the pool evicts independently, so a stale entry just
+        # costs one export probe that returns "not resident". Bounded
+        # LRU; handler threads write it, the probe reader snapshots it.
+        self._kv_prefixes: OrderedDict[str, None] = OrderedDict()
+        self._kv_lock = threading.Lock()
 
     def readiness(self) -> tuple[bool, str, str]:
         return self.sched.readiness()
+
+    def note_kv_prefix(self, key: str | None) -> None:
+        """Record (LRU-front) a prefix this replica's pool now holds."""
+        if not key:
+            return
+        with self._kv_lock:
+            self._kv_prefixes.pop(key, None)
+            self._kv_prefixes[key] = None
+            while len(self._kv_prefixes) > self.KV_PREFIX_MAX:
+                self._kv_prefixes.popitem(last=False)
+
+    def kv_prefix_list(self) -> list[str]:
+        """Most-recent-first snapshot for the /readyz advertisement."""
+        with self._kv_lock:
+            return list(reversed(self._kv_prefixes))
 
     def begin_drain(self) -> None:
         self.sched.begin_drain()
@@ -525,7 +583,8 @@ class BatchedApiState:
     def close(self, drain_s: float = 0.0) -> None:
         self.sched.close(drain_s)
 
-    def complete(self, body: dict, emit=None, fleet=None) -> dict:
+    def complete(self, body: dict, emit=None, fleet=None,
+                 kv_peer: str | None = None) -> dict:
         tok = self.engine.tokenizer
         _validate_body(body)
         messages = body["messages"]
@@ -547,7 +606,8 @@ class BatchedApiState:
             seed=int(body.get("seed", 0xB1A5)),
             stop_on_eos=True,
             timeout_s=timeout_s if timeout_s > 0 else None,
-            on_token=lambda t, p: q.put((t, p)))
+            on_token=lambda t, p: q.put((t, p)),
+            kv_peer=kv_peer)
         if fleet is not None:
             # bound AFTER submit (the scheduler assigns the rid there);
             # the submit span predates the binding, but every later
@@ -608,6 +668,14 @@ class BatchedApiState:
         if finish_reason in ("length", "timeout"):
             gate.flush_tail()
         rt.done(len(ids), n_completion)
+        if hasattr(self.sched.gen, "wire_geometry"):
+            # paged pool: the retired request's prefix blocks are parked
+            # in the cached LRU, matchable — advertise residency so the
+            # fleet router can migrate the KV instead of recomputing
+            # (serve/router.py joins on the same affinity_key)
+            from .router import affinity_key
+
+            self.note_kv_prefix(affinity_key(body))
         out = {
             "text": "".join(gate.parts),
             "finish_reason": finish_reason,
@@ -759,9 +827,19 @@ def make_handler(state: ApiState):
                 # fleet router consumes it; humans debug with "reason"),
                 # plus the shared Retry-After on the unready answer
                 ready, reason, code = state.readiness()
-                self._json(200 if ready else 503,
-                           {"status": "ok" if ready else "unready",
-                            "reason": reason, "code": code},
+                rz = {"status": "ok" if ready else "unready",
+                      "reason": reason, "code": code}
+                # disaggregation/migration advertisement (batched paged
+                # replicas only): the fleet router's probe reads these
+                # off the same body it already parses — role keeps
+                # prefill replicas out of the decode pool, kv_prefixes
+                # feeds the migration donor map
+                if getattr(state, "role", None):
+                    rz["role"] = state.role
+                kv_list = getattr(state, "kv_prefix_list", None)
+                if kv_list is not None:
+                    rz["kv_prefixes"] = kv_list()
+                self._json(200 if ready else 503, rz,
                            headers=None if ready
                            else backpressure_headers(503))
             elif path == "/debug":
@@ -853,10 +931,68 @@ def make_handler(state: ApiState):
             except Exception as e:  # noqa: BLE001 — diagnostics must fail as JSON, never wedge serving
                 self._json(503, {"error": f"{type(e).__name__}: {e}"})
 
+        def _kv_export(self) -> None:
+            # POST /v1/kv/export {"tokens": [...]} → kvwire frame stream
+            # of the paged-KV blocks covering the longest resident prefix
+            # of ``tokens``. 404 when nothing is resident (the importer
+            # treats any failure as "recompute locally"); the stream has
+            # no Content-Length, so the connection closes to delimit it.
+            from ..runtime import kvwire
+
+            sched = getattr(state, "sched", None)
+            if sched is None or not hasattr(sched, "request_kv_export"):
+                self._drain_small_body()
+                self._json(404, {"error": "KV export needs batched paged "
+                                          "serving (--batch-slots N with "
+                                          "--kv-block-size)"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                self._json(400, {"error": "invalid JSON body"})
+                return
+            tokens = body.get("tokens") if isinstance(body, dict) else None
+            if (not isinstance(tokens, list) or not tokens
+                    or not all(isinstance(t, int) for t in tokens)):
+                self._json(400, {"error": "body must carry a non-empty "
+                                          "integer token list in 'tokens'"})
+                return
+            try:
+                n_tokens, blocks = sched.request_kv_export(tokens)
+            except SchedulerUnavailableError as e:
+                self._json(503, {"error": str(e), "code": "draining"
+                                 if "draining" in str(e) else "crashed"},
+                           headers=backpressure_headers(503))
+                return
+            except Exception as e:  # noqa: BLE001 — export must fail as JSON; importer falls back
+                self._json(503, {"error": f"{type(e).__name__}: {e}",
+                                 "code": "crashed"},
+                           headers=backpressure_headers(503))
+                return
+            if not n_tokens:
+                self._json(404, {"error": "prefix not resident"})
+                return
+            geometry = dict(sched.gen.wire_geometry(),
+                            n_blocks=len(blocks), n_tokens=n_tokens)
+            self._count(200)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                kvwire.write_stream(self.wfile, geometry, blocks)
+            except OSError:
+                pass  # importer vanished mid-stream: its problem, not ours
+            self.close_connection = True
+
         def do_POST(self):
             path = self._route()
             if path == "/debug/profile":
                 self._debug_profile()
+                return
+            if path == "/v1/kv/export":
+                self._kv_export()
                 return
             if path not in ("/v1/chat/completions",):
                 self._drain_small_body()
@@ -873,6 +1009,7 @@ def make_handler(state: ApiState):
                 return
             fleet = fleet_identity(self.headers)
             self._fleet_rid = fleet[0] if fleet else None
+            peer = kv_peer(self.headers)
             stream = bool(body.get("stream", False))
             inflight = telemetry.registry().gauge(telemetry.REQUESTS_IN_FLIGHT)
             inflight.add(1)
@@ -926,7 +1063,8 @@ def make_handler(state: ApiState):
 
             try:
                 if stream:
-                    out = state.complete(body, emit=emit, fleet=fleet)
+                    out = state.complete(body, emit=emit, fleet=fleet,
+                                         kv_peer=peer)
                     start_stream()  # zero-delta completion: headers now
                     final = _chunk_json(state, {}, out["finish_reason"])
                     self.wfile.write(
@@ -934,7 +1072,7 @@ def make_handler(state: ApiState):
                     self.wfile.write(b"data: [DONE]\n\n")
                     status = 200
                 else:
-                    out = state.complete(body, fleet=fleet)
+                    out = state.complete(body, fleet=fleet, kv_peer=peer)
                     self._json(200, _completion_json(state, out))
                     status = 200
             except QueueFullError as e:
@@ -1067,11 +1205,17 @@ def run_api_server(args) -> int:
     max_queue = getattr(args, "max_queue", 0) or 0
     request_timeout = getattr(args, "request_timeout", 0.0) or 0.0
     drain_timeout = getattr(args, "drain_timeout", 5.0)
+    role = getattr(args, "role", None) or None
+    if role and (n_slots <= 1 or not (getattr(args, "kv_block_size", 0) or 0)):
+        raise SystemExit("--role tags a disaggregated replica; it needs "
+                         "batched paged serving (--batch-slots N with "
+                         "--kv-block-size) so the KV wire has blocks to "
+                         "export and import")
     ttype = ChatTemplateType(getattr(args, "chat_template", None) or "unknown")
     if n_slots > 1:
         state: ApiState | BatchedApiState = BatchedApiState(
             engine, n_slots, template_type=ttype, max_queue=max_queue,
-            request_timeout=request_timeout)
+            request_timeout=request_timeout, role=role)
         server = ThreadingHTTPServer((args.host, args.port),
                                      make_handler(state))
         print(f"🕸️ continuous batching: {state.sched.n_slots} slots"
@@ -1095,6 +1239,10 @@ def run_api_server(args) -> int:
                 print("⚠️ tiered KV memory requested but the host tier "
                       "came up empty (budget or transfer warmup) — "
                       "serving untiered")
+            print(f"🕸️ KV migration: POST /v1/kv/export serves resident "
+                  f"prefixes over the checksummed Q80 wire"
+                  + (f"; role={role} advertised on /readyz" if role
+                     else ""))
         if engine.spec_lookup:
             paged = bool(getattr(engine, "kv_block_size", 0))
             print(f"🕸️ speculative serving: verify K={engine.spec_lookup} "

@@ -271,6 +271,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "sequence slots (one ragged decode program; requests "
                         "queue beyond the pool). 0/1 = single-sequence mode "
                         "with prefix KV reuse")
+    p.add_argument("--role", choices=("prefill", "decode"), default=None,
+                   help="api mode, batched paged serving: disaggregation "
+                        "tag advertised on /readyz. The fleet router keeps "
+                        "'prefill' replicas out of the decode dispatch "
+                        "pool and uses them to compute prompt KV that "
+                        "decode replicas pull over the checksummed Q80 "
+                        "wire (POST /v1/kv/export) instead of recomputing")
     p.add_argument("--max-queue", type=int, default=0, metavar="N",
                    help="api mode, batched serving: bound the admission "
                         "queue at N waiting requests; submits beyond it are "

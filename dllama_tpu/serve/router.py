@@ -102,6 +102,11 @@ _ROUTES = ("/v1/chat/completions", "/v1/models", "/metrics",
 # headers, dumps, and logs on every tier.
 FLEET_RID_HEADER = "X-Dllama-Request-Id"
 FLEET_HOP_HEADER = "X-Dllama-Hop"
+# KV migration hint stamped on first-hop dispatches: "host:port" of a
+# peer replica whose paged pool holds the prompt's prefix (the replica
+# pulls it over the kvwire stream instead of recomputing). Re-spelled
+# from serve/api.py for the same engine-free-import reason as above.
+KV_PEER_HEADER = "X-Dllama-KV-Peer"
 _RID_SAFE_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 # upstream response headers relayed verbatim; everything hop-by-hop or
@@ -175,6 +180,13 @@ class Replica:
         self.ejected_until = 0.0       # dlint: guarded-by=_lock
         self.backoff_s = backoff_min_s  # dlint: guarded-by=_lock
         self.last_probe_t = 0.0        # dlint: guarded-by=_lock
+        # disaggregation/migration advertisement off the last /readyz
+        # body: the replica's --role tag and its resident-prefix keys
+        self.role = None               # dlint: guarded-by=_lock
+        self.kv_prefixes: list = []    # dlint: guarded-by=_lock
+        # invoked OUTSIDE the lock when the breaker ejects this replica
+        # (the FleetRouter hangs its sticky-affinity purge here)
+        self.on_eject = None
         reg = telemetry.registry()
         self._g_up = reg.gauge(telemetry.ROUTER_REPLICA_UP)
         self._g_inflight = reg.gauge(telemetry.ROUTER_INFLIGHT)
@@ -238,6 +250,20 @@ class Replica:
         if ejected:
             self._g_up.set(0, replica=self.name)
             self._c_ejects.inc(replica=self.name)
+            if self.on_eject is not None:
+                self.on_eject(self)
+
+    def is_prefill(self) -> bool:  # dlint: owner=any
+        with self._lock:
+            return self.role == "prefill"
+
+    def holds_prefix(self, key: str) -> bool:  # dlint: owner=any
+        """Whether this replica's last probe advertised ``key`` as a
+        resident paged-KV prefix. Advisory by construction: the pool
+        evicts independently of the probe cadence, so a stale True costs
+        one export round trip that answers \"not resident\"."""
+        with self._lock:
+            return self.state != "down" and key in self.kv_prefixes
 
     def note_success(self, *, from_probe: bool = False) -> None:  # dlint: owner=any
         """A successful probe or dispatch: failures reset; an ejected
@@ -292,6 +318,23 @@ class Replica:
             if not half_open_failed:
                 self.note_failure()
             return
+        # the disaggregation/migration advertisement rides the same body
+        # on BOTH answers (a draining replica still holds its blocks);
+        # the vocabulary is closed — role outside {prefill, decode} and
+        # non-string prefixes are dropped, never stored
+        role, prefixes = None, []
+        try:
+            rz = json.loads(body)
+            if rz.get("role") in ("prefill", "decode"):
+                role = rz["role"]
+            pf = rz.get("kv_prefixes")
+            if isinstance(pf, list):
+                prefixes = [p for p in pf if isinstance(p, str)][:64]
+        except (ValueError, AttributeError):
+            pass
+        with self._lock:
+            self.role = role
+            self.kv_prefixes = prefixes
         if status == 200:
             self.note_success(from_probe=True)
         else:
@@ -349,6 +392,8 @@ class Replica:
                 "engine_inflight": self.engine_inflight,
                 "block_occupancy": self.block_occupancy,
                 "router_inflight": self.inflight,
+                "role": self.role,
+                "kv_prefixes": list(self.kv_prefixes),
                 "consecutive_failures": self.consecutive_failures,
                 "backoff_s": self.backoff_s if self.state == "down" else 0.0,
                 "last_probe_s_ago": (round(time.monotonic()
@@ -446,6 +491,11 @@ class FleetRouter:
                          for u in replica_urls]
         if len({r.name for r in self.replicas}) != len(self.replicas):
             raise ValueError("duplicate --replica URLs")
+        for r in self.replicas:
+            # affinity hygiene: a breaker eject drops the replica's
+            # sticky entries immediately (not one dispatchable() miss
+            # per returning session at a time)
+            r.on_eject = self._on_replica_eject
         self.probe_interval_s = probe_interval_s
         self.max_inflight = max_inflight
         self.read_timeout_s = read_timeout_s
@@ -466,6 +516,8 @@ class FleetRouter:
         self.c_retries = reg.counter(telemetry.ROUTER_RETRIES)
         self.c_shed = reg.counter(telemetry.ROUTER_SHED)
         self.c_affinity = reg.counter(telemetry.ROUTER_AFFINITY_HITS)
+        self.c_affinity_purged = reg.counter(
+            telemetry.ROUTER_AFFINITY_PURGED)
         self.c_retry_hops = reg.counter(telemetry.ROUTER_RETRY_HOPS)
         self.h_ttft = reg.histogram(telemetry.ROUTER_TTFT_MS)
         self.h_connect = reg.histogram(telemetry.ROUTER_CONNECT_MS)
@@ -521,11 +573,44 @@ class FleetRouter:
 
     # -- dispatch policy -----------------------------------------------------
 
+    def _on_replica_eject(self, rep: Replica) -> None:  # dlint: owner=any
+        """Breaker eject → sticky-map hygiene: purge every affinity
+        entry pointing at the ejected replica so returning sessions
+        re-pick (and possibly KV-migrate) immediately instead of riding
+        a dead pointer through a dispatchable() miss each."""
+        with self._lock:
+            stale = [k for k, v in self._affinity.items() if v is rep]
+            for k in stale:
+                del self._affinity[k]
+        if stale:
+            self.c_affinity_purged.inc(len(stale), replica=rep.name)
+
+    def prefill_replicas(self) -> list:  # dlint: owner=any
+        """Dispatchable prefill-role replicas (disaggregation donors)."""
+        return [r for r in self.replicas
+                if r.dispatchable() and r.is_prefill()]
+
+    def kv_donor(self, key: str | None,
+                 chosen: Replica) -> Replica | None:  # dlint: owner=any
+        """The migration source for a fleet-global prefix hit: a replica
+        (≠ ``chosen``) whose last probe advertised ``key`` as resident.
+        Advisory — a stale advertisement costs one export probe that
+        answers \"not resident\", after which the destination recomputes."""
+        if key is None:
+            return None
+        for rep in self.replicas:
+            if rep is not chosen and rep.holds_prefix(key):
+                return rep
+        return None
+
     def pick(self, key: str | None,
              exclude: set | None = None) -> Replica | None:  # dlint: owner=any
         """The dispatch decision: sticky replica while it stays healthy
         (and isn't excluded by a retry), else least-loaded; updates the
-        sticky map so the session returns here next time."""
+        sticky map so the session returns here next time. Prefill-role
+        replicas serve warm-up work only, so they are excluded — unless
+        they are ALL that remains, in which case availability beats
+        disaggregation purity."""
         exclude = exclude or set()
         if key is not None:
             with self._lock:
@@ -533,14 +618,15 @@ class FleetRouter:
                 if sticky is not None:
                     self._affinity.move_to_end(key)
             if sticky is not None and sticky not in exclude \
-                    and sticky.dispatchable():
+                    and sticky.dispatchable() and not sticky.is_prefill():
                 self.c_affinity.inc()
                 return sticky
         live = [r for r in self.replicas
                 if r not in exclude and r.dispatchable()]
         if not live:
             return None
-        chosen = min(live, key=lambda r: r.load_score())
+        decode = [r for r in live if not r.is_prefill()]
+        chosen = min(decode or live, key=lambda r: r.load_score())
         if key is not None:
             with self._lock:
                 self._affinity[key] = chosen
@@ -1013,6 +1099,43 @@ def make_router_handler(fleet: FleetRouter):
                 fleet.spans.emit_span(rid, "rt_eject", now, now,
                                       replica=rep.name, hop=hop)
 
+        def _prefill_warm(self, body: dict, rid: str) -> Replica | None:
+            """Explicit disaggregation: run the prompt (one token, no
+            stream) on the least-loaded prefill-role replica so its
+            paged pool holds the prefix, then name it as the KV donor
+            for the decode dispatch. Best-effort on every path — a
+            failed or refused warm-up just means the decode replica
+            prefills locally."""
+            pre = fleet.prefill_replicas()
+            if not pre:
+                return None
+            rep = min(pre, key=lambda r: r.load_score())
+            warm = dict(body)
+            warm["max_tokens"] = 1
+            warm["stream"] = False
+            warm.pop("timing", None)
+            t0 = telemetry.now_ns()
+            rep.begin_request()
+            try:
+                conn, resp = self._open_upstream(
+                    rep, "POST", "/v1/chat/completions",
+                    json.dumps(warm).encode("utf-8"),
+                    extra_headers={FLEET_RID_HEADER: rid,
+                                   FLEET_HOP_HEADER: "0"})
+                try:
+                    resp.read()
+                finally:
+                    conn.close()
+                rep.note_success()
+                return rep
+            except _UpstreamDied:
+                return None
+            finally:
+                rep.end_request()
+                fleet.spans.emit_span(rid, "rt_prefill", t0,
+                                      telemetry.now_ns(),
+                                      replica=rep.name)
+
         def _dispatch_completion(self, raw: bytes, body: dict,
                                  rid: str, t0_ns: int) -> bool:
             """Dispatch one admitted completion (with one cross-replica
@@ -1043,15 +1166,34 @@ def make_router_handler(fleet: FleetRouter):
                     load=round(snap["queue_depth"]
                                + snap["engine_inflight"]
                                + snap["router_inflight"], 3))
+                extra = {FLEET_RID_HEADER: rid,
+                         FLEET_HOP_HEADER: str(attempt)}
+                if attempt == 0 and key is not None \
+                        and not rep.holds_prefix(key):
+                    # fleet-global prefix reuse: a peer advertising this
+                    # key becomes the KV donor; with none, explicit
+                    # disaggregation warms a prefill-role replica first.
+                    # First hop only — a retry hop already paid for one
+                    # migration attempt and must not stack another wire
+                    # wait on a degraded fleet
+                    donor = fleet.kv_donor(key, rep)
+                    if donor is None:
+                        donor = self._prefill_warm(body, rid)
+                        if donor is rep:
+                            donor = None
+                    if donor is not None:
+                        extra[KV_PEER_HEADER] = donor.name
+                        t_don = telemetry.now_ns()
+                        fleet.spans.emit_span(rid, "rt_kv_donor", t_don,
+                                              t_don, replica=rep.name,
+                                              donor=donor.name)
                 rep.begin_request()
                 t_hop0 = telemetry.now_ns()
                 try:
                     try:
                         conn, resp = self._open_upstream(
                             rep, "POST", "/v1/chat/completions", raw,
-                            extra_headers={
-                                FLEET_RID_HEADER: rid,
-                                FLEET_HOP_HEADER: str(attempt)})
+                            extra_headers=extra)
                     except _UpstreamDied as e:
                         t_fail = telemetry.now_ns()
                         ns_failed += t_fail - t_hop0

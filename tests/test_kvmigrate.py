@@ -1,0 +1,575 @@
+"""Fault-tolerant KV migration (runtime/kvwire + serving + api + router).
+
+THE correctness property: a request whose prefix KV was migrated over
+the checksummed Q80 wire produces output token-identical to one that
+recomputed the prefix locally — and EVERY wire failure (dead peer,
+corrupt frame, expired deadline, exhausted destination pool) degrades to
+that local recompute with the reason on the fallback counter, never to a
+user-visible error. The wire codec itself must equal one in-graph
+``fake_quant_q80`` application bit for bit, so a migrated prefix carries
+exactly the quantization the sync-q80 parity mode already defines."""
+
+import io
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import tfile
+from dllama_tpu.runtime import failpoints as fp
+from dllama_tpu.runtime import kvwire
+from dllama_tpu.runtime import telemetry as tm
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.runtime.kvblocks import BlockPoolExhausted
+
+from helpers import byte_vocab_tokenizer, tiny_header_params, write_tiny_model
+
+BLOCK = 16
+PATHS = {}
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.registry().clear()
+    yield
+    fp.registry().clear()
+
+
+# -- codec + framing (no engine) ---------------------------------------------
+
+GEOM = {"n_layers": 2, "n_kv_heads": 4, "block_size": 16, "head_dim": 8,
+        "dtype": "float32"}
+
+
+def _mk_blocks(n, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (GEOM["n_layers"], GEOM["n_kv_heads"], GEOM["block_size"],
+             GEOM["head_dim"])
+    return [(rng.standard_normal(shape).astype(np.float32) * 3,
+             rng.standard_normal(shape).astype(np.float32) * 3)
+            for _ in range(n)]
+
+
+def _stream_bytes(blocks, geom=None, n_tokens=None):
+    g = dict(geom or GEOM)
+    g["n_blocks"] = len(blocks)
+    g["n_tokens"] = (n_tokens if n_tokens is not None
+                     else len(blocks) * g["block_size"])
+    buf = io.BytesIO()
+    kvwire.write_stream(buf, g, blocks)
+    return buf.getvalue()
+
+
+def test_q80_codec_matches_fake_quant_bitwise():
+    """The wire roundtrip IS one fake_quant_q80 application: codes from
+    the unrounded f32 scale, dequant by the f16-rounded stored scale."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.ops.linear import fake_quant_q80
+
+    rng = np.random.default_rng(7)
+    x = (rng.standard_normal((4, 256)).astype(np.float32) * 5)
+    x[0, :32] = 0.0  # an all-zero Q80 group must decode to exact zeros
+    codes, scales = kvwire.q80_encode(x)
+    back = kvwire.q80_decode(codes, scales.reshape(-1, 1), x.shape)
+    want = np.asarray(fake_quant_q80(jnp.asarray(x)), np.float32)
+    np.testing.assert_array_equal(back, want)
+
+
+def test_stream_roundtrip_counts_and_order():
+    blocks = _mk_blocks(3)
+    reg = tm.registry()
+    tx0 = reg.counter(tm.KVWIRE_TX_FRAMES).total()
+    rx0 = reg.counter(tm.KVWIRE_RX_BYTES).total()
+    data = _stream_bytes(blocks)
+    hdr, rx = kvwire.read_stream(io.BytesIO(data), GEOM)
+    assert hdr["n_tokens"] == 48 and hdr["n_blocks"] == 3
+    assert [i for i, _, _ in rx] == [0, 1, 2]
+    # header + 3 blocks + end frame on the TX counter; RX counted bytes
+    assert reg.counter(tm.KVWIRE_TX_FRAMES).total() - tx0 == 5
+    assert reg.counter(tm.KVWIRE_RX_BYTES).total() - rx0 == len(data)
+    for (k, _v), (_, rk, _rv) in zip(blocks, rx):
+        ck, sk = kvwire.q80_encode(k)
+        np.testing.assert_array_equal(
+            rk, kvwire.q80_decode(ck, sk.reshape(-1, 1), k.shape))
+
+
+def test_flipped_byte_fails_crc():
+    data = bytearray(_stream_bytes(_mk_blocks(2)))
+    # flip a byte deep inside the first block frame's payload (the
+    # header frame is < 200 B; block frames are ~2.2 kB each)
+    data[400] ^= 0x40
+    with pytest.raises(kvwire.ChecksumError):
+        kvwire.read_stream(io.BytesIO(bytes(data)), GEOM)
+    assert kvwire.classify_failure(kvwire.ChecksumError("x")) == "crc"
+
+
+def test_truncation_is_peer_death():
+    data = _stream_bytes(_mk_blocks(2))
+    for cut in (len(data) // 2, len(data) - 6):  # mid-frame, pre-end
+        with pytest.raises(kvwire.TruncatedStream) as e:
+            kvwire.read_stream(io.BytesIO(data[:cut]), GEOM)
+        assert kvwire.classify_failure(e.value) == "peer_death"
+
+
+def test_geometry_mismatch_refuses_loudly():
+    data = _stream_bytes(_mk_blocks(1))
+    expect = dict(GEOM, head_dim=16)
+    with pytest.raises(kvwire.GeometryMismatch) as e:
+        kvwire.read_stream(io.BytesIO(data), expect)
+    assert "head_dim" in str(e.value)  # the refusal names the field
+
+
+def test_version_mismatch_refuses():
+    body = json.dumps(GEOM).encode()
+    hdr = struct.pack(">4sHI", kvwire.MAGIC, kvwire.VERSION + 1,
+                      len(body)) + body
+    frame = (struct.pack(">I", len(hdr)) + hdr
+             + struct.pack(">I", __import__("zlib").crc32(hdr)))
+    with pytest.raises(kvwire.GeometryMismatch):
+        kvwire.read_stream(io.BytesIO(frame), GEOM)
+
+
+def test_expired_deadline_mid_stream():
+    data = _stream_bytes(_mk_blocks(1))
+    with pytest.raises(kvwire.DeadlineExceeded) as e:
+        kvwire.read_stream(io.BytesIO(data), GEOM,
+                           deadline=time.monotonic() - 1.0)
+    assert kvwire.classify_failure(e.value) == "timeout"
+
+
+def test_failpoint_short_read_classifies_crc():
+    """kvwire:short_read truncates a frame section → the integrity
+    class (reason "crc"), same as a flipped bit — and does NOT retry."""
+    fp.arm("kvwire", "short_read", times=1)
+    with pytest.raises(kvwire.ChecksumError) as e:
+        kvwire.read_stream(io.BytesIO(_stream_bytes(_mk_blocks(1))), GEOM)
+    assert kvwire.classify_failure(e.value) == "crc"
+
+
+def test_failpoint_raise_classifies_peer_death():
+    fp.arm("kvwire", "raise", times=1)
+    with pytest.raises(fp.FailpointError) as e:
+        kvwire.read_stream(io.BytesIO(_stream_bytes(_mk_blocks(1))), GEOM)
+    assert kvwire.classify_failure(e.value) == "peer_death"
+
+
+# -- fetch client (stub HTTP peers) ------------------------------------------
+
+
+class _StubPeer:
+    """A /v1/kv/export stand-in with scripted per-request behavior:
+    each entry of ``script`` is ``"reset"`` (close before any status),
+    ``"busy"`` (503), bytes (serve verbatim), or ``("truncate", bytes,
+    n)`` (serve the first n bytes then close)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.n_requests = 0
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                stub.n_requests += 1
+                step = (stub.script.pop(0) if stub.script else "busy")
+                if step == "reset":
+                    # close before any status byte: the client sees
+                    # RemoteDisconnected (an OSError → transient class)
+                    self.close_connection = True
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.connection.close()
+                    return
+                if step == "busy":
+                    body = b'{"error": "not now"}'
+                    self.send_response(503)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                data = step[1][:step[2]] if isinstance(step, tuple) else step
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(data)
+                self.close_connection = True
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def peer(self):
+        return f"127.0.0.1:{self.port}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_fetch_kv_retries_transient_then_succeeds():
+    """A peer that dies on the first attempt (connection reset before
+    any status byte) is retried with backoff inside the deadline."""
+    data = _stream_bytes(_mk_blocks(2))
+    stub = _StubPeer(["reset", data])
+    try:
+        hdr, blocks = kvwire.fetch_kv(stub.peer, [1, 2, 3], GEOM,
+                                      deadline_s=5.0)
+        assert hdr["n_blocks"] == 2 and len(blocks) == 2
+        assert stub.n_requests == 2
+    finally:
+        stub.close()
+
+
+def test_fetch_kv_exhausts_attempts_on_dead_peer():
+    stub = _StubPeer(["reset", "reset", "reset", "reset"])
+    try:
+        with pytest.raises((kvwire.KVWireError, OSError)) as e:
+            kvwire.fetch_kv(stub.peer, [1], GEOM, deadline_s=5.0,
+                            max_attempts=3)
+        assert stub.n_requests == 3  # bounded: exactly max_attempts
+        assert kvwire.classify_failure(e.value) == "peer_death"
+    finally:
+        stub.close()
+
+
+def test_fetch_kv_integrity_failure_does_not_retry():
+    """A corrupt frame means the SOURCE is bad — retrying the same
+    source would re-download the same corruption; recompute instead."""
+    data = bytearray(_stream_bytes(_mk_blocks(2)))
+    data[400] ^= 0x40
+    stub = _StubPeer([bytes(data), bytes(data)])
+    try:
+        with pytest.raises(kvwire.ChecksumError):
+            kvwire.fetch_kv(stub.peer, [1], GEOM, deadline_s=5.0)
+        assert stub.n_requests == 1
+    finally:
+        stub.close()
+
+
+# -- engine-level migration ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("kvmigrate")
+    mpath, tpath = d / "m.m", d / "t.t"
+    rng = np.random.default_rng(41)
+    # seq_len 256: the llama3 chat template alone is ~90 byte-tokens, and
+    # the migration tests want several full 16-row blocks of prompt KV
+    write_tiny_model(mpath, tiny_header_params(vocab_size=268, seq_len=256),
+                     rng)
+    td = byte_vocab_tokenizer()
+    td.chat_template = "<|start_header_id|>"  # detected as llama3
+    tfile.write_tfile(tpath, td)
+    PATHS["m"], PATHS["t"] = str(mpath), str(tpath)
+    return PATHS
+
+
+def _paged_state(files, n_slots=2, role=None):
+    from dllama_tpu.serve.api import BatchedApiState
+
+    engine = InferenceEngine(files["m"], files["t"], tp=1,
+                             kv_block_size=BLOCK, temperature=0.0, seed=3)
+    return BatchedApiState(engine, n_slots=n_slots, role=role)
+
+
+def _serve(state):
+    from dllama_tpu.serve.api import make_handler
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, port
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url + "/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _body(prompt, n=8, **extra):
+    return {"messages": [{"role": "user", "content": prompt}],
+            "max_tokens": n, "temperature": 0, **extra}
+
+
+def _session_text(tag):
+    # >= 2 full 16-row blocks of templated prompt per session
+    return tag + "".join(chr(97 + j % 26) for j in range(40))
+
+
+@pytest.fixture(scope="module")
+def src_server(files):
+    """The migration SOURCE: a paged batched api-server whose pool is
+    warmed per test; also the never-migrated baseline oracle (batched
+    output equals solo output — the serving invariant pinned by
+    tests/test_serving.py)."""
+    state = _paged_state(files)
+    httpd, port = _serve(state)
+    yield f"http://127.0.0.1:{port}", state, port
+    httpd.shutdown()
+    httpd.server_close()
+    state.close()
+
+
+@pytest.fixture(scope="module")
+def dst_state(files):
+    """The migration DESTINATION, driven directly through
+    ``BatchedApiState.complete(..., kv_peer=...)`` (what the HTTP
+    handler does with the X-Dllama-KV-Peer header)."""
+    state = _paged_state(files)
+    yield state
+    state.close()
+
+
+def _mig_totals():
+    reg = tm.registry()
+    return {
+        "migrated": reg.counter(tm.KVWIRE_MIGRATIONS).total(
+            outcome="migrated"),
+        "fallback": reg.counter(tm.KVWIRE_MIGRATIONS).total(
+            outcome="fallback"),
+        **{r: reg.counter(tm.KVWIRE_FALLBACK).total(reason=r)
+           for r in ("timeout", "crc", "peer_death", "exhaustion")},
+    }
+
+
+def _delta(after, before):
+    return {k: after[k] - before[k] for k in after}
+
+
+def test_migrated_decode_token_exact(src_server, dst_state):
+    """The tentpole contract end to end: warm the source, migrate the
+    prefix to the destination over the wire, and the destination's
+    completion is byte-identical to the never-migrated source run —
+    with the migration on the counters and the kvmigrate TTFT phase."""
+    url, _, port = src_server
+    body = _body(_session_text("mig-exact-"), n=8)
+    baseline = _post(url, body)  # warms the source's pool
+    t0 = _mig_totals()
+    rx0 = tm.registry().counter(tm.KVWIRE_RX_BYTES).total()
+    out = dst_state.complete(dict(body, timing=True),
+                             kv_peer=f"127.0.0.1:{port}")
+    d = _delta(_mig_totals(), t0)
+    assert out["text"] == baseline["choices"][0]["message"]["content"]
+    assert out["finish_reason"] == baseline["choices"][0]["finish_reason"]
+    assert d["migrated"] == 1 and d["fallback"] == 0
+    assert tm.registry().counter(tm.KVWIRE_RX_BYTES).total() > rx0
+    # the migration wall is attributed to its own TTFT phase, carved
+    # out of the queue window (runtime/flightrec.ttft_phases)
+    assert out["timing"]["kvmigrate_ms"] > 0
+
+
+def test_peer_refuses_when_prefix_not_resident(src_server):
+    """/v1/kv/export answers 404 for an unknown prefix; the importer
+    treats it as any other failure — recompute, reason peer_death."""
+    url, state, _ = src_server
+    req = urllib.request.Request(
+        url + "/v1/kv/export",
+        data=json.dumps({"tokens": [9, 9, 9, 9]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 404
+    assert "not resident" in json.loads(e.value.read())["error"]
+    # malformed body: 400, never a 500
+    req = urllib.request.Request(
+        url + "/v1/kv/export", data=b'{"tokens": "nope"}',
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400
+
+
+def test_chaos_dead_peer_falls_back_to_recompute(src_server, dst_state):
+    """kv_peer names a port nobody listens on: bounded retries burn
+    out, the request recomputes locally and completes token-exact."""
+    url, _, _ = src_server
+    body = _body(_session_text("mig-dead-"), n=8)
+    baseline = _post(url, body)
+    t0 = _mig_totals()
+    # an unbound port refuses instantly; keep the wire deadline small
+    # anyway so a filtered port can't stall the test
+    out = dst_state.complete(body, kv_peer="127.0.0.1:9")
+    d = _delta(_mig_totals(), t0)
+    assert out["text"] == baseline["choices"][0]["message"]["content"]
+    assert d["fallback"] == 1 and d["migrated"] == 0
+    assert d["peer_death"] == 1
+
+
+def test_chaos_source_killed_mid_transfer(src_server, dst_state):
+    """The peer dies mid-stream (header + partial block, then the
+    socket closes): the destination rolls back its staged transfer,
+    recomputes, and the request completes token-exact."""
+    url, _, _ = src_server
+    geom = dst_state.sched.gen.wire_geometry()
+    shape = (geom["n_layers"], geom["n_kv_heads"], geom["block_size"],
+             geom["head_dim"])
+    rng = np.random.default_rng(5)
+    blocks = [(rng.standard_normal(shape).astype(np.float32),
+               rng.standard_normal(shape).astype(np.float32))
+              for _ in range(2)]
+    data = _stream_bytes(blocks, geom=geom)
+    # every attempt dies at 60% of the stream — mid-transfer death,
+    # repeated until the retry budget is spent
+    stub = _StubPeer([("truncate", data, int(len(data) * 0.6))] * 3)
+    body = _body(_session_text("mig-kill-"), n=8)
+    baseline = _post(url, body)
+    t0 = _mig_totals()
+    try:
+        out = dst_state.complete(body, kv_peer=stub.peer)
+    finally:
+        stub.close()
+    d = _delta(_mig_totals(), t0)
+    assert out["text"] == baseline["choices"][0]["message"]["content"]
+    assert d["fallback"] == 1 and d["peer_death"] == 1
+
+
+def test_chaos_short_read_injection_is_crc_fallback(src_server, dst_state):
+    """kvwire:short_read fired on the import side truncates a frame →
+    integrity failure (reason "crc"), no retry against the corrupt
+    source, local recompute, token-exact completion."""
+    url, _, port = src_server
+    body = _body(_session_text("mig-crc-"), n=8)
+    baseline = _post(url, body)
+    fired0 = fp.registry().fired("kvwire")
+    fp.arm("kvwire", "short_read", times=1)
+    t0 = _mig_totals()
+    out = dst_state.complete(body, kv_peer=f"127.0.0.1:{port}")
+    d = _delta(_mig_totals(), t0)
+    assert out["text"] == baseline["choices"][0]["message"]["content"]
+    assert d["fallback"] == 1 and d["crc"] == 1 and d["migrated"] == 0
+    assert fp.registry().fired("kvwire") == fired0 + 1
+
+
+def test_chaos_stalled_stream_is_timeout_fallback(src_server, dst_state,
+                                                  monkeypatch):
+    """kvwire:sleep stalls the stream past the per-transfer deadline
+    (shrunk via DLLAMA_KVWIRE_DEADLINE_S) → reason "timeout", local
+    recompute, token-exact completion."""
+    url, _, port = src_server
+    body = _body(_session_text("mig-slow-"), n=8)
+    baseline = _post(url, body)
+    monkeypatch.setenv("DLLAMA_KVWIRE_DEADLINE_S", "0.3")
+    fp.registry().arm("kvwire", "sleep", times=1, delay_s=0.8)
+    t0 = _mig_totals()
+    out = dst_state.complete(body, kv_peer=f"127.0.0.1:{port}")
+    d = _delta(_mig_totals(), t0)
+    assert out["text"] == baseline["choices"][0]["message"]["content"]
+    assert d["fallback"] == 1 and d["timeout"] == 1
+    fp.registry().clear()
+
+
+def test_chaos_destination_pool_exhausted(src_server, dst_state):
+    """The wire delivered, but the destination can't stage: allocation
+    fails mid-ingest → partial blocks released (no leak), reason
+    "exhaustion", the request admits normally and recomputes."""
+    url, _, port = src_server
+    body = _body(_session_text("mig-full-"), n=8)
+    baseline = _post(url, body)
+    pool = dst_state.sched.gen.pool
+    orig_alloc = pool.alloc
+    state = {"armed": True}
+
+    def failing_alloc(*a, **kw):
+        if state["armed"]:
+            state["armed"] = False
+            raise BlockPoolExhausted("injected: no blocks for staging")
+        return orig_alloc(*a, **kw)
+
+    pool.alloc = failing_alloc
+    t0 = _mig_totals()
+    try:
+        out = dst_state.complete(body, kv_peer=f"127.0.0.1:{port}")
+    finally:
+        pool.alloc = orig_alloc
+    d = _delta(_mig_totals(), t0)
+    assert out["text"] == baseline["choices"][0]["message"]["content"]
+    assert d["fallback"] == 1 and d["exhaustion"] == 1
+    assert not state["armed"]  # the injection actually fired
+
+
+# -- full stack: router-orchestrated disaggregation ---------------------------
+
+
+def test_disaggregated_decode_through_router(files):
+    """The acceptance path end to end: router → prefill warm-up on the
+    prefill-role replica → kvwire export → decode replica imports →
+    streams the completion. Output equals a never-migrated direct run;
+    the migration and the prefill dispatch are telemetry-visible."""
+    from dllama_tpu.serve.router import FleetRouter, make_router_handler
+
+    p_state = _paged_state(files, role="prefill")
+    d_state = _paged_state(files)
+    p_httpd, p_port = _serve(p_state)
+    d_httpd, d_port = _serve(d_state)
+    fleet = FleetRouter([f"127.0.0.1:{p_port}", f"127.0.0.1:{d_port}"],
+                        probe_interval_s=0.05)
+    r_httpd, r_port = (lambda h: (h, h.server_address[1]))(
+        ThreadingHTTPServer(("127.0.0.1", 0),
+                            make_router_handler(fleet)))
+    threading.Thread(target=r_httpd.serve_forever, daemon=True).start()
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if (all(r.state == "up" for r in fleet.replicas)
+                    and any(r.is_prefill() for r in fleet.replicas)):
+                break
+            time.sleep(0.02)
+        assert all(r.state == "up" for r in fleet.replicas), \
+            "replicas never probed up"
+        assert any(r.is_prefill() for r in fleet.replicas), \
+            "prefill role never probed"
+        body = _body(_session_text("disagg-"), n=8,
+                     session_id="disagg-e2e", timing=True)
+        t0 = _mig_totals()
+        out = _post(f"http://127.0.0.1:{r_port}", body)
+        d = _delta(_mig_totals(), t0)
+        # the decode replica pulled the prefix the prefill replica
+        # computed — a real wire migration, not a local recompute
+        assert d["migrated"] == 1 and d["fallback"] == 0
+        assert out["timing"]["kvmigrate_ms"] > 0
+        # decode-role replica served it (prefill is fenced off the
+        # dispatch pool)
+        assert tm.registry().counter(tm.ROUTER_DISPATCHES).total(
+            replica=f"127.0.0.1:{d_port}") >= 1
+        assert tm.registry().counter(tm.ROUTER_DISPATCHES).total(
+            replica=f"127.0.0.1:{p_port}") == 0
+        # token-exactness vs a never-migrated run: the prefill replica
+        # already holds the prefix locally, so a direct full completion
+        # there is the recompute oracle (prefix sharing is invariant —
+        # tests/test_serving.py pins that)
+        oracle = _post(f"http://127.0.0.1:{p_port}", _body(
+            _session_text("disagg-"), n=8, session_id="disagg-e2e"))
+        assert out["choices"][0]["message"]["content"] \
+            == oracle["choices"][0]["message"]["content"]
+    finally:
+        r_httpd.shutdown()
+        r_httpd.server_close()
+        fleet.close()
+        for h in (p_httpd, d_httpd):
+            h.shutdown()
+            h.server_close()
+        p_state.close()
+        d_state.close()

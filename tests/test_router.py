@@ -69,8 +69,13 @@ class StubReplica:
             "die_after_chunks": None,   # RST mid-stream after N chunks
             "truncate_nonstream": False,  # declare CL, RST mid-body
             "nonstream_delay_s": 0.0,
+            "role": None,               # /readyz disaggregation tag
+            "kv_prefixes": [],          # /readyz residency advertisement
         }
         self.n_completions = 0
+        # KV migration capture: the X-Dllama-KV-Peer value (or None)
+        # seen on each completion attempt, in arrival order
+        self.seen_kv_peers: list = []
         # fleet-trace capture: (fleet_rid, hop) per completion attempt,
         # plus a flight-shaped dump served at /debug/flight so the
         # router's fleet-timeline join can be driven end to end
@@ -136,13 +141,18 @@ class StubReplica:
             def do_GET(self):
                 b = stub.behavior
                 if self.path == "/readyz":
+                    extra = {}
+                    if b["role"]:
+                        extra["role"] = b["role"]
+                    if b["kv_prefixes"]:
+                        extra["kv_prefixes"] = list(b["kv_prefixes"])
                     if b["ready"]:
                         self._json(200, {"status": "ok", "reason": "ok",
-                                         "code": "ok"})
+                                         "code": "ok", **extra})
                     else:
                         self._json(503, {"status": "unready",
                                          "reason": b["ready_code"],
-                                         "code": b["ready_code"]},
+                                         "code": b["ready_code"], **extra},
                                    headers={"Retry-After": "5"})
                 elif self.path == "/metrics":
                     text = (f"dllama_queue_depth {b['queue_depth']}\n"
@@ -174,6 +184,8 @@ class StubReplica:
                 stub.n_completions += 1
                 frid = self.headers.get("X-Dllama-Request-Id")
                 fhop = self.headers.get("X-Dllama-Hop")
+                stub.seen_kv_peers.append(
+                    self.headers.get("X-Dllama-KV-Peer"))
                 t0_ns = time.monotonic_ns()
                 local = stub.note_fleet(frid, fhop)
                 if b["nonstream_delay_s"]:
@@ -480,10 +492,26 @@ def test_circuit_breaker_ejects_then_halfopen_readmits():
               what="both replicas up")
         name = f"127.0.0.1:{a.port}"
         e0, ra0 = ejects.total(replica=name), readmits.total(replica=name)
+        # seed sticky sessions; the entries pointing at the victim must
+        # be purged at ejection (affinity hygiene), not left to rot as
+        # one dispatchable() miss per returning session
+        purged = tm.registry().counter(tm.ROUTER_AFFINITY_PURGED)
+        p0 = purged.total(replica=name)
+        stuck_on_a = 0
+        for i in range(6):
+            with _post(url, _body(f"warm-{i}",
+                                  session_id=f"sess-{i}")) as r:
+                if json.loads(r.read())["replica"] == "a":
+                    stuck_on_a += 1
+        assert stuck_on_a  # at least one sticky entry names the victim
         a.kill()
         _wait(lambda: ejects.total(replica=name) == e0 + 1,
               what="breaker ejection")
         assert _up(fleet, name) == 0
+        assert purged.total(replica=name) - p0 == stuck_on_a
+        with fleet._lock:
+            assert not any(rep.name == name
+                           for rep in fleet._affinity.values())
         snap = [s for s in fleet.fleet_snapshot()["replicas"]
                 if s["replica"] == name][0]
         assert snap["state"] == "down" and snap["backoff_s"] > 0
@@ -512,6 +540,78 @@ def _served_by(url, name, n=6):
             if json.loads(r.read())["replica"] == name:
                 return True
     return False
+
+
+# -- KV migration orchestration ----------------------------------------------
+
+
+def test_kv_donor_header_on_residency_hit():
+    """A peer advertising the prompt's affinity key on /readyz becomes
+    the KV donor: the dispatch carries X-Dllama-KV-Peer naming it. When
+    the chosen replica itself advertises the key, no donor is named
+    (migrating a prefix onto the replica that already holds it would be
+    pure wire waste)."""
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        key = "sid:donor-sess"
+        b.behavior["kv_prefixes"] = [key]
+        _wait(lambda: any(r.holds_prefix(key) for r in fleet.replicas),
+              what="residency advertisement probed")
+        with _post(url, _body("migrate me",
+                              session_id="donor-sess")) as r:
+            assert json.loads(r.read())["replica"] == "a"
+        assert a.seen_kv_peers[-1] == f"127.0.0.1:{b.port}"
+        # /debug/fleet surfaces the advertisement
+        snap = fleet.fleet_snapshot()["replicas"]
+        assert [s for s in snap
+                if s["replica"] == f"127.0.0.1:{b.port}"][0][
+                    "kv_prefixes"] == [key]
+        # chosen replica already resident: no donor header
+        a.behavior["kv_prefixes"] = [key]
+        rep_a = [r for r in fleet.replicas
+                 if r.name == f"127.0.0.1:{a.port}"][0]
+        _wait(lambda: rep_a.holds_prefix(key),
+              what="chosen replica's own advertisement probed")
+        with _post(url, _body("already here",
+                              session_id="donor-sess")) as r:
+            r.read()
+        assert a.seen_kv_peers[-1] is None
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_prefill_role_warms_then_names_donor():
+    """Explicit disaggregation: a prefill-role replica never serves
+    decode traffic; with no resident donor, the router first runs a
+    one-token warm-up on it, then dispatches to the decode replica with
+    the prefill replica named as KV donor."""
+    p, d = StubReplica("p"), StubReplica("d")
+    p.start(), d.start()
+    p.behavior["role"] = "prefill"
+    url, fleet, close = make_router([p, d])
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        rep_p = [r for r in fleet.replicas
+                 if r.name == f"127.0.0.1:{p.port}"][0]
+        _wait(lambda: rep_p.is_prefill(), what="prefill role probed")
+        with _post(url, _body("disaggregate me",
+                              session_id="disagg-sess")) as r:
+            assert json.loads(r.read())["replica"] == "d"
+        # the prefill replica saw exactly the warm-up (no donor header,
+        # max_tokens clamped to 1, not streamed)
+        assert p.n_completions == 1
+        assert p.seen_kv_peers == [None]
+        # the decode dispatch names the prefill replica as donor
+        assert d.seen_kv_peers[-1] == f"127.0.0.1:{p.port}"
+    finally:
+        close()
+        p.kill(), d.kill()
 
 
 # -- shedding / drain --------------------------------------------------------
