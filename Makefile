@@ -30,12 +30,23 @@
 #                   "no evidence" and stays green. Re-record with
 #                   `python bench.py --baseline update` after a
 #                   deliberate perf change lands ON CHIP.
+#   make quality-check  The quality-regression sentinel: the built-in
+#                   fixture eval (deterministic tiny model over
+#                   tests/goldens/eval_tiny.jsonl, every config in
+#                   telemetry.EVAL_CONFIGS) checked against the
+#                   committed QUALITY_BASELINE.json
+#                   (tools/quality_baseline.py). Exits nonzero naming a
+#                   perplexity regression beyond the documented
+#                   tolerance or any bit-level parity drift between
+#                   exact-parity configs. Re-record with
+#                   `python tools/quality_baseline.py record` after a
+#                   deliberate numerics change.
 #   make graft      Compile-check the jittable entry + the 8-device
 #                   multi-chip dry run (tp/pp/dp/sp/ep shardings).
 
 PY ?= python
 
-.PHONY: test test-tpu test-all native tsan bench perf-check graft lint clean
+.PHONY: test test-tpu test-all native tsan bench perf-check quality-check graft lint clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -60,6 +71,9 @@ bench:
 
 perf-check:
 	$(PY) bench.py --baseline check
+
+quality-check:
+	JAX_PLATFORMS=cpu $(PY) tools/quality_baseline.py check
 
 graft:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1623,9 +1623,101 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
-SCENARIOS = ("continuous", "multichip", "fleet")
+def bench_eval(deadline: float, *, out: dict | None = None) -> dict:
+    """``--scenario eval``: the quality observatory's throughput-and-
+    parity scenario. Scores the committed fixture
+    (tests/goldens/eval_tiny.jsonl) teacher-forced through the REAL
+    serving stack (runtime/evalharness) under every config in
+    telemetry.EVAL_CONFIGS — the engine oracle plus dense/paged/
+    paged_spec continuous batching — and reports, per config,
+    ``eval_tok_per_s`` (scored positions per wall second; ranked
+    higher-better by tools/bench_compare.py) beside ``perplexity``
+    (ranked lower-better) and the bit-exact ``total_nll_hex``. The
+    headline carries the batched ``eval_tok_per_s`` and a
+    ``parity_drift`` flag: any exact-parity pair (telemetry.EVAL_PARITY)
+    whose totals differ bit-from-bit is a numerics bug, not a quality
+    tradeoff, and tools/bench_compare.py calls it out as such.
+
+    Workload knobs (env): DLLAMA_BENCH_SCN_SLOTS (4),
+    DLLAMA_BENCH_KV_BLOCK (16)."""
+    import shutil
+    import tempfile
+
+    out = {} if out is None else out
+    out["phase"] = "scenario_setup"
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tests"))
+    import numpy as np
+
+    from helpers import (byte_vocab_tokenizer, tiny_header_params,
+                         write_tiny_model)
+
+    from dllama_tpu.formats import tfile
+    from dllama_tpu.runtime import evalharness
+    from dllama_tpu.runtime import telemetry as tm
+    from dllama_tpu.runtime.engine import InferenceEngine
+    from dllama_tpu.runtime.serving import BatchScheduler
+
+    n_slots = _scn_int("DLLAMA_BENCH_SCN_SLOTS", 4)
+    block = _scn_int("DLLAMA_BENCH_KV_BLOCK", 16)
+    out.update(n_slots=n_slots, kv_block_size=block, dataset="eval_tiny")
+    seqs = evalharness.load_dataset(
+        os.path.join(here, "tests", "goldens", "eval_tiny.jsonl"))
+    out["n_seqs"] = len(seqs)
+
+    d = tempfile.mkdtemp(prefix="dllama-bench-eval-")
+    try:
+        mpath, tpath = os.path.join(d, "m.m"), os.path.join(d, "t.t")
+        rng = np.random.default_rng(0xC0)
+        write_tiny_model(mpath, tiny_header_params(
+            dim=256, hidden_dim=512, n_layers=2, n_heads=4, n_kv_heads=2,
+            head_dim=64, vocab_size=268, seq_len=256), rng)
+        tfile.write_tfile(tpath, byte_vocab_tokenizer())
+
+        out["phase"] = "scenario_eval"
+        configs: dict = {}
+        for config in tm.EVAL_CONFIGS:
+            kw = {}
+            if config in ("paged", "paged_spec"):
+                kw["kv_block_size"] = block
+            if config == "paged_spec":
+                kw["spec_lookup"] = 4
+            eng = InferenceEngine(mpath, tpath, tp=1, **kw)
+            sched = None
+            try:
+                if config == "single":
+                    run = evalharness.run_eval(seqs, dataset="eval_tiny",
+                                               config=config, engine=eng)
+                else:
+                    sched = BatchScheduler(eng, n_slots=n_slots)
+                    run = evalharness.run_eval(seqs, dataset="eval_tiny",
+                                               config=config, sched=sched)
+            finally:
+                if sched is not None:
+                    sched.close()
+                eng.close()
+            configs[config] = {k: run[k] for k in (
+                "n_tokens", "perplexity", "total_nll_hex",
+                "eval_tok_per_s", "wall_s")}
+        out["configs"] = configs
+        # the ranked numbers: batched eval throughput (paged — the config
+        # production promotion would run) and the dataset perplexity
+        out["eval_tok_per_s"] = configs["paged"]["eval_tok_per_s"]
+        out["perplexity"] = round(configs["paged"]["perplexity"], 6)
+        out["total_nll_hex"] = configs["paged"]["total_nll_hex"]
+        out["parity_drift"] = any(
+            configs[a]["total_nll_hex"] != configs[b]["total_nll_hex"]
+            for a, b in tm.EVAL_PARITY
+            if a in configs and b in configs)
+        out["phase"] = "done"
+        return out
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+SCENARIOS = ("continuous", "multichip", "fleet", "eval")
 SCENARIO_FNS = {"continuous": bench_continuous, "multichip": bench_multichip,
-                "fleet": bench_fleet}
+                "fleet": bench_fleet, "eval": bench_eval}
 
 
 def _result_skeleton(metric: str) -> dict:
@@ -1675,7 +1767,8 @@ def scenario_main(name: str) -> None:
     the preset stages), and print exactly ONE JSON line whose per-stage
     fields tools/bench_compare.py knows how to diff."""
     t_start = time.monotonic()
-    result = _result_skeleton(f"{name}_agg_tok_per_s")
+    result = _result_skeleton("eval_tok_per_s" if name == "eval"
+                              else f"{name}_agg_tok_per_s")
     if name not in SCENARIOS:
         result["error"] = f"unknown scenario {name!r} (have {SCENARIOS})"
         emit(result)
@@ -1717,6 +1810,9 @@ def scenario_main(name: str) -> None:
         result["error"] = res.get("skip_reason")
     elif res.get("agg_tok_per_s"):
         result["value"] = res["agg_tok_per_s"]
+    elif res.get("eval_tok_per_s"):
+        # the eval scenario's headline is scored positions per second
+        result["value"] = res["eval_tok_per_s"]
     else:
         result["error"] = res.get("error", "scenario did not measure")
     result["elapsed_s"] = round(time.monotonic() - t_start, 1)

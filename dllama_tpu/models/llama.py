@@ -955,6 +955,44 @@ def forward_with_taps(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return (logits, taps), kv
 
 
+def nll_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Fused log-softmax-gather: per-position negative log-likelihood.
+
+    ``logits [B, T, vocab]`` (float32), ``targets [B, T]`` int32 →
+    ``nll [B, T]`` float32 where ``nll[b, t] = logsumexp(logits[b, t]) -
+    logits[b, t, targets[b, t]]`` (always >= 0). The reduction is the
+    whole point: jitted as the epilogue of :func:`prefill_nll`, the
+    program's output is ``[B, T]``, so full-vocab logits for a long eval
+    chunk never round-trip through HBM as a program result the host then
+    downloads — the quality observatory scores 8k-token sequences at
+    prefill bandwidth.
+    """
+    m = jnp.max(logits, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1))
+    picked = jnp.take_along_axis(
+        logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return lse - picked
+
+
+def prefill_nll(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                targets: jax.Array, start_pos: jax.Array,
+                kv: KVCache) -> tuple[jax.Array, KVCache]:
+    """Teacher-forced prefill twin of :func:`forward` for the quality
+    observatory (runtime/evalharness.py): same body, but the epilogue is
+    the fused :func:`nll_from_logits` reduction instead of returning
+    full-vocab logits. ``tokens [B, T]`` at ``start_pos`` with next-token
+    ``targets [B, T]`` → per-position ``nll [B, T]`` float32 plus the
+    updated cache, so an eval sequence's chunks double as its prefill.
+    Padding rows (token 0 / target 0 past the chunk's valid length)
+    compute garbage NLL the caller slices off — exactly the padding
+    discipline of the serving prefill chunks, which is what makes the
+    batched path bit-identical to the engine oracle.
+    """
+    logits, kv = forward(params, cfg, tokens, start_pos, kv)
+    nll = constrain(nll_from_logits(logits, targets), "batch", None)
+    return nll, kv
+
+
 # ---------------------------------------------------------------------------
 # Guarded decode steps — the non-finite tripwire (runtime/numerics)
 # ---------------------------------------------------------------------------

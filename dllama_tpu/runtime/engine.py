@@ -38,6 +38,7 @@ from ..models.llama import (
     greedy_step_guarded,
     greedy_steps_guarded,
     load_params_from_mfile,
+    prefill_nll,
     sampled_step_guarded,
     sampled_steps_guarded,
     verify_step_guarded,
@@ -646,6 +647,10 @@ class InferenceEngine:
                 replicated_verify_guarded, scope=_sc,
                 program="replicated_verify", static_argnums=1,
                 donate_argnums=(4,))
+            # quality observatory: no replicated prefill_nll twin yet —
+            # score_nll refuses loudly instead of silently diverging the
+            # worker mirrors with an un-broadcast program
+            self._nll_step = None
         else:
             _sc = self.introspection_scope
             self._step = plan_scoped_jit(forward, scope=_sc, static_argnums=1,
@@ -682,6 +687,16 @@ class InferenceEngine:
                                                 program="verify_step",
                                                 static_argnums=1,
                                                 donate_argnums=(4,))
+            # quality observatory (runtime/evalharness): teacher-forced
+            # prefill twin whose epilogue is the fused log-softmax-gather
+            # NLL reduction — eval chunks never download full-vocab
+            # logits. Registration is trace-lazy: nothing compiles until
+            # an eval run dispatches it, so a serving-only engine's
+            # compile ledger is byte-identical to before.
+            self._nll_step = plan_scoped_jit(prefill_nll, scope=_sc,
+                                             program="prefill_nll",
+                                             static_argnums=1,
+                                             donate_argnums=(5,))
         # activation taps (numerics observatory): the tapped forward is
         # only jitted when the engine opted in — a taps-off engine never
         # registers the program, keeping the default compile ledger
@@ -1351,6 +1366,58 @@ class InferenceEngine:
             self.pos += len(chunk)
             i += len(chunk)
         return float(np.exp(nll / count))
+
+    def score_nll(self, token_ids: list[int]) -> np.ndarray:
+        """Teacher-forced per-token NLL of ``token_ids`` — the quality
+        observatory's single-sequence oracle (runtime/evalharness.py).
+
+        Chunks ``token_ids[:-1]`` through the jitted ``prefill_nll``
+        program with the same bucket boundaries and zero padding the
+        batched serving prefill uses, which is what makes the batched
+        path's per-token values bit-identical to this oracle's. Returns
+        the ``len(token_ids) - 1`` float32 NLL values in position order.
+        Resets the engine's cache and advances ``self.pos`` like
+        :meth:`perplexity`.
+        """
+        if self._nll_step is None:
+            raise RuntimeError(
+                "eval scoring is unsupported under --multihost (no "
+                "replicated prefill_nll twin); score on a single-host "
+                "engine")
+        if len(token_ids) < 2:
+            raise ValueError("scoring needs at least 2 tokens")
+        if len(token_ids) > self.cfg.seq_len:
+            raise ValueError("sequence longer than seq_len")
+        self.reset()
+        rest = token_ids[:-1]
+        out: list[np.ndarray] = []
+        i, n = 0, len(rest)
+        while i < n:
+            size = self._prefill_chunk_size(n - i)
+            chunk = rest[i:i + size]
+            valid = len(chunk)
+            pad_to = min(size, self.cfg.seq_len - self.pos)
+            pad = [0] * (pad_to - valid)
+            targets = token_ids[i + 1:i + 1 + valid]
+            with self.watchdog.guard("dispatch"):
+                failpoints.fire("step_hang")
+                with (use_plan(self.plan) if self.plan is not None
+                        else nullcontext()):
+                    nll, self.kv = self._nll_step(
+                        self.params, self.cfg,
+                        jnp.asarray(np.asarray([chunk + pad]), jnp.int32),
+                        jnp.asarray(np.asarray([targets + pad]), jnp.int32),
+                        jnp.int32(self.pos), self.kv)
+            vals = np.asarray(nll[0, :valid], dtype=np.float32)
+            bad = int(vals.size - np.count_nonzero(np.isfinite(vals)))
+            if bad:
+                numerics.check_nonfinite(bad, "eval",
+                                         failfast=self.nf_failfast)
+            out.append(vals)
+            self.seen_buckets.add(pad_to)
+            self.pos += valid
+            i += valid
+        return np.concatenate(out)
 
 
 def _tp_ok(cfg: ModelConfig, tp: int) -> bool:

@@ -98,6 +98,13 @@ have at least one call site:
   the non-finite tripwire and fails only the affected request,
   503-shaped. Requires a trace that contains the ring collectives
   (``--comm-overlap`` on a tp mesh).
+* ``eval`` — the quality observatory's per-sequence scoring point
+  (``runtime/evalharness.py``, fired once per eval sequence as the
+  harness submits/scores it): a ``raise`` aborts the run mid-dataset,
+  which must surface as :class:`~.evalharness.EvalAborted` carrying a
+  partial-results summary naming completed vs in-flight sequences —
+  the eval CLI exits non-zero with that JSON, never a silently
+  truncated perplexity.
 """
 
 from __future__ import annotations

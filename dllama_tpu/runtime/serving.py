@@ -212,6 +212,13 @@ class Request:
     # accept rate surfaced in the opt-in `timing` response block
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # quality observatory (runtime/evalharness): a teacher-forced eval
+    # sequence — admitted and chunk-prefilled like any request, but every
+    # chunk dispatches the fused prefill_nll program, the per-chunk NLL
+    # values accumulate here (float32, position order), and the sequence
+    # retires at end of prefill: no decode, no prefix-index registration.
+    score: bool = False
+    nll_parts: list = field(default_factory=list)
 
     def __post_init__(self):
         self.rng_state = self.seed & _MASK64
@@ -536,6 +543,66 @@ class _GeneratorCore:
             return  # direct-generator use (tests) has no submit stamp
         flightrec.record_ttft(self._m_ttft_attrib, bd)
 
+    # -- teacher-forced eval (the quality observatory) ----------------------
+
+    def _exec_prefill_nll(self, col, padded, targets, pos: int):
+        """One teacher-forced NLL chunk over a slot column: the engine's
+        jitted ``prefill_nll`` program (fused log-softmax-gather — the
+        chunk's full-vocab logits never leave the device) on the SAME
+        padded chunk the plain prefill would dispatch, so eval chunking
+        stays bit-comparable to the engine oracle's."""
+        with self.eng.watchdog.guard("batch_prefill"):
+            failpoints.fire("step_hang")
+            with self._plan_ctx():
+                nll, col = self.eng._nll_step(
+                    self.eng.params, self.cfg,
+                    jnp.asarray(np.asarray(padded).reshape(1, -1), jnp.int32),
+                    jnp.asarray(np.asarray(targets).reshape(1, -1),
+                                jnp.int32),
+                    jnp.int32(pos), col)
+            return nll, col
+
+    def _prefill_nll_chunk(self, adm: "_Admission", padded, targets,
+                           n_valid: int) -> None:
+        """The scoring twin of :meth:`_prefill_chunk`: same timing,
+        attribution (own prefill wall, bystanders' preempt stall), and
+        ``prefill_chunk`` span, plus the chunk's host-fetched NLL values
+        appended to the request — sliced to the valid positions, so the
+        padding rows' garbage never reaches a sum."""
+        t0 = telemetry.now_ns()
+        nll, adm.col = self._exec_prefill_nll(adm.col, padded, targets,
+                                              adm.pos)
+        vals = np.asarray(nll[0, :n_valid], dtype=np.float32)
+        t1 = telemetry.now_ns()
+        ms = (t1 - t0) / 1e6
+        adm.req.ms_prefill += ms
+        for s in self.slots:
+            if s is not None:
+                s.ms_preempt += ms
+        bad = int(vals.size - np.count_nonzero(np.isfinite(vals)))
+        if bad:
+            numerics.record_nonfinite(bad, "eval")
+        adm.req.nll_parts.append(vals)
+        self.flight.note_prefill(adm.req.rid, ms, n_valid)
+        telemetry.tracer().emit(adm.req.rid, "prefill_chunk", t0, t1,
+                                slot=adm.slot, n_tokens=n_valid)
+
+    def _finish_score(self, adm: "_Admission") -> None:  # dlint: owner=loop-thread
+        """Retire a teacher-forced eval admission at end of prefill: eval
+        sequences never decode — the scored chunks ARE the work. RETIRES
+        balances begin_admit's ADMISSIONS increment, and the ``eval``
+        span covers admission start → last NLL chunk so eval traffic is
+        attributable in timelines next to user requests."""
+        req = adm.req
+        self._tm.counter(telemetry.RETIRES).inc()
+        n = max(0, len(req.prompt_ids) - 1)
+        telemetry.tracer().emit(req.rid, "eval",
+                                req.t_admit or telemetry.now_ns(),
+                                telemetry.now_ns(), slot=adm.slot,
+                                n_tokens=n)
+        self.flight.note("eval_done", req.rid, slot=adm.slot, n_tokens=n)
+        req.done.set()
+
     def flight_blocks(self) -> dict | None:
         """Block-pool occupancy for the tick record (paged pool only)."""
         return None
@@ -838,7 +905,11 @@ class BatchedGenerator(_GeneratorCore):
                 f"prompt of {len(ids)} tokens exceeds the usable context "
                 f"({limit} = seq_len {self.cfg.seq_len}"
                 + (f" - spec-lookup {self.spec}" if self.spec else "") + ")")
-        src, k = self._best_prefix(ids[:-1])
+        # teacher-forced eval (runtime/evalharness): every position must
+        # be scored, so cross-slot prefix reuse is disabled — a matched
+        # prefix would skip its NLL terms and the run would no longer be
+        # bit-comparable to the single-sequence oracle
+        src, k = (0, 0) if req.score else self._best_prefix(ids[:-1])
         self._bcast(CTRL_SRV_TAKE, src if k else slot, [slot])
         adm = _Admission(req=req, slot=slot,
                          col=self._exec_take(src if k else slot),
@@ -875,12 +946,28 @@ class BatchedGenerator(_GeneratorCore):
             chunk = rest[adm.pos:adm.pos + n_b]
             pad_to = min(n_b, self.cfg.seq_len - adm.pos)
             padded = chunk + [0] * (pad_to - len(chunk))
-            self._bcast(CTRL_SRV_PREFILL, adm.slot, [adm.pos] + padded)
-            self._prefill_chunk(adm, padded, len(chunk))
+            if adm.req.score:
+                # teacher-forced eval chunk: NO worker broadcast (eval is
+                # gated off multihost at submit) — the fused NLL program
+                # replaces the plain prefill on the same padded chunk
+                tgt = adm.req.prompt_ids[adm.pos + 1:
+                                         adm.pos + 1 + len(chunk)]
+                tgt = tgt + [0] * (len(padded) - len(chunk))
+                self._prefill_nll_chunk(adm, padded, tgt, len(chunk))
+            else:
+                self._bcast(CTRL_SRV_PREFILL, adm.slot, [adm.pos] + padded)
+                self._prefill_chunk(adm, padded, len(chunk))
             self.eng.seen_buckets.add(len(padded))  # the DISPATCHED width
             adm.pos += len(chunk)
             if adm.pos < len(rest):
                 return False
+        if adm.req.score:
+            # eval sequences are done at end of prefill: no commit (the
+            # scored column is discarded — the slot's pool rows and any
+            # recorded prefix context stay exactly as the previous
+            # occupant left them), no proposer, no decode arming
+            self._finish_score(adm)
+            return True
         self._bcast(CTRL_SRV_COMMIT, adm.slot)
         self._exec_commit(adm.slot, adm.col)
         self._ctx[adm.slot] = list(adm.req.prompt_ids[:-1])
@@ -1609,7 +1696,14 @@ class PagedGenerator(_GeneratorCore):
                 f"(seq_len {self.cfg.seq_len})")
         t_begin = telemetry.now_ns()  # the "admit" span: block bookkeeping
         rest = ids[:-1]
-        shared, n_tok, cow_src, cow_r = self.pool.match_prefix(rest)
+        if req.score:
+            # teacher-forced eval (runtime/evalharness): every position
+            # must be scored, so block-level prefix reuse is disabled —
+            # a matched prefix would skip its NLL terms and the run would
+            # no longer be bit-comparable to the single-sequence oracle
+            shared, n_tok, cow_src, cow_r = [], 0, None, 0
+        else:
+            shared, n_tok, cow_src, cow_r = self.pool.match_prefix(rest)
         # KV tier: matched blocks may be HOST-resident (a resumed /
         # prefix-matched session whose cold blocks spilled under
         # pressure). Stage their page-in NOW — device blocks allocated
@@ -1795,12 +1889,28 @@ class PagedGenerator(_GeneratorCore):
             chunk = rest[adm.pos:adm.pos + n_b]
             pad_to = min(n_b, self.cfg.seq_len - adm.pos)
             padded = chunk + [0] * (pad_to - len(chunk))
-            self._prefill_chunk(adm, padded, len(chunk))
+            if adm.req.score:
+                # teacher-forced eval chunk: the fused NLL program
+                # replaces the plain prefill on the same padded chunk
+                tgt = adm.req.prompt_ids[adm.pos + 1:
+                                         adm.pos + 1 + len(chunk)]
+                tgt = tgt + [0] * (len(padded) - len(chunk))
+                self._prefill_nll_chunk(adm, padded, tgt, len(chunk))
+            else:
+                self._prefill_chunk(adm, padded, len(chunk))
             self.eng.seen_buckets.add(len(padded))
             adm.pos += len(chunk)
             if adm.pos < len(rest):
                 return False
         slot = adm.slot
+        if adm.req.score:
+            # eval sequences are done at end of prefill: no commit
+            # scatter, no register_prompt (eval KV must never seed the
+            # prefix index), no proposer, no decode arming — the blocks
+            # release now and the scored column is discarded
+            self._release_blocks(slot)
+            self._finish_score(adm)
+            return True
         bids = self._seq_bids[slot]
         if adm.col is not None:
             # scatter only the slot's OWN blocks back: shared-prefix
@@ -2177,7 +2287,11 @@ class BatchScheduler:
                temperature: float = 0.0, topp: float = 0.9,
                seed: int = 0xB1A5, stop_on_eos: bool = True,
                timeout_s: float | None = None, on_token=None,
-               kv_peer: str | None = None) -> Request:
+               kv_peer: str | None = None, score: bool = False) -> Request:
+        if score and getattr(self.gen.eng, "_nll_step", None) is None:
+            raise ValueError(
+                "eval scoring is unsupported on this engine: no "
+                "prefill_nll program (multihost has no replicated twin)")
         with self._lock:
             if self._stop or self._draining or not self._healthy or (
                     self._thread is not None and not self._thread.is_alive()):
@@ -2199,7 +2313,7 @@ class BatchScheduler:
             req = Request(rid=rid, prompt_ids=list(prompt_ids),
                           max_tokens=max_tokens, temperature=temperature,
                           topp=topp, seed=seed, stop_on_eos=stop_on_eos,
-                          on_token=on_token)
+                          on_token=on_token, score=score)
             if kv_peer and hasattr(self.gen, "wire_geometry"):
                 # peer-KV migration is paged-pool-only; a dense pool (or
                 # an empty peer) just recomputes — no error, no field
@@ -2225,6 +2339,16 @@ class BatchScheduler:
         """Loop thread running and not crash-exhausted."""
         return (self._healthy and not self._stop
                 and (self._thread is None or self._thread.is_alive()))
+
+    def eval_resident(self) -> int:  # dlint: owner=any
+        """Teacher-forced eval sequences currently queued or mid-prefill
+        (runtime/evalharness). Surfaced on ``/readyz`` and the api banner
+        so the fleet router's least-loaded dispatch can SEE why this
+        replica's queue depth is elevated — eval sequences already count
+        in dllama_queue_depth; this makes the reason observable."""
+        with self._lock:
+            return (sum(1 for r in self._queue if r.score)
+                    + sum(1 for a in self._admissions if a.req.score))
 
     def readiness(self) -> tuple[bool, str, str]:  # dlint: owner=any
         """(ready, human reason, machine code) for ``GET /readyz``:

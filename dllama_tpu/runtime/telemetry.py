@@ -106,6 +106,10 @@ WEIGHT_IO_RETRIES = "dllama_weight_io_retries_total"
 LOAD_CORRUPTION = "dllama_load_corruption_total"
 WATCHDOG_STALLS = "dllama_watchdog_stalls_total"
 HBM_ADMISSION_REJECTS = "dllama_hbm_admission_rejects_total"
+# quality observatory (runtime/evalharness.py — teacher-forced NLL eval)
+EVAL_TOKENS = "dllama_eval_tokens_total"
+EVAL_NLL = "dllama_eval_nll_total"
+EVAL_PERPLEXITY = "dllama_eval_perplexity"
 
 # flight recorder + latency attribution (runtime/flightrec.py, wired in
 # runtime/serving.py and serve/api.py)
@@ -425,6 +429,18 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "Flight-recorder postmortem dumps written, by reason "
           "(watchdog_stall / scheduler_crash / kv_block_exhaustion; "
           "rate-limited per reason)"),
+    _spec(EVAL_TOKENS, "counter",
+          "Teacher-forced eval positions scored by the quality "
+          "observatory, by dataset and config (runtime/evalharness.py; "
+          "config drawn from the EVAL_CONFIGS closed world)"),
+    _spec(EVAL_NLL, "counter",
+          "Summed per-token negative log-likelihood over scored eval "
+          "positions, by dataset and config (perplexity = "
+          "exp(nll / tokens); NLL is >= 0 per token, so the counter "
+          "is monotone)"),
+    _spec(EVAL_PERPLEXITY, "gauge",
+          "Perplexity of the labeled dataset from the most recent eval "
+          "run in this process (what tools/quality_baseline.py gates)"),
     _spec(ROUTER_REPLICA_UP, "gauge",
           "Fleet router: 1 while the labeled replica is dispatchable "
           "(probed up, not breaker-ejected, not draining), else 0"),
@@ -721,8 +737,36 @@ def registry() -> Registry:
 #   blocks committed (or rolled back to recompute) on the destination
 #   (runtime/kvwire.py + the serving import path; also a TTFT
 #   attribution phase).
+# * ``eval`` — one teacher-forced eval sequence scored end to end by the
+#   quality observatory (runtime/evalharness.py): admission → final NLL
+#   chunk when riding the batch scheduler, or the engine oracle's
+#   chunked ``prefill_nll`` loop in the single-sequence path.
 PHASES = ("queue", "admit", "prefill", "prefill_chunk", "decode", "verify",
-          "requeue", "pagein", "kvmigrate")
+          "requeue", "pagein", "kvmigrate", "eval")
+
+# The closed-world eval config vocabulary (tools/check_eval_names.py
+# lints it both directions): the ``eval --compare`` CLI grammar, the
+# parity keys in QUALITY_BASELINE.json, and the ``config`` label on
+# dllama_eval_* series all draw from exactly this set.
+#
+# * ``single`` — the single-sequence engine oracle: chunked
+#   ``prefill_nll`` dispatches via InferenceEngine.score_nll, no
+#   scheduler.
+# * ``dense`` — eval sequences admitted through BatchScheduler over the
+#   dense slot-pool generator as continuous-batching work.
+# * ``paged`` — same, over the paged block-pool generator
+#   (PagedGenerator), speculation off.
+# * ``paged_spec`` — ``paged`` with speculative serving armed; eval
+#   sequences never decode, so spec-on greedy must match spec-off
+#   bit for bit.
+EVAL_CONFIGS = ("single", "dense", "paged", "paged_spec")
+
+# Exact-parity pairs: each (config, reference) pair must produce
+# BIT-IDENTICAL total NLL — same jitted prefill_nll program, same chunk
+# boundaries, same zero padding, same summation order. A mismatch is
+# parity drift, not a quality tradeoff.
+EVAL_PARITY = (("dense", "single"), ("paged", "single"),
+               ("paged_spec", "paged"))
 
 # Router span vocabulary (serve/router.py RouterSpanRing.emit_span) — the
 # fleet-side counterpart of PHASES, closed-world-checked the same way

@@ -36,8 +36,8 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
-from ..runtime import (failpoints, flightrec, introspection, numerics,
-                       profiling, roofline, telemetry)
+from ..runtime import (evalharness, failpoints, flightrec, introspection,
+                       numerics, profiling, roofline, telemetry)
 from ..runtime.engine import InferenceEngine
 from ..runtime.serving import (HbmAdmissionError, QueueFullError,
                                RequestTimeoutError,
@@ -54,7 +54,7 @@ _ROUTES = ("/v1/chat/completions", "/v1/kv/export", "/v1/models", "/metrics",
            "/health", "/healthz", "/readyz", "/debug",
            "/debug/compiles", "/debug/requests", "/debug/profile",
            "/debug/numerics", "/debug/flight", "/debug/timeline",
-           "/debug/roofline")
+           "/debug/roofline", "/debug/eval")
 
 # the GET /debug index: one line per diagnostic endpoint. Closed-world with
 # _ROUTES (tools/check_route_labels.py: every /debug/* route has exactly one
@@ -77,6 +77,10 @@ _DEBUG_INDEX = {
     "/debug/roofline": "GET: roofline observatory — per-program achieved "
                        "bytes/FLOPs vs chip ceilings, memory- vs "
                        "compute-bound classification",
+    "/debug/eval": "GET: quality observatory — the most recent "
+                   "teacher-forced eval run's summary (per-sequence NLL, "
+                   "perplexity, bit-exact total-NLL hex; partial + "
+                   "completed/in-flight ids after an aborted run)",
 }
 
 # POST /debug/profile capture-window bounds (ms): long enough to catch a few
@@ -562,6 +566,11 @@ class BatchedApiState:
     def readiness(self) -> tuple[bool, str, str]:
         return self.sched.readiness()
 
+    def eval_resident(self) -> int:
+        """Teacher-forced eval sequences queued/admitted right now —
+        surfaced on /readyz so the router sees WHY depth is elevated."""
+        return self.sched.eval_resident()
+
     def note_kv_prefix(self, key: str | None) -> None:
         """Record (LRU-front) a prefix this replica's pool now holds."""
         if not key:
@@ -839,6 +848,16 @@ def make_handler(state: ApiState):
                 kv_list = getattr(state, "kv_prefix_list", None)
                 if kv_list is not None:
                     rz["kv_prefixes"] = kv_list()
+                # quality-observatory residency: how many teacher-forced
+                # eval sequences are queued/admitted RIGHT NOW. Eval work
+                # inflates queue depth without producing decode tokens, so
+                # the fleet router's least-loaded dispatch needs to SEE
+                # the reason, not just the symptom
+                ev = getattr(state, "eval_resident", None)
+                if ev is not None:
+                    n_eval = ev()
+                    if n_eval:
+                        rz["eval_resident"] = n_eval
                 self._json(200 if ready else 503, rz,
                            headers=None if ready
                            else backpressure_headers(503))
@@ -883,6 +902,16 @@ def make_handler(state: ApiState):
                 # last tapped dispatch's per-layer stats, canary status
                 self._json(200, numerics.debug_snapshot(
                     getattr(state, "engine", None)))
+            elif path == "/debug/eval":
+                # the quality observatory: last eval run scored in THIS
+                # process (runtime/evalharness.last_run) — includes the
+                # bit-exact total-NLL hex quality_baseline gates on, or
+                # the partial-results shape after an aborted run
+                last = evalharness.last_run()
+                self._json(200, last if last is not None
+                           else {"run": None,
+                                 "note": "no eval run in this process "
+                                         "(python -m dllama_tpu eval)"})
             else:
                 self._not_found()
 
@@ -1249,6 +1278,9 @@ def run_api_server(args) -> int:
                   f"per slot "
                   + ("(greedy exact + rejection-sampled temperature>0)"
                      if paged else "(greedy requests)"))
+        print("🕸️ quality observatory: teacher-forced eval rides these "
+              "slots (resident runs advertised on /readyz as "
+              "eval_resident; last summary on GET /debug/eval)")
     else:
         state = ApiState(engine, template_type=ttype,
                          request_timeout=request_timeout)

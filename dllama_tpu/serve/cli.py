@@ -25,6 +25,7 @@ import sys
 import time
 
 from ..formats.quants import F32, Q80
+from ..runtime import telemetry as _telemetry
 from ..runtime.engine import InferenceEngine
 from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
                               ChatTemplateType)
@@ -33,9 +34,9 @@ from ..tokenizer.chat import (ChatItem, ChatTemplateGenerator,
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllama_tpu",
                                 description="TPU-native distributed-llama")
-    p.add_argument("mode", choices=["inference", "chat", "perplexity", "api",
-                                    "worker", "verify", "audit", "timeline",
-                                    "router", "fleettrace"])
+    p.add_argument("mode", choices=["inference", "chat", "perplexity", "eval",
+                                    "api", "worker", "verify", "audit",
+                                    "timeline", "router", "fleettrace"])
     p.add_argument("--model", required=False, help=".m model file")
     p.add_argument("--tokenizer", required=False, help=".t tokenizer file")
     p.add_argument("--verify-weights", action="store_true",
@@ -239,6 +240,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--audit-json", action="store_true",
                    help="audit mode: print the per-tensor table as one "
                         "JSON object instead of text")
+    p.add_argument("--data", default=None, metavar="FILE.jsonl",
+                   help="eval mode: the teacher-forced eval dataset — one "
+                        "JSON object per line with 'tokens' (token-id "
+                        "list) or 'text' (tokenized with --tokenizer), "
+                        "plus an optional 'id' (runtime/evalharness.py)")
+    p.add_argument("--compare", default=None, metavar="CONFIG",
+                   choices=list(_telemetry.EVAL_CONFIGS),
+                   help="eval mode: ALSO score the dataset under CONFIG "
+                        "(single/dense/paged/paged_spec) and assert its "
+                        "total NLL is BIT-IDENTICAL to the primary run's "
+                        "— a mismatch is parity drift and exits non-zero")
+    p.add_argument("--json", action="store_true",
+                   help="eval mode: print the run summary as one JSON "
+                        "line (what tools/quality_baseline.py consumes) "
+                        "instead of the human table")
     p.add_argument("--trace-out", default=None, metavar="FILE",
                    help="append per-request phase spans (queue/prefill/"
                         "decode/verify) as JSONL trace events to FILE "
@@ -865,6 +881,121 @@ def run_perplexity(args) -> int:
     return 0
 
 
+def _eval_primary_config(args) -> str:
+    """The PRIMARY eval config implied by the serving flags (one of
+    telemetry.EVAL_CONFIGS — the closed world tools/check_eval_names.py
+    lints)."""
+    if args.batch_slots and args.batch_slots > 1:
+        if args.kv_block_size:
+            return "paged_spec" if args.spec_lookup else "paged"
+        return "dense"
+    return "single"
+
+
+def _eval_args_for(args, config: str):
+    """A copy of ``args`` shaped for one eval config: the config name
+    decides the generator family; unset sizing flags get eval-sized
+    defaults so ``--compare paged`` works without extra flags."""
+    import copy
+
+    a = copy.copy(args)
+    if config == "single":
+        a.batch_slots, a.kv_block_size, a.spec_lookup = 0, 0, 0
+        a.kv_host_blocks = 0
+    elif config == "dense":
+        a.kv_block_size, a.spec_lookup, a.kv_host_blocks = 0, 0, 0
+    elif config == "paged":
+        a.kv_block_size = args.kv_block_size or 16
+        a.spec_lookup = 0
+    else:  # paged_spec
+        a.kv_block_size = args.kv_block_size or 16
+        a.spec_lookup = args.spec_lookup or 4
+    return a
+
+
+def _run_eval_config(args, seqs, dataset: str, config: str) -> dict:
+    """Build the serving stack for ``config``, score ``seqs``, tear it
+    down. Each config gets its own engine so the comparison covers the
+    REAL construction path, not a mutated shared one."""
+    from ..runtime import evalharness
+    from ..runtime.serving import BatchScheduler
+
+    eng = make_engine(_eval_args_for(args, config))
+    sched = None
+    try:
+        if config == "single":
+            return evalharness.run_eval(seqs, dataset=dataset,
+                                        config=config, engine=eng)
+        n_slots = args.batch_slots if args.batch_slots > 1 else 4
+        sched = BatchScheduler(eng, n_slots=n_slots)
+        return evalharness.run_eval(seqs, dataset=dataset, config=config,
+                                    sched=sched)
+    finally:
+        if sched is not None:
+            sched.close()
+        eng.close()
+
+
+def run_eval_mode(args) -> int:
+    """``eval`` mode: teacher-forced NLL over ``--data`` through the
+    real serving stack (runtime/evalharness.py). ``--json`` emits the
+    one-line summary tools/quality_baseline.py consumes; ``--compare``
+    re-scores under a second config and asserts BIT-IDENTICAL total NLL
+    (exit 1 on parity drift). A mid-run failure exits 1 with a
+    partial-results JSON naming completed vs in-flight sequences."""
+    import json as _json
+
+    from ..runtime import evalharness, failpoints
+
+    if not args.data:
+        raise SystemExit("--data FILE.jsonl is required for eval mode")
+    if failpoints.configure_from_env():
+        print("💣 fault injection armed from DLLAMA_FAILPOINTS="
+              f"{os.environ.get('DLLAMA_FAILPOINTS')}", file=sys.stderr)
+    dataset = os.path.splitext(os.path.basename(args.data))[0]
+    tok = None
+    if args.tokenizer:
+        from ..tokenizer.bpe import Tokenizer
+
+        tok = Tokenizer.load(args.tokenizer)
+    seq_cap = args.max_seq_len or 0
+    seqs = evalharness.load_dataset(args.data, tok, seq_len=seq_cap)
+    primary = _eval_primary_config(args)
+    try:
+        result = _run_eval_config(args, seqs, dataset, primary)
+        if args.compare and args.compare != primary:
+            cmp_res = _run_eval_config(args, seqs, dataset, args.compare)
+            result = dict(result)
+            result["compare"] = cmp_res
+            result["parity_drift"] = (
+                cmp_res["total_nll_hex"] != result["total_nll_hex"])
+    except evalharness.EvalAborted as e:
+        print(_json.dumps(e.partial), flush=True)
+        print(f"💥 {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(_json.dumps(result), flush=True)
+    else:
+        print(f"📊 eval {dataset} [{result['config']}]: "
+              f"{result['n_seqs']} seqs, {result['n_tokens']} tokens")
+        print(f"📊 Perplexity: {result['perplexity']:.4f}  "
+              f"total NLL: {result['total_nll']:.6f} "
+              f"({result['total_nll_hex']})")
+        print(f"📊 Time: {result['wall_s']:.2f}s "
+              f"({result['eval_tok_per_s']:.1f} tok/s)")
+        if "compare" in result:
+            c = result["compare"]
+            print(f"📊 compare [{c['config']}]: perplexity "
+                  f"{c['perplexity']:.4f} ({c['total_nll_hex']})")
+    if result.get("parity_drift"):
+        print(f"💥 parity drift: total NLL differs bit-from-bit between "
+              f"{result['config']} and {result['compare']['config']} — "
+              f"these configs are exact-parity by contract",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def _worker_supervisor(args) -> int:
     """--worker-reserve outer loop — the reference worker's while(true)
     re-serve (app.cpp:299-358) at process granularity: jax.distributed cannot
@@ -1106,6 +1237,8 @@ def main(argv=None) -> int:
         return run_chat(args)
     if args.mode == "perplexity":
         return run_perplexity(args)
+    if args.mode == "eval":
+        return run_eval_mode(args)
     if args.mode == "api":
         from .api import run_api_server
 

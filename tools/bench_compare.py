@@ -24,14 +24,15 @@ import sys
 _RATES = ("decode_tok_per_s", "prefill_tok_per_s", "sampled_decode_tok_per_s",
           "chunked_decode_tok_per_s", "paged_decode_tok_per_s",
           "agg_tok_per_s", "accepted_tok_per_s", "decode_tok_per_s_q80",
-          "sessions_per_chip", "slo_compliance_min")
+          "sessions_per_chip", "slo_compliance_min", "eval_tok_per_s")
 # lower-is-better latencies (--scenario continuous/fleet TTFT + the
 # tiered wave's resume TTFT; --scenario multichip exposed collective
-# wall; the fleet scenario's worst SLO error-budget burn): the printed
-# pct is still "improvement-positive", so the sign is flipped before
-# ranking
+# wall; the fleet scenario's worst SLO error-budget burn; --scenario
+# eval teacher-forced perplexity): the printed pct is still
+# "improvement-positive", so the sign is flipped before ranking
 _LATENCIES = ("ttft_ms_p50", "ttft_ms_p95", "resume_ttft_p95_ms",
-              "comm_exposed_ms", "comm_exposed_ms_off", "slo_worst_burn")
+              "comm_exposed_ms", "comm_exposed_ms_off", "slo_worst_burn",
+              "perplexity")
 # context-only scenario fields: printed for both sides, never ranked (a
 # higher occupancy or sharing count is workload-dependent, not a win/loss
 # — and the fleet scenario's churn counters describe the kill/restart
@@ -42,7 +43,8 @@ _GAUGES = ("block_occupancy_peak", "block_occupancy_mean",
            "wire_q80_shrink", "exposed_overlap_lower",
            "f32_tokens_identical",
            "router_retries", "router_ejects", "router_shed",
-           "n_midstream_error", "readmitted")
+           "n_midstream_error", "readmitted",
+           "total_nll_hex", "parity_drift")
 
 
 def _from_baseline(doc: dict) -> dict:
@@ -135,6 +137,18 @@ def main() -> None:
     if a.get("skipped") or b.get("skipped"):
         print("⚠️ deltas below compare non-live data — not a regression "
               "signal\n")
+    # the eval scenario's bit-parity verdict: a side whose exact-parity
+    # configs (telemetry.EVAL_PARITY — paged vs dense vs the single-seq
+    # oracle, spec-on vs spec-off) disagree on total NLL is numerically
+    # broken; its perplexity/eval_tok_per_s deltas describe a bug, not a
+    # quality tradeoff
+    for tag, d in (("A", a), ("B", b)):
+        for stage, rec in sorted((d.get("stages") or {}).items()):
+            if isinstance(rec, dict) and rec.get("parity_drift"):
+                print(f"❌ {tag} stage '{stage}': PARITY DRIFT — "
+                      f"exact-parity eval configs disagree bit-for-bit "
+                      f"on total NLL; treat this side's quality numbers "
+                      f"as a numerics bug, not a quality tradeoff")
     hv_a, hv_b = a.get("value") or 0, b.get("value") or 0
     if hv_a and hv_b:
         print(f"headline {a.get('metric')}: {hv_a} -> {hv_b} "

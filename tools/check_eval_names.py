@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Eval config-name lint: every config in telemetry.EVAL_CONFIGS is
+grammar-clean, derived (not hand-copied) by its consumers (the eval
+CLI's --compare grammar, the harness, bench, the quality ledger),
+documented in README.md, and closed-world vs the committed
+QUALITY_BASELINE.json parity keys — in both directions.
+
+Thin wrapper (Makefile ``lint`` compatibility): the scanner itself
+lives on the shared dlint framework as the ``eval-names`` rule —
+``python -m tools.dlint --only eval-names`` is the canonical entry
+point; this script exists so direct CLI invocations keep working.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tools.dlint import Project, run_rules  # noqa: E402
+
+
+def main() -> int:
+    return run_rules(Project(), only=["eval-names"])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

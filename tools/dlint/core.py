@@ -221,6 +221,7 @@ def rule(name: str, doc: str, *, suppressible: bool = True):
 def load_rule_modules() -> None:
     """Import every rule module so its ``@rule`` registrations run."""
     from . import (  # noqa: F401
+        eval_names,
         exception_hygiene,
         failpoint_sites,
         metrics_names,

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Generate the committed deterministic eval fixture.
+
+``tests/goldens/eval_tiny.jsonl`` is the quality observatory's pinned
+dataset: a handful of token-id sequences (no tokenizer needed — the
+``tokens`` entry form of runtime/evalharness.load_dataset) sized for the
+tests' tiny toy models. Token ids stay below 128 so the fixture works
+against every tiny_header_params() vocab in tests/helpers.py, and the
+generator is a seeded LCG — rerunning this script reproduces the file
+byte for byte, so the golden NLL asserted in tests/test_evalharness.py
+stays pinned to committed bytes, not to a random stream.
+
+Rerun ``python tools/make_eval_fixture.py [--seed N]`` to regenerate
+(the default seed is the committed fixture's).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_SEED = 0xE7A1
+VOCAB_CAP = 128  # ids < min tiny-model vocab (tests/helpers.py default)
+# lengths chosen to cross prefill-chunk boundaries in the tiny configs:
+# shorter than one chunk, exactly around bucket edges, and multi-chunk
+SEQ_LENS = (12, 17, 24, 31, 40, 13)
+
+
+def lcg(seed: int):
+    """Tiny deterministic generator (numerical-recipes constants) — no
+    dependence on random-module versioning for a committed fixture."""
+    state = seed & 0xFFFFFFFF
+    while True:
+        state = (1664525 * state + 1013904223) & 0xFFFFFFFF
+        yield state >> 16
+
+
+def make_seqs(seed: int) -> list[dict]:
+    g = lcg(seed)
+    seqs = []
+    for i, n in enumerate(SEQ_LENS):
+        toks = [next(g) % VOCAB_CAP for _ in range(n)]
+        seqs.append({"id": f"seq{i}", "tokens": toks})
+    return seqs
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=lambda s: int(s, 0), default=DEFAULT_SEED,
+                    help="LCG seed (default: the committed fixture's)")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "tests", "goldens", "eval_tiny.jsonl"))
+    args = ap.parse_args()
+    seqs = make_seqs(args.seed)
+    with open(args.out, "w", encoding="utf-8") as f:
+        for s in seqs:
+            f.write(json.dumps(s) + "\n")
+    n_tok = sum(len(s["tokens"]) for s in seqs)
+    print(f"wrote {args.out}: {len(seqs)} seqs, {n_tok} tokens "
+          f"(seed {args.seed:#x})")
+
+
+if __name__ == "__main__":
+    main()
