@@ -1324,7 +1324,16 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
     context): ``agg_tok_per_s``, ``ttft_ms_p50``/``ttft_ms_p95``
     (measured at the client through the router, queue + dispatch
     included), and the router's retry/eject/shed counters proving the
-    kill/restart schedule actually ran.
+    kill/restart schedule actually ran — plus the durable-streams
+    verdict on the churn wave: ``streams_resumed`` (mid-stream deaths
+    the failover spliced; the happy path is ``streams_resumed > 0,
+    streams_dropped = 0``), ``streams_dropped`` (client-visible
+    mid-stream errors that survived nothing), and ``resume_p95_ms``
+    (detection → first continued token). The kill is aimed: the
+    scenario waits (bounded) for a stream that has delivered its first
+    chunk and kills the replica its session is bound to, so the death
+    lands mid-stream — a pre-first-byte death is an ordinary retry hop
+    and would leave the resume path unmeasured.
 
     Workload knobs (env): DLLAMA_BENCH_FLEET_REPLICAS (3),
     DLLAMA_BENCH_SCN_REQUESTS (18), DLLAMA_BENCH_SCN_MAXTOK (12),
@@ -1440,6 +1449,10 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
         retries0 = reg.counter(tm.ROUTER_RETRIES).total()
         ejects0 = reg.counter(tm.ROUTER_EJECTS).total()
         shed0 = reg.counter(tm.ROUTER_SHED).total()
+        resumed0 = reg.counter(tm.ROUTER_STREAM_RESUMES).total(
+            outcome="resumed")
+        h_resume = reg.histogram(tm.ROUTER_STREAM_RESUME_MS)
+        resume_n0 = h_resume.count()
         mig0 = reg.counter(tm.KVWIRE_MIGRATIONS).total(outcome="migrated")
         fb0 = reg.counter(tm.KVWIRE_MIGRATIONS).total(outcome="fallback")
         txb0 = reg.counter(tm.KVWIRE_TX_BYTES).total()
@@ -1464,10 +1477,13 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
                                   "content": f"fleet bench {i % 6} "
                                              + "ab" * (i % 4)}],
                     "max_tokens": max_tok, "temperature": 0,
-                    "stream": stream}
+                    "stream": stream, "session_id": f"s{i}"}
             if disagg and not stream:
                 body["timing"] = True  # carries kvmigrate_ms attribution
-            rec: dict = {"t_sub": t0}
+            # registered up front (and mutated in place) so the churn
+            # choreography can see which requests are mid-flight
+            rec: dict = {"t_sub": t0, "stream": stream}
+            results[i] = rec
             try:
                 req = urllib.request.Request(
                     router_url + "/v1/chat/completions",
@@ -1511,6 +1527,7 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
         # lone prefill tier dying would just measure the (covered
         # elsewhere) no-prefill fallback instead of disaggregation
         ki = (n_replicas - 1) if disagg else 0
+        idx_of = {u.split("//", 1)[1]: j for j, u in enumerate(urls)}
         threads: list = []
         t0 = time.perf_counter()
         for i in range(n_reqs):
@@ -1519,8 +1536,28 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
                 break
             if i == kill_at:
                 # the churn event: a replica dies mid-traffic — new
-                # connections refused, its scheduler fails in-flight work
+                # connections refused, its scheduler fails in-flight
+                # work. Aim it MID-STREAM: wait (bounded) for a stream
+                # that has delivered its first chunk and kill the
+                # replica its session is bound to — a pre-first-byte
+                # death is a plain retry hop, not a durable-stream
+                # resume, and would leave the failover path unmeasured
                 out["phase"] = "scenario_kill"
+                t_aim = time.monotonic() + 30
+                while time.monotonic() < min(t_aim, deadline):
+                    with fleet._lock:
+                        aff = {k: v.name
+                               for k, v in fleet._affinity.items()}
+                    live = [j for j, r in list(results.items())
+                            if r.get("stream") and "t_first" in r
+                            and "t_end" not in r
+                            and f"sid:s{j}" in aff
+                            and (not disagg
+                                 or idx_of[aff[f"sid:s{j}"]] != 0)]
+                    if live:
+                        ki = idx_of[aff[f"sid:s{live[0]}"]]
+                        break
+                    time.sleep(0.02)
                 servers[ki].shutdown()
                 servers[ki].server_close()
                 states[ki].close(drain_s=0.0)
@@ -1558,6 +1595,15 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
                                    - ejects0)
         out["router_shed"] = int(reg.counter(tm.ROUTER_SHED).total()
                                  - shed0)
+        # durable streams under churn: the kill lands mid-stream, so
+        # the router's failover must splice continuations — resumed
+        # streams finish token-exactly (they count toward n_completed),
+        # dropped ones surface as the client-visible mid-stream error
+        out["streams_resumed"] = int(reg.counter(
+            tm.ROUTER_STREAM_RESUMES).total(outcome="resumed") - resumed0)
+        out["streams_dropped"] = out["n_midstream_error"]
+        out["resume_p95_ms"] = (round(h_resume.quantile(0.95), 1)
+                                if h_resume.count() > resume_n0 else None)
         if disagg:
             # wire outcomes + volume: what the disaggregation actually
             # moved instead of recomputing, and what fell back

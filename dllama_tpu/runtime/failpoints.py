@@ -98,6 +98,13 @@ have at least one call site:
   the non-finite tripwire and fails only the affected request,
   503-shaped. Requires a trace that contains the ring collectives
   (``--comm-overlap`` on a tp mesh).
+* ``resume`` — the fleet router's mid-stream failover re-dispatch
+  (``serve/router.py`` ``_resume_stream``, fired once per spliced
+  continuation before the resume target is contacted): a
+  ``conn_reset``/``broken_pipe``/``raise`` kills the re-dispatch
+  exactly where a dying resume target would, driving the bounded
+  resume budget to its terminal SSE 502 while bystander streams stay
+  token-intact (tests/test_chaos.py).
 * ``eval`` — the quality observatory's per-sequence scoring point
   (``runtime/evalharness.py``, fired once per eval sequence as the
   harness submits/scores it): a ``raise`` aborts the run mid-dataset,

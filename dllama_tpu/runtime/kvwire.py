@@ -89,6 +89,14 @@ class DeadlineExceeded(KVWireError):
     """The per-transfer deadline expired mid-stream."""
 
 
+# the closed ``dllama_kvwire_fallback_total{reason}`` vocabulary (the
+# failure-taxonomy dlint rule holds call sites and PERF.md to it):
+# "timeout" deadline/socket expiry, "crc" integrity or geometry refusal,
+# "peer_death" the peer vanished mid-transfer, "exhaustion" the import
+# side could not stage blocks (assigned in runtime/serving.py, not here)
+FALLBACK_REASONS = ("timeout", "crc", "peer_death", "exhaustion")
+
+
 def classify_failure(exc: BaseException) -> str:
     """Map a transfer failure onto the closed
     ``dllama_kvwire_fallback_total{reason}`` vocabulary (``exhaustion``

@@ -207,6 +207,14 @@ class Request:
     # field and the request admits normally (recompute fallback) — a
     # migration is an optimization, never a correctness dependency.
     kv_peer: str | None = None
+    # mid-stream resume (serve/router.py failover): the TAIL of
+    # prompt_ids carries this many already-emitted tokens from the dead
+    # replica's stream. Admission treats them like any prompt prefix
+    # (match/share/chunked prefill, kv_peer migration included); the
+    # sampled-coin stream is fast-forwarded by the same count so the
+    # continuation draws exactly the coins the dead replica would have
+    # (coin i == emitted token i, the spec_coins_consumed invariant).
+    resume_from: int = 0
     # speculative accounting (paged/dense spec serving): drafted tokens
     # offered to verify dispatches and the accepted count — the per-request
     # accept rate surfaced in the opt-in `timing` response block
@@ -222,6 +230,8 @@ class Request:
 
     def __post_init__(self):
         self.rng_state = self.seed & _MASK64
+        for _ in range(self.resume_from):
+            _, self.rng_state = xorshift_random_f32(self.rng_state)
 
     def ttft_breakdown(self) -> dict | None:
         """This request's TTFT decomposition (ms) via the one shared
@@ -393,6 +403,12 @@ class _GeneratorCore:
 
             req.decoder = copy.copy(self.eng.tokenizer)
             req.decoder._pending = bytearray()
+            # resumed stream: replay the already-emitted history through
+            # the fresh decoder (output discarded) so its UTF-8 carry-over
+            # matches the dead replica's state at the splice point —
+            # a kill inside a multi-byte character still decodes exactly
+            for t in req.prompt_ids[len(req.prompt_ids) - req.resume_from:]:
+                req.decoder.decode(t)
         req.t_decode = telemetry.now_ns()
         if req.t_admit:
             # n_tokens = positions actually prefilled (after prefix reuse),
@@ -2099,11 +2115,13 @@ class PagedGenerator(_GeneratorCore):
         and near-done slots keep decoding at width 1 instead of retiring
         early, and a varying-lens batch never retraces (lens is traced).
         Greedy rows emit their exact accepted run; sampled rows emit the
-        rejection-sampled run, committing exactly the consumed coins
-        (final coin first, then one accept coin per test —
-        ``speculative.spec_coins_consumed``) from a COPY of their RNG
-        state, so every request's stream stays independent of its
-        batch-mates."""
+        exact-match-verified run, drawing coins in POSITION order (the
+        K draft-position coins, then the bonus coin) from a COPY of
+        their RNG state and committing one coin per emitted token
+        (``speculative.spec_coins_consumed``), so coin ``i`` of a
+        request's stream always belongs to emitted token ``i`` — the
+        invariant mid-stream resume fast-forwards on — and every
+        request's stream stays independent of its batch-mates."""
         from .speculative import spec_coins_consumed
 
         spec = self.spec
@@ -2132,12 +2150,14 @@ class PagedGenerator(_GeneratorCore):
             drafted += cap
             if req.temperature > 0.0:
                 # pre-draw from a COPY (committed post-dispatch by the
-                # consumed count): FINAL coin first so a zero-length
-                # draft consumes exactly the one coin plain decode would
+                # consumed count) in POSITION order: all K draft-slot
+                # coins then the bonus coin, so stream coin i is always
+                # emitted-token i's coin (a zero-length draft's position
+                # 0 is acoins[0] — the very draw plain decode would make)
                 st = req.rng_state
-                fcoins[i], st = xorshift_random_f32(st)
-                for j in range(cap):
+                for j in range(spec):
                     acoins[i, j], st = xorshift_random_f32(st)
+                fcoins[i], st = xorshift_random_f32(st)
         self._grow_or_fail(active, lens)
         if not active:
             return 0
@@ -2287,7 +2307,8 @@ class BatchScheduler:
                temperature: float = 0.0, topp: float = 0.9,
                seed: int = 0xB1A5, stop_on_eos: bool = True,
                timeout_s: float | None = None, on_token=None,
-               kv_peer: str | None = None, score: bool = False) -> Request:
+               kv_peer: str | None = None, score: bool = False,
+               resume_from: int = 0) -> Request:
         if score and getattr(self.gen.eng, "_nll_step", None) is None:
             raise ValueError(
                 "eval scoring is unsupported on this engine: no "
@@ -2310,10 +2331,15 @@ class BatchScheduler:
                                 self.gen.hbm_need)
             rid = self._next_rid
             self._next_rid += 1
+            if not 0 <= resume_from < len(prompt_ids):
+                raise ValueError(
+                    f"resume_from {resume_from} out of range for a "
+                    f"{len(prompt_ids)}-token prompt+history")
             req = Request(rid=rid, prompt_ids=list(prompt_ids),
                           max_tokens=max_tokens, temperature=temperature,
                           topp=topp, seed=seed, stop_on_eos=stop_on_eos,
-                          on_token=on_token, score=score)
+                          on_token=on_token, score=score,
+                          resume_from=resume_from)
             if kv_peer and hasattr(self.gen, "wire_geometry"):
                 # peer-KV migration is paged-pool-only; a dense pool (or
                 # an empty peer) just recomputes — no error, no field
@@ -2326,6 +2352,9 @@ class BatchScheduler:
                 len(self._queue))
             self.flight.note("submit", rid, n_prompt=len(prompt_ids),
                              max_tokens=max_tokens)
+            if resume_from:
+                self.flight.note("resume", rid, n_history=resume_from,
+                                 peer=kv_peer or "")
         self._wake.set()
         return req
 

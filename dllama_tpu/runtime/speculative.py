@@ -15,19 +15,22 @@ documents). Identity is asserted token-for-token on the CPU mesh
 (test_tpu_hw.py::test_spec_transcript_identity_on_hw).
 
 Sampled traffic (temperature > 0) cashes the same check through
-**speculative rejection sampling** (:func:`spec_decide`, the logits
-epilogue of the paged verify program family in models/llama.py): the
-prompt-lookup draft is a deterministic proposal — a point mass on the
-drafted token — so the standard speculative-sampling acceptance rule
-collapses to *accept draft token d with probability p_target(d); on the
-first rejection resample from the residual distribution p_target with d
-zeroed, renormalized*. The emitted-token distribution is exactly the
-target sampling distribution at every position (the point-mass case of
-the speculative-sampling theorem; asserted by a TV-distance bound in
-tests/test_speculative.py), where the target distribution is literally
-the one :func:`dllama_tpu.ops.sampling.sampled_token` samples — the
-bonus token at the all-accepted position runs that very function, so a
-zero-length draft degrades to the plain sampled decode step bit-exactly.
+**exact-match speculative verify** (:func:`spec_decide`, the logits
+epilogue of the paged verify program family in models/llama.py): every
+verify lane runs the plain sampled decode step —
+:func:`dllama_tpu.ops.sampling.sampled_token` on that position's logits
+with that position's coin from the request's sequential coin stream —
+and a draft token is accepted iff it EQUALS the sample. The emitted
+token at every position therefore IS the plain-decode sample for that
+position (distribution trivially exact; asserted by a TV-distance bound
+in tests/test_speculative.py), spec-on output is bit-identical to
+spec-off (only step segmentation differs), and the coin-stream
+invariant *coins consumed == tokens emitted* holds — which is what lets
+a mid-stream failover resume (serve/router.py) fast-forward the RNG by
+the emitted-token count and continue a sampled stream token-exactly on
+another replica. A zero-length draft degrades to the plain sampled
+decode step bit-exactly (position 0's coin is the next stream draw,
+same as the non-speculative path's single draw).
 
 The reference has no speculative path (one token per step, dllama.cpp:88-99);
 this is TPU-economics-driven: decode is HBM-bound, so tokens-per-weight-read
@@ -127,18 +130,19 @@ def target_sampling_probs(logits, temps, topps):
 
 
 def spec_decide(logits, tokens, lens, temps, topps, acoins, fcoins):
-    """The verify program's logits epilogue — greedy exact-match AND
-    speculative rejection sampling over one ragged batch.
+    """The verify program's logits epilogue — exact-match verify over
+    one ragged batch, greedy and sampled rows alike.
 
     ``logits [B, K+1, V]`` from the verify forward over ``tokens
     [B, K+1]`` (committed token + K drafts, padded past each row's
     ``lens [B]`` draft length); ``temps/topps [B]`` per-row sampling
-    knobs; ``acoins [B, K]`` per-draft accept coins and ``fcoins [B]``
-    the final coin — the host draws the FINAL coin first, then the
-    accept coins, and commits ``tests + 1`` draws (``tests = n_acc`` on
-    full acceptance else ``n_acc + 1``), so the emitted tokens depend on
-    exactly the committed prefix of the request's own coin stream
-    (untested accept coins influenced nothing and are safely re-drawn).
+    knobs; ``acoins [B, K]`` the coins for draft positions ``0..K-1``
+    and ``fcoins [B]`` the coin for the bonus position ``K`` — drawn by
+    the host in POSITION order from the request's sequential coin
+    stream, committed post-dispatch by the consumed count
+    (:func:`spec_coins_consumed`), so coin ``i`` of the stream is
+    always the coin of emitted-token ordinal ``i`` regardless of how
+    speculation segments the steps.
 
     Returns ``(n_acc [B], out [B, K+1])``; the caller emits
     ``out[b, : n_acc[b] + 1]``:
@@ -146,20 +150,20 @@ def spec_decide(logits, tokens, lens, temps, topps, acoins, fcoins):
     * greedy rows (``temp <= 0``): ``n_acc`` = longest draft prefix
       matching the model's own argmax (capped at ``lens``), ``out`` =
       the argmax predictions — token-identical to sequential greedy.
-    * sampled rows: draft token ``i`` accepted iff ``acoins[:, i] <
-      p_target(draft)`` (point-mass proposal ⇒ accept prob =
-      ``min(1, p/1)``); ``out[:, :n_acc]`` = the accepted drafts, and
-      position ``n_acc`` carries the residual resample (first rejection:
-      ``mult_sample`` over ``p_target`` with the rejected token zeroed,
-      renormalized) or — on full acceptance — the bonus token from
-      :func:`ops.sampling.sampled_token` on that position's logits with
-      the same final coin, so ``lens == 0`` reproduces the plain sampled
-      decode step bit-exactly.
+    * sampled rows: every position runs the plain sampled decode step
+      (:func:`ops.sampling.sampled_token` with that position's coin);
+      draft token ``i`` is accepted iff it EQUALS the sample at
+      position ``i``, and ``out`` carries the samples themselves — the
+      emitted token at every position is the one plain decode would
+      have produced with the same coin stream, so spec-on output is
+      bit-identical to spec-off and ``lens == 0`` reproduces the plain
+      sampled decode step bit-exactly (position 0's coin is the next
+      stream draw).
     """
-    import jax
+    import jax  # noqa: F401 — jit context for sampled_token's cond path
     import jax.numpy as jnp
 
-    from ..ops.sampling import mult_sample, sampled_token
+    from ..ops.sampling import sampled_token
 
     B, W, V = logits.shape
     K = W - 1
@@ -171,55 +175,35 @@ def spec_decide(logits, tokens, lens, temps, topps, acoins, fcoins):
     ok = ((tokens[:, 1:] == preds[:, :-1]) & (lane < lens[:, None]))
     n_acc_g = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=-1), axis=-1)
 
-    # target probs at the K draft positions (position K never needs them:
-    # it is only ever the bonus position, sampled by sampled_token below)
-    p_draft_rows = target_sampling_probs(
-        logits[:, :K].reshape(B * K, V),
-        jnp.repeat(temps, K), jnp.repeat(jnp.asarray(topps, jnp.float32), K)
-    ).reshape(B, K, V)
-    p_d = jnp.take_along_axis(p_draft_rows, tokens[:, 1:, None],
-                              axis=2)[..., 0]                  # [B, K]
-    acc = (jnp.asarray(acoins, jnp.float32) < p_d) & (lane < lens[:, None])
-    n_acc_s = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=-1), axis=-1)
+    # sampled rows: the plain sampled step at EVERY position with that
+    # position's stream coin (acoins are positions 0..K-1, fcoin is K)
+    coins = jnp.concatenate(
+        [jnp.asarray(acoins, jnp.float32).reshape(B, K),
+         jnp.asarray(fcoins, jnp.float32)[:, None]], axis=1)   # [B, K+1]
+    s = sampled_token(
+        logits.reshape(B * W, V), jnp.repeat(temps, W),
+        jnp.repeat(jnp.asarray(topps, jnp.float32), W),
+        coins.reshape(-1)).reshape(B, W).astype(jnp.int32)
+    ok_s = ((tokens[:, 1:] == s[:, :-1]) & (lane < lens[:, None]))
+    n_acc_s = jnp.sum(jnp.cumprod(ok_s.astype(jnp.int32), axis=-1), axis=-1)
 
-    rejected = n_acc_s < lens
-    j = n_acc_s                                                # [B]
-    # residual resample at the rejection position (j <= K-1 when rejected)
-    j_draft = jnp.minimum(j, K - 1) if K else jnp.zeros_like(j)
-    pj = jnp.take_along_axis(p_draft_rows, j_draft[:, None, None],
-                             axis=1)[:, 0] if K else jnp.zeros((B, V))
-    d_j = (jnp.take_along_axis(tokens[:, 1:], j_draft[:, None], axis=1)[:, 0]
-           if K else jnp.zeros((B,), jnp.int32))
-    resid = jnp.where(jnp.arange(V, dtype=jnp.int32)[None, :] == d_j[:, None],
-                      0.0, pj)
-    resid = resid / jnp.maximum(jnp.sum(resid, axis=-1, keepdims=True), 1e-30)
-    fcoins = jnp.asarray(fcoins, jnp.float32)
-    resample = jax.vmap(mult_sample)(resid, fcoins)
-    # bonus on full acceptance: THE plain sampled-step function on the
-    # accepted position's logits with the same final coin (lens == 0 ⇒
-    # bit-identical to the non-speculative sampled decode step)
-    logits_j = jnp.take_along_axis(logits, j[:, None, None], axis=1)[:, 0]
-    bonus = sampled_token(logits_j, temps, topps, fcoins)
-    final = jnp.where(rejected, resample, bonus)
-
-    drafts_pad = jnp.concatenate(
-        [tokens[:, 1:], tokens[:, -1:]], axis=1)               # [B, K+1]
-    out_s = jnp.where(jnp.arange(W, dtype=jnp.int32)[None, :] == j[:, None],
-                      final[:, None], drafts_pad)
     greedy_row = temps <= 0.0
     n_acc = jnp.where(greedy_row, n_acc_g, n_acc_s)
-    out = jnp.where(greedy_row[:, None], preds, out_s)
+    out = jnp.where(greedy_row[:, None], preds, s)
     return n_acc, out
 
 
 def spec_coins_consumed(n_acc: int, draft_len: int) -> int:
     """Host-side coin-stream commit rule for one sampled row of a verify
-    dispatch: the final coin (drawn first) plus one accept coin per test
-    performed — ``n_acc`` tests on full acceptance, ``n_acc + 1`` when a
-    rejection ended the run. Shared by the generator's RNG commit and the
-    tests so the discipline can never drift."""
-    tests = n_acc if n_acc >= draft_len else n_acc + 1
-    return tests + 1
+    dispatch: one coin per EMITTED token — ``n_acc`` accepted drafts
+    plus the position-``n_acc`` sample — keeping the stream-position
+    invariant *coins consumed == tokens emitted* that exact-match verify
+    and mid-stream resume both lean on. ``draft_len`` is unused by the
+    rule (kept in the signature so call sites document the step shape);
+    shared by the generator's RNG commit and the tests so the
+    discipline can never drift."""
+    del draft_len
+    return n_acc + 1
 
 
 class NgramProposer:

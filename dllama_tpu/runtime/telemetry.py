@@ -132,6 +132,8 @@ ROUTER_TTFT_MS = "dllama_router_ttft_ms"
 ROUTER_CONNECT_MS = "dllama_router_connect_ms"
 ROUTER_RETRY_MS = "dllama_router_retry_ms"
 ROUTER_RETRY_HOPS = "dllama_router_retry_hops_total"
+ROUTER_STREAM_RESUMES = "dllama_router_stream_resumes_total"
+ROUTER_STREAM_RESUME_MS = "dllama_router_stream_resume_ms"
 # SLO observatory (runtime/slo.py, evaluated at the router)
 SLO_COMPLIANCE = "dllama_slo_compliance"
 SLO_BURN_RATE = "dllama_slo_burn_rate"
@@ -485,6 +487,15 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "Fleet router: dispatch attempts by hop index (hop=\"0\" first "
           "attempt, hop=\"1\" retry — the same index the "
           "X-Dllama-Hop header carries to the replica)"),
+    _spec(ROUTER_STREAM_RESUMES, "counter",
+          "Fleet router: mid-stream failover attempts by outcome "
+          "(outcome=\"resumed\" spliced continuation, \"exhausted\" "
+          "--max-stream-resumes used up, \"no_budget\" no remaining "
+          "request-timeout budget, \"failed\" re-dispatch itself died)"),
+    _spec(ROUTER_STREAM_RESUME_MS, "histogram",
+          "Fleet router: wall time from mid-stream death detection to "
+          "the first continued token relayed to the client (the "
+          "client-visible stall a successful resume costs)"),
     _spec(SLO_COMPLIANCE, "gauge",
           "SLO observatory: 1 while the labeled objective currently "
           "meets its target over the evaluation window, else 0 "
@@ -793,9 +804,12 @@ EVAL_PARITY = (("dense", "single"), ("paged", "single"),
 # * ``rt_kv_donor`` — an instant marker: the dispatch carried an
 #   ``X-Dllama-KV-Peer`` pointer naming the replica the decode side
 #   should pull its prefix KV from (runtime/kvwire).
+# * ``rt_resume`` — one mid-stream failover: death detection → the
+#   first continued token relayed (detect / re-dispatch / first-token
+#   attribution rides in the span's extra fields).
 ROUTER_PHASES = ("rt_queue", "rt_dispatch", "rt_connect", "rt_first_byte",
                  "rt_stream", "rt_retry", "rt_eject", "rt_prefill",
-                 "rt_kv_donor")
+                 "rt_kv_donor", "rt_resume")
 
 
 class SpanTracer:

@@ -101,16 +101,34 @@ _MAX_TIMEOUT_S = 3600.0
 # replica it has not successfully probed yet.
 READY_CODES = ("ok", "draining", "crashed", "queue_full", "loading")
 
+# the closed finish_reason vocabulary: every terminal SSE chunk and
+# non-streaming response (here and the router's terminal abort event,
+# serve/router.py) spells one of these — "length"/"stop" are the normal
+# completions, "timeout" a request-deadline truncation, "error" the
+# mid-stream abort marker. Closed-world with the fallback-reason and
+# resume-outcome vocabularies by tools/dlint's failure-taxonomy rule.
+FINISH_REASONS = ("length", "stop", "timeout", "error")
+
 # one Retry-After policy for every backpressure answer — the 429 shed
 # path, the 503 drain/crash/unready paths, and /readyz 503, here and in
 # serve/router.py — so the surfaces can't drift: 429 is transient queue
-# pressure (retry soon), 503 means the process needs orchestrator time
+# pressure (retry soon), 503 means the process needs orchestrator time.
+# Bounded random jitter is ADDED to the base (integer seconds — the
+# header grammar) so clients backpressured in the same instant don't
+# come back in one synchronized stampede against a recovering replica.
 RETRY_AFTER_S = {429: 1, 503: 5}
+RETRY_AFTER_JITTER_S = {429: 1, 503: 3}
 
 
 def backpressure_headers(status: int) -> dict:
-    """The shared Retry-After header block for a 429/503 answer."""
-    return {"Retry-After": str(RETRY_AFTER_S[status])}
+    """The shared Retry-After header block for a 429/503 answer, with
+    bounded random jitter (base..base+jitter seconds) to de-synchronize
+    retry waves."""
+    import random
+
+    return {"Retry-After": str(RETRY_AFTER_S[status]
+                               + random.randint(
+                                   0, RETRY_AFTER_JITTER_S[status]))}
 
 
 # fleet trace identity (serve/router.py is the usual sender): one request
@@ -224,6 +242,19 @@ def _validate_body(body: dict) -> None:
         raise ValueError("stop must be a string or a list of strings")
     if isinstance(stop, list) and not all(isinstance(s, str) for s in stop):
         raise ValueError("stop must be a string or a list of strings")
+    # mid-stream resume (the fleet router sends these on a failover
+    # re-dispatch, never ordinary clients): the already-emitted token
+    # history rides in the body so admission can treat it as prompt
+    rf = body.get("resume_from")
+    rtoks = body.get("resume_tokens")
+    if rf is not None or rtoks is not None:
+        if isinstance(rf, bool) or not isinstance(rf, int) or rf < 1:
+            raise ValueError("resume_from must be a positive integer")
+        if (not isinstance(rtoks, list) or len(rtoks) != rf
+                or not all(isinstance(t, int) and not isinstance(t, bool)
+                           and t >= 0 for t in rtoks)):
+            raise ValueError("resume_tokens must be a list of exactly "
+                             "resume_from non-negative token ids")
 
 
 @dataclass
@@ -351,6 +382,13 @@ class ApiState:
         engine = self.engine
         tok = engine.tokenizer
         _validate_body(body)
+        if body.get("resume_from"):
+            # mid-stream resume admission is scheduler work (prompt+
+            # history prefill + positioned coin stream); the single-
+            # sequence mode never stamps resumable chunks, so a resume
+            # dispatch landing here is a router/client bug — 400-shaped
+            raise ValueError("stream resume requires batched serving "
+                             "(--batch-slots N)")
         # retrace sentinel (runtime.introspection): a completion that ran
         # end-to-end without a single compile is the single-sequence
         # definition of steady state — from then on, recompiles are WARNed
@@ -531,6 +569,11 @@ class BatchedApiState:
     # for a fleet's worth of sticky sessions, small enough that /readyz
     # bodies stay probe-sized
     KV_PREFIX_MAX = 64
+    # advertisement TTL (seconds): the paged pool evicts cached blocks
+    # independently, so an advertisement older than this is more likely
+    # stale than resident — expiring it keeps a dead or recycled prefix
+    # at one 404 export probe worst-case, never a doomed migration plan
+    KV_PREFIX_TTL_S = 120.0
 
     def __init__(self, engine: InferenceEngine, n_slots: int,
                  model_name: str = "dllama-tpu",
@@ -559,8 +602,9 @@ class BatchedApiState:
         # prompts whose KV this replica's paged pool RECENTLY held.
         # Advisory: the pool evicts independently, so a stale entry just
         # costs one export probe that returns "not resident". Bounded
-        # LRU; handler threads write it, the probe reader snapshots it.
-        self._kv_prefixes: OrderedDict[str, None] = OrderedDict()
+        # LRU with a TTL (key → monotonic stamp); handler threads write
+        # it, the probe reader snapshots it, both prune expired entries.
+        self._kv_prefixes: OrderedDict[str, float] = OrderedDict()
         self._kv_lock = threading.Lock()
 
     def readiness(self) -> tuple[bool, str, str]:
@@ -572,18 +616,36 @@ class BatchedApiState:
         return self.sched.eval_resident()
 
     def note_kv_prefix(self, key: str | None) -> None:
-        """Record (LRU-front) a prefix this replica's pool now holds."""
+        """Record (LRU-front, TTL-stamped) a prefix this replica's pool
+        now holds; a re-note refreshes the stamp."""
         if not key:
             return
         with self._kv_lock:
             self._kv_prefixes.pop(key, None)
-            self._kv_prefixes[key] = None
-            while len(self._kv_prefixes) > self.KV_PREFIX_MAX:
-                self._kv_prefixes.popitem(last=False)
+            self._kv_prefixes[key] = time.monotonic()
+            self._prune_kv_prefixes()
+
+    def drop_kv_prefix(self, key: str | None) -> None:
+        """Evict one advertisement early (retire-time eviction or an
+        export probe that answered "not resident")."""
+        if not key:
+            return
+        with self._kv_lock:
+            self._kv_prefixes.pop(key, None)
+
+    def _prune_kv_prefixes(self) -> None:
+        # caller holds _kv_lock
+        cutoff = time.monotonic() - self.KV_PREFIX_TTL_S
+        for k in [k for k, ts in self._kv_prefixes.items() if ts < cutoff]:
+            del self._kv_prefixes[k]
+        while len(self._kv_prefixes) > self.KV_PREFIX_MAX:
+            self._kv_prefixes.popitem(last=False)
 
     def kv_prefix_list(self) -> list[str]:
-        """Most-recent-first snapshot for the /readyz advertisement."""
+        """Most-recent-first snapshot for the /readyz advertisement
+        (expired entries pruned on read — a probe never sees them)."""
         with self._kv_lock:
+            self._prune_kv_prefixes()
             return list(reversed(self._kv_prefixes))
 
     def begin_drain(self) -> None:
@@ -601,10 +663,35 @@ class BatchedApiState:
                  for m in messages]
         prompt = self.template.generate(items, append_generation_prompt=True)
         ids = tok.encode(prompt.content, is_start=True, add_special_tokens=True)
+        # mid-stream resume (serve/router.py failover re-dispatch): the
+        # dead replica's already-emitted tokens are PROMPT now — they
+        # ride the tail of ids through the one ordinary admission path
+        # (match/share/chunked prefill, kv_peer migration included) and
+        # decode continues from position n with the coin stream
+        # fast-forwarded by the same count (scheduler-side)
+        resume_from = int(body.get("resume_from") or 0)
+        if resume_from:
+            ids = ids + [int(t) for t in body["resume_tokens"]]
         max_tokens = int(body.get("max_tokens") or 0)
         if max_tokens <= 0:
             max_tokens = max(1, self.engine.cfg.seq_len - len(ids))
+        else:
+            # the client's bound covers the WHOLE generation; n of it
+            # was already delivered by the dead replica
+            max_tokens = max(1, max_tokens - resume_from)
         timeout_s = float(body.get("timeout") or self.request_timeout or 0)
+
+        # SSE token stamping: each streamed chunk carries the cumulative
+        # generated-token index plus the ids emitted since the previous
+        # chunk, so the fleet router can keep a resume record and splice
+        # a failover continuation with exactly-once delivery
+        n_fed = resume_from
+        since: list[int] = []
+        memit = None
+        if emit is not None:
+            def memit(d):
+                emit(d, {"index": n_fed, "tokens": since.copy()})
+                since.clear()
 
         sampler = self.engine.sampler  # CLI flags are the per-request defaults
         q: queue.Queue = queue.Queue()
@@ -616,7 +703,7 @@ class BatchedApiState:
             stop_on_eos=True,
             timeout_s=timeout_s if timeout_s > 0 else None,
             on_token=lambda t, p: q.put((t, p)),
-            kv_peer=kv_peer)
+            kv_peer=kv_peer, resume_from=resume_from)
         if fleet is not None:
             # bound AFTER submit (the scheduler assigns the rid there);
             # the submit span predates the binding, but every later
@@ -625,15 +712,30 @@ class BatchedApiState:
             flightrec.recorder().note("fleet_rid", rid=req.rid,
                                       reason=fleet[0], hop=fleet[1])
 
-        gate = _EosGate(tok, _request_stops(self.stop_pieces, body), emit)
+        gate = _EosGate(tok, _request_stops(self.stop_pieces, body), memit)
+        if resume_from:
+            # prime the gate with the history (emission suppressed: the
+            # client already holds those tokens) so the stop-string
+            # detector's buffer and the UTF-8 decode carry-over match
+            # the dead replica's state at the splice point exactly
+            import copy
+
+            gate.emit = None
+            dec = copy.copy(tok)
+            dec._pending = bytearray()
+            for t in ids[len(ids) - resume_from:]:
+                gate.feed(t, dec.decode(t))
+            gate.emit = memit
         rt = telemetry.RequestTimer()
         n_completion = 0
         finish_reason = "length"
         try:
             # inside the try: the public-prompt echo is the FIRST socket
             # write, so a peer that disconnected right after POSTing must
-            # cancel the slot here too, not only mid-stream
-            if prompt.public_prompt:
+            # cancel the slot here too, not only mid-stream (a resume
+            # never re-echoes: the client got the echo from the first
+            # replica already)
+            if prompt.public_prompt and not resume_from:
                 gate._out(prompt.public_prompt)
             while True:
                 try:
@@ -643,6 +745,8 @@ class BatchedApiState:
                         break
                     continue
                 n_completion += 1
+                n_fed += 1
+                since.append(t)
                 rt.token()
                 if gate.feed(t, piece):
                     # stop STRING matched (spelled by ordinary tokens — the
@@ -1066,10 +1170,15 @@ def make_handler(state: ApiState):
                 self.end_headers()
                 headers_sent = True
 
-            def emit(text: str) -> None:
+            def emit(text: str, meta: dict | None = None) -> None:
                 failpoints.fire("emit")
                 start_stream()
                 chunk = _chunk_json(state, {"content": text})
+                if meta is not None:
+                    # resume stamping (batched mode): monotonic token
+                    # index + the ids this chunk covers — the fleet
+                    # router's per-request resume record reads these
+                    chunk["dllama"] = meta
                 self.wfile.write(
                     b"data: " + json.dumps(chunk).encode("utf-8") + b"\n\n")
                 self.wfile.flush()

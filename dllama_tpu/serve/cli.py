@@ -282,6 +282,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="router mode: health-probe interval per replica "
                         "(jittered ±20%% so a fleet of routers never "
                         "synchronizes its probe bursts)")
+    p.add_argument("--max-stream-resumes", type=int, default=1,
+                   metavar="N",
+                   help="router mode: how many mid-stream replica deaths "
+                        "one streaming request may survive — each death "
+                        "re-dispatches the stream to a healthy replica "
+                        "as a token-exact spliced continuation (0 = the "
+                        "first death is the terminal SSE 502, the "
+                        "pre-failover behavior). Batched replicas "
+                        "(--batch-slots) stamp their chunks with token "
+                        "indices to make the splice exactly-once; "
+                        "unstamped streams keep the terminal-502 "
+                        "contract regardless")
     p.add_argument("--batch-slots", type=int, default=0, metavar="N",
                    help="api mode: continuous batching over N concurrent "
                         "sequence slots (one ragged decode program; requests "
@@ -307,7 +319,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "one is cancelled at the next step boundary "
                         "(finish_reason \"timeout\", partial output). The "
                         "request body's 'timeout' field overrides per "
-                        "request; 0 = no deadline")
+                        "request; 0 = no deadline. Router mode: the wall "
+                        "budget a mid-stream failover must fit inside — a "
+                        "spliced continuation is only dispatched within "
+                        "the remaining deadline")
     p.add_argument("--drain-timeout", type=float, default=5.0, metavar="SEC",
                    help="api mode: on SIGTERM/shutdown, stop admitting "
                         "(readyz → 503) and let active requests finish for "

@@ -35,12 +35,26 @@ path with chaos):
   success re-admits it (``dllama_router_readmits_total``).
 * **Per-request budgets** — a dispatch that fails before the FIRST
   byte reaches the client is transparently retried once on a different
-  replica (``dllama_router_retries_total``); a stream that dies
-  mid-flight gets an explicit terminal SSE error event naming the 502
-  plus ``[DONE]`` — never a silent hang; when every replica is
+  replica (``dllama_router_retries_total``); when every replica is
   saturated (or the router-level ``--max-queue`` in-flight bound is
   hit) the request is shed with 429 + ``Retry-After``
   (``dllama_router_shed_total``).
+* **Durable streams** — a stream that dies mid-flight (EOF without the
+  ``[DONE]`` sentinel, a read error, or a replica-authored terminal
+  ``finish_reason: "error"`` chunk from a crash/watchdog fail-all) is
+  re-dispatched to a healthy replica as a token-exact spliced
+  continuation when its chunks carried the batched replica's
+  ``dllama`` index stamps: the router replays the full token history
+  (body ``resume_from``/``resume_tokens`` + the
+  ``X-Dllama-Resume-From`` header), prefers pulling the prefix KV from
+  any advertising peer (the dying donor included) over the checksummed
+  wire, and drops any replayed index so delivery is exactly-once
+  (``dllama_router_stream_resumes_total{outcome}`` /
+  ``dllama_router_stream_resume_ms``; ``rt_resume`` span). Bounded by
+  ``--max-stream-resumes`` (default 1) and the remaining
+  ``--request-timeout`` budget — past either bound, and for unstamped
+  streams always, the legacy contract stands: an explicit terminal SSE
+  error event naming the 502 plus ``[DONE]`` — never a silent hang.
 * **Drain awareness** — a replica whose ``/readyz`` goes 503
   (draining) stops receiving new dispatches while its in-flight
   streams finish; the router's own SIGTERM does the same one level up
@@ -107,6 +121,19 @@ FLEET_HOP_HEADER = "X-Dllama-Hop"
 # pulls it over the kvwire stream instead of recomputing). Re-spelled
 # from serve/api.py for the same engine-free-import reason as above.
 KV_PEER_HEADER = "X-Dllama-KV-Peer"
+# Mid-stream failover: a spliced continuation names the count of tokens
+# the client already holds; the replica admits the request with the full
+# token history (body "resume_from"/"resume_tokens") and emits nothing
+# at or below that index. Re-spelled from serve/api.py, same reason.
+RESUME_FROM_HEADER = "X-Dllama-Resume-From"
+# Closed outcome vocabulary of dllama_router_stream_resumes_total (the
+# failure-taxonomy dlint rule holds it to telemetry's label docs and
+# PERF.md): resumed — continuation spliced, the client's transcript
+# continued token-exactly; exhausted — another death after
+# --max-stream-resumes continuations; no_budget — no remaining
+# --request-timeout budget to resume into; failed — the re-dispatch
+# itself found no healthy replica or died before the splice.
+RESUME_OUTCOMES = ("resumed", "exhausted", "no_budget", "failed")
 _RID_SAFE_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 # upstream response headers relayed verbatim; everything hop-by-hop or
@@ -264,6 +291,15 @@ class Replica:
         one export round trip that answers \"not resident\"."""
         with self._lock:
             return self.state != "down" and key in self.kv_prefixes
+
+    def purge_kv_prefixes(self) -> None:  # dlint: owner=any
+        """Breaker-eject hygiene: a down replica must stop being a
+        KV-donor candidate NOW, not one stale ``holds_prefix`` miss per
+        dispatch until its next probe refresh (``holds_prefix`` already
+        refuses ``down`` replicas — this keeps /debug/fleet and any
+        direct reader honest too)."""
+        with self._lock:
+            self.kv_prefixes = []
 
     def note_success(self, *, from_probe: bool = False) -> None:  # dlint: owner=any
         """A successful probe or dispatch: failures reset; an ejected
@@ -479,6 +515,8 @@ class FleetRouter:
                  backoff_max_s: float = BACKOFF_MAX_S,
                  connect_timeout_s: float = 2.0,
                  read_timeout_s: float = 120.0,
+                 max_stream_resumes: int = 1,
+                 request_timeout_s: float = 0.0,
                  start_probes: bool = True,
                  slo_objectives: dict[str, float] | None = None):
         if not replica_urls:
@@ -499,6 +537,13 @@ class FleetRouter:
         self.probe_interval_s = probe_interval_s
         self.max_inflight = max_inflight
         self.read_timeout_s = read_timeout_s
+        # mid-stream failover budget: how many spliced continuations one
+        # stream may consume (--max-stream-resumes; the N+1th death is
+        # terminal) and the wall deadline resumes must fit inside
+        # (--request-timeout; 0 = unbounded — a client body "timeout"
+        # still bounds its own request)
+        self.max_stream_resumes = max_stream_resumes
+        self.request_timeout_s = request_timeout_s
         self._lock = threading.Lock()
         self._affinity: OrderedDict = OrderedDict()  # dlint: guarded-by=_lock
         self._inflight_total = 0                     # dlint: guarded-by=_lock
@@ -522,6 +567,8 @@ class FleetRouter:
         self.h_ttft = reg.histogram(telemetry.ROUTER_TTFT_MS)
         self.h_connect = reg.histogram(telemetry.ROUTER_CONNECT_MS)
         self.h_retry = reg.histogram(telemetry.ROUTER_RETRY_MS)
+        self.c_resumes = reg.counter(telemetry.ROUTER_STREAM_RESUMES)
+        self.h_resume = reg.histogram(telemetry.ROUTER_STREAM_RESUME_MS)
         self._threads: list[threading.Thread] = []
         if start_probes:
             self.start()
@@ -578,6 +625,7 @@ class FleetRouter:
         entry pointing at the ejected replica so returning sessions
         re-pick (and possibly KV-migrate) immediately instead of riding
         a dead pointer through a dispatchable() miss each."""
+        rep.purge_kv_prefixes()
         with self._lock:
             stale = [k for k, v in self._affinity.items() if v is rep]
             for k in stale:
@@ -709,6 +757,106 @@ class _UpstreamDied(Exception):
         self.code = code
 
 
+class _StreamState:
+    """Per-request resume ledger carried across relay attempts: every
+    SSE event the client was sent passes through :meth:`admit`, which
+    reads the replica's ``dllama`` stamp (``{"index": n, "tokens":
+    [...]}``; serve/api.py batched mode) and keeps the transcript's
+    position — ``n_tokens`` tokens held by the client, their ids in
+    ``tokens``. A spliced continuation re-enters the same ledger, so a
+    replayed index (``<= n_tokens``) is dropped before the client can
+    see a duplicate: the exactly-once half of the token-exact contract
+    (the gap-free half is the replica resuming AT ``n_tokens``)."""
+
+    def __init__(self):
+        self.headers_sent = False   # response status/headers relayed once
+        self.stamped = False        # any dllama index stamp observed
+        self.echo_relayed = False   # the index-0 prompt-echo chunk sent
+        self.done = False           # the [DONE] sentinel reached the client
+        self.upstream_error = False  # held-back terminal "error" chunk
+        self.n_tokens = 0           # last stamped index relayed
+        self.tokens: list[int] = []  # the ids behind those indices
+        self.resumes = 0            # spliced continuations consumed
+        # resume-latency attribution, armed by the resume dispatch and
+        # consumed by the relay loop at the first continued event:
+        # (t_detect_ns, t_redispatch_ns, t_connect_ns, resume_from)
+        self.resume_t: tuple | None = None
+
+    def resumable(self) -> bool:
+        """Only a stamped stream whose ledger is self-consistent (ids
+        held == indices relayed — what the replica-side resume admission
+        validates) can be spliced; anything else keeps the legacy
+        terminal-502 contract."""
+        return self.stamped and len(self.tokens) == self.n_tokens
+
+    def admit(self, evt: bytes) -> bool:
+        """Whether one complete SSE event reaches the client; updates
+        the ledger from the event's ``dllama`` stamp. Unstamped events
+        (errors, usage epilogues, non-JSON) always pass."""
+        body = evt.strip()
+        if not body.startswith(b"data:"):
+            return True
+        data = body[5:].strip()
+        if data == b"[DONE]":
+            self.done = True
+            return True
+        try:
+            obj = json.loads(data)
+        except ValueError:
+            return True
+        if not isinstance(obj, dict):
+            return True
+        if self.stamped:
+            # a replica-authored terminal `finish_reason: "error"` chunk
+            # (scheduler crash fail-all, watchdog trip) is a mid-stream
+            # death in a cleanly-FINed socket: hold it back and let the
+            # caller splice a continuation — a terminal abort past the
+            # resume budget still ends the stream explicitly
+            ch = obj.get("choices")
+            if isinstance(ch, list) and ch and isinstance(ch[0], dict) \
+                    and ch[0].get("finish_reason") == "error":
+                self.upstream_error = True
+                return False
+        meta = obj.get("dllama")
+        if not isinstance(meta, dict):
+            return True
+        try:
+            idx = int(meta.get("index"))
+            toks = [int(t) for t in meta.get("tokens") or ()]
+        except (TypeError, ValueError):
+            return True
+        self.stamped = True
+        if idx == 0:
+            # the prompt-echo chunk: once, ever (a from-zero re-dispatch
+            # replays it; the client already holds it)
+            if self.echo_relayed:
+                return False
+            self.echo_relayed = True
+            return True
+        if idx <= self.n_tokens:
+            # a tail flush (same index, no new tokens) is text the
+            # stop-string detector held back past the last counted
+            # token — never yet relayed, so it passes; anything
+            # carrying token ids at a held index is a splice replay
+            return idx == self.n_tokens and not toks
+        self.n_tokens = idx
+        self.tokens.extend(toks)
+        return True
+
+
+class _StreamDied(Exception):
+    """The upstream died AFTER the client saw stream bytes — not
+    retryable as a fresh dispatch (the transcript is half-delivered);
+    resumable as a spliced continuation when the chunks carried the
+    replica's ``dllama`` index stamps. Carries the request's
+    :class:`_StreamState` ledger and the underlying failure."""
+
+    def __init__(self, st: _StreamState, exc: Exception):
+        super().__init__(f"{type(exc).__name__}: {exc}")
+        self.st = st
+        self.exc = exc
+
+
 def make_router_handler(fleet: FleetRouter):
     from .api import backpressure_headers
 
@@ -838,13 +986,17 @@ def make_router_handler(fleet: FleetRouter):
 
         def _relay_response(self, rep: Replica, conn, resp, *,
                             rid: str = "", hop: int = 0,
-                            t0_ns: int = 0) -> int:
+                            t0_ns: int = 0,
+                            st: _StreamState | None = None) -> int:
             """Stream the upstream response to the client. Buffered when
             a Content-Length is known (an upstream death mid-body stays
             retryable because nothing reached the client); incremental
-            for SSE/EOF-delimited bodies, with the explicit terminal 502
-            event on a mid-stream death. ``rid``/``hop``/``t0_ns`` feed
-            the trace spans and the router-measured TTFT/ITL."""
+            for SSE/EOF-delimited bodies, event-parsed through the
+            request's :class:`_StreamState` ledger so a mid-stream death
+            raises :class:`_StreamDied` for the caller to either splice
+            a continuation (``_resume_stream``) or send the explicit
+            terminal 502 event. ``rid``/``hop``/``t0_ns`` feed the trace
+            spans and the router-measured TTFT/ITL."""
             try:
                 length = resp.getheader("Content-Length")
                 if length is not None:
@@ -865,7 +1017,9 @@ def make_router_handler(fleet: FleetRouter):
                     return resp.status
                 # EOF-delimited (the api server's SSE streams): relay as
                 # data arrives; from the first byte on, failures are no
-                # longer retryable — a death becomes the terminal 502.
+                # longer retryable as a fresh dispatch — a death raises
+                # _StreamDied and the caller splices a continuation (a
+                # stamped stream) or sends the terminal 502 event.
                 # A dying replica's socket closes with a clean FIN, so
                 # EOF alone can't prove completion: the api server's SSE
                 # contract is that a healthy stream ends with the
@@ -873,22 +1027,23 @@ def make_router_handler(fleet: FleetRouter):
                 # mid-stream death.
                 is_sse = (resp.getheader("Content-Type") or "").startswith(
                     "text/event-stream")
-                self._relay_headers(resp, resp.status, force_close=True)
-                tail = b""
+                if st is None:
+                    st = _StreamState()
+                if not st.headers_sent:
+                    self._relay_headers(resp, resp.status,
+                                        force_close=True)
+                    st.headers_sent = True
+                buf = b""
                 t_prev: int | None = None
                 while True:
                     try:
                         chunk = resp.read1(65536)
                     except (OSError, http.client.HTTPException) as e:
-                        self._stream_abort(rep, e)
-                        self._end_stream(rid, rep, hop, 502)
-                        return 502
+                        raise _StreamDied(st, e) from e
                     if not chunk:
-                        if is_sse and b"data: [DONE]" not in tail:
-                            self._stream_abort(rep, ConnectionError(
+                        if is_sse and not st.done:
+                            raise _StreamDied(st, ConnectionError(
                                 "EOF before the [DONE] sentinel"))
-                            self._end_stream(rid, rep, hop, 502)
-                            return 502
                         self._end_stream(rid, rep, hop, resp.status)
                         return resp.status
                     now = telemetry.now_ns()
@@ -899,11 +1054,54 @@ def make_router_handler(fleet: FleetRouter):
                         # (one SSE event per chunk in practice)
                         fleet.slo.observe_itl((now - t_prev) / 1e6)
                     t_prev = now
-                    self.wfile.write(chunk)
-                    self.wfile.flush()
-                    tail = (tail + chunk)[-64:]
+                    if not is_sse:
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                        continue
+                    # event-parsed relay: the exactly-once filter needs
+                    # whole `data:` events (split on the SSE separator),
+                    # and in practice each chunk IS one event
+                    buf += chunk
+                    out = b""
+                    while b"\n\n" in buf:
+                        evt, buf = buf.split(b"\n\n", 1)
+                        if st.upstream_error:
+                            # the held-back terminal error chunk ends
+                            # this upstream: its trailing [DONE] belongs
+                            # to the dead stream, never to the client
+                            break
+                        if st.admit(evt):
+                            out += evt + b"\n\n"
+                    if out:
+                        if st.resume_t is not None:
+                            self._note_resume_spliced(rid, rep, hop,
+                                                      st, now)
+                        self.wfile.write(out)
+                        self.wfile.flush()
+                    if st.upstream_error:
+                        raise _StreamDied(st, ConnectionError(
+                            "upstream terminal error chunk"))
             finally:
                 conn.close()
+
+        def _note_resume_spliced(self, rid: str, rep: Replica, hop: int,
+                                 st: _StreamState, now_ns: int) -> None:
+            """First continued event of a spliced continuation reached
+            the client: the resume succeeded — record the detect→
+            first-token latency (dllama_router_stream_resume_ms), the
+            outcome counter, and the ``rt_resume`` span with its phase
+            attribution (re-dispatch decision, upstream connect, first
+            continued token) in the span's extra fields."""
+            t_detect, t_redispatch, t_connect, n_resume = st.resume_t
+            st.resume_t = None
+            fleet.c_resumes.inc(outcome="resumed")
+            fleet.h_resume.record((now_ns - t_detect) / 1e6)
+            fleet.spans.emit_span(
+                rid, "rt_resume", t_detect, now_ns,
+                replica=rep.name, hop=hop, resume_from=n_resume,
+                detect_ms=round((t_redispatch - t_detect) / 1e6, 3),
+                redispatch_ms=round((t_connect - t_redispatch) / 1e6, 3),
+                first_token_ms=round((now_ns - t_connect) / 1e6, 3))
 
         def _stream_abort(self, rep: Replica, exc: Exception) -> None:
             """Mid-stream upstream death: an explicit terminal SSE event
@@ -922,6 +1120,125 @@ def make_router_handler(fleet: FleetRouter):
             except OSError:
                 pass  # the peer is gone too; nothing left to tell it
             self.close_connection = True
+
+        def _resume_stream(self, body: dict, rid: str, rep: Replica,
+                           hop: int, sd: _StreamDied,
+                           t0_ns: int) -> int:
+            """Mid-stream failover: the serving replica died with the
+            transcript half-delivered — re-dispatch the request to a
+            healthy replica as a spliced continuation (``resume_from`` +
+            the full token history from the relay ledger) and keep
+            relaying from the splice, exactly-once (``_StreamState``
+            drops any replayed index). Bounded by ``--max-stream-
+            resumes`` spliced continuations and the remaining request
+            deadline; past either bound — or for a stream whose chunks
+            carried no index stamps (single-sequence replicas) — the
+            legacy contract stands: the explicit terminal 502 event.
+            Returns the final relayed status."""
+            st, exc = sd.st, sd.exc
+            dead = {rep}
+            while True:
+                t_detect = telemetry.now_ns()
+                if not st.resumable():
+                    # unstamped stream or a ledger hole: not spliceable
+                    self._stream_abort(rep, exc)
+                    self._end_stream(rid, rep, hop, 502)
+                    return 502
+                outcome = None
+                if st.resumes >= fleet.max_stream_resumes:
+                    outcome = "exhausted"
+                # the deadline a continuation must fit inside: the
+                # client's own body "timeout" when it set one, else the
+                # router-level --request-timeout default (0 = unbounded)
+                limit_s = 0.0
+                t = body.get("timeout")
+                if isinstance(t, (int, float)) \
+                        and not isinstance(t, bool) and t > 0:
+                    limit_s = float(t)
+                elif fleet.request_timeout_s > 0:
+                    limit_s = fleet.request_timeout_s
+                remaining_s = (limit_s - (t_detect - t0_ns) / 1e9
+                               if limit_s else 0.0)
+                if outcome is None and limit_s and remaining_s <= 0.05:
+                    outcome = "no_budget"
+                rep2 = None
+                if outcome is None:
+                    st.resumes += 1
+                    rep2 = fleet.pick(affinity_key(body), exclude=dead)
+                    if rep2 is None:
+                        outcome = "failed"
+                if outcome is not None:
+                    fleet.c_resumes.inc(outcome=outcome)
+                    self._stream_abort(rep, exc)
+                    self._end_stream(rid, rep, hop, 502)
+                    return 502
+                hop += 1
+                rbody = dict(body)
+                rbody.pop("resume_from", None)
+                rbody.pop("resume_tokens", None)
+                if st.n_tokens:
+                    rbody["resume_from"] = st.n_tokens
+                    rbody["resume_tokens"] = list(st.tokens)
+                if limit_s:
+                    rbody["timeout"] = round(remaining_s, 3)
+                extra = {FLEET_RID_HEADER: rid,
+                         FLEET_HOP_HEADER: str(hop),
+                         RESUME_FROM_HEADER: str(st.n_tokens)}
+                # prefer pulling the prefix (prompt + history) over the
+                # KV wire: any advertising peer serves — including the
+                # dying donor while it still answers, or a prefill-role
+                # replica — with the replica's recompute fallback
+                # covering every refusal
+                donor = fleet.kv_donor(affinity_key(body), rep2)
+                if donor is not None:
+                    extra[KV_PEER_HEADER] = donor.name
+                    t_don = telemetry.now_ns()
+                    fleet.spans.emit_span(rid, "rt_kv_donor", t_don,
+                                          t_don, replica=rep2.name,
+                                          donor=donor.name)
+                t_redispatch = telemetry.now_ns()
+                rep2.begin_request()
+                try:
+                    try:
+                        # the resume chaos sever point: an armed
+                        # `resume` failpoint kills the re-dispatch
+                        # exactly where a dying resume target would
+                        failpoints.fire("resume")
+                        conn, resp = self._open_upstream(
+                            rep2, "POST", "/v1/chat/completions",
+                            json.dumps(rbody).encode("utf-8"),
+                            extra_headers=extra)
+                    except (OSError, failpoints.FailpointError,
+                            _UpstreamDied) as e:
+                        if isinstance(e, _UpstreamDied) \
+                                and e.code in ("draining", "queue_full"):
+                            rep2.note_unready(e.code)
+                        else:
+                            rep2.note_failure()
+                        fleet.c_resumes.inc(outcome="failed")
+                        dead.add(rep2)
+                        rep, exc = rep2, e
+                        continue  # another attempt if the budget allows
+                    rep2.note_success()
+                    fleet.c_dispatch.inc(replica=rep2.name)
+                    st.upstream_error = False
+                    st.resume_t = (t_detect, t_redispatch,
+                                   telemetry.now_ns(), st.n_tokens)
+                    try:
+                        return self._relay_response(
+                            rep2, conn, resp, rid=rid, hop=hop,
+                            t0_ns=t0_ns, st=st)
+                    except _StreamDied as sd2:
+                        if st.resume_t is not None:
+                            # died before one continued event: the
+                            # splice never happened — attempt failed
+                            st.resume_t = None
+                            fleet.c_resumes.inc(outcome="failed")
+                        dead.add(rep2)
+                        rep, exc = rep2, sd2.exc
+                        continue
+                finally:
+                    rep2.end_request()
 
         def _proxy_buffered(self, method: str, path: str,
                             body: bytes | None) -> None:
@@ -1240,6 +1557,17 @@ def make_router_handler(fleet: FleetRouter):
                         self._note_eject(rid, rep, attempt)
                         last = e
                         continue
+                    except _StreamDied as sd:
+                        # the stream died with bytes already relayed: a
+                        # fresh retry would duplicate the transcript —
+                        # splice a continuation instead (or send the
+                        # explicit terminal 502 past the resume budget)
+                        try:
+                            status = self._resume_stream(
+                                body, rid, rep, attempt, sd, t0_ns)
+                        except (BrokenPipeError, ConnectionResetError):
+                            status = "client_disconnect"
+                            self.close_connection = True
                     except (BrokenPipeError, ConnectionResetError):
                         status = "client_disconnect"
                         self.close_connection = True
@@ -1309,6 +1637,8 @@ def run_router(args) -> int:
         replicas,
         probe_interval_s=getattr(args, "probe_interval", 2.0) or 2.0,
         max_inflight=getattr(args, "max_queue", 0) or 0,
+        max_stream_resumes=getattr(args, "max_stream_resumes", 1),
+        request_timeout_s=getattr(args, "request_timeout", 0.0) or 0.0,
         slo_objectives=slo_objectives)
     if slo_objectives:
         print("🎯 SLO observatory: "
@@ -1323,7 +1653,10 @@ def run_router(args) -> int:
           f"({', '.join(r.name for r in fleet.replicas)}), probe every "
           f"~{fleet.probe_interval_s:g}s"
           + (f", shed beyond {fleet.max_inflight} in flight"
-             if fleet.max_inflight else ""))
+             if fleet.max_inflight else "")
+          + (f", streams survive ≤{fleet.max_stream_resumes} replica "
+             f"death(s) mid-flight"
+             if fleet.max_stream_resumes else ""))
 
     def _on_sigterm(signum, frame):
         print("🛑 SIGTERM: router draining (readyz → 503, in-flight "

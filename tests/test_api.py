@@ -355,3 +355,79 @@ def test_batched_defaults_to_engine_sampler_settings(batched_server):
     with _post(url, dict(body, temperature=0)) as r:
         b = json.loads(r.read())["choices"][0]["message"]["content"]
     assert a == b
+
+
+# -- durable-stream satellites: resume validation, jitter, advertisement --
+
+
+def test_resume_field_validation():
+    """The router-only resume fields die in _validate_body as 400-shaped
+    ValueErrors: resume_from a positive true int, resume_tokens exactly
+    resume_from non-negative ids, never one without the other."""
+    from dllama_tpu.serve.api import _validate_body
+
+    ok = {"messages": [{"role": "user", "content": "hi"}]}
+    _validate_body(dict(ok, resume_from=2, resume_tokens=[5, 9]))
+    bad = [
+        dict(ok, resume_from=0, resume_tokens=[]),        # zero
+        dict(ok, resume_from=-1, resume_tokens=[1]),      # negative
+        dict(ok, resume_from=True, resume_tokens=[1]),    # bool-as-int
+        dict(ok, resume_from="2", resume_tokens=[1, 2]),  # non-int
+        dict(ok, resume_from=2),                          # from w/o tokens
+        dict(ok, resume_tokens=[1, 2]),                   # tokens w/o from
+        dict(ok, resume_from=2, resume_tokens=[1]),       # length mismatch
+        dict(ok, resume_from=1, resume_tokens="x"),       # non-list
+        dict(ok, resume_from=2, resume_tokens=[1, -2]),   # negative id
+        dict(ok, resume_from=2, resume_tokens=[1, True]), # bool id
+    ]
+    for body in bad:
+        with pytest.raises(ValueError):
+            _validate_body(body)
+
+
+def test_backpressure_retry_after_jitter_bounds():
+    """Retry-After carries bounded random jitter (base..base+jitter) so
+    a synchronized 429/503 wave doesn't re-arrive as one — and the
+    jitter actually varies rather than collapsing to the base."""
+    from dllama_tpu.serve.api import (RETRY_AFTER_JITTER_S, RETRY_AFTER_S,
+                                      backpressure_headers)
+
+    for status in (429, 503):
+        lo = RETRY_AFTER_S[status]
+        hi = lo + RETRY_AFTER_JITTER_S[status]
+        got = {int(backpressure_headers(status)["Retry-After"])
+               for _ in range(200)}
+        assert min(got) >= lo and max(got) <= hi
+        assert len(got) > 1, f"Retry-After jitter never varied for {status}"
+
+
+def test_kv_prefix_advertisement_ttl_and_lru_bound():
+    """The prefix-residency advertisement is a TTL'd bounded LRU:
+    re-notes refresh, drops evict early, expired stamps never reach a
+    probe, and the cap sheds the oldest entry first."""
+    from collections import OrderedDict
+
+    from dllama_tpu.serve.api import BatchedApiState
+
+    st = BatchedApiState.__new__(BatchedApiState)  # advertisement only
+    st._kv_prefixes = OrderedDict()
+    st._kv_lock = threading.Lock()
+
+    st.note_kv_prefix("sid:a")
+    st.note_kv_prefix("sid:b")
+    st.note_kv_prefix("sid:a")  # re-note refreshes and moves to front
+    assert st.kv_prefix_list() == ["sid:a", "sid:b"]
+    st.drop_kv_prefix("sid:b")
+    st.drop_kv_prefix(None)  # no-op, never raises
+    assert st.kv_prefix_list() == ["sid:a"]
+
+    with st._kv_lock:  # age the stamp past the TTL window
+        st._kv_prefixes["sid:a"] -= BatchedApiState.KV_PREFIX_TTL_S + 1
+    assert st.kv_prefix_list() == []
+
+    for i in range(BatchedApiState.KV_PREFIX_MAX + 5):
+        st.note_kv_prefix(f"sid:{i}")
+    lst = st.kv_prefix_list()
+    assert len(lst) == BatchedApiState.KV_PREFIX_MAX
+    assert lst[0] == f"sid:{BatchedApiState.KV_PREFIX_MAX + 4}"
+    assert "sid:0" not in lst

@@ -1101,3 +1101,80 @@ def test_draft_failpoint_degrades_slot_to_plain_decode(tmp_path):
         fp.registry().clear()
         sched.close()
         eng.close()
+
+
+# -- durable streams: resume-target death chaos -------------------------------
+
+
+def test_resume_failpoint_kills_target_terminal_502_bystanders_intact():
+    """The `resume` failpoint severs the mid-stream failover re-dispatch
+    exactly where a dying resume target would: the attempt counts
+    "failed", the --max-stream-resumes budget is found spent on the next
+    pass ("exhausted"), and the victim stream ends with ONE explicit
+    terminal 502 event + [DONE] — while a bystander stream riding the
+    same fleet through the whole chaos window stays token-intact."""
+    from test_router import (StubReplica, _body, _post, _resume_totals,
+                             _sse_events, _stamp_indices, _wait, _up,
+                             make_router)
+
+    stubs = [StubReplica(f"r{i}") for i in range(3)]
+    for s in stubs:
+        s.behavior["stamp"] = True
+        s.behavior["stream_chunks"] = ["c1 ", "c2 ", "c3 ", "c4 ", "c5"]
+    stubs[0].behavior["die_after_chunks"] = 2
+    # bystander chunks slow enough to span the victim's whole death +
+    # failed resume + terminal abort
+    stubs[2].behavior["chunk_delay_s"] = 0.15
+    for s in (stubs[1], stubs[2]):
+        s.behavior["queue_depth"] = 50  # first dispatch lands on r0
+    for s in stubs:
+        s.start()
+    url, fleet, close = make_router(stubs)
+    http = tm.registry().counter(tm.HTTP_REQUESTS)
+    bystander: dict = {}
+
+    def ride_along():
+        with _post(url, _body("bystander", stream=True,
+                              session_id="bystander-sess"),
+                   timeout=60) as r:
+            bystander["raw"] = r.read()
+
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas)
+              and fleet.replicas[1].load_score() >= 50,
+              what="probes: up + load")
+        # pin the bystander to r2 (sticky affinity), then start it
+        with fleet._lock:
+            fleet._affinity["sid:bystander-sess"] = fleet.replicas[2]
+        t = threading.Thread(target=ride_along)
+        t.start()
+        t0 = _resume_totals()
+        c0 = http.total(route="/v1/chat/completions", status="502")
+        fired0 = fp.registry().fired("resume")
+        fp.arm("resume", "conn_reset", times=1)
+        with _post(url, _body("victim", stream=True,
+                              session_id="victim-sess"), timeout=60) as r:
+            raw = r.read()
+        t.join(timeout=60)
+        assert fp.registry().fired("resume") == fired0 + 1
+        # victim: delivered prefix intact, then exactly one terminal 502
+        events = _sse_events(raw)
+        assert _stamp_indices(events) == [0, 1, 2]
+        assert raw.count(b'"upstream_error"') == 1
+        assert raw.rstrip().endswith(b"data: [DONE]")
+        d = {k: v - t0[k] for k, v in _resume_totals().items()}
+        assert d == {"resumed": 0, "exhausted": 1, "no_budget": 0,
+                     "failed": 1}
+        assert http.total(route="/v1/chat/completions",
+                          status="502") == c0 + 1
+        # bystander: full gapless transcript, normal finish
+        bevents = _sse_events(bystander["raw"])
+        assert _stamp_indices(bevents) == [0, 1, 2, 3, 4, 5]
+        assert b'"upstream_error"' not in bystander["raw"]
+        assert bevents[-1] == "[DONE]"
+    finally:
+        fp.registry().clear()
+        close()
+        for s in stubs:
+            if s.httpd is not None:
+                s.kill()

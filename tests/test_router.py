@@ -71,8 +71,23 @@ class StubReplica:
             "nonstream_delay_s": 0.0,
             "role": None,               # /readyz disaggregation tag
             "kv_prefixes": [],          # /readyz residency advertisement
+            # stamped streaming (serve/api.py batched mode): chunks carry
+            # the dllama {"index", "tokens"} resume meta, and a body with
+            # resume_from is honored — continuation starts AT that index
+            # (replaying it once; the router must dedup), exactly like a
+            # real replica racing the splice
+            "stamp": False,
+            # emit a terminal finish_reason "error" chunk + [DONE] after
+            # N token chunks — what a killed api-server's fail-all path
+            # actually writes (ThreadingHTTPServer handlers survive
+            # shutdown; the scheduler fails the slot, the socket FINs
+            # cleanly)
+            "error_after_chunks": None,
         }
         self.n_completions = 0
+        # resume capture: one dict per STREAM completion attempt with the
+        # X-Dllama-Resume-From header and the request body as received
+        self.seen_resumes: list = []
         # KV migration capture: the X-Dllama-KV-Peer value (or None)
         # seen on each completion attempt, in arrival order
         self.seen_kv_peers: list = []
@@ -212,16 +227,63 @@ class StubReplica:
                     self.send_header("Cache-Control", "no-cache")
                     self.send_header("Connection", "close")
                     self.end_headers()
-                    for i, piece in enumerate(b["stream_chunks"]):
+
+                    def send(piece, finish=None, meta=None):
                         chunk = {"object": "chat.completion.chunk",
                                  "replica": stub.name,
                                  "choices": [{"index": 0,
-                                              "delta": {"content": piece},
-                                              "finish_reason": None}]}
+                                              "delta": ({"content": piece}
+                                                        if piece else {}),
+                                              "finish_reason": finish}]}
+                        if meta is not None:
+                            chunk["dllama"] = meta
                         self.wfile.write(b"data: "
                                          + json.dumps(chunk).encode()
                                          + b"\n\n")
                         self.wfile.flush()
+
+                    if b["stamp"]:
+                        stub.seen_resumes.append({
+                            "header": self.headers.get(
+                                "X-Dllama-Resume-From"),
+                            "body": body})
+                        resume_from = int(body.get("resume_from") or 0)
+                        pieces = list(b["stream_chunks"])
+                        if resume_from == 0:
+                            # the prompt-echo chunk, index 0
+                            send("", meta={"index": 0, "tokens": []})
+                        n_emitted = 0
+                        # a resume replays its splice index once — the
+                        # router's exactly-once filter must drop it
+                        for i in range(max(1, resume_from),
+                                       len(pieces) + 1):
+                            send(pieces[i - 1],
+                                 meta={"index": i, "tokens": [100 + i]})
+                            n_emitted += 1
+                            if b["chunk_delay_s"]:
+                                time.sleep(b["chunk_delay_s"])
+                            if b["die_after_chunks"] is not None \
+                                    and n_emitted >= b["die_after_chunks"]:
+                                self.close_connection = True
+                                stub.note_span(local, t0_ns, frid, fhop)
+                                return
+                            if b["error_after_chunks"] is not None \
+                                    and n_emitted >= \
+                                    b["error_after_chunks"]:
+                                send("", finish="error")
+                                self.wfile.write(b"data: [DONE]\n\n")
+                                self.close_connection = True
+                                stub.note_span(local, t0_ns, frid, fhop)
+                                return
+                        # the real final chunk is unstamped (api.py
+                        # writes it outside the emit path)
+                        send("", finish="length")
+                        self.wfile.write(b"data: [DONE]\n\n")
+                        self.close_connection = True
+                        stub.note_span(local, t0_ns, frid, fhop)
+                        return
+                    for i, piece in enumerate(b["stream_chunks"]):
+                        send(piece)
                         if b["chunk_delay_s"]:
                             time.sleep(b["chunk_delay_s"])
                         if b["die_after_chunks"] is not None \
@@ -824,6 +886,233 @@ def test_midstream_death_gets_terminal_502_event_never_a_hang():
         a.kill()
 
 
+# -- durable streams: mid-stream failover ------------------------------------
+
+
+def _sse_events(raw: bytes) -> list:
+    """Parsed data events of an SSE transcript, [DONE] as the string."""
+    out = []
+    for evt in raw.split(b"\n\n"):
+        evt = evt.strip()
+        if not evt.startswith(b"data:"):
+            continue
+        data = evt[5:].strip()
+        out.append("[DONE]" if data == b"[DONE]" else json.loads(data))
+    return out
+
+
+def _stamp_indices(events) -> list:
+    return [e["dllama"]["index"] for e in events
+            if isinstance(e, dict) and "dllama" in e]
+
+
+def _resume_totals():
+    c = tm.registry().counter(tm.ROUTER_STREAM_RESUMES)
+    return {o: c.total(outcome=o)
+            for o in ("resumed", "exhausted", "no_budget", "failed")}
+
+
+def test_midstream_death_splices_resume_exactly_once():
+    """The tentpole contract at the router tier: a stamped stream whose
+    replica dies mid-flight is re-dispatched to a healthy replica as a
+    spliced continuation (resume_from + full token history + the
+    X-Dllama-Resume-From header), the replayed splice index is dropped,
+    and the client sees one gapless duplicate-free transcript ending in
+    a normal finish — with the resume on the outcome counter, the
+    latency histogram, and an rt_resume span, and the dying replica
+    (still advertising the prefix) named as KV donor."""
+    a, b = StubReplica("a"), StubReplica("b")
+    for s in (a, b):
+        s.behavior["stamp"] = True
+        s.behavior["stream_chunks"] = ["t1 ", "t2 ", "t3 ", "t4 ", "t5"]
+    a.behavior["die_after_chunks"] = 2
+    a.behavior["kv_prefixes"] = ["sid:resume-sess"]
+    b.behavior["queue_depth"] = 50  # first dispatch lands on a
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    h_resume = tm.registry().histogram(tm.ROUTER_STREAM_RESUME_MS)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas)
+              and fleet.replicas[1].load_score() >= 50
+              and any(r.holds_prefix("sid:resume-sess")
+                      for r in fleet.replicas),
+              what="probes: up + load + residency")
+        t0, n0 = _resume_totals(), h_resume.count()
+        with _post(url, _body("durable", stream=True,
+                              session_id="resume-sess", timeout=30),
+                   timeout=30) as r:
+            raw = r.read()
+        events = _sse_events(raw)
+        # gapless, duplicate-free: echo once, every index exactly once
+        assert _stamp_indices(events) == [0, 1, 2, 3, 4, 5]
+        assert b'"upstream_error"' not in raw
+        finals = [e for e in events if isinstance(e, dict)
+                  and e.get("choices")
+                  and e["choices"][0].get("finish_reason")]
+        assert [e["choices"][0]["finish_reason"] for e in finals] \
+            == ["length"]
+        assert events[-1] == "[DONE]"
+        # both replicas contributed — the splice really happened
+        assert {e["replica"] for e in events if isinstance(e, dict)} \
+            == {"a", "b"}
+        d = {k: v - t0[k] for k, v in _resume_totals().items()}
+        assert d == {"resumed": 1, "exhausted": 0, "no_budget": 0,
+                     "failed": 0}
+        assert h_resume.count() == n0 + 1
+        # the resume dispatch b saw: splice position 2, the 2 relayed
+        # ids as history, the remaining deadline re-budgeted
+        res = b.seen_resumes[-1]
+        assert res["header"] == "2"
+        assert res["body"]["resume_from"] == 2
+        assert res["body"]["resume_tokens"] == [101, 102]
+        assert 0 < res["body"]["timeout"] <= 30
+        # the dying donor still serves the prefix over the KV wire
+        assert b.seen_kv_peers[-1] == f"127.0.0.1:{a.port}"
+        spans = [s for s in fleet.fleet_snapshot()["spans"]
+                 if s["phase"] == "rt_resume"]
+        assert spans and spans[-1]["resume_from"] == 2
+        assert spans[-1]["replica"] == f"127.0.0.1:{b.port}"
+        for k in ("detect_ms", "redispatch_ms", "first_token_ms"):
+            assert spans[-1][k] >= 0
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_upstream_error_chunk_is_resumed_not_relayed():
+    """The third death signal: a killed api-server's handler threads
+    outlive the process shutdown and write a terminal finish_reason
+    "error" chunk over a cleanly-FINed socket. On a stamped stream the
+    router holds that chunk back, treats it as mid-stream death, and
+    splices a continuation — the client never sees the error."""
+    a, b = StubReplica("a"), StubReplica("b")
+    for s in (a, b):
+        s.behavior["stamp"] = True
+        s.behavior["stream_chunks"] = ["x1 ", "x2 ", "x3 ", "x4"]
+    a.behavior["error_after_chunks"] = 2
+    b.behavior["queue_depth"] = 50
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas)
+              and fleet.replicas[1].load_score() >= 50,
+              what="probes: up + load")
+        t0 = _resume_totals()
+        with _post(url, _body("heal the error", stream=True),
+                   timeout=30) as r:
+            raw = r.read()
+        events = _sse_events(raw)
+        assert _stamp_indices(events) == [0, 1, 2, 3, 4]
+        reasons = [e["choices"][0].get("finish_reason") for e in events
+                   if isinstance(e, dict) and e.get("choices")]
+        assert "error" not in reasons and reasons[-1] == "length"
+        assert b'"upstream_error"' not in raw
+        assert _resume_totals()["resumed"] == t0["resumed"] + 1
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_resume_budget_exhausted_ends_with_terminal_502():
+    """Per-attempt + terminal accounting: the resume target dies too —
+    its splice counts \"resumed\" (a continued token reached the
+    client), the next death finds the --max-stream-resumes budget spent
+    (\"exhausted\") and the stream ends with the explicit terminal 502
+    event + [DONE], everything delivered so far intact."""
+    a, b = StubReplica("a"), StubReplica("b")
+    for s in (a, b):
+        s.behavior["stamp"] = True
+        s.behavior["stream_chunks"] = ["y1 ", "y2 ", "y3 ", "y4 ", "y5"]
+        s.behavior["die_after_chunks"] = 2
+    b.behavior["queue_depth"] = 50
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    http = tm.registry().counter(tm.HTTP_REQUESTS)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas)
+              and fleet.replicas[1].load_score() >= 50,
+              what="probes: up + load")
+        t0 = _resume_totals()
+        c0 = http.total(route="/v1/chat/completions", status="502")
+        with _post(url, _body("doubly doomed", stream=True),
+                   timeout=30) as r:
+            raw = r.read()
+        events = _sse_events(raw)
+        # a delivered 1,2; b replayed 2 (dropped) and delivered 3, then
+        # died — the transcript stays gapless and duplicate-free
+        assert _stamp_indices(events) == [0, 1, 2, 3]
+        assert b'"upstream_error"' in raw and b'"code": 502' in raw
+        assert events[-1] == "[DONE]"
+        d = {k: v - t0[k] for k, v in _resume_totals().items()}
+        assert d == {"resumed": 1, "exhausted": 1, "no_budget": 0,
+                     "failed": 0}
+        assert http.total(route="/v1/chat/completions",
+                          status="502") == c0 + 1
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_max_stream_resumes_zero_keeps_legacy_contract():
+    """--max-stream-resumes 0 is the pre-failover behavior: the death is
+    classified (\"exhausted\") and the stream ends with the terminal 502
+    event immediately — no re-dispatch ever leaves the router."""
+    a, b = StubReplica("a"), StubReplica("b")
+    for s in (a, b):
+        s.behavior["stamp"] = True
+    a.behavior["die_after_chunks"] = 1
+    b.behavior["queue_depth"] = 50
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b], max_stream_resumes=0)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas)
+              and fleet.replicas[1].load_score() >= 50,
+              what="probes: up + load")
+        t0 = _resume_totals()
+        n_b0 = b.n_completions
+        with _post(url, _body("no budget at all", stream=True),
+                   timeout=30) as r:
+            raw = r.read()
+        assert b'"upstream_error"' in raw
+        assert raw.rstrip().endswith(b"data: [DONE]")
+        d = {k: v - t0[k] for k, v in _resume_totals().items()}
+        assert d == {"resumed": 0, "exhausted": 1, "no_budget": 0,
+                     "failed": 0}
+        assert b.n_completions == n_b0  # nothing was re-dispatched
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
+def test_resume_outside_request_timeout_is_no_budget():
+    """A spliced continuation must fit inside the remaining
+    --request-timeout budget: with the deadline already burned at
+    detection time the outcome is \"no_budget\" and the stream ends
+    with the terminal 502, not a hopeless re-dispatch."""
+    a, b = StubReplica("a"), StubReplica("b")
+    for s in (a, b):
+        s.behavior["stamp"] = True
+    a.behavior["die_after_chunks"] = 1
+    b.behavior["queue_depth"] = 50
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b], request_timeout_s=0.04)
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas)
+              and fleet.replicas[1].load_score() >= 50,
+              what="probes: up + load")
+        t0 = _resume_totals()
+        with _post(url, _body("late already", stream=True),
+                   timeout=30) as r:
+            raw = r.read()
+        assert b'"upstream_error"' in raw
+        d = {k: v - t0[k] for k, v in _resume_totals().items()}
+        assert d == {"resumed": 0, "exhausted": 0, "no_budget": 1,
+                     "failed": 0}
+    finally:
+        close()
+        a.kill(), b.kill()
+
+
 # -- the ISSUE-12 chaos acceptance test --------------------------------------
 
 
@@ -1193,6 +1482,11 @@ def test_shed_feeds_slo_outcome():
         _wait(lambda: fleet.readiness()[0], what="replica up")
         with _post(url, _body("admitted one")) as r:
             r.read()
+        # the admitted outcome is fed after the response is written, so
+        # the client can get here first — wait for it to land
+        _wait(lambda: fleet.slo.evaluate()
+              ["objectives"]["shed_rate"]["n"] >= 1,
+              what="admitted outcome observed")
         a.behavior.update(ready=False, ready_code="queue_full")
         _wait(lambda: not fleet.readiness()[0], what="fleet saturated")
         for _ in range(3):

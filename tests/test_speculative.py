@@ -264,9 +264,10 @@ def test_speculative_under_sp_matches_plain(model_files, tp):
 
 def test_spec_decide_zero_draft_is_plain_sampled_step():
     """A zero-length draft degrades to the plain sampled decode step
-    BIT-exactly: the bonus token runs ops.sampling.sampled_token on the
-    position-0 logits with the final coin — the same function, the same
-    coin the non-speculative step would consume."""
+    BIT-exactly: position 0's sample runs ops.sampling.sampled_token on
+    the position-0 logits with position 0's coin (``acoins[:, 0]`` — the
+    next draw of the request's sequential coin stream, the same draw
+    the non-speculative step would consume)."""
     from dllama_tpu.ops.sampling import sampled_token
     from dllama_tpu.runtime.speculative import spec_decide
 
@@ -276,12 +277,12 @@ def test_spec_decide_zero_draft_is_plain_sampled_step():
     tokens = jnp.asarray(rng.integers(0, V, (B, K + 1)), jnp.int32)
     temps = jnp.asarray([0.6, 0.9, 1.3, 0.8], jnp.float32)
     topps = jnp.asarray([0.9, 0.5, 1.0, 0.95], jnp.float32)  # incl. topp=1
-    fcoins = jnp.asarray(rng.random(B), jnp.float32)
+    acoins = jnp.asarray(rng.random((B, K)), jnp.float32)
     n_acc, out = jax.jit(spec_decide)(
         logits, tokens, jnp.zeros(B, jnp.int32), temps, topps,
-        jnp.asarray(rng.random((B, K)), jnp.float32), fcoins)
+        acoins, jnp.asarray(rng.random(B), jnp.float32))
     np.testing.assert_array_equal(np.asarray(n_acc), 0)
-    want = sampled_token(logits[:, 0], temps, topps, fcoins)
+    want = sampled_token(logits[:, 0], temps, topps, acoins[:, 0])
     np.testing.assert_array_equal(np.asarray(out)[:, 0], np.asarray(want))
 
 
@@ -311,10 +312,11 @@ def test_spec_decide_greedy_rows_match_exact_prefix_rule():
 def test_spec_decide_distribution_preserved_tv_bound():
     """The satellite's statistical acceptance: the emitted next-token
     distribution of spec-sampled decode equals non-spec sampling within
-    a total-variation bound on a toy model (fixed seeds). Point-mass
-    proposal ⇒ accept w.p. p_target(draft), residual-resample on
-    rejection — the theorem says the marginal IS p_target; the empirical
-    TV distance over N draws concentrates within ~sqrt(V/N)."""
+    a total-variation bound on a toy model (fixed seeds). Exact-match
+    verify emits the plain-decode sample at every position, so the
+    marginal IS p_target by construction (and the accept rate equals
+    p_target(draft)); the empirical TV distance over N draws
+    concentrates within ~sqrt(V/N)."""
     from dllama_tpu.ops.sampling import sampled_token
     from dllama_tpu.runtime.speculative import spec_decide
 
@@ -353,13 +355,14 @@ def test_spec_decide_distribution_preserved_tv_bound():
 
 
 def test_spec_coins_consumed_rule():
-    """The host commit rule: final coin + one accept coin per test
-    (n_acc tests on full acceptance, n_acc+1 when rejected)."""
+    """The host commit rule: one coin per EMITTED token (n_acc accepted
+    drafts + the position-n_acc sample), independent of draft length —
+    the stream-position invariant resume fast-forwards on."""
     from dllama_tpu.runtime.speculative import spec_coins_consumed
 
     assert spec_coins_consumed(0, 0) == 1   # no draft: plain decode's coin
-    assert spec_coins_consumed(0, 4) == 2   # first test rejected
-    assert spec_coins_consumed(2, 4) == 4   # 3 tests + final
+    assert spec_coins_consumed(0, 4) == 1   # first draft wrong: 1 emitted
+    assert spec_coins_consumed(2, 4) == 3   # 2 accepted + the sample
     assert spec_coins_consumed(4, 4) == 5   # all accepted + bonus
 
 

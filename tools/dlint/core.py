@@ -224,6 +224,7 @@ def load_rule_modules() -> None:
         eval_names,
         exception_hygiene,
         failpoint_sites,
+        failure_taxonomy,
         metrics_names,
         pallas_gate,
         route_labels,
