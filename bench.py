@@ -1335,9 +1335,20 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
     lands mid-stream — a pre-first-byte death is an ordinary retry hop
     and would leave the resume path unmeasured.
 
+    After the churn wave, a TWO-TENANT CONTENTION wave runs against the
+    restored fleet: a ``flooder`` tenant bursts every request at once
+    while a lighter ``interactive`` tenant trickles in behind it, both
+    named via ``X-Dllama-Tenant`` and fair-share-scheduled
+    (runtime/tenancy weighted per-tenant FIFOs). Reported: per-tenant
+    tok/s, queue-wait p95, and sheds under ``tenants``, plus
+    ``jain_index`` — Jain's fairness over the wave's per-tenant token
+    deltas (higher is better; a flooder that starves the interactive
+    tenant drags it toward 0.5).
+
     Workload knobs (env): DLLAMA_BENCH_FLEET_REPLICAS (3),
     DLLAMA_BENCH_SCN_REQUESTS (18), DLLAMA_BENCH_SCN_MAXTOK (12),
-    DLLAMA_BENCH_SCN_STAGGER (0.05 s).
+    DLLAMA_BENCH_SCN_STAGGER (0.05 s), DLLAMA_BENCH_TENANT_HEAVY (10),
+    DLLAMA_BENCH_TENANT_LIGHT (5).
 
     DLLAMA_BENCH_FLEET_DISAGG=1 switches the fleet to prefill/decode
     disaggregation: every replica runs the paged pool, replica 0 is
@@ -1629,6 +1640,81 @@ def bench_fleet(deadline: float, *, out: dict | None = None) -> dict:
                 and not up.value(replica=killed):
             time.sleep(0.1)
         out["readmitted"] = bool(up.value(replica=killed))
+        # two-tenant contention wave: a flooding tenant bursts the
+        # restored fleet while a light interactive tenant trickles in
+        # behind it — fair-share admission (weighted per-tenant FIFOs,
+        # runtime/tenancy) must keep the light tenant served. In-process
+        # fleet means ONE shared tenant registry across the router and
+        # every replica, so per-tenant totals are read directly.
+        # Reported: per-tenant tok/s + queue-wait p95 + sheds, and
+        # ``jain_index`` — Jain's fairness over the wave's per-tenant
+        # decode-token deltas (1.0 = served proportionally to demand;
+        # a starved light tenant drags it toward 1/n). Knobs:
+        # DLLAMA_BENCH_TENANT_HEAVY (10) / DLLAMA_BENCH_TENANT_LIGHT (5).
+        out["phase"] = "scenario_tenants"
+        from dllama_tpu.runtime import tenancy as tn
+        treg = tn.registry()
+        treg.set_limits(tn.parse_limits(
+            {"flooder": {"weight": 1.0},
+             "interactive": {"weight": 4.0}}))
+        n_heavy = _scn_int("DLLAMA_BENCH_TENANT_HEAVY", 10)
+        n_light = _scn_int("DLLAMA_BENCH_TENANT_LIGHT", 5)
+        snap0 = treg.snapshot()["tenants"]
+        tok0 = {t: st.get("decode_tokens", 0)
+                for t, st in snap0.items()}
+        t_results: dict = {}
+
+        def tenant_request(tag, tenant, i):
+            rec: dict = {"t_sub": time.perf_counter()}
+            t_results[tag] = rec
+            body = {"messages": [{"role": "user",
+                                  "content": f"tenant {tenant} wave {i}"}],
+                    "max_tokens": max_tok, "temperature": 0,
+                    "stream": False}
+            try:
+                req = urllib.request.Request(
+                    router_url + "/v1/chat/completions",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json",
+                             "X-Dllama-Tenant": tenant})
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    json.loads(r.read())
+                    rec["ok"] = True
+            except Exception as e:  # noqa: BLE001 — per-request forensics
+                rec.update(ok=False, error=repr(e)[:120])
+            rec["t_end"] = time.perf_counter()
+
+        t_threads: list = []
+        tw0 = time.perf_counter()
+        for i in range(n_heavy):  # the flood: all at once
+            th = threading.Thread(target=tenant_request,
+                                  args=(f"h{i}", "flooder", i))
+            th.start()
+            t_threads.append(th)
+        for i in range(n_light):  # the interactive trickle
+            th = threading.Thread(target=tenant_request,
+                                  args=(f"l{i}", "interactive", i))
+            th.start()
+            t_threads.append(th)
+            time.sleep(stagger_s)
+        for th in t_threads:
+            th.join(timeout=max(5.0, deadline - time.monotonic()))
+        tw = time.perf_counter() - tw0
+        snap1 = treg.snapshot()["tenants"]
+        tenant_toks: dict = {}
+        out["tenants"] = {}
+        for tenant in ("flooder", "interactive"):
+            st = snap1.get(tenant, {})
+            toks = st.get("decode_tokens", 0) - tok0.get(tenant, 0)
+            tenant_toks[tenant] = toks
+            qw = st.get("queue_wait_ms", {})
+            out["tenants"][tenant] = {
+                "tok_per_s": round(toks / tw, 2) if tw > 0 else None,
+                "queue_wait_ms_p95": (round(qw["p95"], 1)
+                                      if qw.get("n") else None),
+                "sheds": sum(st.get("sheds", {}).values())}
+        out["jain_index"] = round(
+            tn.jain_index(tenant_toks.values()), 4)
         # the SLO observatory's verdict on the run: per-objective
         # compliance + worst burn, plus the two flat fields the
         # compare/baseline tools rank (slo_compliance_min: 1.0 = every

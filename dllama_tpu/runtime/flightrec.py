@@ -391,11 +391,17 @@ def to_chrome_trace(data: dict) -> dict:
             tid = _span_tid(s["slot"])
             ts = s["start_ns"] / 1e3
             dur = max(0.0, (s["end_ns"] - s["start_ns"]) / 1e3)
+            args = {"request_id": rid, "phase": s["phase"],
+                    "n_tokens": s["n_tokens"]}
+            if s.get("tenant"):
+                # tenant-bound spans (telemetry.SpanTracer.bind_tenant)
+                # keep their attribution in the rendered trace, so a
+                # Perfetto query can slice one tenant's requests out of
+                # a mixed-tenant timeline
+                args["tenant"] = s["tenant"]
             out.append({"ph": "X", "pid": 2, "tid": tid, "ts": ts,
                         "dur": dur, "name": f"r{rid} {s['phase']}",
-                        "cat": "request",
-                        "args": {"request_id": rid, "phase": s["phase"],
-                                 "n_tokens": s["n_tokens"]}})
+                        "cat": "request", "args": args})
             if len(ss) == 1:
                 # a single-span request still gets a complete flow: start
                 # at the slice begin, finish at its end

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import failpoints, flightrec, introspection, numerics, telemetry
+from . import failpoints, flightrec, introspection, numerics, telemetry, tenancy
 
 from ..models.llama import forward, sampled_step_guarded
 from ..parallel.api import plan_scoped_jit, use_plan
@@ -67,6 +67,13 @@ class SchedulerError(RuntimeError):
 
 class QueueFullError(SchedulerError):
     """Bounded admission: the wait queue is at --max-queue (HTTP 429)."""
+
+
+class TenantOverBudgetError(QueueFullError):
+    """Per-tenant admission: THIS tenant's --tenant-limits token-rate
+    bucket ran dry (HTTP 429 with the same backpressure headers as a
+    queue-full shed — the subclassing is the contract). Other tenants
+    are unaffected; the caller retries after Retry-After."""
 
 
 class SchedulerUnavailableError(SchedulerError):
@@ -158,6 +165,11 @@ class Request:
     seed: int = 0xB1A5
     stop_on_eos: bool = True
     on_token: Callable[[int, str | None], None] | None = None
+    # tenant observatory (runtime/tenancy): the canonical tenant label
+    # this request's tokens/latency/KV residency are attributed to —
+    # already resolved through TenantRegistry.resolve() at submit (the
+    # cardinality bound), so accounting sites use it verbatim
+    tenant: str = tenancy.ANON
     # filled by the generator:
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
@@ -188,6 +200,9 @@ class Request:
     # admission/prefill/first_decode are derived from these at the first
     # emitted token and must sum to wall TTFT by construction
     t_first_token: int = 0
+    # last emitted-run stamp (monotonic ns): the per-tenant ITL
+    # histogram records each emit-run's mean inter-token gap from it
+    t_last_emit: int = 0
     ms_prefill: float = 0.0       # own prefill chunk dispatch wall
     ms_decode_steps: float = 0.0  # decode dispatch wall while slot active
     ms_preempt: float = 0.0       # others' interleaved prefill wall while
@@ -331,6 +346,10 @@ class _GeneratorCore:
         self.flight = flightrec.recorder()
         self._m_ttft_attrib = self._tm.histogram(telemetry.TTFT_ATTRIB_MS)
         self._m_itl_attrib = self._tm.histogram(telemetry.ITL_ATTRIB_MS)
+        # tenant observatory (runtime/tenancy): every accounting site
+        # below notes the SAME value it publishes globally, so per-tenant
+        # sums reconcile with the global counters bit-exactly
+        self._tenancy = tenancy.registry()
 
     # -- slot lifecycle -----------------------------------------------------
 
@@ -375,7 +394,11 @@ class _GeneratorCore:
                                     telemetry.now_ns(), slot=slot,
                                     n_tokens=len(req.tokens))
         self.flight.note("retire", req.rid, reason=reason, slot=slot,
-                         n_tokens=len(req.tokens))
+                         n_tokens=len(req.tokens), tenant=req.tenant)
+        # speculative accounting charges once, at retire — the same
+        # place the per-request accept rate becomes final
+        self._tenancy.note_spec(req.tenant, req.spec_drafted,
+                                req.spec_accepted)
         # ITL attribution (once per request, at retire): total decode
         # dispatch wall vs the tick-budget preemption stall other
         # admissions' prefill chunks imposed while this slot waited
@@ -426,14 +449,19 @@ class _GeneratorCore:
         req.t_admit = telemetry.now_ns()
         self._tm.counter(telemetry.ADMISSIONS).inc()
         self.flight.note("admit", req.rid, slot=slot, reused=reused,
-                         n_prompt=len(req.prompt_ids))
+                         n_prompt=len(req.prompt_ids), tenant=req.tenant)
         if reused:
             self._tm.counter(telemetry.PREFIX_REUSE_TOKENS).inc(reused)
         if req.t_submit:
-            self._tm.histogram(telemetry.QUEUE_WAIT_MS).record(
-                (req.t_admit - req.t_submit) / 1e6)
+            wait_ms = (req.t_admit - req.t_submit) / 1e6
+            self._tm.histogram(telemetry.QUEUE_WAIT_MS).record(wait_ms)
+            # the SAME wait value feeds the tenant's queue-wait histogram
+            # (per-tenant count/sum must reconcile with the global one)
+            self._tenancy.note_admission(req.tenant, wait_ms)
             telemetry.tracer().emit(req.rid, "queue", req.t_submit,
                                     req.t_admit, slot=slot)
+        else:
+            self._tenancy.note_admission(req.tenant)
 
     # -- emit/tripwire tails shared by every dispatch kind ------------------
 
@@ -547,6 +575,7 @@ class _GeneratorCore:
         for s in self.slots:
             if s is not None:
                 s.ms_preempt += ms
+        self._tenancy.note_prefill_tokens(adm.req.tenant, n_valid)
         self.flight.note_prefill(adm.req.rid, ms, n_valid)
         telemetry.tracer().emit(adm.req.rid, "prefill_chunk", t0, t1,
                                 slot=adm.slot, n_tokens=n_valid)
@@ -599,6 +628,7 @@ class _GeneratorCore:
         if bad:
             numerics.record_nonfinite(bad, "eval")
         adm.req.nll_parts.append(vals)
+        self._tenancy.note_prefill_tokens(adm.req.tenant, n_valid)
         self.flight.note_prefill(adm.req.rid, ms, n_valid)
         telemetry.tracer().emit(adm.req.rid, "prefill_chunk", t0, t1,
                                 slot=adm.slot, n_tokens=n_valid)
@@ -623,6 +653,14 @@ class _GeneratorCore:
         """Block-pool occupancy for the tick record (paged pool only)."""
         return None
 
+    def kv_blocks_by_slot(self, slot: int) -> float:
+        """KV blocks slot ``slot`` holds right now, for the tenant
+        observatory's device block-second charging. The dense pool has
+        no blocks — one synthetic block per slot column (the whole
+        column is reserved whether short or long); the paged pool
+        reports the slot's real block count."""
+        return 1.0
+
     def _emit_run(self, i: int, run: list[int]) -> int:  # dlint: owner=loop-thread
         """Deliver a run of tokens to slot ``i``'s request: append, stream,
         advance position, retire on EOS / limits. Returns tokens emitted.
@@ -644,11 +682,24 @@ class _GeneratorCore:
         run = run[:n_keep]
         self.pos[i] += len(run)
         self.next_token[i] = run[-1]
+        t_emit = telemetry.now_ns()
         if req.t_first_token == 0:
             # first emitted token: stamp + publish the TTFT decomposition
-            req.t_first_token = telemetry.now_ns()
+            req.t_first_token = t_emit
             self.flight.note("first_token", req.rid, slot=i)
             self._record_ttft_attrib(req)
+            if req.t_submit:
+                self._tenancy.note_ttft(
+                    req.tenant, (t_emit - req.t_submit) / 1e6)
+        elif req.t_last_emit:
+            # later runs: the run's mean inter-token gap, weighted by its
+            # token count — a spec-accepted burst records its true
+            # per-token latency, not one misleading burst-sized gap
+            self._tenancy.note_itl(
+                req.tenant, (t_emit - req.t_last_emit) / 1e6 / len(run),
+                n=len(run))
+        req.t_last_emit = t_emit
+        self._tenancy.note_decode_tokens(req.tenant, len(run))
         req.tokens.extend(run)
         if self._proposers[i] is not None:
             self._proposers[i].extend(run)
@@ -1976,6 +2027,9 @@ class PagedGenerator(_GeneratorCore):
         super()._retire(slot, reason)
         self._release_blocks(slot)
 
+    def kv_blocks_by_slot(self, slot: int) -> float:
+        return float(len(self._seq_bids[slot]))
+
     def abort_admit(self, adm: "_Admission") -> None:  # dlint: owner=loop-thread
         """Release everything ``begin_admit`` took for an admission that
         will never commit. Safe in every abort window: blocks this
@@ -2244,6 +2298,7 @@ class BatchScheduler:
 
     def __init__(self, engine: "InferenceEngine", n_slots: int = 4, *,
                  max_queue: int = 0, max_restarts: int = 3,
+                 tenant_limits: dict | None = None,
                  _start_thread: bool = True):
         # --kv-block-size selects the paged block-pool generator; the
         # scheduler's queue/deadline/supervision machinery is identical
@@ -2264,11 +2319,23 @@ class BatchScheduler:
         self.flight = self.gen.flight
         self.max_queue = max_queue
         self.max_restarts = max_restarts
+        # tenant observatory (runtime/tenancy): the process-wide
+        # accounting registry plus this scheduler's fair-share knobs —
+        # --tenant-limits (weight/max_slots/tokens_per_s) applied here so
+        # tests can construct a limited scheduler without CLI plumbing
+        self._tenancy = tenancy.registry()
+        if tenant_limits is not None:
+            self._tenancy.set_limits(tenant_limits)
         # shared scheduler state: mutated by handler threads (submit),
         # the loop thread, the closer, and the watchdog monitor — every
         # write outside __init__ must hold _lock (machine-checked by
         # dlint's lock-guard rule via the guarded-by declarations)
-        self._queue: list[Request] = []          # dlint: guarded-by=_lock
+        # The wait queue is per-tenant FIFOs drained by weighted
+        # round-robin (tenancy.FairQueue — FIFO within a tenant, WRR
+        # across tenants); it supports len/iter/remove/clear, so the
+        # deadline sweep and fail-all treat it like the list it replaced.
+        self._queue = tenancy.FairQueue(         # dlint: guarded-by=_lock
+            weight_of=lambda t: self._tenancy.limit_for(t).weight)
         self._admissions: list[_Admission] = []  # dlint: guarded-by=_lock
         # KV migration (runtime/kvwire): requests parked mid-transfer +
         # peer export gathers awaiting the loop thread. Guarded so
@@ -2296,6 +2363,10 @@ class BatchScheduler:
         self._watchdog = getattr(engine, "watchdog", None)
         if self._watchdog is not None:
             self._watchdog.on_stall.append(self._on_stall)
+        # tick-usage clock: KV block-seconds and fairness-window slot
+        # occupancy are charged per tick as (now - last tick) — the idle
+        # path resets it so a long quiet stretch never bills anyone
+        self._t_last_tick = time.monotonic()     # dlint: owner=loop-thread
         self._thread: threading.Thread | None = None
         if _start_thread:
             self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -2308,11 +2379,14 @@ class BatchScheduler:
                seed: int = 0xB1A5, stop_on_eos: bool = True,
                timeout_s: float | None = None, on_token=None,
                kv_peer: str | None = None, score: bool = False,
-               resume_from: int = 0) -> Request:
+               resume_from: int = 0, tenant: str = tenancy.ANON) -> Request:
         if score and getattr(self.gen.eng, "_nll_step", None) is None:
             raise ValueError(
                 "eval scoring is unsupported on this engine: no "
                 "prefill_nll program (multihost has no replicated twin)")
+        # resolve BEFORE the lock: the cardinality bound + overflow
+        # counter live in the tenancy registry, not scheduler state
+        tenant = self._tenancy.resolve(tenant)
         with self._lock:
             if self._stop or self._draining or not self._healthy or (
                     self._thread is not None and not self._thread.is_alive()):
@@ -2321,9 +2395,26 @@ class BatchScheduler:
                     else "scheduler is not running")
             if self.max_queue and len(self._queue) >= self.max_queue:
                 telemetry.registry().counter(telemetry.REQUESTS_SHED).inc()
+                self._tenancy.note_shed(tenant, "queue_full")
+                self.flight.note("shed", reason="queue_full", tenant=tenant)
                 raise QueueFullError(
                     f"queue full ({len(self._queue)} waiting, "
                     f"--max-queue {self.max_queue}); retry later")
+            # per-tenant token-rate budget (--tenant-limits): cost is the
+            # request's worst case (prompt + decode limit), charged up
+            # front — a 429 here sheds only THIS tenant's request; the
+            # global queue bound above takes precedence so a full queue
+            # never reads as a tenant-budget problem
+            if not self._tenancy.try_charge_tokens(
+                    tenant, len(prompt_ids) + max_tokens):
+                telemetry.registry().counter(telemetry.REQUESTS_SHED).inc()
+                self._tenancy.note_shed(tenant, "tenant_rate_budget")
+                self.flight.note("shed", reason="tenant_rate_budget",
+                                 tenant=tenant)
+                raise TenantOverBudgetError(
+                    f"tenant {tenant!r} is over its token-rate budget "
+                    f"({self._tenancy.limit_for(tenant).tokens_per_s:g} "
+                    f"tok/s); retry later")
             # HBM admission guard: refuse a request that would push the
             # device past its limit (measured-bytes cross-check +
             # uncompiled-bucket workspace) instead of OOM-crashing later
@@ -2339,7 +2430,7 @@ class BatchScheduler:
                           max_tokens=max_tokens, temperature=temperature,
                           topp=topp, seed=seed, stop_on_eos=stop_on_eos,
                           on_token=on_token, score=score,
-                          resume_from=resume_from)
+                          resume_from=resume_from, tenant=tenant)
             if kv_peer and hasattr(self.gen, "wire_geometry"):
                 # peer-KV migration is paged-pool-only; a dense pool (or
                 # an empty peer) just recomputes — no error, no field
@@ -2347,14 +2438,19 @@ class BatchScheduler:
             req.t_submit = telemetry.now_ns()
             if timeout_s is not None and timeout_s > 0:
                 req.deadline_ns = req.t_submit + int(timeout_s * 1e9)
-            self._queue.append(req)
+            # the span tracer binds rid → tenant BEFORE the request is
+            # findable by the loop thread, so every span it ever emits —
+            # queue, prefill, decode, the --trace-out JSONL — carries
+            # the attribution
+            telemetry.tracer().bind_tenant(rid, tenant)
+            self._queue.push(req)
             telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
                 len(self._queue))
             self.flight.note("submit", rid, n_prompt=len(prompt_ids),
-                             max_tokens=max_tokens)
+                             max_tokens=max_tokens, tenant=tenant)
             if resume_from:
                 self.flight.note("resume", rid, n_history=resume_from,
-                                 peer=kv_peer or "")
+                                 peer=kv_peer or "", tenant=tenant)
         self._wake.set()
         return req
 
@@ -2455,6 +2551,9 @@ class BatchScheduler:
                       else "drain_timeout" if drain_s > 0 else "aborted")
             self.flight.note("drain_end", n_failed=remainder,
                              reason=reason)
+            # final ledger line at drain: the cumulative totals a billing
+            # pipeline reconciles against are never lost to the interval
+            tenancy.ledger().maybe_write(self._tenancy, force=True)
         # the remainder fails EXPLICITLY (the close() that used to leak
         # waiters would leave these threads in done.wait() forever)
         self._fail_all("server shutting down")
@@ -2471,6 +2570,9 @@ class BatchScheduler:
     def _timeout_request(self, req: Request) -> None:  # dlint: owner=any
         req.timed_out = True
         telemetry.registry().counter(telemetry.REQUEST_TIMEOUTS).inc()
+        # same site, same count: per-tenant timeouts reconcile exactly
+        # with dllama_request_timeouts_total
+        self._tenancy.note_timeout(req.tenant)
 
     def _fail_all(self, msg: str) -> None:  # dlint: owner=any
         """Fail every queued, admitting, and in-flight request with
@@ -2513,19 +2615,22 @@ class BatchScheduler:
                     len(self._queue))
         for req in expired:
             self._timeout_request(req)
-            self.flight.note("timeout", req.rid, reason="queued")
+            self.flight.note("timeout", req.rid, reason="queued",
+                             tenant=req.tenant)
             req.done.set()
         for holder in (a.req for a in self._admissions):
             if holder.deadline_ns and now >= holder.deadline_ns \
                     and not holder.timed_out:
                 self._timeout_request(holder)
-                self.flight.note("timeout", holder.rid, reason="admitting")
+                self.flight.note("timeout", holder.rid, reason="admitting",
+                                 tenant=holder.tenant)
                 holder.cancel.set()
         for s in self.gen.slots:
             if s is not None and s.deadline_ns and now >= s.deadline_ns \
                     and not s.timed_out:
                 self._timeout_request(s)
-                self.flight.note("timeout", s.rid, reason="in_flight")
+                self.flight.note("timeout", s.rid, reason="in_flight",
+                                 tenant=s.tenant)
                 s.cancel.set()
 
     # -- KV migration (runtime/kvwire): peer pull before admission -----------
@@ -2614,10 +2719,13 @@ class BatchScheduler:
                 self.flight.note("kvmigrate_fallback", req.rid,
                                  reason=reason, peer=mig.peer)
             with self._lock:
-                # head of the queue: the request was at the front when it
-                # parked, and its prefix (migrated or not) admits through
-                # the one ordinary path — match, share, chunked prefill
-                self._queue.insert(0, req)
+                # head of its tenant's queue: the request was at the
+                # front when it parked, and its prefix (migrated or not)
+                # admits through the one ordinary path — match, share,
+                # chunked prefill. push_front also refunds the WRR pass
+                # the park's pop charged, so a migration isn't billed as
+                # two turns against the tenant's share.
+                self._queue.push_front(req)
                 telemetry.registry().gauge(telemetry.QUEUE_DEPTH).set(
                     len(self._queue))
             self._wake.set()
@@ -2798,18 +2906,25 @@ class BatchScheduler:
         reserved = {a.slot for a in self._admissions}
         started: list[_KVMigration] = []
         with self._lock:
-            # start admissions into free, unreserved slots; on the paged
-            # pool each request is priced in BLOCKS first (worst-case
-            # need vs free+evictable blocks) — an unaffordable request
-            # stays queued, preserving FIFO order
-            while self._queue:
-                head = self._queue[0]
+            # start admissions into free, unreserved slots, drained in
+            # weighted-round-robin order across tenants (FairQueue —
+            # FIFO within a tenant); on the paged pool each request is
+            # priced in BLOCKS first (worst-case need vs free+evictable
+            # blocks) — an unaffordable request stays queued at its
+            # tenant's head. A tenant at its --tenant-limits slot cap is
+            # SKIPPED (blocked for this tick), not a barrier: the other
+            # tenants keep admitting past it.
+            blocked: set[str] = set()
+            while True:
+                head = self._queue.peek(blocked)
+                if head is None:
+                    break
                 if head.kv_peer:
                     # peer-KV pull: park the request while a fetch
                     # thread streams frames across ticks — bystanders
                     # keep admitting and decoding untouched; any wire
                     # failure requeues it for ordinary recompute
-                    self._queue.pop(0)
+                    self._queue.pop(head)
                     mig = _KVMigration(req=head, peer=head.kv_peer,
                                        t0_ns=telemetry.now_ns())
                     head.kv_peer = None  # one attempt, ever
@@ -2820,27 +2935,38 @@ class BatchScheduler:
                         if s not in reserved]
                 if not free:
                     break
-                if not self.gen.can_admit(self._queue[0]):
+                lim = self._tenancy.limit_for(head.tenant)
+                if lim.max_slots and self._tenant_active(
+                        head.tenant, reserved) >= lim.max_slots:
+                    self.flight.note("defer", head.rid,
+                                     reason="tenant_slot_cap",
+                                     tenant=head.tenant)
+                    blocked.add(head.tenant)
+                    continue
+                if not self.gen.can_admit(head):
                     # blocks unaffordable: the head stays queued (FIFO) —
                     # the tick record says WHY nothing admitted this tick
-                    self.flight.note("defer", self._queue[0].rid,
-                                     reason="blocks_unaffordable")
+                    self.flight.note("defer", head.rid,
+                                     reason="blocks_unaffordable",
+                                     tenant=head.tenant)
                     break
-                req = self._queue.pop(0)
+                req = self._queue.pop(head)
                 try:
                     failpoints.fire("admit")
                     adm = self.gen.begin_admit(req, free[0])
                 except BlockPoolExhausted:
                     # block-pool exhaustion (organic or kv_alloc-injected)
-                    # DEGRADES TO QUEUEING: the request goes back to the
-                    # head and waits for retirements to free blocks —
-                    # back-pressure surfaces as 429s (queue full) or 408s
-                    # (deadline), never a crash or a silent drop
-                    self._queue.insert(0, req)
+                    # DEGRADES TO QUEUEING: the request goes back to its
+                    # tenant's head and waits for retirements to free
+                    # blocks — back-pressure surfaces as 429s (queue
+                    # full) or 408s (deadline), never a crash or a
+                    # silent drop
+                    self._queue.push_front(req)
                     now = telemetry.now_ns()
                     telemetry.tracer().emit(req.rid, "requeue", now, now)
                     self.flight.note("requeue", req.rid,
-                                     reason="kv_block_exhaustion")
+                                     reason="kv_block_exhaustion",
+                                     tenant=req.tenant)
                     break
                 except Exception as e:  # noqa: BLE001 — reject, don't wedge
                     req.error = f"{type(e).__name__}: {e}"
@@ -2848,7 +2974,8 @@ class BatchScheduler:
                     # host tier broke, not the request) — 503-shaped
                     req.server_error = isinstance(e, PageInError)
                     self.flight.note("reject", req.rid,
-                                     reason=type(e).__name__)
+                                     reason=type(e).__name__,
+                                     tenant=req.tenant)
                     req.done.set()
                     continue
                 self._admissions.append(adm)
@@ -2880,7 +3007,8 @@ class BatchScheduler:
                 # counted as admitted in begin_admit: balance the pair so
                 # admissions_total - retires_total stays "live requests"
                 telemetry.registry().counter(telemetry.RETIRES).inc()
-                self.flight.note("cancel", adm.req.rid, reason="admitting")
+                self.flight.note("cancel", adm.req.rid, reason="admitting",
+                                 tenant=adm.req.tenant)
                 adm.req.done.set()
         spent = 0
         for adm in list(self._admissions):
@@ -2889,7 +3017,8 @@ class BatchScheduler:
                 # the preempt decision is what ITL attribution's
                 # tick-budget story is built from
                 self.flight.note("preempt", adm.req.rid,
-                                 reason="prefill_budget")
+                                 reason="prefill_budget",
+                                 tenant=adm.req.tenant)
                 continue
             remaining = len(adm.req.prompt_ids) - 1 - adm.pos
             spent += self.gen.eng._prefill_chunk_size(max(1, remaining))
@@ -2920,6 +3049,11 @@ class BatchScheduler:
         if canary is not None:
             canary.maybe_run()
         if self.gen.n_active == 0 and not self._admissions:
+            # idle: nobody holds KV, so reset the usage clock (a quiet
+            # hour must not be billed to whoever admits next) — but the
+            # ledger keeps its cadence so consumers see liveness
+            self._t_last_tick = time.monotonic()
+            tenancy.ledger().maybe_write(self._tenancy)
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             return
@@ -2936,3 +3070,40 @@ class BatchScheduler:
         # only work-carrying ticks advance the steady countdown: an idle
         # server must not declare itself steady before ever compiling
         self._mark_steady_if_quiet(compiles_before)
+        self._note_tick_usage()
+
+    def _tenant_active(self, tenant: str, reserved: set) -> int:  # dlint: owner=loop-thread
+        """Slots ``tenant`` currently occupies or is admitting into —
+        the count its --tenant-limits ``max_slots`` cap gates on.
+        Caller holds ``_lock`` (the admission loop)."""
+        return (sum(1 for s in self.gen.slots
+                    if s is not None and s.tenant == tenant)
+                + sum(1 for a in self._admissions
+                      if a.req.tenant == tenant))
+
+    def _note_tick_usage(self) -> None:  # dlint: owner=loop-thread
+        """Tenant observatory tick accounting: charge this tick's wall to
+        each tenant's KV residency (device tier: blocks its live slots
+        hold — one synthetic block per slot on the dense pool; host
+        tier: spilled blocks its admissions' outstanding page-ins still
+        reference), feed the fairness window, and give the usage ledger
+        its periodic chance to append. Pure host bookkeeping — dict
+        updates and at most one small file append — so steady-state
+        dispatch traces are untouched."""
+        now = time.monotonic()
+        dt = now - self._t_last_tick
+        self._t_last_tick = now
+        device: dict[str, float] = {}
+        for i, s in enumerate(self.gen.slots):
+            if s is not None:
+                device[s.tenant] = (device.get(s.tenant, 0.0)
+                                    + self.gen.kv_blocks_by_slot(i))
+        host: dict[str, float] = {}
+        with self._lock:
+            for a in self._admissions:
+                n = len(a.pagein)
+                if n:
+                    host[a.req.tenant] = host.get(a.req.tenant, 0.0) + n
+        if device or host:
+            self._tenancy.note_tick(dt, device, host)
+        tenancy.ledger().maybe_write(self._tenancy)

@@ -233,29 +233,58 @@ class SloEngine:
         self._objectives = {name: _Objective(name, thr)
                             for name, thr in objectives.items()}
         self._hists = {"ttft": LogHistogram(), "itl": LogHistogram()}
+        # per-tenant twin state (runtime/tenancy's observatory): lifetime
+        # histograms + shed counts keyed by canonical tenant label — the
+        # caller resolves labels through TenantRegistry.resolve(), so
+        # cardinality is already bounded there; the local cap below is a
+        # second fence (tenancy can't be imported here: it uses this
+        # module's LogHistogram). Burn windows stay GLOBAL only — per
+        # tenant×objective×window gauge series is exactly the cardinality
+        # blow-up the observatory is built to prevent.
+        self._tenants: dict[str, dict] = {}
+
+    _TENANT_CAP = 64  # mirrors tenancy.TENANT_CAP; overflow → "other"
+
+    def _tenant_state(self, tenant: str) -> dict:
+        st = self._tenants.get(tenant)
+        if st is None:
+            if tenant != "other" and len(self._tenants) >= self._TENANT_CAP:
+                return self._tenant_state("other")
+            st = self._tenants[tenant] = {
+                "hists": {"ttft": LogHistogram(), "itl": LogHistogram()},
+                "shed": [0, 0]}  # [bad, events]
+        return st
 
     @property
     def objective_names(self) -> tuple[str, ...]:
         return tuple(self._objectives)
 
-    def _observe_latency(self, metric: str, ms: float) -> None:
+    def _observe_latency(self, metric: str, ms: float,
+                         tenant: str | None = None) -> None:
         now = self._clock()
         with self._lock:
             self._hists[metric].record(ms)
+            if tenant is not None:
+                self._tenant_state(tenant)["hists"][metric].record(ms)
             for obj in self._objectives.values():
                 if obj.kind == "latency" and obj.metric == metric:
                     obj.note(now, ms > obj.threshold)
 
-    def observe_ttft(self, ms: float) -> None:
-        self._observe_latency("ttft", ms)
+    def observe_ttft(self, ms: float, tenant: str | None = None) -> None:
+        self._observe_latency("ttft", ms, tenant)
 
-    def observe_itl(self, ms: float) -> None:
-        self._observe_latency("itl", ms)
+    def observe_itl(self, ms: float, tenant: str | None = None) -> None:
+        self._observe_latency("itl", ms, tenant)
 
-    def observe_outcome(self, *, shed: bool) -> None:
+    def observe_outcome(self, *, shed: bool,
+                        tenant: str | None = None) -> None:
         """One admission decision: admitted (good) or shed (bad)."""
         now = self._clock()
         with self._lock:
+            if tenant is not None:
+                st = self._tenant_state(tenant)["shed"]
+                st[0] += 1 if shed else 0
+                st[1] += 1
             for obj in self._objectives.values():
                 if obj.kind == "rate":
                     obj.note(now, shed)
@@ -291,11 +320,36 @@ class SloEngine:
                     n, bad_frac = w.fractions(now)
                     burns[label] = (bad_frac / obj.budget) if n else 0.0
                 rec["burn"] = burns
+                # per-tenant compliance (the tenant observatory): the
+                # same objective evaluated over each tenant's own
+                # lifetime observations — a fleet meeting its p95
+                # globally can still be failing ONE tenant, and that
+                # must be visible as dllama_slo_compliance{tenant=...}
+                tenants: dict[str, dict] = {}
+                for t, st in self._tenants.items():
+                    if obj.kind == "latency":
+                        h = st["hists"][obj.metric]
+                        if not h.n:
+                            continue
+                        est = h.quantile(obj.quantile)
+                        ok = est <= obj.threshold
+                    else:
+                        bad, n = st["shed"]
+                        if not n:
+                            continue
+                        est = bad / n
+                        ok = est <= obj.threshold
+                    tenants[t] = {"estimate": est, "compliant": bool(ok)}
+                if tenants:
+                    rec["tenants"] = tenants
                 out["objectives"][name] = rec
         comp_g = self._reg.gauge(telemetry.SLO_COMPLIANCE)
         burn_g = self._reg.gauge(telemetry.SLO_BURN_RATE)
         for name, rec in out["objectives"].items():
             comp_g.set(1.0 if rec["compliant"] else 0.0, objective=name)
+            for t, trec in rec.get("tenants", {}).items():
+                comp_g.set(1.0 if trec["compliant"] else 0.0,
+                           objective=name, tenant=t)
             for label, burn in rec["burn"].items():
                 burn_g.set(burn, objective=name, window=label)
         return out

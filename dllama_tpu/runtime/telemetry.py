@@ -138,6 +138,26 @@ ROUTER_STREAM_RESUME_MS = "dllama_router_stream_resume_ms"
 SLO_COMPLIANCE = "dllama_slo_compliance"
 SLO_BURN_RATE = "dllama_slo_burn_rate"
 
+# tenant observatory (runtime/tenancy.py — per-tenant accounting bound
+# to the X-Dllama-Tenant identity; label cardinality bounded by the
+# registry's LRU, overflow collapsing into tenant="other")
+TENANT_PREFILL_TOKENS = "dllama_tenant_prefill_tokens_total"
+TENANT_DECODE_TOKENS = "dllama_tenant_decode_tokens_total"
+TENANT_ADMISSIONS = "dllama_tenant_admissions_total"
+TENANT_SHED = "dllama_tenant_shed_total"
+TENANT_TIMEOUTS = "dllama_tenant_timeouts_total"
+TENANT_OVERFLOW = "dllama_tenant_overflow_total"
+TENANT_KV_BLOCK_SECONDS = "dllama_tenant_kv_block_seconds_total"
+TENANT_SPEC_DRAFT_TOKENS = "dllama_tenant_spec_draft_tokens_total"
+TENANT_SPEC_ACCEPTED_TOKENS = "dllama_tenant_spec_accepted_tokens_total"
+TENANT_QUEUE_WAIT_MS = "dllama_tenant_queue_wait_ms"
+TENANT_TTFT_MS = "dllama_tenant_ttft_ms"
+TENANT_ITL_MS = "dllama_tenant_itl_ms"
+TENANT_FAIRNESS_JAIN = "dllama_tenant_fairness_jain"
+TENANT_SHARE_MAX = "dllama_tenant_share_max"
+TENANT_SHARE_MIN = "dllama_tenant_share_min"
+TENANT_ACTIVE = "dllama_tenant_active"
+
 # HTTP layer (serve/api.py)
 HTTP_REQUESTS = "dllama_http_requests_total"
 REQUESTS_IN_FLIGHT = "dllama_requests_in_flight"
@@ -504,6 +524,64 @@ SPECS: dict[str, MetricSpec] = {s.name: s for s in (
           "SLO observatory: error-budget burn rate for the labeled "
           "objective over the labeled sliding window (1.0 = burning "
           "exactly the budget; >1 exhausts it early)"),
+    _spec(TENANT_PREFILL_TOKENS, "counter",
+          "Prompt positions prefilled for the labeled tenant by batched "
+          "serving (post-prefix-reuse — skipped positions are not "
+          "charged; runtime/tenancy.py)"),
+    _spec(TENANT_DECODE_TOKENS, "counter",
+          "Tokens emitted to the labeled tenant's requests by batched "
+          "serving (sums over tenants to dllama_batch_tokens_total for "
+          "scheduler-run work — the conservation invariant the tenancy "
+          "tests pin)"),
+    _spec(TENANT_ADMISSIONS, "counter",
+          "Requests of the labeled tenant admitted into a slot"),
+    _spec(TENANT_SHED, "counter",
+          "Requests of the labeled tenant shed at admission, by reason "
+          "(queue_full: the shared --max-queue bound; "
+          "tenant_rate_budget: the tenant's own --tenant-limits token "
+          "bucket ran dry; router_queue_full: the fleet router's "
+          "admission gate — both 429-shaped)"),
+    _spec(TENANT_TIMEOUTS, "counter",
+          "Requests of the labeled tenant cancelled by deadline expiry"),
+    _spec(TENANT_OVERFLOW, "counter",
+          "Tenant ids collapsed into the `other` label because the "
+          "registry's LRU cardinality bound was full — a tenant-id "
+          "fuzzer inflates this counter, never /metrics"),
+    _spec(TENANT_KV_BLOCK_SECONDS, "counter",
+          "KV residency charged to the labeled tenant, block-seconds by "
+          "tier (device: blocks held by its live slots per tick — one "
+          "synthetic block per slot column on the dense pool; host: "
+          "spilled blocks awaiting its admissions' page-in restores)"),
+    _spec(TENANT_SPEC_DRAFT_TOKENS, "counter",
+          "Speculative draft tokens offered on the labeled tenant's "
+          "slots (charged at retire from the per-request accounting)"),
+    _spec(TENANT_SPEC_ACCEPTED_TOKENS, "counter",
+          "Speculative draft tokens accepted on the labeled tenant's "
+          "slots (per-tenant accept rate = accepted / draft)"),
+    _spec(TENANT_QUEUE_WAIT_MS, "gauge",
+          "Per-tenant submit-to-admission wait quantile estimate, ms "
+          "(log-bucket streaming histogram, runtime/slo.LogHistogram; "
+          "labels tenant + q in {p50,p95})"),
+    _spec(TENANT_TTFT_MS, "gauge",
+          "Per-tenant time-to-first-token quantile estimate, ms "
+          "(labels tenant + q)"),
+    _spec(TENANT_ITL_MS, "gauge",
+          "Per-tenant inter-token latency quantile estimate, ms "
+          "(per emit-run mean gap; labels tenant + q)"),
+    _spec(TENANT_FAIRNESS_JAIN, "gauge",
+          "Jain fairness index over the active tenants' weight-"
+          "normalized dominant-resource shares (slot-ticks vs emitted "
+          "tokens) in the trailing occupancy window — 1.0 is perfectly "
+          "fair, 1/n is one tenant hogging everything"),
+    _spec(TENANT_SHARE_MAX, "gauge",
+          "Largest weight-normalized dominant-resource share held by "
+          "any tenant over the trailing occupancy window"),
+    _spec(TENANT_SHARE_MIN, "gauge",
+          "Smallest weight-normalized dominant-resource share held by "
+          "any active tenant over the trailing occupancy window"),
+    _spec(TENANT_ACTIVE, "gauge",
+          "Tenants with accounted activity in the trailing occupancy "
+          "window (bounded by the registry's LRU cap)"),
     _spec(HTTP_REQUESTS, "counter",
           "HTTP requests by route and status code"),
     _spec(REQUESTS_IN_FLIGHT, "gauge", "Completions currently executing"),
@@ -841,6 +919,10 @@ class SpanTracer:
         # X-Dllama-Request-Id binding the API layer registers so every
         # span for that request carries the fleet-wide join key
         self._fleet: dict[int, tuple[str, int]] = {}
+        # engine-local int rid -> sanitized tenant id (X-Dllama-Tenant):
+        # same registration point, same bound, so spans and --trace-out
+        # JSONL attribute every phase to the tenant it served
+        self._tenant: dict[int, str] = {}
 
     def bind_fleet(self, request_id: int, fleet_id: str,
                    hop: int = 0) -> None:
@@ -855,6 +937,17 @@ class SpanTracer:
             while len(self._fleet) > self.RING_SPANS * 8:
                 # dicts iterate in insertion order: drop the oldest binding
                 self._fleet.pop(next(iter(self._fleet)))
+
+    def bind_tenant(self, request_id: int, tenant: str) -> None:
+        """Bind an engine-local integer request id to its sanitized
+        tenant id (the api layer's ``X-Dllama-Tenant`` parse). Spans
+        emitted for that id then carry a ``tenant`` field — the ring,
+        ``--trace-out`` JSONL, and ``/debug/flight`` ``spans`` alike —
+        so cross-tier timelines stay attributable per caller."""
+        with self._lock:
+            self._tenant[int(request_id)] = str(tenant)
+            while len(self._tenant) > self.RING_SPANS * 8:
+                self._tenant.pop(next(iter(self._tenant)))
 
     def configure(self, path: str | None) -> None:
         with self._lock:
@@ -874,6 +967,9 @@ class SpanTracer:
             bound = self._fleet.get(request_id)
             if bound is not None:
                 rec["fleet"], rec["hop"] = bound
+            ten = self._tenant.get(request_id)
+            if ten is not None:
+                rec["tenant"] = ten
             self._ring.append(rec)
             if self._f is not None:
                 self._f.write(json.dumps(rec) + "\n")
@@ -1007,6 +1103,14 @@ def stats_line(reg: Registry | None = None, *,
                         for k in slo_keys)
         parts.append(f"slo={marks} burn={worst:.2f}"
                      + ("!" if worst > 1.0 else ""))
+    # tenant observatory (runtime/tenancy): active-tenant count + the
+    # windowed Jain fairness index — the fragment appears only once the
+    # fairness window saw occupancy, so a server that never ran tenant
+    # accounting keeps its old stats line verbatim
+    n_tenants = reg.gauge(TENANT_ACTIVE).value()
+    if n_tenants:
+        parts.append(f"tenants={int(n_tenants)} "
+                     f"fair={reg.gauge(TENANT_FAIRNESS_JAIN).value():.2f}")
     # TTFT attribution p50s (runtime/flightrec): where first-token time
     # actually went — queue / admission / prefill / first decode
     attrib = reg.histogram(TTFT_ATTRIB_MS)

@@ -37,7 +37,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer, ThreadingHTTPServer
 
 from ..runtime import (evalharness, failpoints, flightrec, introspection,
-                       numerics, profiling, roofline, telemetry)
+                       numerics, profiling, roofline, telemetry, tenancy)
 from ..runtime.engine import InferenceEngine
 from ..runtime.serving import (HbmAdmissionError, QueueFullError,
                                RequestTimeoutError,
@@ -54,7 +54,7 @@ _ROUTES = ("/v1/chat/completions", "/v1/kv/export", "/v1/models", "/metrics",
            "/health", "/healthz", "/readyz", "/debug",
            "/debug/compiles", "/debug/requests", "/debug/profile",
            "/debug/numerics", "/debug/flight", "/debug/timeline",
-           "/debug/roofline", "/debug/eval")
+           "/debug/roofline", "/debug/eval", "/debug/tenants")
 
 # the GET /debug index: one line per diagnostic endpoint. Closed-world with
 # _ROUTES (tools/check_route_labels.py: every /debug/* route has exactly one
@@ -81,6 +81,10 @@ _DEBUG_INDEX = {
                    "teacher-forced eval run's summary (per-sequence NLL, "
                    "perplexity, bit-exact total-NLL hex; partial + "
                    "completed/in-flight ids after an aborted run)",
+    "/debug/tenants": "GET: tenant observatory — per-tenant cumulative "
+                      "usage (tokens, sheds, latency quantiles, KV "
+                      "block-seconds), configured limits, and the "
+                      "windowed fairness view (Jain index, shares)",
 }
 
 # POST /debug/profile capture-window bounds (ms): long enough to catch a few
@@ -160,6 +164,22 @@ def kv_peer(headers) -> str | None:
     if not peer or not KV_PEER_RE.match(peer):
         return None
     return peer
+
+
+# tenant identity (runtime/tenancy): who this request's tokens, latency,
+# KV residency, and shed decisions are attributed to. Same charset
+# contract as the fleet request id above; absent or malformed degrades
+# to "anon" — attribution, never authentication. Echoed (sanitized) on
+# every completion response, and forwarded by the fleet router across
+# retries, stream resumes, and KV-donor warm requests so failover
+# traffic keeps its owner.
+TENANT_HEADER = "X-Dllama-Tenant"
+
+
+def tenant_identity(headers) -> str:
+    """The sanitized tenant label off a request's headers (the one
+    parse both the api server and the fleet router use)."""
+    return tenancy.sanitize_tenant(headers.get(TENANT_HEADER))
 
 
 def fleet_identity(headers) -> tuple[str, int] | None:
@@ -366,7 +386,8 @@ class ApiState:
         return True, "ok", "ok"
 
     def complete(self, body: dict, emit=None, fleet=None,
-                 kv_peer: str | None = None) -> dict:
+                 kv_peer: str | None = None,
+                 tenant: str = tenancy.ANON) -> dict:
         """Run one chat completion; ``emit(text)`` streams deltas when set.
         ``kv_peer`` is accepted for interface parity with the batched
         state and ignored — the single-sequence engine has no paged pool
@@ -374,6 +395,9 @@ class ApiState:
         ``fleet`` is the optional ``(fleet_request_id, hop)`` trace
         identity from :func:`fleet_identity` — bound to this request's
         engine-local rid so spans and lifecycle events join fleet-wide.
+        ``tenant`` (:func:`tenant_identity`) binds the same rid to its
+        caller so single-sequence spans stay attributable too; the full
+        accounting registry is batched-scheduler work.
 
         Flow mirrors ApiServer::complete (dllama-api.cpp:363-484): resolve the
         delta prompt against the cache, template + encode, chunked prefill,
@@ -407,6 +431,8 @@ class ApiState:
             telemetry.tracer().bind_fleet(self._rid, fleet[0], fleet[1])
             flightrec.recorder().note("fleet_rid", rid=self._rid,
                                       reason=fleet[0], hop=fleet[1])
+        telemetry.tracer().bind_tenant(
+            self._rid, tenancy.registry().resolve(tenant))
         t_req0 = telemetry.now_ns()  # TTFT attribution origin (queue = 0:
         # the single-threaded server has no scheduler queue)
         rt = telemetry.RequestTimer()
@@ -655,7 +681,8 @@ class BatchedApiState:
         self.sched.close(drain_s)
 
     def complete(self, body: dict, emit=None, fleet=None,
-                 kv_peer: str | None = None) -> dict:
+                 kv_peer: str | None = None,
+                 tenant: str = tenancy.ANON) -> dict:
         tok = self.engine.tokenizer
         _validate_body(body)
         messages = body["messages"]
@@ -703,7 +730,7 @@ class BatchedApiState:
             stop_on_eos=True,
             timeout_s=timeout_s if timeout_s > 0 else None,
             on_token=lambda t, p: q.put((t, p)),
-            kv_peer=kv_peer, resume_from=resume_from)
+            kv_peer=kv_peer, resume_from=resume_from, tenant=tenant)
         if fleet is not None:
             # bound AFTER submit (the scheduler assigns the rid there);
             # the submit span predates the binding, but every later
@@ -876,6 +903,11 @@ def make_handler(state: ApiState):
         # so callers — and the router's own client — can correlate);
         # reset per request: keep-alive reuses the handler instance
         _fleet_rid: str | None = None
+        # the current POST's sanitized tenant label, echoed back so the
+        # caller sees what it was attributed as (a malformed header
+        # echoes "anon" — silent misattribution is the failure mode
+        # this surfaces); reset per request like the fleet id
+        _tenant: str | None = None
 
         def _route(self) -> str:
             # route matching and the counter label both ignore the query
@@ -901,6 +933,8 @@ def make_handler(state: ApiState):
             self.send_header("Content-Length", str(len(body)))
             if self._fleet_rid:
                 self.send_header(FLEET_RID_HEADER, self._fleet_rid)
+            if self._tenant is not None:
+                self.send_header(TENANT_HEADER, self._tenant)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -914,6 +948,7 @@ def make_handler(state: ApiState):
 
         def do_GET(self):
             self._fleet_rid = None  # keep-alive: no stale POST echo
+            self._tenant = None
             path = self._route()
             if path == "/v1/models":
                 self._json(200, {"object": "list", "data": [{
@@ -1016,6 +1051,11 @@ def make_handler(state: ApiState):
                            else {"run": None,
                                  "note": "no eval run in this process "
                                          "(python -m dllama_tpu eval)"})
+            elif path == "/debug/tenants":
+                # the tenant observatory: cumulative per-tenant usage,
+                # configured limits, and the windowed fairness view
+                # (runtime/tenancy — pure host reads)
+                self._json(200, tenancy.registry().snapshot())
             else:
                 self._not_found()
 
@@ -1142,6 +1182,8 @@ def make_handler(state: ApiState):
                 return
             fleet = fleet_identity(self.headers)
             self._fleet_rid = fleet[0] if fleet else None
+            tenant = tenant_identity(self.headers)
+            self._tenant = tenant
             peer = kv_peer(self.headers)
             stream = bool(body.get("stream", False))
             inflight = telemetry.registry().gauge(telemetry.REQUESTS_IN_FLIGHT)
@@ -1167,6 +1209,8 @@ def make_handler(state: ApiState):
                 self.send_header("Connection", "close")
                 if self._fleet_rid:
                     self.send_header(FLEET_RID_HEADER, self._fleet_rid)
+                if self._tenant is not None:
+                    self.send_header(TENANT_HEADER, self._tenant)
                 self.end_headers()
                 headers_sent = True
 
@@ -1202,7 +1246,7 @@ def make_handler(state: ApiState):
             try:
                 if stream:
                     out = state.complete(body, emit=emit, fleet=fleet,
-                                         kv_peer=peer)
+                                         kv_peer=peer, tenant=tenant)
                     start_stream()  # zero-delta completion: headers now
                     final = _chunk_json(state, {}, out["finish_reason"])
                     self.wfile.write(
@@ -1210,7 +1254,8 @@ def make_handler(state: ApiState):
                     self.wfile.write(b"data: [DONE]\n\n")
                     status = 200
                 else:
-                    out = state.complete(body, fleet=fleet, kv_peer=peer)
+                    out = state.complete(body, fleet=fleet, kv_peer=peer,
+                                         tenant=tenant)
                     self._json(200, _completion_json(state, out))
                     status = 200
             except QueueFullError as e:
@@ -1292,6 +1337,22 @@ def run_api_server(args) -> int:
     if getattr(args, "trace_out", None):
         telemetry.tracer().configure(args.trace_out)
         print(f"🔬 request trace (JSONL spans) → {args.trace_out}")
+    # tenant observatory (runtime/tenancy): fair-share limits + the
+    # usage ledger configure the process-global registry BEFORE the
+    # scheduler builds, so its FairQueue weights apply from request one
+    if getattr(args, "tenant_limits", None):
+        try:
+            limits = tenancy.load_limits(args.tenant_limits)
+        except ValueError as e:
+            raise SystemExit(f"--tenant-limits: {e}")
+        tenancy.registry().set_limits(limits)
+        print(f"🕸️ tenant limits: {len(limits)} "
+              f"entr{'y' if len(limits) == 1 else 'ies'} "
+              f"(weighted round-robin admission; over-budget → 429)")
+    if getattr(args, "usage_ledger", None):
+        tenancy.ledger().configure(args.usage_ledger)
+        print(f"📒 usage ledger (per-tenant cumulative JSONL) → "
+              f"{args.usage_ledger}")
     if failpoints.configure_from_env():
         print("💣 fault injection armed from DLLAMA_FAILPOINTS="
               f"{os.environ['DLLAMA_FAILPOINTS']}")

@@ -312,6 +312,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "shed with HTTP 429 + Retry-After instead of "
                         "building unbounded latency (0 = unbounded). "
                         "/readyz reports unready while the queue is full")
+    p.add_argument("--tenant-limits", default=None, metavar="SPEC",
+                   help="api mode, batched serving: per-tenant fair-share "
+                        "limits — a JSON object (inline or a file path) "
+                        "mapping tenant ids (or '*' for the default) to "
+                        "{weight, max_slots, tokens_per_s}. Admission "
+                        "drains per-tenant FIFOs by weighted round-robin; "
+                        "a tenant at max_slots is skipped (others keep "
+                        "admitting), one over its token rate is shed with "
+                        "its own HTTP 429 (runtime/tenancy.py; identity "
+                        "from the X-Dllama-Tenant header, absent → anon)")
+    p.add_argument("--usage-ledger", default=None, metavar="FILE",
+                   help="api mode: append periodic per-tenant usage "
+                        "snapshots (monotonic cumulative totals — tokens, "
+                        "sheds, KV block-seconds) to FILE as JSONL, the "
+                        "billing/capacity artifact; diff any two lines for "
+                        "an interval's usage (GET /debug/tenants serves "
+                        "the live view)")
     p.add_argument("--request-timeout", type=float, default=0.0,
                    metavar="SEC",
                    help="api mode: default per-request deadline. Past it a "

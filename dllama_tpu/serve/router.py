@@ -101,13 +101,14 @@ from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlsplit
 
-from ..runtime import failpoints, flightrec, slo, telemetry
+from ..runtime import failpoints, flightrec, slo, telemetry, tenancy
 
 # known routes for the HTTP request counter's route label (the router's
 # twin of serve/api.py _ROUTES; anything else folds into "other")
 _ROUTES = ("/v1/chat/completions", "/v1/models", "/metrics",
            "/health", "/healthz", "/readyz", "/debug/fleet",
-           "/debug/fleet/timeline", "/debug/slo")
+           "/debug/fleet/timeline", "/debug/fleet/tenants",
+           "/debug/slo")
 
 # fleet trace identity headers — canonical parse side in serve/api.py
 # (FLEET_RID_HEADER / FLEET_HOP_HEADER / FLEET_RID_RE there); spelled
@@ -126,6 +127,13 @@ KV_PEER_HEADER = "X-Dllama-KV-Peer"
 # token history (body "resume_from"/"resume_tokens") and emits nothing
 # at or below that index. Re-spelled from serve/api.py, same reason.
 RESUME_FROM_HEADER = "X-Dllama-Resume-From"
+# Tenant identity: sanitized at the edge (runtime/tenancy — absent or
+# malformed collapses to "anon"), echoed on every router-authored
+# answer, and forwarded on EVERY upstream dispatch — first hops, retry
+# hops, spliced stream continuations, and prefill warm-ups alike — so a
+# replica never misattributes router-originated work to "anon".
+# Re-spelled from serve/api.py, same engine-free-import reason.
+TENANT_HEADER = "X-Dllama-Tenant"
 # Closed outcome vocabulary of dllama_router_stream_resumes_total (the
 # failure-taxonomy dlint rule holds it to telemetry's label docs and
 # PERF.md): resumed — continuation spliced, the client's transcript
@@ -867,6 +875,7 @@ def make_router_handler(fleet: FleetRouter):
         # per-request trace state (reset at the top of each do_GET/do_POST
         # — keep-alive reuses the handler instance across requests)
         _fleet_rid: str | None = None
+        _tenant: str | None = None
         _t_first_ns: int | None = None
 
         def log_message(self, fmt, *args):
@@ -889,6 +898,8 @@ def make_router_handler(fleet: FleetRouter):
                 # every router-authored answer names the request: the
                 # client learns the minted id even on shed/error paths
                 self.send_header(FLEET_RID_HEADER, self._fleet_rid)
+            if self._tenant is not None:
+                self.send_header(TENANT_HEADER, self._tenant)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -952,6 +963,8 @@ def make_router_handler(fleet: FleetRouter):
                 # the fleet trace id rides every relayed response, so a
                 # client can join its request into /debug/fleet/timeline
                 self.send_header(FLEET_RID_HEADER, self._fleet_rid)
+            if self._tenant is not None:
+                self.send_header(TENANT_HEADER, self._tenant)
             if force_close:
                 self.send_header("Connection", "close")
                 self.close_connection = True
@@ -972,7 +985,7 @@ def make_router_handler(fleet: FleetRouter):
             fleet.spans.emit_span(rid, "rt_first_byte", t0_ns, now,
                                   replica=rep.name, hop=hop)
             if fleet.slo is not None:
-                fleet.slo.observe_ttft(ms)
+                fleet.slo.observe_ttft(ms, tenant=self._tenant)
 
         def _end_stream(self, rid: str, rep: Replica, hop: int,
                         status) -> None:
@@ -1052,7 +1065,8 @@ def make_router_handler(fleet: FleetRouter):
                     elif fleet.slo is not None:
                         # router-measured ITL: inter-chunk relay gaps
                         # (one SSE event per chunk in practice)
-                        fleet.slo.observe_itl((now - t_prev) / 1e6)
+                        fleet.slo.observe_itl((now - t_prev) / 1e6,
+                                              tenant=self._tenant)
                     t_prev = now
                     if not is_sse:
                         self.wfile.write(chunk)
@@ -1183,7 +1197,12 @@ def make_router_handler(fleet: FleetRouter):
                     rbody["timeout"] = round(remaining_s, 3)
                 extra = {FLEET_RID_HEADER: rid,
                          FLEET_HOP_HEADER: str(hop),
-                         RESUME_FROM_HEADER: str(st.n_tokens)}
+                         RESUME_FROM_HEADER: str(st.n_tokens),
+                         # router-authored re-dispatch: without this the
+                         # continuation lands on the new replica as
+                         # "anon" and the tenant's usage splits across
+                         # identities mid-stream
+                         TENANT_HEADER: self._tenant or tenancy.ANON}
                 # prefer pulling the prefix (prompt + history) over the
                 # KV wire: any advertising peer serves — including the
                 # dying donor while it still answers, or a prefill-role
@@ -1299,8 +1318,51 @@ def make_router_handler(fleet: FleetRouter):
             self._json(200, flightrec.fleet_chrome_trace(
                 fleet.fleet_snapshot(), dumps))
 
+        def _fleet_tenants(self) -> None:
+            """``GET /debug/fleet/tenants`` — pull every replica's live
+            ``/debug/tenants`` and join them into one fleet-wide usage
+            view: per-replica registries verbatim, per-tenant totals
+            summed across replicas, and a fleet Jain's index over the
+            summed decode tokens. A replica that cannot answer
+            contributes nothing (``replicas_joined`` says how many did);
+            the router's own registry rides along so router-tier sheds
+            (``router_queue_full``) are visible in the same body."""
+            replicas: dict[str, dict] = {}
+            for rep in fleet.replicas:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=rep.connect_timeout_s)
+                try:
+                    conn.request("GET", "/debug/tenants")
+                    resp = conn.getresponse()
+                    if resp.status == 200:
+                        replicas[rep.name] = json.loads(resp.read())
+                except (OSError, ValueError, http.client.HTTPException):
+                    continue  # dead replica: absent entry, not a 5xx
+                finally:
+                    conn.close()
+            totals: dict[str, dict] = {}
+            for snap in replicas.values():
+                for t, st in (snap.get("tenants") or {}).items():
+                    agg = totals.setdefault(t, {})
+                    for k, v in st.items():
+                        if isinstance(v, (int, float)):
+                            agg[k] = agg.get(k, 0) + v
+                        elif isinstance(v, dict) and k == "sheds":
+                            sh = agg.setdefault("sheds", {})
+                            for r, n in v.items():
+                                sh[r] = sh.get(r, 0) + n
+            self._json(200, {
+                "replicas_joined": len(replicas),
+                "replicas": replicas,
+                "tenants": totals,
+                "fleet_jain_index": tenancy.jain_index(
+                    st.get("decode_tokens", 0)
+                    for st in totals.values()),
+                "router": tenancy.registry().snapshot()})
+
         def do_GET(self):
             self._fleet_rid = None  # keep-alive: no stale POST echo
+            self._tenant = None
             path = self.path.split("?", 1)[0]
             if path in ("/health", "/healthz"):
                 self._json(200, {"status": "ok"})
@@ -1328,6 +1390,8 @@ def make_router_handler(fleet: FleetRouter):
                 self._json(200, fleet.fleet_snapshot())
             elif path == "/debug/fleet/timeline":
                 self._fleet_timeline()
+            elif path == "/debug/fleet/tenants":
+                self._fleet_tenants()
             elif path == "/debug/slo":
                 if fleet.slo is None:
                     self._json(404, {"error": "no SLO objectives "
@@ -1375,20 +1439,30 @@ def make_router_handler(fleet: FleetRouter):
             # fleet trace identity: honor a sanitary client id, else mint
             rid = fleet.mint_rid(self.headers.get(FLEET_RID_HEADER))
             self._fleet_rid = rid
+            # tenant identity: sanitized + cardinality-bounded here (the
+            # router's own registry attributes router-tier decisions);
+            # the canonical label rides every upstream hop and answer
+            tenant = tenancy.registry().resolve(
+                self.headers.get(TENANT_HEADER))
+            self._tenant = tenant
             if not fleet.admit():
                 if fleet.is_draining():
                     fleet.spans.emit_span(rid, "rt_queue", t_recv,
                                           telemetry.now_ns(),
-                                          outcome="draining")
+                                          outcome="draining",
+                                          tenant=tenant)
                     self._json(503, {"error": "router is draining",
                                      "code": "draining"},
                                headers=backpressure_headers(503))
                     return
                 fleet.c_shed.inc()
+                tenancy.registry().note_shed(tenant, "router_queue_full")
                 if fleet.slo is not None:
-                    fleet.slo.observe_outcome(shed=True)
+                    fleet.slo.observe_outcome(shed=True, tenant=tenant)
                 fleet.spans.emit_span(rid, "rt_queue", t_recv,
-                                      telemetry.now_ns(), outcome="shed")
+                                      telemetry.now_ns(), outcome="shed",
+                                      tenant=tenant,
+                                      reason="router_queue_full")
                 self._json(429, {"error": f"router at --max-queue "
                                           f"({fleet.max_inflight} in "
                                           f"flight); retry later",
@@ -1399,14 +1473,15 @@ def make_router_handler(fleet: FleetRouter):
             # phase (near-zero here — admission is one lock — but the
             # span anchors the request's flow at the router tier)
             fleet.spans.emit_span(rid, "rt_queue", t_recv,
-                                  telemetry.now_ns(), outcome="admitted")
+                                  telemetry.now_ns(), outcome="admitted",
+                                  tenant=tenant)
             shed = False
             try:
                 shed = self._dispatch_completion(raw, body, rid, t_recv)
             finally:
                 fleet.release()
             if fleet.slo is not None:
-                fleet.slo.observe_outcome(shed=shed)
+                fleet.slo.observe_outcome(shed=shed, tenant=tenant)
 
         def _note_eject(self, rid: str, rep: Replica, hop: int) -> None:
             """Instant ``rt_eject`` marker when a dispatch failure trips
@@ -1438,7 +1513,11 @@ def make_router_handler(fleet: FleetRouter):
                     rep, "POST", "/v1/chat/completions",
                     json.dumps(warm).encode("utf-8"),
                     extra_headers={FLEET_RID_HEADER: rid,
-                                   FLEET_HOP_HEADER: "0"})
+                                   FLEET_HOP_HEADER: "0",
+                                   # warm-up work bills to its caller,
+                                   # not to "anon" on the prefill pod
+                                   TENANT_HEADER: self._tenant
+                                   or tenancy.ANON})
                 try:
                     resp.read()
                 finally:
@@ -1484,7 +1563,8 @@ def make_router_handler(fleet: FleetRouter):
                                + snap["engine_inflight"]
                                + snap["router_inflight"], 3))
                 extra = {FLEET_RID_HEADER: rid,
-                         FLEET_HOP_HEADER: str(attempt)}
+                         FLEET_HOP_HEADER: str(attempt),
+                         TENANT_HEADER: self._tenant or tenancy.ANON}
                 if attempt == 0 and key is not None \
                         and not rep.holds_prefix(key):
                     # fleet-global prefix reuse: a peer advertising this
@@ -1601,6 +1681,14 @@ def make_router_handler(fleet: FleetRouter):
             reason, code = fleet.unready_reason()
             if code == "queue_full":
                 fleet.c_shed.inc()
+                # fleet-saturated shed is attributable too: same
+                # router-tier reason as the --max-queue bound
+                tenant = self._tenant or tenancy.ANON
+                tenancy.registry().note_shed(tenant, "router_queue_full")
+                fleet.spans.emit_span(rid, "rt_queue", t0_ns,
+                                      telemetry.now_ns(), outcome="shed",
+                                      tenant=tenant,
+                                      reason="router_queue_full")
                 self._json(429, {"error": reason, "code": code},
                            headers=backpressure_headers(429))
                 return True

@@ -431,3 +431,36 @@ def test_kv_prefix_advertisement_ttl_and_lru_bound():
     assert len(lst) == BatchedApiState.KV_PREFIX_MAX
     assert lst[0] == f"sid:{BatchedApiState.KV_PREFIX_MAX + 4}"
     assert "sid:0" not in lst
+
+
+def test_tenant_echo_and_debug_tenants(batched_server):
+    """ISSUE-20: the api server echoes the sanitized X-Dllama-Tenant on
+    the response, bills the request's tokens to that tenant, and serves
+    the observatory at GET /debug/tenants; a malformed id is anon."""
+    from dllama_tpu.runtime import tenancy
+
+    url, _ = batched_server
+    body = {"messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 4, "temperature": 0}
+    req = urllib.request.Request(
+        url + "/v1/chat/completions", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Dllama-Tenant": "acme-api"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers["X-Dllama-Tenant"] == "acme-api"
+        data = json.loads(r.read())
+    n = data["usage"]["completion_tokens"]
+    with urllib.request.urlopen(url + "/debug/tenants", timeout=30) as r:
+        snap = json.loads(r.read())
+    assert snap["cap"] == tenancy.TENANT_CAP
+    st = snap["tenants"]["acme-api"]
+    assert st["decode_tokens"] >= n
+    assert st["admissions"] >= 1
+    assert "jain_index" in snap["fairness"]
+    # malformed identity collapses to anon on the echo
+    req = urllib.request.Request(
+        url + "/v1/chat/completions", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Dllama-Tenant": "bad id!{}"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers["X-Dllama-Tenant"] == "anon"

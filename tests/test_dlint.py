@@ -41,7 +41,8 @@ def test_all_rules_registered():
     assert {"jit-entry", "shard-map-shim", "tracer-hazard", "guarded-twin",
             "thread-ownership", "lock-guard", "lock-order",
             "metrics-names", "exception-hygiene", "route-labels",
-            "failpoint-sites", "span-phases", "pallas-gate"} <= names
+            "failpoint-sites", "span-phases", "pallas-gate",
+            "tenant-reasons"} <= names
 
 
 def test_live_repo_scans_clean():
@@ -547,6 +548,70 @@ def test_shard_map_wrapper_cli_still_works():
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stderr
     assert "shard-map-shim" in out.stdout
+
+
+# -- tenant-reasons ------------------------------------------------------------
+
+def test_tenant_reasons_fixture(tmp_path):
+    """Both closed-world directions on a seeded fixture: an emit site
+    naming an undeclared reason fires, and a declared reason with no
+    emit site fires (injectable vocabulary, no repo import)."""
+    from tools.dlint import tenant_names
+
+    proj = _tree(tmp_path, {
+        "dllama_tpu/runtime/tenancy.py": '''
+            # * ``queue_full`` — the shared bound shed the submit.
+            # * ``ghost_reason`` — declared but never emitted.
+            ADMIT_REASONS = ("queue_full", "ghost_reason")
+        ''',
+        "dllama_tpu/runtime/serving.py": '''
+            class S:
+                def submit(self, tenant):
+                    self._tenancy.note_shed(tenant, "queue_full")
+                    self.flight.note("shed", reason="queue_full",
+                                     tenant=tenant)
+                    self.flight.note("defer", rid,
+                                     reason="mystery_reason",
+                                     tenant=tenant)
+                    # lifecycle reasons are out of scope for the rule
+                    self.flight.note("timeout", rid, reason="queued",
+                                     tenant=tenant)
+        ''',
+        "dllama_tpu/serve/router.py": "",
+        "PERF.md": "`dllama_tenant_shed_total{tenant,reason}` — sheds.\n"
+                   "Reasons: queue_full, ghost_reason.\n",
+    })
+    specs = {"dllama_tenant_shed_total": SimpleNamespace(
+        kind="counter", help="sheds")}
+    findings, _ = tenant_names.check(
+        proj, vocab=(("queue_full", "ghost_reason"), specs))
+    msgs = [f.message for f in findings]
+    assert any("mystery_reason" in m and "not in tenancy.ADMIT_REASONS"
+               in m for m in msgs), msgs
+    assert any("ghost_reason" in m and "no emit site" in m
+               for m in msgs), msgs
+    # nothing else fires: the in-scope emit sites are vocabulary-clean,
+    # the docs cover the metric family and both declared reasons
+    assert len(findings) == 2, msgs
+    assert all(f.rule == "tenant-reasons" for f in findings)
+    # the finding anchors the offending emit line
+    bad = next(f for f in findings if "mystery_reason" in f.message)
+    src = (tmp_path / "dllama_tpu/runtime/serving.py").read_text()
+    assert 'reason="mystery_reason"' in "".join(
+        src.splitlines()[bad.lineno - 1:bad.lineno + 1])
+
+
+def test_tenant_reasons_live_repo_clean():
+    res = _run("tenant-reasons", Project(REPO))
+    assert not res.findings, [str(f) for f in res.findings]
+
+
+def test_tenant_wrapper_cli_still_works():
+    out = subprocess.run(
+        [sys.executable, "tools/check_tenant_names.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "tenant-reasons" in out.stdout
 
 
 # -- cycle-robustness regressions (review findings) ---------------------------

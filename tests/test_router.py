@@ -83,6 +83,9 @@ class StubReplica:
             # shutdown; the scheduler fails the slot, the socket FINs
             # cleanly)
             "error_after_chunks": None,
+            # a canned /debug/tenants snapshot (None -> 404), so the
+            # router's fleet-wide tenant join can be driven end to end
+            "tenants_snapshot": None,
         }
         self.n_completions = 0
         # resume capture: one dict per STREAM completion attempt with the
@@ -91,6 +94,9 @@ class StubReplica:
         # KV migration capture: the X-Dllama-KV-Peer value (or None)
         # seen on each completion attempt, in arrival order
         self.seen_kv_peers: list = []
+        # tenant capture: the X-Dllama-Tenant value (or None) seen on
+        # each completion attempt, in arrival order
+        self.seen_tenants: list = []
         # fleet-trace capture: (fleet_rid, hop) per completion attempt,
         # plus a flight-shaped dump served at /debug/flight so the
         # router's fleet-timeline join can be driven end to end
@@ -181,6 +187,11 @@ class StubReplica:
                 elif self.path == "/v1/models":
                     self._json(200, {"object": "list", "data": [
                         {"id": f"stub-{stub.name}", "object": "model"}]})
+                elif self.path == "/debug/tenants":
+                    if b["tenants_snapshot"] is None:
+                        self._json(404, {"error": "not found"})
+                    else:
+                        self._json(200, b["tenants_snapshot"])
                 elif self.path == "/debug/flight":
                     self._json(200, {
                         "tick_seq": 0, "ticks": [], "dumps": [],
@@ -201,6 +212,8 @@ class StubReplica:
                 fhop = self.headers.get("X-Dllama-Hop")
                 stub.seen_kv_peers.append(
                     self.headers.get("X-Dllama-KV-Peer"))
+                stub.seen_tenants.append(
+                    self.headers.get("X-Dllama-Tenant"))
                 t0_ns = time.monotonic_ns()
                 local = stub.note_fleet(frid, fhop)
                 if b["nonstream_delay_s"]:
@@ -1589,3 +1602,207 @@ class _FakeStub:
     @property
     def url(self):
         return f"http://127.0.0.1:{self.port}"
+
+
+# -- tenant observatory -------------------------------------------------------
+
+
+def test_tenant_header_echoed_forwarded_and_sanitized():
+    """The tenant-identity contract at the router tier: a sanitary
+    X-Dllama-Tenant is forwarded to the replica and echoed on the
+    response; a malformed one collapses to "anon"; no header is "anon"
+    too — the router never invents or trusts unsanitary identity."""
+    from dllama_tpu.runtime import tenancy
+
+    tenancy.reset()
+    a = StubReplica("a")
+    a.start()
+    url, fleet, close = make_router([a])
+    try:
+        _wait(lambda: fleet.readiness()[0], what="replica up")
+        with _post_raw(url, _body("bill me"),
+                       headers={"X-Dllama-Tenant": "acme"}) as r:
+            assert r.headers["X-Dllama-Tenant"] == "acme"
+        assert a.seen_tenants[-1] == "acme"
+        # malformed id: never forwarded verbatim — collapses to anon
+        with _post_raw(url, _body("spoof me"),
+                       headers={"X-Dllama-Tenant": "no spaces!{}"}) as r:
+            assert r.headers["X-Dllama-Tenant"] == "anon"
+        assert a.seen_tenants[-1] == "anon"
+        # absent header: anon, still forwarded so the replica bills it
+        with _post(url, _body("nameless")) as r:
+            assert r.headers["X-Dllama-Tenant"] == "anon"
+        assert a.seen_tenants[-1] == "anon"
+        # the router's own registry saw both identities
+        snap = tenancy.registry().snapshot()
+        assert {"acme", "anon"} <= set(snap["tenants"])
+    finally:
+        close()
+        a.kill()
+        tenancy.reset()
+
+
+def test_router_shed_names_tenant_and_reason():
+    """A router-tier shed is attributable: the 429 carries the tenant
+    echo, dllama_tenant_shed_total counts it under the closed-world
+    reason router_queue_full, and the rt_queue span names both."""
+    from dllama_tpu.runtime import tenancy
+
+    tenancy.reset()
+    a, b = StubReplica("a"), StubReplica("b")
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        for s in (a, b):
+            s.behavior.update(ready=False, ready_code="queue_full")
+        _wait(lambda: not fleet.readiness()[0], what="fleet saturated")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_raw(url, _body("shed me"),
+                      headers={"X-Dllama-Tenant": "flooder"})
+        assert e.value.code == 429
+        assert e.value.headers["X-Dllama-Tenant"] == "flooder"
+        snap = tenancy.registry().snapshot()
+        assert snap["tenants"]["flooder"]["sheds"] \
+            == {"router_queue_full": 1}
+        shed = tm.registry().counter("dllama_tenant_shed_total")
+        assert shed.total(tenant="flooder",
+                          reason="router_queue_full") == 1
+        spans = [s for s in fleet.fleet_snapshot()["spans"]
+                 if s["phase"] == "rt_queue"
+                 and s.get("reason") == "router_queue_full"]
+        assert spans and spans[-1]["tenant"] == "flooder"
+    finally:
+        close()
+        a.kill(), b.kill()
+        tenancy.reset()
+
+
+def test_stream_resume_carries_originating_tenant():
+    """ISSUE-20 satellite: a mid-stream failover continuation must NOT
+    land on the resume replica as "anon" — the re-dispatch carries the
+    originating tenant so the continuation bills to the caller."""
+    from dllama_tpu.runtime import tenancy
+
+    tenancy.reset()
+    a, b = StubReplica("a"), StubReplica("b")
+    for s in (a, b):
+        s.behavior["stamp"] = True
+        s.behavior["stream_chunks"] = ["t1 ", "t2 ", "t3 ", "t4 ", "t5"]
+    a.behavior["die_after_chunks"] = 2
+    b.behavior["queue_depth"] = 50  # first dispatch lands on a
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas)
+              and fleet.replicas[1].load_score() >= 50,
+              what="probes: up + load")
+        req = urllib.request.Request(
+            url + "/v1/chat/completions",
+            data=json.dumps(_body("durable", stream=True,
+                                  timeout=30)).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Dllama-Tenant": "acme"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers["X-Dllama-Tenant"] == "acme"
+            raw = r.read()
+        events = _sse_events(raw)
+        assert _stamp_indices(events) == [0, 1, 2, 3, 4, 5]
+        # the splice happened, and BOTH hops saw the tenant: the
+        # original dispatch on a, the resume re-dispatch on b
+        assert {e["replica"] for e in events if isinstance(e, dict)} \
+            == {"a", "b"}
+        assert a.seen_tenants[-1] == "acme"
+        assert b.seen_resumes[-1]["body"]["resume_from"] == 2
+        assert b.seen_tenants[-1] == "acme"
+    finally:
+        close()
+        a.kill(), b.kill()
+        tenancy.reset()
+
+
+def test_prefill_warm_carries_originating_tenant():
+    """ISSUE-20 satellite: the disaggregation warm-up request the
+    router sends to a prefill-role replica carries the caller's tenant
+    — warm-up work bills to the tenant who triggered it, not "anon"."""
+    from dllama_tpu.runtime import tenancy
+
+    tenancy.reset()
+    p, d = StubReplica("p"), StubReplica("d")
+    p.start(), d.start()
+    p.behavior["role"] = "prefill"
+    url, fleet, close = make_router([p, d])
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        rep_p = [r for r in fleet.replicas
+                 if r.name == f"127.0.0.1:{p.port}"][0]
+        _wait(lambda: rep_p.is_prefill(), what="prefill role probed")
+        with _post_raw(url, _body("disaggregate me",
+                                  session_id="disagg-sess"),
+                       headers={"X-Dllama-Tenant": "acme"}) as r:
+            assert json.loads(r.read())["replica"] == "d"
+        # the warm-up on the prefill replica carried the tenant, and so
+        # did the decode dispatch
+        assert p.seen_tenants == ["acme"]
+        assert d.seen_tenants[-1] == "acme"
+    finally:
+        close()
+        p.kill(), d.kill()
+        tenancy.reset()
+
+
+def test_fleet_tenants_join_sums_replicas():
+    """GET /debug/fleet/tenants joins per-replica usage registries:
+    numeric totals and shed maps sum per tenant, the fleet Jain index
+    covers the summed decode tokens, dead replicas contribute nothing,
+    and the router's own registry rides along."""
+    from dllama_tpu.runtime import tenancy
+
+    tenancy.reset()
+    a, b = StubReplica("a"), StubReplica("b")
+    a.behavior["tenants_snapshot"] = {
+        "cap": 64, "n_tenants": 2, "overflow_total": 0,
+        "tenants": {
+            "acme": {"decode_tokens": 300, "prefill_tokens": 40,
+                     "sheds": {"queue_full": 2}},
+            "zed": {"decode_tokens": 100, "prefill_tokens": 10,
+                    "sheds": {}}}}
+    b.behavior["tenants_snapshot"] = {
+        "cap": 64, "n_tenants": 1, "overflow_total": 0,
+        "tenants": {
+            "acme": {"decode_tokens": 100, "prefill_tokens": 5,
+                     "sheds": {"queue_full": 1,
+                               "tenant_rate_budget": 3}}}}
+    a.start(), b.start()
+    url, fleet, close = make_router([a, b])
+    try:
+        _wait(lambda: all(_up(fleet, r.name) for r in fleet.replicas),
+              what="both replicas up")
+        with urllib.request.urlopen(url + "/debug/fleet/tenants",
+                                    timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["replicas_joined"] == 2
+        acme = body["tenants"]["acme"]
+        assert acme["decode_tokens"] == 400
+        assert acme["prefill_tokens"] == 45
+        assert acme["sheds"] == {"queue_full": 3, "tenant_rate_budget": 3}
+        assert body["tenants"]["zed"]["decode_tokens"] == 100
+        # Jain over (400, 100): 500^2 / (2 * 170000) ~= 0.735
+        assert abs(body["fleet_jain_index"]
+                   - 500 ** 2 / (2 * (400 ** 2 + 100 ** 2))) < 1e-9
+        assert body["router"]["cap"] == 64
+        # a dead replica contributes nothing, join count says so
+        b.kill()
+        with urllib.request.urlopen(url + "/debug/fleet/tenants",
+                                    timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["replicas_joined"] == 1
+        assert body["tenants"]["acme"]["decode_tokens"] == 300
+    finally:
+        close()
+        a.kill()
+        if b.httpd is not None:
+            b.kill()
+        tenancy.reset()

@@ -24,7 +24,8 @@ import sys
 _RATES = ("decode_tok_per_s", "prefill_tok_per_s", "sampled_decode_tok_per_s",
           "chunked_decode_tok_per_s", "paged_decode_tok_per_s",
           "agg_tok_per_s", "accepted_tok_per_s", "decode_tok_per_s_q80",
-          "sessions_per_chip", "slo_compliance_min", "eval_tok_per_s")
+          "sessions_per_chip", "slo_compliance_min", "eval_tok_per_s",
+          "jain_index")
 # lower-is-better latencies (--scenario continuous/fleet TTFT + the
 # tiered wave's resume TTFT; --scenario multichip exposed collective
 # wall; the fleet scenario's worst SLO error-budget burn; --scenario

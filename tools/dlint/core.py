@@ -230,6 +230,7 @@ def load_rule_modules() -> None:
         route_labels,
         slo_names,
         span_phases,
+        tenant_names,
         thread_ownership,
         trace_safety,
     )

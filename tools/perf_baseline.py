@@ -49,7 +49,7 @@ RATE_FIELDS = ("decode_tok_per_s", "prefill_tok_per_s",
                "paged_decode_tok_per_s", "agg_tok_per_s",
                "accepted_tok_per_s", "decode_tok_per_s_q80",
                "sessions_per_chip", "slo_compliance_min",
-               "eval_tok_per_s")
+               "eval_tok_per_s", "jain_index")
 LATENCY_FIELDS = ("decode_ms_per_step", "verify_k4_ms",
                   "ttft_ms_p50", "ttft_ms_p95", "resume_ttft_p95_ms",
                   "comm_exposed_ms", "slo_worst_burn")
